@@ -1,0 +1,96 @@
+"""Headline benchmark: BERT-base fine-tune samples/sec/chip.
+
+Runs the real jitted training step (same code path as ``scripts/train.py``)
+on the available TPU chip(s): BERT-base, seq 512, per-chip batch 8, bf16
+compute — the reference's default workload shape (BERT-family, IMDb
+padded to 512, batch 8/worker; reference ``launch.py:13-18``,
+``scripts/train.py:81-86``) on synthetic IMDb-shaped data (zero-egress
+environment).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+comparison point is the reference's default hardware envelope — BERT-base
+fine-tuning at seq 512 / batch 8 / mixed precision on the ml.p3.2xlarge
+V100, ≈32 samples/s (public MLPerf-era V100 BERT fine-tune throughput);
+vs_baseline = our samples/sec/chip ÷ 32.
+"""
+
+from __future__ import annotations
+
+import json
+
+V100_BASELINE_SAMPLES_PER_SEC = 32.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+        BertForSequenceClassification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+    from huggingface_sagemaker_tensorflow_distributed_tpu.utils.timing import StepMeter
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+    seq_len = 512
+    per_chip_batch = 8
+    global_batch = per_chip_batch * n_chips
+
+    mesh = build_mesh(MeshConfig(dp=-1))
+    model_cfg = EncoderConfig(dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                              max_position_embeddings=512)  # BERT-base
+    model = BertForSequenceClassification(model_cfg, num_labels=2)
+    params = init_params(model, model_cfg, seed=0)
+    config = TrainConfig(dtype="bfloat16" if on_tpu else "float32",
+                         train_batch_size=per_chip_batch,
+                         max_seq_length=seq_len, log_every_steps=0)
+    trainer = Trainer(config, model, params, mesh)
+
+    tok = WordHashTokenizer()
+    n_examples = global_batch * 14
+    texts, labels = synthetic_text_classification(n_examples, seed=0,
+                                                  min_len=300, max_len=600)
+    ds = ArrayDataset.from_texts(tok, texts, labels, max_length=seq_len)
+    batcher = ShardedBatcher(ds, global_batch, mesh, shuffle=False, seed=0)
+
+    meter = StepMeter(n_chips=n_chips, skip_first=3)
+    steps = 0
+    for epoch in range(2):
+        for batch in batcher.global_arrays(epoch):
+            meter.start_step()
+            trainer.state, metrics = trainer._train_step(trainer.state, batch)
+            jax.block_until_ready(metrics["loss"])
+            meter.end_step(global_batch)
+            steps += 1
+        if steps >= 12:
+            break
+
+    value = round(meter.samples_per_sec_per_chip, 3)
+    print(json.dumps({
+        "metric": "bert_base_finetune_samples_per_sec_per_chip",
+        "value": value,
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(value / V100_BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

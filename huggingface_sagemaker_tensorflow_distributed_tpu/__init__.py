@@ -1,0 +1,24 @@
+"""TPU-native distributed fine-tuning framework.
+
+A brand-new JAX/XLA framework providing the capabilities of
+``philschmid/huggingface_sagemaker_tensorflow_distributed`` (reference at
+``/root/reference``): fine-tune transformer models (BERT / DistilBERT /
+RoBERTa / T5) on text-classification, token-classification, QA and seq2seq
+tasks with synchronous data-parallel (and beyond: FSDP / tensor / sequence
+parallel) training over a ``jax.sharding.Mesh``, a typed config layer, an
+explicit jitted train/eval engine, checkpoint/resume, HF-compatible export,
+and a TPU-slice launcher.
+
+Where the reference delegates to Horovod/SMDDP + NCCL (reference
+``scripts/train.py:13-31``) this framework uses XLA collectives over ICI/DCN
+emitted by the compiler from sharding annotations; where the reference
+delegates to Keras ``model.fit`` (``scripts/train.py:145``) this framework
+has an explicit ``jit``-compiled train step.
+"""
+
+__version__ = "0.1.0"
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import (  # noqa: F401
+    TrainConfig,
+    parse_args,
+)

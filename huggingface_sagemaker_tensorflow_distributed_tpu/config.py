@@ -1,0 +1,177 @@
+"""Typed configuration: hyperparameters, environment contract, CLI.
+
+Capability parity with the reference's three-tier config layer
+(launcher dict → platform-serialized CLI strings → argparse with env
+defaults; reference ``launch.py:13-18`` and ``scripts/train.py:36-52``),
+rebuilt as ONE typed dataclass with validated parsing. This fixes by
+construction the reference's stringly-typed bugs:
+
+- ``--learning_rate`` declared ``type=str`` (reference
+  ``scripts/train.py:43``) so ``lr * world_size`` performs string
+  repetition when the flag is passed → here it is a float.
+- ``--do_train``/``--do_eval`` declared ``type=bool`` (reference
+  ``scripts/train.py:44-45``) so ``--do_train False`` is truthy → here
+  booleans parse "true/false/1/0" properly.
+
+Environment contract: the reference consumes SageMaker's ``SM_OUTPUT_DATA_DIR``,
+``SM_MODEL_DIR``, ``SM_NUM_GPUS`` (``scripts/train.py:48-50``). We honour the
+same variables for drop-in compatibility and add TPU-native equivalents
+(``TPU_OUTPUT_DATA_DIR``, ``TPU_MODEL_DIR``) plus multi-host coordination
+variables (``TPU_COORDINATOR_ADDRESS``, ``TPU_NUM_PROCESSES``,
+``TPU_PROCESS_ID``) consumed by ``parallel.distributed``.
+
+Unknown CLI args are tolerated (``parse_known_args``), matching the
+reference's tolerance of platform-injected extras (``scripts/train.py:52``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Optional
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "y"):
+        return True
+    if s in ("false", "0", "no", "n", ""):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {v!r}")
+
+
+def _env(*names: str, default: Optional[str] = None) -> Optional[str]:
+    for name in names:
+        if name in os.environ:
+            return os.environ[name]
+    return default
+
+
+@dataclass
+class TrainConfig:
+    """All knobs for a fine-tuning job.
+
+    Field names follow the reference's hyperparameter contract
+    (``launch.py:13-18``: epochs, train_batch_size, eval_batch_size,
+    model_name_or_path) so launcher dicts are drop-in compatible.
+    """
+
+    # --- model / task ---
+    model_name_or_path: str = "bert-base-uncased"
+    task: str = "seq-cls"          # seq-cls | token-cls | qa | seq2seq
+    num_labels: int = 2
+    max_seq_length: int = 512      # reference pads to tokenizer.model_max_length=512 (train.py:81)
+    from_scratch: bool = False     # random init instead of pretrained weights
+
+    # --- data ---
+    dataset: str = "imdb"          # imdb | sst2 | conll2003 | squad | cnn_dailymail | synthetic
+    dataset_path: Optional[str] = None   # local dataset dir (offline mode)
+    max_train_samples: Optional[int] = None
+    max_eval_samples: Optional[int] = None
+
+    # --- optimization (reference defaults: train.py:39-43) ---
+    epochs: int = 3
+    train_batch_size: int = 8      # per-worker, as in the reference (launch.py:15)
+    eval_batch_size: int = 4
+    learning_rate: float = 5e-5
+    scale_lr_by_world_size: bool = True   # reference semantics: lr × hvd.size() (train.py:112)
+    warmup_ratio: float = 0.0
+    weight_decay: float = 0.0
+    max_grad_norm: float = 0.0     # 0 disables clipping (reference has none)
+    steps_per_epoch: Optional[int] = None
+    seed: int = 42
+
+    # --- precision ---
+    dtype: str = "bfloat16"        # compute dtype on TPU; tests override to float32
+    param_dtype: str = "float32"
+
+    # --- parallelism mesh (reference supports DP only; see SURVEY.md §2) ---
+    dp: int = -1                   # -1: use all remaining devices on the data axis
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1                    # sequence/context parallel (ring attention)
+
+    # --- control flags (reference train.py:44-45, typed correctly here) ---
+    do_train: bool = True
+    do_eval: bool = True
+
+    # --- checkpoint / resume (reference commented these out, train.py:136-137) ---
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_steps: int = 0      # 0: per-epoch only
+    resume: bool = True                   # resume from latest checkpoint if present
+    keep_checkpoints: int = 3
+
+    # --- output contract (reference train.py:48-50) ---
+    output_data_dir: str = field(
+        default_factory=lambda: _env("TPU_OUTPUT_DATA_DIR", "SM_OUTPUT_DATA_DIR", default="/tmp/output")
+    )
+    model_dir: str = field(
+        default_factory=lambda: _env("TPU_MODEL_DIR", "SM_MODEL_DIR", default="/tmp/model")
+    )
+
+    # --- observability ---
+    log_every_steps: int = 10
+    profile: bool = False          # capture a jax.profiler trace of a few steps
+    profile_dir: str = "/tmp/profile"
+    log_all_hosts: bool = False
+
+    def __post_init__(self):
+        if self.task not in ("seq-cls", "token-cls", "qa", "seq2seq"):
+            raise ValueError(f"unknown task {self.task!r}")
+        if self.dtype not in ("bfloat16", "float32", "float16"):
+            raise ValueError(f"unknown dtype {self.dtype!r}")
+        if self.epochs < 0 or self.train_batch_size <= 0 or self.eval_batch_size <= 0:
+            raise ValueError("epochs must be >= 0 and batch sizes positive")
+        if self.learning_rate <= 0:
+            raise ValueError("learning_rate must be positive")
+        for ax in ("fsdp", "tp", "sp"):
+            if getattr(self, ax) <= 0:
+                raise ValueError(f"mesh axis {ax} must be positive")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainConfig":
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def _add_field_arg(parser: argparse.ArgumentParser, f: dataclasses.Field) -> None:
+    name = "--" + f.name
+    if f.type in ("bool", bool):
+        parser.add_argument(name, type=_parse_bool, default=None)
+    elif f.type in ("int", int):
+        parser.add_argument(name, type=int, default=None)
+    elif f.type in ("float", float):
+        parser.add_argument(name, type=float, default=None)
+    elif f.type in ("Optional[int]",):
+        parser.add_argument(name, type=int, default=None)
+    else:
+        parser.add_argument(name, type=str, default=None)
+
+
+def parse_args(argv: Optional[list[str]] = None) -> TrainConfig:
+    """Build a TrainConfig from CLI args layered over env/defaults.
+
+    Hyperparameters arrive as ``--key value`` strings exactly as the
+    SageMaker platform serializes them (reference ``launch.py:51`` →
+    ``scripts/train.py:39-46``); every value is validated and coerced to
+    its typed field. Unknown args are ignored.
+    """
+    parser = argparse.ArgumentParser(allow_abbrev=False)
+    for f in fields(TrainConfig):
+        _add_field_arg(parser, f)
+    ns, _unknown = parser.parse_known_args(argv)
+    overrides = {k: v for k, v in vars(ns).items() if v is not None}
+    base = TrainConfig()
+    merged = {**base.to_dict(), **overrides}
+    return TrainConfig.from_dict(merged)

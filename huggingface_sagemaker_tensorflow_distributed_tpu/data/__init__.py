@@ -1,0 +1,11 @@
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (  # noqa: F401
+    load_tokenizer,
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (  # noqa: F401
+    load_text_classification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (  # noqa: F401
+    ArrayDataset,
+    ShardedBatcher,
+)

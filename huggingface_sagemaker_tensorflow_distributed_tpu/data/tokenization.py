@@ -1,0 +1,146 @@
+"""Tokenization interface.
+
+TPU-native replacement for the reference's tokenizer surface
+(``AutoTokenizer.from_pretrained`` + ``tokenizer(e["text"],
+truncation=True, padding=...)`` at reference ``scripts/train.py:69,75,90``
+and ``tokenizer.save_pretrained`` at ``scripts/train.py:183``).
+Tokenization is pure host-side data prep (SURVEY.md D8 — not on the
+device path), so we wrap it behind one small interface with two
+implementations:
+
+- ``HFTokenizer``: delegates to HF ``tokenizers`` (Rust) when tokenizer
+  files exist locally — full fidelity with the reference.
+- ``WordHashTokenizer``: self-contained, dependency-free fallback
+  (deterministic word→bucket hashing with CLS/SEP/PAD specials) so the
+  framework trains end-to-end in zero-egress environments (tests, bench).
+
+Both return the reference's dict contract: ``input_ids`` +
+``attention_mask``, padded to a static ``max_length`` (the reference
+densifies to ``[N, tokenizer.model_max_length]`` at
+``scripts/train.py:80-83``; static shapes are mandatory under XLA anyway).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from typing import Sequence
+
+import numpy as np
+
+
+class WordHashTokenizer:
+    """Deterministic hashing tokenizer (offline fallback).
+
+    Vocabulary layout: 0=PAD, 1=CLS, 2=SEP, 3=UNK, 4..vocab_size-1 hash
+    buckets. Same text → same ids across processes and runs (md5, not
+    Python ``hash`` which is salted per process — per-host determinism is
+    what makes multi-host input pipelines consistent).
+    """
+
+    model_max_length = 512
+
+    def __init__(self, vocab_size: int = 30522, lowercase: bool = True):
+        self.vocab_size = vocab_size
+        self.lowercase = lowercase
+        self.pad_token_id = 0
+        self.cls_token_id = 1
+        self.sep_token_id = 2
+
+    def _word_id(self, word: str) -> int:
+        digest = hashlib.md5(word.encode("utf-8")).digest()
+        bucket = int.from_bytes(digest[:4], "little") % (self.vocab_size - 4)
+        return 4 + bucket
+
+    def __call__(self, texts, truncation: bool = True, padding: str = "max_length",
+                 max_length: int | None = None, text_pairs=None):
+        if isinstance(texts, str):
+            texts = [texts]
+        max_length = max_length or self.model_max_length
+        ids_list, seg_list = [], []
+        for i, text in enumerate(texts):
+            if self.lowercase:
+                text = text.lower()
+            words = re.findall(r"\w+|[^\w\s]", text)
+            ids = [self.cls_token_id] + [self._word_id(w) for w in words] + [self.sep_token_id]
+            segs = [0] * len(ids)
+            if text_pairs is not None:
+                pair = text_pairs[i].lower() if self.lowercase else text_pairs[i]
+                pair_ids = [self._word_id(w) for w in re.findall(r"\w+|[^\w\s]", pair)] + [self.sep_token_id]
+                ids += pair_ids
+                segs += [1] * len(pair_ids)
+            if truncation:
+                ids, segs = ids[:max_length], segs[:max_length]
+            ids_list.append(ids)
+            seg_list.append(segs)
+        if padding == "longest":
+            max_length = min(max_length, max(len(i) for i in ids_list))
+        input_ids = np.full((len(ids_list), max_length), self.pad_token_id, np.int32)
+        attention_mask = np.zeros((len(ids_list), max_length), np.int32)
+        token_type_ids = np.zeros((len(ids_list), max_length), np.int32)
+        for r, (ids, segs) in enumerate(zip(ids_list, seg_list)):
+            ids, segs = ids[:max_length], segs[:max_length]
+            input_ids[r, : len(ids)] = ids
+            attention_mask[r, : len(ids)] = 1
+            token_type_ids[r, : len(segs)] = segs
+        out = {"input_ids": input_ids, "attention_mask": attention_mask}
+        if text_pairs is not None:
+            out["token_type_ids"] = token_type_ids
+        return out
+
+    def save_pretrained(self, output_dir: str) -> None:
+        os.makedirs(output_dir, exist_ok=True)
+        with open(os.path.join(output_dir, "word_hash_tokenizer.json"), "w") as f:
+            json.dump({"type": "word_hash", "vocab_size": self.vocab_size,
+                       "lowercase": self.lowercase,
+                       "model_max_length": self.model_max_length}, f)
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "WordHashTokenizer":
+        with open(os.path.join(path, "word_hash_tokenizer.json")) as f:
+            spec = json.load(f)
+        tok = cls(vocab_size=spec["vocab_size"], lowercase=spec["lowercase"])
+        tok.model_max_length = spec.get("model_max_length", 512)
+        return tok
+
+
+class HFTokenizer:
+    """Wraps a local HF fast tokenizer behind the same interface."""
+
+    def __init__(self, hf_tokenizer):
+        self._tok = hf_tokenizer
+        self.model_max_length = min(hf_tokenizer.model_max_length, 1 << 20)
+        self.pad_token_id = hf_tokenizer.pad_token_id or 0
+
+    def __call__(self, texts, truncation: bool = True, padding: str = "max_length",
+                 max_length: int | None = None, text_pairs=None):
+        out = self._tok(
+            texts, text_pairs, truncation=truncation, padding=padding,
+            max_length=max_length or self.model_max_length, return_tensors="np")
+        res = {"input_ids": out["input_ids"].astype(np.int32),
+               "attention_mask": out["attention_mask"].astype(np.int32)}
+        if "token_type_ids" in out and text_pairs is not None:
+            res["token_type_ids"] = out["token_type_ids"].astype(np.int32)
+        return res
+
+
+    def save_pretrained(self, output_dir: str) -> None:
+        self._tok.save_pretrained(output_dir)
+
+    @classmethod
+    def from_pretrained(cls, path: str) -> "HFTokenizer":
+        from transformers import AutoTokenizer
+        return cls(AutoTokenizer.from_pretrained(path, local_files_only=True))
+
+
+def load_tokenizer(model_name_or_path: str, vocab_size: int = 30522):
+    """Tokenizer factory: HF files if present locally, hash fallback otherwise."""
+    if os.path.isdir(model_name_or_path):
+        if os.path.exists(os.path.join(model_name_or_path, "word_hash_tokenizer.json")):
+            return WordHashTokenizer.from_pretrained(model_name_or_path)
+        if any(os.path.exists(os.path.join(model_name_or_path, f))
+               for f in ("tokenizer.json", "vocab.txt", "spiece.model", "tokenizer_config.json")):
+            return HFTokenizer.from_pretrained(model_name_or_path)
+    return WordHashTokenizer(vocab_size=vocab_size)

@@ -1,0 +1,9 @@
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (  # noqa: F401
+    EncoderConfig,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (  # noqa: F401
+    MODEL_REGISTRY,
+    build_model,
+    from_pretrained,
+    save_pretrained,
+)

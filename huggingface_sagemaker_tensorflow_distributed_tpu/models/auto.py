@@ -1,0 +1,187 @@
+"""Auto-model construction and HF-layout export.
+
+TPU-native replacement for the reference's model load/save surface:
+``AutoTokenizer.from_pretrained`` + ``TFAutoModelForSequenceClassification
+.from_pretrained`` (reference ``scripts/train.py:69,117``) and
+``save_pretrained`` of model+tokenizer (``scripts/train.py:182-183``).
+
+``from_pretrained(path, task=...)`` reads ``config.json`` to pick the
+architecture family, builds the matching Flax module + config, initializes
+the full param tree (fresh task head), and overlays the converted
+checkpoint weights. ``save_pretrained(...)`` writes ``model.safetensors``
+(+ ``config.json``) in HF layout so artifacts are loadable by the HF
+ecosystem — the same interchange contract the reference relies on.
+
+Offline-first: paths are local directories (this environment has no
+network egress); a hub name with no local directory raises with a clear
+message. ``from_scratch=True`` (or config-only dirs) skips weight load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import bert, distilbert, roberta
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.convert import (
+    hf_to_params,
+    load_hf_config,
+    load_hf_state_dict,
+    merge_into,
+    params_to_hf,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# (family, task) → model class
+MODEL_REGISTRY: dict[tuple[str, str], Any] = {
+    ("bert", "seq-cls"): bert.BertForSequenceClassification,
+    ("bert", "token-cls"): bert.BertForTokenClassification,
+    ("bert", "qa"): bert.BertForQuestionAnswering,
+    ("roberta", "seq-cls"): roberta.RobertaForSequenceClassification,
+    ("roberta", "token-cls"): roberta.RobertaForTokenClassification,
+    ("roberta", "qa"): roberta.RobertaForQuestionAnswering,
+    ("distilbert", "seq-cls"): distilbert.DistilBertForSequenceClassification,
+    ("distilbert", "token-cls"): distilbert.DistilBertForTokenClassification,
+    ("distilbert", "qa"): distilbert.DistilBertForQuestionAnswering,
+}
+
+CONFIG_BUILDERS = {
+    "bert": bert.bert_config_from_hf,
+    "roberta": roberta.roberta_config_from_hf,
+    "distilbert": distilbert.distilbert_config_from_hf,
+}
+
+# Our config → HF config.json for export
+_HF_CONFIG_EXPORTERS = {
+    "bert": lambda c: {
+        "model_type": "bert", "architectures": ["BertForSequenceClassification"],
+        "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_layers, "num_attention_heads": c.num_heads,
+        "intermediate_size": c.intermediate_size,
+        "max_position_embeddings": c.max_position_embeddings,
+        "type_vocab_size": c.type_vocab_size, "hidden_act": c.hidden_act,
+        "layer_norm_eps": c.layer_norm_eps,
+        "hidden_dropout_prob": c.hidden_dropout,
+        "attention_probs_dropout_prob": c.attention_dropout,
+        "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
+    },
+    "roberta": lambda c: {
+        "model_type": "roberta", "architectures": ["RobertaForSequenceClassification"],
+        "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_layers, "num_attention_heads": c.num_heads,
+        "intermediate_size": c.intermediate_size,
+        "max_position_embeddings": c.max_position_embeddings,
+        "type_vocab_size": c.type_vocab_size, "hidden_act": c.hidden_act,
+        "layer_norm_eps": c.layer_norm_eps,
+        "hidden_dropout_prob": c.hidden_dropout,
+        "attention_probs_dropout_prob": c.attention_dropout,
+        "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
+    },
+    "distilbert": lambda c: {
+        "model_type": "distilbert", "architectures": ["DistilBertForSequenceClassification"],
+        "vocab_size": c.vocab_size, "dim": c.hidden_size,
+        "n_layers": c.num_layers, "n_heads": c.num_heads,
+        "hidden_dim": c.intermediate_size,
+        "max_position_embeddings": c.max_position_embeddings,
+        "activation": c.hidden_act, "dropout": c.hidden_dropout,
+        "attention_dropout": c.attention_dropout,
+        "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
+    },
+}
+
+
+def detect_family(hf_config: dict) -> str:
+    mt = hf_config.get("model_type", "")
+    if mt in CONFIG_BUILDERS:
+        return mt
+    raise ValueError(f"unsupported model_type {mt!r} (supported: {sorted(CONFIG_BUILDERS)})")
+
+
+def build_model(family: str, task: str, config: EncoderConfig, num_labels: int = 2):
+    cls = MODEL_REGISTRY.get((family, task))
+    if cls is None:
+        raise ValueError(f"no model for family={family!r} task={task!r}")
+    if task == "qa":
+        return cls(config)
+    return cls(config, num_labels=num_labels)
+
+
+def init_params(model, config: EncoderConfig, seed: int = 0, seq_len: int = 8):
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.ones((1, seq_len), jnp.int32)
+    variables = model.init(rng, dummy, jnp.ones((1, seq_len), jnp.int32))
+    return variables["params"]
+
+
+def from_pretrained(
+    model_name_or_path: str,
+    task: str = "seq-cls",
+    num_labels: int = 2,
+    dtype=jnp.float32,
+    param_dtype=jnp.float32,
+    seed: int = 0,
+    from_scratch: bool = False,
+    **config_overrides,
+):
+    """Load (or freshly init) a model. Returns (model, params, family, config)."""
+    if not os.path.isdir(model_name_or_path):
+        raise FileNotFoundError(
+            f"{model_name_or_path!r} is not a local directory. This framework is "
+            "offline-first: pass a local checkpoint directory containing "
+            "config.json (+ model.safetensors), e.g. produced by "
+            "`save_pretrained` or an HF download.")
+    hf_config = load_hf_config(model_name_or_path)
+    family = detect_family(hf_config)
+    if family == "bert" and task != "seq-cls":
+        # HF Bert QA/token-cls models are built with add_pooling_layer=False;
+        # only the seq-cls head consumes the pooler.
+        config_overrides.setdefault("use_pooler", False)
+    config = CONFIG_BUILDERS[family](
+        hf_config, dtype=dtype, param_dtype=param_dtype, **config_overrides)
+    model = build_model(family, task, config, num_labels)
+    params = init_params(model, config, seed=seed)
+    has_weights = os.path.exists(os.path.join(model_name_or_path, "model.safetensors")) or \
+        os.path.exists(os.path.join(model_name_or_path, "pytorch_model.bin"))
+    if not from_scratch and has_weights:
+        state = load_hf_state_dict(model_name_or_path)
+        loaded = hf_to_params(state, family)
+        params, missing = merge_into(params, loaded)
+        logger.info("loaded %s (%s) — %d fresh head params", model_name_or_path,
+                    family, len(missing))
+    else:
+        logger.info("initialized %s (%s) from scratch", model_name_or_path, family)
+    return model, params, family, config
+
+
+def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderConfig,
+                    host0_only: bool = True) -> None:
+    """Export params in HF layout (reference ``scripts/train.py:182-183``).
+
+    Host-0 gated — the reference saves from every rank (racy on shared
+    filesystems; its own comment warns about this, ``scripts/train.py:181``).
+    """
+    if jax.process_count() > 1:
+        # Params may be sharded across non-addressable devices (fsdp/tp
+        # spanning hosts): gather to fully-replicated host arrays first.
+        # Collective — every host must participate before the host-0 gate.
+        from jax.experimental import multihost_utils
+        params = multihost_utils.process_allgather(params)
+    if host0_only and jax.process_index() != 0:
+        return
+    os.makedirs(output_dir, exist_ok=True)
+    state = params_to_hf(jax.device_get(params), family)
+    state = {k: np.ascontiguousarray(v) for k, v in state.items()}
+    from safetensors.numpy import save_file
+    save_file(state, os.path.join(output_dir, "model.safetensors"),
+              metadata={"format": "pt"})
+    with open(os.path.join(output_dir, "config.json"), "w") as f:
+        json.dump(_HF_CONFIG_EXPORTERS[family](config), f, indent=2)
+    logger.info("exported HF-layout checkpoint to %s", output_dir)

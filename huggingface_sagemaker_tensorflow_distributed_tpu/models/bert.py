@@ -1,0 +1,90 @@
+"""BERT models + task heads.
+
+TPU-native replacement for ``TFAutoModelForSequenceClassification`` with
+BERT checkpoints — the reference's default model path
+(``bert-large-uncased-whole-word-masking``, reference ``launch.py:17``,
+loaded at ``scripts/train.py:117``). Heads beyond seq-cls (token-cls,
+QA) cover the breadth configs in BASELINE.json.
+
+HF-parity notes: post-LN encoder, erf-exact GeLU, tanh pooler on the
+CLS token; head structure mirrors HF ``BertForSequenceClassification``
+(pooled → dropout → classifier) so converted checkpoints are numerically
+identical (tested in ``tests/test_hf_parity.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    EncoderBackbone,
+    EncoderConfig,
+    _dense,
+)
+
+
+def bert_config_from_hf(hf_config: dict, **overrides) -> EncoderConfig:
+    """Map an HF BertConfig dict (config.json) to our EncoderConfig."""
+    kw = dict(
+        vocab_size=hf_config["vocab_size"],
+        hidden_size=hf_config["hidden_size"],
+        num_layers=hf_config["num_hidden_layers"],
+        num_heads=hf_config["num_attention_heads"],
+        intermediate_size=hf_config["intermediate_size"],
+        max_position_embeddings=hf_config["max_position_embeddings"],
+        type_vocab_size=hf_config.get("type_vocab_size", 2),
+        hidden_act=hf_config.get("hidden_act", "gelu"),
+        layer_norm_eps=hf_config.get("layer_norm_eps", 1e-12),
+        hidden_dropout=hf_config.get("hidden_dropout_prob", 0.1),
+        attention_dropout=hf_config.get("attention_probs_dropout_prob", 0.1),
+        pad_token_id=hf_config.get("pad_token_id", 0),
+        initializer_range=hf_config.get("initializer_range", 0.02),
+    )
+    kw.update(overrides)
+    return EncoderConfig(**kw)
+
+
+class BertForSequenceClassification(nn.Module):
+    """Backbone → pooler → dropout → linear classifier (HF head parity)."""
+
+    config: EncoderConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        _, pooled = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        x = nn.Dropout(self.config.hidden_dropout)(pooled, deterministic=deterministic)
+        return _dense(self.config, self.num_labels, "classifier")(x)
+
+
+class BertForTokenClassification(nn.Module):
+    config: EncoderConfig
+    num_labels: int = 9  # CoNLL-2003 default
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        x = nn.Dropout(self.config.hidden_dropout)(seq, deterministic=deterministic)
+        return _dense(self.config, self.num_labels, "classifier")(x)
+
+
+class BertForQuestionAnswering(nn.Module):
+    """Start/end span logits (SQuAD); HF ``qa_outputs`` parity."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        logits = _dense(self.config, 2, "qa_outputs")(seq)
+        start, end = jnp.split(logits, 2, axis=-1)
+        return start[..., 0], end[..., 0]

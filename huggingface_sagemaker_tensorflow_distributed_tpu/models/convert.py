@@ -1,0 +1,298 @@
+"""HF checkpoint ⇄ JAX param-tree conversion.
+
+TPU-native replacement for the pretrained-weight loading the reference
+gets from ``TFAutoModelForSequenceClassification.from_pretrained``
+(reference ``scripts/train.py:117``) and the export it gets from
+``save_pretrained`` (``scripts/train.py:182-183``). Reads HF
+``model.safetensors`` / ``pytorch_model.bin`` from a local directory,
+translates torch key names to our Flax param paths (and back, for
+HF-layout export), transposing ``nn.Linear`` weights (torch stores
+[out, in]; Flax Dense stores [in, out]).
+
+Name translation is regex-table-driven per architecture family — this is
+SURVEY.md §7 hard-part 1 (silent numerics bugs live here); fidelity is
+enforced by ``tests/test_hf_parity.py`` which compares logits against HF
+torch models to ~1e-4.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Each rule: (torch-key regex, our-path template). ``\1``-style groups
+# carry layer indices. Applied first-match-wins. ``.weight`` / ``.bias``
+# suffixes are handled after structural translation.
+_BERT_RULES = [
+    (r"^(?:bert\.)?embeddings\.word_embeddings$", r"backbone/embeddings/word_embeddings"),
+    (r"^(?:bert\.)?embeddings\.position_embeddings$", r"backbone/embeddings/position_embeddings"),
+    (r"^(?:bert\.)?embeddings\.token_type_embeddings$", r"backbone/embeddings/token_type_embeddings"),
+    (r"^(?:bert\.)?embeddings\.LayerNorm$", r"backbone/embeddings/embeddings_ln"),
+    (r"^(?:bert\.)?encoder\.layer\.(\d+)\.attention\.self\.query$", r"backbone/encoder/layer_\1/attention/query"),
+    (r"^(?:bert\.)?encoder\.layer\.(\d+)\.attention\.self\.key$", r"backbone/encoder/layer_\1/attention/key"),
+    (r"^(?:bert\.)?encoder\.layer\.(\d+)\.attention\.self\.value$", r"backbone/encoder/layer_\1/attention/value"),
+    (r"^(?:bert\.)?encoder\.layer\.(\d+)\.attention\.output\.dense$", r"backbone/encoder/layer_\1/attention/attention_out"),
+    (r"^(?:bert\.)?encoder\.layer\.(\d+)\.attention\.output\.LayerNorm$", r"backbone/encoder/layer_\1/attention_ln"),
+    (r"^(?:bert\.)?encoder\.layer\.(\d+)\.intermediate\.dense$", r"backbone/encoder/layer_\1/ffn/intermediate"),
+    (r"^(?:bert\.)?encoder\.layer\.(\d+)\.output\.dense$", r"backbone/encoder/layer_\1/ffn/ffn_out"),
+    (r"^(?:bert\.)?encoder\.layer\.(\d+)\.output\.LayerNorm$", r"backbone/encoder/layer_\1/ffn_ln"),
+    (r"^(?:bert\.)?pooler\.dense$", r"backbone/pooler/pooler"),
+    (r"^qa_outputs$", r"qa_outputs"),
+    (r"^classifier$", r"classifier"),
+]
+
+_ROBERTA_RULES = [
+    (r"^(?:roberta\.)?embeddings\.word_embeddings$", r"backbone/embeddings/word_embeddings"),
+    (r"^(?:roberta\.)?embeddings\.position_embeddings$", r"backbone/embeddings/position_embeddings"),
+    (r"^(?:roberta\.)?embeddings\.token_type_embeddings$", r"backbone/embeddings/token_type_embeddings"),
+    (r"^(?:roberta\.)?embeddings\.LayerNorm$", r"backbone/embeddings/embeddings_ln"),
+    (r"^(?:roberta\.)?encoder\.layer\.(\d+)\.attention\.self\.query$", r"backbone/encoder/layer_\1/attention/query"),
+    (r"^(?:roberta\.)?encoder\.layer\.(\d+)\.attention\.self\.key$", r"backbone/encoder/layer_\1/attention/key"),
+    (r"^(?:roberta\.)?encoder\.layer\.(\d+)\.attention\.self\.value$", r"backbone/encoder/layer_\1/attention/value"),
+    (r"^(?:roberta\.)?encoder\.layer\.(\d+)\.attention\.output\.dense$", r"backbone/encoder/layer_\1/attention/attention_out"),
+    (r"^(?:roberta\.)?encoder\.layer\.(\d+)\.attention\.output\.LayerNorm$", r"backbone/encoder/layer_\1/attention_ln"),
+    (r"^(?:roberta\.)?encoder\.layer\.(\d+)\.intermediate\.dense$", r"backbone/encoder/layer_\1/ffn/intermediate"),
+    (r"^(?:roberta\.)?encoder\.layer\.(\d+)\.output\.dense$", r"backbone/encoder/layer_\1/ffn/ffn_out"),
+    (r"^(?:roberta\.)?encoder\.layer\.(\d+)\.output\.LayerNorm$", r"backbone/encoder/layer_\1/ffn_ln"),
+    # RobertaClassificationHead
+    (r"^classifier\.dense$", r"head/head_dense"),
+    (r"^classifier\.out_proj$", r"head/classifier"),
+    (r"^qa_outputs$", r"qa_outputs"),
+    (r"^classifier$", r"classifier"),  # token-cls head (no sub-keys)
+]
+
+_DISTILBERT_RULES = [
+    (r"^(?:distilbert\.)?embeddings\.word_embeddings$", r"backbone/embeddings/word_embeddings"),
+    (r"^(?:distilbert\.)?embeddings\.position_embeddings$", r"backbone/embeddings/position_embeddings"),
+    (r"^(?:distilbert\.)?embeddings\.LayerNorm$", r"backbone/embeddings/embeddings_ln"),
+    (r"^(?:distilbert\.)?transformer\.layer\.(\d+)\.attention\.q_lin$", r"backbone/encoder/layer_\1/attention/query"),
+    (r"^(?:distilbert\.)?transformer\.layer\.(\d+)\.attention\.k_lin$", r"backbone/encoder/layer_\1/attention/key"),
+    (r"^(?:distilbert\.)?transformer\.layer\.(\d+)\.attention\.v_lin$", r"backbone/encoder/layer_\1/attention/value"),
+    (r"^(?:distilbert\.)?transformer\.layer\.(\d+)\.attention\.out_lin$", r"backbone/encoder/layer_\1/attention/attention_out"),
+    (r"^(?:distilbert\.)?transformer\.layer\.(\d+)\.sa_layer_norm$", r"backbone/encoder/layer_\1/attention_ln"),
+    (r"^(?:distilbert\.)?transformer\.layer\.(\d+)\.ffn\.lin1$", r"backbone/encoder/layer_\1/ffn/intermediate"),
+    (r"^(?:distilbert\.)?transformer\.layer\.(\d+)\.ffn\.lin2$", r"backbone/encoder/layer_\1/ffn/ffn_out"),
+    (r"^(?:distilbert\.)?transformer\.layer\.(\d+)\.output_layer_norm$", r"backbone/encoder/layer_\1/ffn_ln"),
+    (r"^pre_classifier$", r"pre_classifier"),
+    (r"^qa_outputs$", r"qa_outputs"),
+    (r"^classifier$", r"classifier"),
+]
+
+RULES_BY_FAMILY: dict[str, list] = {
+    "bert": _BERT_RULES,
+    "roberta": _ROBERTA_RULES,
+    "distilbert": _DISTILBERT_RULES,
+}
+
+
+def load_hf_state_dict(model_dir: str) -> dict[str, np.ndarray]:
+    """Read a local HF checkpoint directory into a flat numpy dict."""
+    st_path = os.path.join(model_dir, "model.safetensors")
+    bin_path = os.path.join(model_dir, "pytorch_model.bin")
+    if os.path.exists(st_path):
+        from safetensors.numpy import load_file
+        return dict(load_file(st_path))
+    if os.path.exists(bin_path):
+        import torch
+        sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+        return {k: v.numpy() for k, v in sd.items()}
+    raise FileNotFoundError(f"no model.safetensors / pytorch_model.bin in {model_dir}")
+
+
+def _split_suffix(torch_key: str) -> tuple[str, str]:
+    for suffix in (".weight", ".bias"):
+        if torch_key.endswith(suffix):
+            return torch_key[: -len(suffix)], suffix[1:]
+    return torch_key, ""
+
+
+def translate_key(torch_key: str, family: str) -> str | None:
+    """torch key → 'a/b/c/leaf' path in our tree, or None if unmapped."""
+    stem, kind = _split_suffix(torch_key)
+    for pattern, template in RULES_BY_FAMILY[family]:
+        m = re.match(pattern, stem)
+        if m:
+            base = m.expand(template)
+            leaf_name = base.rsplit("/", 1)[-1]
+            is_embed = "word_embeddings" in base or "position_embeddings" in base \
+                or "token_type_embeddings" in base
+            is_ln = leaf_name.endswith("_ln") or "layernorm" in leaf_name.lower()
+            if kind == "weight":
+                leaf = "embedding" if is_embed else ("scale" if is_ln else "kernel")
+            elif kind == "bias":
+                leaf = "bias"
+            else:
+                leaf = "embedding" if is_embed else kind
+            return f"{base}/{leaf}"
+    return None
+
+
+def hf_to_params(state_dict: dict[str, np.ndarray], family: str) -> dict:
+    """Flat torch state dict → nested Flax param dict (unvalidated)."""
+    nested: dict = {}
+    for torch_key, value in state_dict.items():
+        path = translate_key(torch_key, family)
+        if path is None:
+            logger.info("convert: skipping unmapped key %s", torch_key)
+            continue
+        if path.endswith("/kernel") and value.ndim == 2:
+            value = value.T  # torch Linear [out,in] → Flax Dense [in,out]
+        parts = path.split("/")
+        node = nested
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.asarray(value)
+    return nested
+
+
+def merge_into(template: Any, loaded: dict, strict_backbone: bool = True) -> tuple[Any, list[str]]:
+    """Overlay converted weights onto an initialized param tree.
+
+    Head params absent from the checkpoint keep their fresh random init —
+    matching HF's new-task-head behavior at reference
+    ``scripts/train.py:117``. Returns (params, missing_paths).
+    """
+    missing: list[str] = []
+
+    def walk(tpl, src, path):
+        if isinstance(tpl, dict):
+            out = {}
+            for key, sub in tpl.items():
+                out[key] = walk(sub, src.get(key) if isinstance(src, dict) else None,
+                                path + (key,))
+            return out
+        if src is None:
+            missing.append("/".join(path))
+            return tpl
+        if tuple(np.shape(src)) != tuple(np.shape(tpl)):
+            raise ValueError(
+                f"shape mismatch at {'/'.join(path)}: checkpoint {np.shape(src)} "
+                f"vs model {np.shape(tpl)}")
+        return jnp.asarray(src, dtype=jnp.asarray(tpl).dtype)
+
+    merged = walk(template, loaded, ())
+    if missing:
+        backbone_missing = [m for m in missing if m.startswith("backbone/")]
+        if backbone_missing and strict_backbone:
+            raise ValueError(f"backbone params missing from checkpoint: {backbone_missing[:8]}")
+        logger.info("convert: freshly initialized head params: %s", missing)
+    return merged, missing
+
+
+# Reverse rules (our-path regex → torch stem template) per family, used
+# for HF-layout export. Kept explicit (not derived from the forward
+# table) so both directions are simple to read; the round-trip test in
+# tests/test_convert.py keeps them consistent.
+_BERT_REVERSE = [
+    (r"^backbone/embeddings/word_embeddings$", "bert.embeddings.word_embeddings"),
+    (r"^backbone/embeddings/position_embeddings$", "bert.embeddings.position_embeddings"),
+    (r"^backbone/embeddings/token_type_embeddings$", "bert.embeddings.token_type_embeddings"),
+    (r"^backbone/embeddings/embeddings_ln$", "bert.embeddings.LayerNorm"),
+    (r"^backbone/encoder/layer_(\d+)/attention/query$", "bert.encoder.layer.{}.attention.self.query"),
+    (r"^backbone/encoder/layer_(\d+)/attention/key$", "bert.encoder.layer.{}.attention.self.key"),
+    (r"^backbone/encoder/layer_(\d+)/attention/value$", "bert.encoder.layer.{}.attention.self.value"),
+    (r"^backbone/encoder/layer_(\d+)/attention/attention_out$", "bert.encoder.layer.{}.attention.output.dense"),
+    (r"^backbone/encoder/layer_(\d+)/attention_ln$", "bert.encoder.layer.{}.attention.output.LayerNorm"),
+    (r"^backbone/encoder/layer_(\d+)/ffn/intermediate$", "bert.encoder.layer.{}.intermediate.dense"),
+    (r"^backbone/encoder/layer_(\d+)/ffn/ffn_out$", "bert.encoder.layer.{}.output.dense"),
+    (r"^backbone/encoder/layer_(\d+)/ffn_ln$", "bert.encoder.layer.{}.output.LayerNorm"),
+    (r"^backbone/pooler/pooler$", "bert.pooler.dense"),
+    (r"^qa_outputs$", "qa_outputs"),
+    (r"^classifier$", "classifier"),
+]
+
+_ROBERTA_REVERSE = [
+    (r"^backbone/embeddings/word_embeddings$", "roberta.embeddings.word_embeddings"),
+    (r"^backbone/embeddings/position_embeddings$", "roberta.embeddings.position_embeddings"),
+    (r"^backbone/embeddings/token_type_embeddings$", "roberta.embeddings.token_type_embeddings"),
+    (r"^backbone/embeddings/embeddings_ln$", "roberta.embeddings.LayerNorm"),
+    (r"^backbone/encoder/layer_(\d+)/attention/query$", "roberta.encoder.layer.{}.attention.self.query"),
+    (r"^backbone/encoder/layer_(\d+)/attention/key$", "roberta.encoder.layer.{}.attention.self.key"),
+    (r"^backbone/encoder/layer_(\d+)/attention/value$", "roberta.encoder.layer.{}.attention.self.value"),
+    (r"^backbone/encoder/layer_(\d+)/attention/attention_out$", "roberta.encoder.layer.{}.attention.output.dense"),
+    (r"^backbone/encoder/layer_(\d+)/attention_ln$", "roberta.encoder.layer.{}.attention.output.LayerNorm"),
+    (r"^backbone/encoder/layer_(\d+)/ffn/intermediate$", "roberta.encoder.layer.{}.intermediate.dense"),
+    (r"^backbone/encoder/layer_(\d+)/ffn/ffn_out$", "roberta.encoder.layer.{}.output.dense"),
+    (r"^backbone/encoder/layer_(\d+)/ffn_ln$", "roberta.encoder.layer.{}.output.LayerNorm"),
+    (r"^head/head_dense$", "classifier.dense"),
+    (r"^head/classifier$", "classifier.out_proj"),
+    (r"^qa_outputs$", "qa_outputs"),
+    (r"^classifier$", "classifier"),
+]
+
+_DISTILBERT_REVERSE = [
+    (r"^backbone/embeddings/word_embeddings$", "distilbert.embeddings.word_embeddings"),
+    (r"^backbone/embeddings/position_embeddings$", "distilbert.embeddings.position_embeddings"),
+    (r"^backbone/embeddings/embeddings_ln$", "distilbert.embeddings.LayerNorm"),
+    (r"^backbone/encoder/layer_(\d+)/attention/query$", "distilbert.transformer.layer.{}.attention.q_lin"),
+    (r"^backbone/encoder/layer_(\d+)/attention/key$", "distilbert.transformer.layer.{}.attention.k_lin"),
+    (r"^backbone/encoder/layer_(\d+)/attention/value$", "distilbert.transformer.layer.{}.attention.v_lin"),
+    (r"^backbone/encoder/layer_(\d+)/attention/attention_out$", "distilbert.transformer.layer.{}.attention.out_lin"),
+    (r"^backbone/encoder/layer_(\d+)/attention_ln$", "distilbert.transformer.layer.{}.sa_layer_norm"),
+    (r"^backbone/encoder/layer_(\d+)/ffn/intermediate$", "distilbert.transformer.layer.{}.ffn.lin1"),
+    (r"^backbone/encoder/layer_(\d+)/ffn/ffn_out$", "distilbert.transformer.layer.{}.ffn.lin2"),
+    (r"^backbone/encoder/layer_(\d+)/ffn_ln$", "distilbert.transformer.layer.{}.output_layer_norm"),
+    (r"^pre_classifier$", "pre_classifier"),
+    (r"^qa_outputs$", "qa_outputs"),
+    (r"^classifier$", "classifier"),
+]
+
+REVERSE_RULES_BY_FAMILY: dict[str, list] = {
+    "bert": _BERT_REVERSE,
+    "roberta": _ROBERTA_REVERSE,
+    "distilbert": _DISTILBERT_REVERSE,
+}
+
+
+def params_to_hf(params: Any, family: str) -> dict[str, np.ndarray]:
+    """Our param tree → flat torch-layout state dict (for HF export).
+
+    Inverse of ``hf_to_params``; kernels transposed back to [out, in].
+    """
+    flat: dict[str, np.ndarray] = {}
+
+    def flatten(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                flatten(v, path + (k,))
+        else:
+            flat["/".join(path)] = np.asarray(node)
+
+    flatten(params, ())
+
+    out: dict[str, np.ndarray] = {}
+    for path, value in flat.items():
+        base, leaf = path.rsplit("/", 1)
+        torch_stem = None
+        for inv_pat, stem in REVERSE_RULES_BY_FAMILY[family]:
+            m = re.match(inv_pat, base)
+            if m:
+                torch_stem = stem.format(*m.groups()) if m.groups() else stem
+                break
+        if torch_stem is None:
+            logger.info("export: skipping unmapped param %s", path)
+            continue
+        if leaf == "kernel":
+            out[torch_stem + ".weight"] = value.T if value.ndim == 2 else value
+        elif leaf in ("scale", "embedding"):
+            out[torch_stem + ".weight"] = value
+        elif leaf == "bias":
+            out[torch_stem + ".bias"] = value
+        else:
+            out[torch_stem + "." + leaf] = value
+    return out
+
+
+def load_hf_config(model_dir: str) -> dict:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        return json.load(f)

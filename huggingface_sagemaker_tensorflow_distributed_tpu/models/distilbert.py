@@ -1,0 +1,86 @@
+"""DistilBERT models + task heads.
+
+Covers the BASELINE.json parity config "DistilBERT-base seq-classification
+on IMDb (CPU)" — the single-node baseline mirroring reference
+``scripts/singe_node_train.py``. Structure: BERT layers without token-type
+embeddings or pooler; seq-cls head is pre_classifier(+ReLU) → dropout →
+classifier on the CLS token (HF ``DistilBertForSequenceClassification``
+parity).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    EncoderBackbone,
+    EncoderConfig,
+    _dense,
+)
+
+
+def distilbert_config_from_hf(hf_config: dict, **overrides) -> EncoderConfig:
+    kw = dict(
+        vocab_size=hf_config["vocab_size"],
+        hidden_size=hf_config["dim"],
+        num_layers=hf_config["n_layers"],
+        num_heads=hf_config["n_heads"],
+        intermediate_size=hf_config["hidden_dim"],
+        max_position_embeddings=hf_config["max_position_embeddings"],
+        hidden_act=hf_config.get("activation", "gelu"),
+        layer_norm_eps=1e-12,
+        hidden_dropout=hf_config.get("dropout", 0.1),
+        attention_dropout=hf_config.get("attention_dropout", 0.1),
+        pad_token_id=hf_config.get("pad_token_id", 0),
+        initializer_range=hf_config.get("initializer_range", 0.02),
+        use_token_type=False,
+        use_pooler=False,
+    )
+    kw.update(overrides)
+    return EncoderConfig(**kw)
+
+
+class DistilBertForSequenceClassification(nn.Module):
+    """CLS → pre_classifier → ReLU → dropout → classifier (HF parity)."""
+
+    config: EncoderConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        seq, _ = EncoderBackbone(cfg, name="backbone")(
+            input_ids, attention_mask, None, deterministic=deterministic)
+        x = seq[:, 0]
+        x = jax.nn.relu(_dense(cfg, cfg.hidden_size, "pre_classifier")(x))
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        return _dense(cfg, self.num_labels, "classifier")(x)
+
+
+class DistilBertForTokenClassification(nn.Module):
+    config: EncoderConfig
+    num_labels: int = 9
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, None, deterministic=deterministic)
+        x = nn.Dropout(self.config.hidden_dropout)(seq, deterministic=deterministic)
+        return _dense(self.config, self.num_labels, "classifier")(x)
+
+
+class DistilBertForQuestionAnswering(nn.Module):
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        import jax.numpy as jnp
+        seq, _ = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, None, deterministic=deterministic)
+        logits = _dense(self.config, 2, "qa_outputs")(seq)
+        start, end = jnp.split(logits, 2, axis=-1)
+        return start[..., 0], end[..., 0]

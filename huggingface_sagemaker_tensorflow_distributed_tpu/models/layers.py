@@ -1,0 +1,233 @@
+"""Shared transformer building blocks (Flax).
+
+TPU-native replacement for the model architectures the reference
+delegates entirely to ``transformers`` TF models (reference
+``scripts/train.py:117``; SURVEY.md component D7). One set of blocks
+serves BERT / RoBERTa / DistilBERT; module names are chosen so parameter
+paths line up with the tensor-parallel sharding rules in
+``parallel/sharding.py`` (query/key/value/attention_out, intermediate/
+ffn_out, embedding, pooler, classifier).
+
+Numerics: parameters live in ``param_dtype`` (fp32), compute runs in
+``dtype`` (bf16 on TPU for MXU throughput), layernorm statistics and
+attention softmax in fp32 — the bf16 discipline SURVEY.md §7 hard-part 5
+calls for.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import jax
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+    dot_product_attention,
+    make_attention_mask,
+)
+
+ACT2FN: dict[str, Callable] = {
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),  # HF "gelu" is erf-exact
+    "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "tanh": jnp.tanh,
+}
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    """Architecture hyperparameters shared by the BERT-family encoders."""
+
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_act: str = "gelu"
+    layer_norm_eps: float = 1e-12
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    pad_token_id: int = 0
+    position_offset: int = 0      # RoBERTa: pad_token_id + 1
+    use_token_type: bool = True   # DistilBERT: False
+    use_pooler: bool = True       # DistilBERT: False
+    initializer_range: float = 0.02
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"   # xla | flash (pallas)
+    remat: bool = False           # rematerialize encoder layers (trade FLOPs for HBM)
+
+
+def _dense(cfg: EncoderConfig, features: int, name: str) -> nn.Dense:
+    return nn.Dense(
+        features,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.initializers.normal(cfg.initializer_range),
+        name=name,
+    )
+
+
+def _layernorm(cfg: EncoderConfig, name: str) -> nn.LayerNorm:
+    # stats in fp32 even under bf16 compute
+    return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name=name)
+
+
+class Embeddings(nn.Module):
+    """Word + learned-position (+ token-type) embeddings with LN/dropout.
+
+    Parity target: HF ``BertEmbeddings`` / ``RobertaEmbeddings`` /
+    ``DistilBert Embeddings`` as exercised via reference
+    ``scripts/train.py:117``. RoBERTa's position ids start at
+    ``position_offset`` past-pad convention is reproduced via the config.
+    """
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, position_ids=None,
+                 attention_mask=None, deterministic: bool = True):
+        cfg = self.config
+        word = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                        embedding_init=nn.initializers.normal(cfg.initializer_range),
+                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        name="word_embeddings")(input_ids)
+        if position_ids is None:
+            seq_len = input_ids.shape[-1]
+            if cfg.position_offset and attention_mask is not None:
+                # RoBERTa convention: positions count only non-pad tokens,
+                # starting at position_offset (= pad_token_id + 1).
+                position_ids = jnp.cumsum(attention_mask, axis=-1) * attention_mask
+                position_ids = position_ids + cfg.position_offset - 1
+                position_ids = position_ids * attention_mask + cfg.pad_token_id * (1 - attention_mask)
+            else:
+                position_ids = jnp.arange(cfg.position_offset,
+                                          seq_len + cfg.position_offset)[None, :]
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="position_embeddings")(position_ids)
+        x = word + pos
+        if cfg.use_token_type:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                             embedding_init=nn.initializers.normal(cfg.initializer_range),
+                             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                             name="token_type_embeddings")(token_type_ids)
+        x = _layernorm(cfg, "embeddings_ln")(x)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        return x
+
+
+class SelfAttention(nn.Module):
+    """Multi-head self-attention (post-LN residual handled by caller).
+
+    QKV projections are column-parallel and the output projection
+    row-parallel under the ``tensor`` mesh axis (see
+    ``parallel/sharding.py``); with tensor parallelism XLA inserts a
+    single all-reduce after ``attention_out`` — the Megatron pattern.
+    """
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        def split(x):
+            b, s, _ = x.shape
+            return x.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        q = split(_dense(cfg, cfg.hidden_size, "query")(hidden))
+        k = split(_dense(cfg, cfg.hidden_size, "key")(hidden))
+        v = split(_dense(cfg, cfg.hidden_size, "value")(hidden))
+
+        ctx = dot_product_attention(q, k, v, mask=attn_mask, impl=cfg.attention_impl)
+        b, h, s, d = ctx.shape
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        out = _dense(cfg, cfg.hidden_size, "attention_out")(ctx)
+        out = nn.Dropout(cfg.hidden_dropout)(out, deterministic=deterministic)
+        return out
+
+
+class FeedForward(nn.Module):
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, hidden, deterministic: bool = True):
+        cfg = self.config
+        x = _dense(cfg, cfg.intermediate_size, "intermediate")(hidden)
+        x = ACT2FN[cfg.hidden_act](x)
+        x = _dense(cfg, cfg.hidden_size, "ffn_out")(x)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        return x
+
+
+class EncoderLayer(nn.Module):
+    """Post-LN transformer layer (BERT family ordering)."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
+        cfg = self.config
+        attn = SelfAttention(cfg, name="attention")(hidden, attn_mask, deterministic)
+        hidden = _layernorm(cfg, "attention_ln")(hidden + attn)
+        ffn = FeedForward(cfg, name="ffn")(hidden, deterministic)
+        hidden = _layernorm(cfg, "ffn_ln")(hidden + ffn)
+        return hidden
+
+
+class Encoder(nn.Module):
+    """Stack of encoder layers; optional per-layer rematerialization."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
+        cfg = self.config
+        layer_cls = EncoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            hidden = layer_cls(cfg, name=f"layer_{i}")(hidden, attn_mask, deterministic)
+        return hidden
+
+
+class Pooler(nn.Module):
+    """CLS-token pooler (tanh dense), as in HF BERT."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        cls = hidden[:, 0]
+        return jnp.tanh(_dense(cfg, cfg.hidden_size, "pooler")(cls))
+
+
+class EncoderBackbone(nn.Module):
+    """Embeddings + encoder (+ pooler): the shared trunk for all heads."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic: bool = True):
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        additive_mask = make_attention_mask(attention_mask)
+        x = Embeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, position_ids, attention_mask, deterministic)
+        x = Encoder(cfg, name="encoder")(x, additive_mask, deterministic)
+        pooled = Pooler(cfg, name="pooler")(x) if cfg.use_pooler else None
+        return x, pooled

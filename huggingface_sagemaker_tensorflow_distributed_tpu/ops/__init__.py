@@ -1,0 +1,7 @@
+from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (  # noqa: F401
+    dot_product_attention,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.ops.losses import (  # noqa: F401
+    softmax_cross_entropy_with_integer_labels,
+    masked_mean,
+)

@@ -1,0 +1,68 @@
+"""Attention kernels.
+
+TPU-native replacement for the attention compute the reference gets from
+TF/CUDA kernels inside ``TFAutoModelForSequenceClassification``
+(reference ``scripts/train.py:117``). Three tiers, selected at trace
+time:
+
+1. ``xla`` — einsum + softmax, fully fused by XLA; correct everywhere
+   (CPU tests, TPU). The default.
+2. ``flash`` — Pallas blockwise flash attention (``ops/pallas_attention.py``)
+   for long sequences on TPU, O(seq) memory.
+3. ``ring`` — sequence-parallel ring attention over the ``seq`` mesh axis
+   (``parallel/ring_attention.py``) for sequences longer than one chip's
+   memory.
+
+All tiers take [batch, heads, q_len, head_dim] q and [batch, heads,
+kv_len, head_dim] k/v plus an additive float mask broadcastable to
+[batch, heads, q_len, kv_len], and return [batch, heads, q_len, head_dim].
+Softmax is computed in float32 regardless of input dtype (bf16-safe,
+SURVEY.md §7 hard-part 5).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def xla_attention(q, k, v, mask=None, scale=None):
+    """Reference einsum attention; XLA fuses mask+softmax into the matmuls."""
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else head_dim ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    weights = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+def dot_product_attention(q, k, v, mask=None, scale=None, impl: str = "xla"):
+    """Dispatch on implementation tier. ``impl='flash'`` requires TPU."""
+    if impl == "flash":
+        from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+        return flash_attention(q, k, v, mask=mask, scale=scale)
+    if impl != "xla":
+        raise ValueError(f"unknown attention impl {impl!r} (xla | flash)")
+    return xla_attention(q, k, v, mask=mask, scale=scale)
+
+
+def make_attention_mask(attention_mask, dtype=jnp.float32, neg=-1e9):
+    """[batch, kv_len] {0,1} padding mask → additive [batch, 1, 1, kv_len].
+
+    The reference feeds HF models a {0,1} ``attention_mask`` built by the
+    tokenizer (``scripts/train.py:75-83``); this converts that contract to
+    the additive-logit form the kernels use.
+    """
+    m = attention_mask[:, None, None, :].astype(dtype)
+    return (1.0 - m) * neg
+
+
+def make_causal_mask(q_len: int, kv_len: int | None = None, dtype=jnp.float32, neg=-1e9):
+    kv_len = kv_len or q_len
+    i = jnp.arange(q_len)[:, None]
+    j = jnp.arange(kv_len)[None, :]
+    return jnp.where(j <= i, 0.0, neg).astype(dtype)[None, None, :, :]

@@ -1,0 +1,39 @@
+"""Loss and metric primitives.
+
+TPU-native replacement for the reference's Keras loss/metric objects:
+``SparseCategoricalCrossentropy(from_logits=True)`` and
+``SparseCategoricalAccuracy`` (reference ``scripts/train.py:118-119``).
+Computed in float32 with explicit validity masking so padded eval
+batches (required by XLA static shapes, SURVEY.md §7 hard-part 2) do not
+pollute metrics — the reference never needed masking because tf.data
+allows a ragged final batch (``scripts/train.py:98-100``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy_with_integer_labels(logits, labels):
+    """Per-example CE in float32. logits [..., C], labels [...] int."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return logz - label_logit
+
+
+def masked_mean(values, mask=None):
+    """Mean over valid entries; mask is {0,1} broadcastable to values."""
+    values = values.astype(jnp.float32)
+    if mask is None:
+        return jnp.mean(values)
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(values * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def accuracy(logits, labels, mask=None):
+    """SparseCategoricalAccuracy parity (reference train.py:119)."""
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == labels).astype(jnp.float32)
+    return masked_mean(correct, mask)

@@ -1,0 +1,94 @@
+"""Pallas fused attention kernel for TPU.
+
+TPU-native replacement for the attention CUDA kernels the reference gets
+through TF (reference ``scripts/train.py:117``). Blocked over query
+positions with the softmax row kept in VMEM: logits for one (batch·head,
+q-block) tile never round-trip to HBM, removing the O(S²) logits traffic
+of the unfused path. K/V for the row live in VMEM (fine to ~4k tokens
+in bf16); sequences beyond one chip's VMEM are the job of the ring
+attention path (``parallel/ring_attention.py``) which wraps this kernel
+per shard.
+
+Numerics match ``ops.attention.xla_attention``: fp32 logits, additive
+mask, fp32 softmax, output cast back to the input dtype (verified in
+``tests/test_pallas_attention.py`` via interpret mode on CPU and on real
+TPU by the bench path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale):
+    q = q_ref[0, 0].astype(jnp.float32)           # [BQ, D]
+    k = k_ref[0, 0].astype(jnp.float32)           # [S, D]
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # [BQ, S]
+    if mask_ref is not None:
+        logits = logits + mask_ref[0].astype(jnp.float32)    # [1, S] → broadcast
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    e = jnp.exp(logits - m)
+    w = e / jnp.sum(e, axis=-1, keepdims=True)
+    v = v_ref[0, 0].astype(jnp.float32)
+    o_ref[0, 0] = jax.lax.dot_general(
+        w, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "block_q", "interpret"))
+def _flash_call(q, k, v, mask, scale, block_q, interpret):
+    batch, heads, q_len, head_dim = q.shape
+    kv_len = k.shape[2]
+    grid = (batch, heads, q_len // block_q)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, kv_len, head_dim), lambda b, h, j: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, kv_len, head_dim), lambda b, h, j: (b, h, 0, 0)),
+    ]
+    args = [q, k, v]
+    if mask is not None:
+        # additive [B,1,1,S] → [B,1,S]; the singleton keeps the last two
+        # block dims equal to the array dims (TPU tiling constraint)
+        mask2 = mask.reshape(batch, 1, kv_len)
+        in_specs.append(pl.BlockSpec((1, 1, kv_len), lambda b, h, j: (b, 0, 0)))
+        args.append(mask2)
+        kernel = functools.partial(_attn_kernel, scale=scale)
+    else:
+        kernel = functools.partial(
+            lambda q_, k_, v_, o_, scale: _attn_kernel(q_, k_, v_, None, o_, scale=scale),
+            scale=scale)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, j: (b, h, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch, heads, q_len, head_dim), q.dtype),
+        interpret=interpret,
+    )(*args)
+
+
+def flash_attention(q, k, v, mask=None, scale=None, block_q: int = 128,
+                    interpret: bool | None = None):
+    """Fused attention. q,k,v: [B, H, S, D]; mask additive, broadcastable
+    to [B, 1, 1, S] (padding masks; [B,H,Q,K] masks fall back to XLA)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import xla_attention
+
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else head_dim ** -0.5
+    q_len = q.shape[2]
+    block_q = min(block_q, q_len)
+    general_mask = mask is not None and (mask.shape[1] > 1 or mask.shape[2] > 1)
+    if q_len % block_q != 0 or general_mask:
+        return xla_attention(q, k, v, mask=mask, scale=scale)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _flash_call(q, k, v, mask, scale, block_q, interpret)

@@ -1,0 +1,18 @@
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+    AXIS_SEQ,
+    data_axis_names,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    named_sharding,
+    param_shardings,
+    replicated,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.distributed import (  # noqa: F401
+    initialize_distributed,
+)

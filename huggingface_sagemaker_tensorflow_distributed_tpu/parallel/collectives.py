@@ -1,0 +1,55 @@
+"""Collective helpers used inside jitted/shard_mapped code.
+
+TPU-native replacement for the reference's L-1 communication layer.
+The reference's entire collective vocabulary (SURVEY.md §5.8) is:
+rendezvous (``scripts/train.py:24``), rank-0 broadcast
+(``scripts/train.py:133``), and per-step gradient allreduce
+(``scripts/train.py:114``) — all implemented in Horovod/NCCL C++.
+Here the same operations are XLA collectives over ICI/DCN: under ``jit``
+with sharded inputs XLA inserts them automatically from sharding
+annotations; under ``shard_map`` (used by the ring-attention path) they
+are written explicitly with ``lax`` primitives. No hand-written
+transport exists because the TPU runtime provides it below XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pmean_over(tree, axis_names):
+    """Mean a pytree over mesh axes — the gradient allreduce of
+    ``hvd.DistributedOptimizer`` (reference ``scripts/train.py:114``),
+    for use inside ``shard_map`` regions."""
+    return jax.tree.map(lambda x: lax.pmean(x, axis_names), tree)
+
+
+def psum_over(tree, axis_names):
+    return jax.tree.map(lambda x: lax.psum(x, axis_names), tree)
+
+
+def ppermute_shift(x, axis_name: str, shift: int = 1):
+    """Ring shift along a mesh axis (building block for ring attention
+    and hand-rolled reduce-scatter). ``shift=1`` sends to the next
+    device on the ring."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def param_fingerprint(params) -> jnp.ndarray:
+    """Cheap replica-divergence detector (SURVEY.md §5.2): a scalar
+    checksum of the param tree. Compare across hosts to detect replica
+    divergence — the failure mode the reference avoids only by
+    convention (its worker-0-checkpoint comment, ``scripts/train.py:135-137``)."""
+    leaves = jax.tree.leaves(params)
+    acc = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        acc = acc + jnp.sum(jnp.asarray(leaf, jnp.float32) ** 2)
+    return acc

@@ -1,0 +1,59 @@
+"""Multi-host runtime initialization and topology queries.
+
+TPU-native replacement for the reference's backend-select + init layer
+(reference ``scripts/train.py:13-31``): where the reference picks
+SMDDP vs Horovod at import time and calls ``hvd.init()`` for MPI/Gloo
+rendezvous, we call ``jax.distributed.initialize`` against the JAX
+coordinator service. Device pinning (``scripts/train.py:27-31``) has no
+TPU equivalent — each host owns its local chips.
+
+The reference's backend-swap capability (SMDDP vs Horovod vs none,
+``launch.py:19-24``) maps to platform selection: a real TPU slice, a
+single chip, or a virtual CPU mesh for tests — same trainer code.
+
+Environment contract (set by our launcher, ``launch/launcher.py``):
+``TPU_COORDINATOR_ADDRESS``, ``TPU_NUM_PROCESSES``, ``TPU_PROCESS_ID``.
+On GCP TPU VMs all three are auto-detected by JAX and may be omitted.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_INITIALIZED = False
+
+
+def initialize_distributed() -> tuple[int, int]:
+    """Initialize multi-host JAX if the env asks for it.
+
+    Returns ``(process_index, process_count)`` — the parity of
+    ``hvd.rank()`` / ``hvd.size()`` at host granularity (reference
+    ``scripts/train.py:112,152``). Safe to call repeatedly and in
+    single-process mode (no coordinator env → no-op).
+    """
+    global _INITIALIZED
+    coord = os.environ.get("TPU_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("TPU_NUM_PROCESSES")
+    pid = os.environ.get("TPU_PROCESS_ID")
+    if not _INITIALIZED and coord and nproc and pid:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+        _INITIALIZED = True
+        logger.info(
+            "distributed init: process %d/%d, coordinator %s",
+            jax.process_index(), jax.process_count(), coord,
+        )
+    return jax.process_index(), jax.process_count()
+
+
+def is_host0() -> bool:
+    return jax.process_index() == 0

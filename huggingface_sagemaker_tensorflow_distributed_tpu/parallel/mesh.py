@@ -1,0 +1,98 @@
+"""Device-mesh construction: the distribution substrate.
+
+TPU-native replacement for the reference's L3 distributed runtime (the
+Horovod-style rank topology at reference ``scripts/train.py:24-31`` and the
+in-process ``tf.distribute.MirroredStrategy`` at
+``scripts/singe_node_train.py:40``). Both of the reference's strategies —
+multi-process DP and single-host mirrored DP — collapse here into ONE
+code path: a ``jax.sharding.Mesh`` whose shape decides the parallelism.
+A 1-chip mesh, an 8-chip host, and a multi-host v5e-32 slice all run the
+same trainer; only the mesh shape differs (SURVEY.md §7 "ambient" model).
+
+Axes:
+
+- ``data``: pure data parallelism (the reference's only axis —
+  ``hvd.size()`` at ``scripts/train.py:112``).
+- ``fsdp``: data parallelism with parameter/optimizer sharding (ZeRO-3
+  style; absent in the reference, SURVEY.md §2).
+- ``tensor``: Megatron-style tensor parallelism inside attention/FFN.
+- ``seq``: sequence/context parallelism (ring attention) for long
+  sequences.
+
+Device order: ``jax.devices()`` orders TPU devices so that nearest
+neighbours on the ICI torus are adjacent; we reshape row-major with
+``data`` outermost and ``tensor``/``seq`` innermost, so the
+bandwidth-hungry tensor/sequence collectives ride intra-host ICI links
+while the once-per-step gradient reduction spans hosts (DCN when
+crossing slices).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "seq"
+
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR)
+
+
+def data_axis_names() -> tuple[str, ...]:
+    """Axes over which a global batch is sharded (and grads reduced)."""
+    return (AXIS_DATA, AXIS_FSDP)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Mesh shape request. ``dp=-1`` absorbs all remaining devices."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        fixed = self.fsdp * self.tp * self.sp
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"fsdp*tp*sp={fixed} does not divide device count {n_devices}"
+            )
+        dp = self.dp if self.dp != -1 else n_devices // fixed
+        if dp * fixed != n_devices:
+            raise ValueError(
+                f"mesh {dp}x{self.fsdp}x{self.sp}x{self.tp} != {n_devices} devices"
+            )
+        return (dp, self.fsdp, self.sp, self.tp)
+
+
+def build_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build the global mesh over all addressable devices.
+
+    Single-chip, single-host and multi-host all go through here; under
+    multi-host each process sees the same global mesh
+    (``jax.devices()`` is global after ``jax.distributed.initialize``) —
+    the TPU-native equivalent of Horovod's rendezvous
+    (reference ``scripts/train.py:24``).
+    """
+    config = config or MeshConfig()
+    devices = devices if devices is not None else jax.devices()
+    shape = config.resolve(len(devices))
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, MESH_AXES)
+
+
+def world_size(mesh: Mesh) -> int:
+    """Total device count — ``hvd.size()`` parity (reference train.py:112)."""
+    return math.prod(mesh.devices.shape)
+
+
+def data_parallel_size(mesh: Mesh) -> int:
+    """Number of data-parallel replicas (data × fsdp axes)."""
+    return mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]

@@ -1,0 +1,7 @@
+from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (  # noqa: F401
+    Trainer,
+    TrainState,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.train.optim import (  # noqa: F401
+    build_optimizer,
+)

@@ -1,0 +1,49 @@
+"""Optimizer construction.
+
+TPU-native replacement for the reference's optimizer setup (reference
+``scripts/train.py:110-114``): Adam with the learning rate linearly
+scaled by world size, then wrapped for gradient allreduce. Here the
+allreduce wrapper does not exist — gradients are averaged across the
+data axes by XLA because the loss is a global mean over a sharded batch;
+optax only ever sees already-reduced gradients.
+
+Beyond reference parity: optional warmup + linear decay schedule,
+decoupled weight decay (AdamW) and global-norm clipping — standard
+fine-tuning practice the reference omits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+
+
+def build_optimizer(
+    config: TrainConfig,
+    world_size: int = 1,
+    total_steps: Optional[int] = None,
+) -> tuple[optax.GradientTransformation, float]:
+    """Returns (optax transformation, scaled base lr)."""
+    lr = config.learning_rate * (world_size if config.scale_lr_by_world_size else 1.0)
+
+    if config.warmup_ratio > 0 and total_steps:
+        warmup = max(1, int(total_steps * config.warmup_ratio))
+        schedule = optax.schedules.warmup_linear_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=warmup,
+            decay_steps=total_steps, end_value=0.0)
+    else:
+        schedule = lr  # constant — reference behavior (train.py:113)
+
+    if config.weight_decay > 0:
+        core = optax.adamw(schedule, weight_decay=config.weight_decay)
+    else:
+        core = optax.adam(schedule)
+
+    parts = []
+    if config.max_grad_norm > 0:
+        parts.append(optax.clip_by_global_norm(config.max_grad_norm))
+    parts.append(core)
+    return optax.chain(*parts), lr

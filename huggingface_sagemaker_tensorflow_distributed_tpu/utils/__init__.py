@@ -1,0 +1,10 @@
+from huggingface_sagemaker_tensorflow_distributed_tpu.utils.logging import (  # noqa: F401
+    get_logger,
+    setup_logging,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.utils.results import (  # noqa: F401
+    write_results_file,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.utils.timing import (  # noqa: F401
+    StepMeter,
+)

@@ -1,0 +1,42 @@
+"""Logging setup.
+
+Capability parity with the reference's logging layer (reference
+``scripts/train.py:55-63`` and ``scripts/singe_node_train.py:32-38``):
+stdlib logging to stdout at INFO with a timestamped format. Improvements
+over the reference: configured once (the reference duplicates the block in
+both entry points), and rank-aware — by default only host 0 logs at INFO
+while other hosts log at WARNING, generalizing the reference's
+rank-0-only Keras verbosity (``scripts/train.py:152``).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+_CONFIGURED = False
+
+
+def setup_logging(level: str = "INFO", process_index: int = 0, all_hosts: bool = False) -> None:
+    """Configure root logging to stdout.
+
+    Non-zero hosts are quieted to WARNING unless ``all_hosts`` is set, so a
+    multi-host job produces one readable stream (the reference instead
+    relies on per-rank ``verbose=`` flags, ``scripts/train.py:152``).
+    """
+    global _CONFIGURED
+    effective = level if (process_index == 0 or all_hosts) else "WARNING"
+    logging.basicConfig(
+        level=logging.getLevelName(effective),
+        handlers=[logging.StreamHandler(sys.stdout)],
+        format=_FORMAT,
+        force=True,
+    )
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    if not _CONFIGURED:
+        setup_logging()
+    return logging.getLogger(name)

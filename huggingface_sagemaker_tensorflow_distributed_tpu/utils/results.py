@@ -1,0 +1,53 @@
+"""Result-file emission: the ``key = value`` contract.
+
+Capability parity with the reference's ``train_results.txt`` /
+``eval_results.txt`` emission (reference ``scripts/train.py:157-179``,
+``scripts/singe_node_train.py:94-116``): one ``key = value`` line per
+metric, written into ``output_data_dir``. Improvement over the reference:
+writes are gated to host 0 (the reference lets every rank write the same
+file, racy on shared filesystems — see its own comment at
+``scripts/train.py:181``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import jax
+
+
+def write_results_file(
+    output_data_dir: str,
+    filename: str,
+    results: Mapping[str, Any],
+    logger=None,
+    host0_only: bool = True,
+) -> str | None:
+    """Write ``key = value`` lines to ``output_data_dir/filename``.
+
+    Returns the path written, or None when skipped on a non-zero host.
+    """
+    if host0_only and jax.process_index() != 0:
+        return None
+    os.makedirs(output_data_dir, exist_ok=True)
+    path = os.path.join(output_data_dir, filename)
+    with open(path, "w") as writer:
+        for key, value in results.items():
+            if logger is not None:
+                logger.info("  %s = %s", key, value)
+            writer.write("%s = %s\n" % (key, value))
+    return path
+
+
+def read_results_file(path: str) -> dict[str, str]:
+    """Parse a ``key = value`` results file back into a dict (for tests)."""
+    out: dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or " = " not in line:
+                continue
+            key, value = line.split(" = ", 1)
+            out[key] = value
+    return out

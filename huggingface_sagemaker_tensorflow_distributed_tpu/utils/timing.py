@@ -1,0 +1,100 @@
+"""Wall-clock timing and throughput meters.
+
+Capability parity with the reference's ``train_runtime`` measurement
+(``time.time()`` bracketing ``model.fit``, reference
+``scripts/train.py:142,154``), extended with the per-step samples/sec/chip
+meter that the north-star metric requires (BASELINE.md): the reference has
+no throughput instrumentation at all.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class StepMeter:
+    """Accumulates step wall-times and computes throughput.
+
+    ``skip_first`` steps are excluded from throughput (first step pays XLA
+    compilation, ~20-40s on TPU).
+    """
+
+    n_chips: int = 1
+    skip_first: int = 1
+    _t0: float | None = None
+    _steps: int = 0
+    _samples: int = 0
+    _measured_time: float = 0.0
+    _measured_samples: int = 0
+    _measured_steps: int = 0
+    _epoch_times: list = field(default_factory=list)
+    _w0: float | None = None
+    _w_samples: int = 0
+    _w_steps: int = 0
+
+    def start_step(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end_step(self, batch_samples: int) -> float:
+        dt = time.perf_counter() - self._t0
+        self._steps += 1
+        self._samples += batch_samples
+        if self._steps > self.skip_first:
+            self._measured_time += dt
+            self._measured_samples += batch_samples
+            self._measured_steps += 1
+        return dt
+
+    # -- window API: measure between explicit device-sync points, so the
+    # train loop never has to block per step (async dispatch preserved) --
+
+    def begin_window(self) -> None:
+        self._w0 = time.perf_counter()
+        self._w_samples = 0
+        self._w_steps = 0
+
+    def window_step(self, batch_samples: int) -> None:
+        self._w_samples += batch_samples
+        self._w_steps += 1
+
+    def end_window(self) -> None:
+        """Call right after a device sync; attributes the window's wall
+        time to the samples dispatched inside it."""
+        if self._w0 is None:
+            return
+        self._measured_time += time.perf_counter() - self._w0
+        self._measured_samples += self._w_samples
+        self._measured_steps += self._w_steps
+        self._steps += self._w_steps
+        self._samples += self._w_samples
+        self._w0 = None
+
+    @property
+    def samples_per_sec(self) -> float:
+        if self._measured_time == 0:
+            return 0.0
+        return self._measured_samples / self._measured_time
+
+    @property
+    def samples_per_sec_per_chip(self) -> float:
+        return self.samples_per_sec / max(1, self.n_chips)
+
+    @property
+    def avg_step_time(self) -> float:
+        if self._measured_steps == 0:
+            return 0.0
+        return self._measured_time / self._measured_steps
+
+
+class Stopwatch:
+    """``train_runtime`` bracket (reference ``scripts/train.py:142,154``)."""
+
+    def __enter__(self):
+        self.start = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = round(time.time() - self.start, 4)
+        return False

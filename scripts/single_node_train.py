@@ -1,0 +1,30 @@
+"""Single-node trainer — parity alias.
+
+The reference ships a separate ``MirroredStrategy`` trainer
+(``scripts/singe_node_train.py`` — typo in the reference filename) because
+its multi-worker path needs Horovod rank juggling. In this framework
+distribution is ambient in the mesh, so single-node IS the same program;
+this alias exists for launcher/entry-point parity (reference
+``launch.py:39-40`` swaps entry points) and disables the world-size LR
+scaling exactly as the reference's single-node script does (it compiles a
+plain ``Adam(lr)``, ``singe_node_train.py:78``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from train import main as _main  # noqa: E402
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--scale_lr_by_world_size" not in " ".join(argv):
+        argv += ["--scale_lr_by_world_size", "false"]
+    return _main(argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Test harness: virtual 8-device CPU mesh (SURVEY.md §4).
+
+Forces JAX onto 8 fake CPU devices so the REAL mesh/pjit/collective code
+paths run with no TPU and no cluster — the JAX-native fake backend. Must
+run before any backend initialization: the env var seeds XLA, and
+``jax.config.update`` overrides the axon/TPU platform this container
+pins via ``JAX_PLATFORMS``.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8 and devs[0].platform == "cpu"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,67 @@
+"""Config layer tests: typed parsing fixes the reference's stringly-typed
+bugs (SURVEY.md §2 behavioral quirks)."""
+
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig, parse_args
+
+
+def test_defaults_match_reference_contract():
+    # reference defaults: epochs=3, train_batch_size=8, eval_batch_size=4,
+    # lr=5e-5 (scripts/train.py:39-43)
+    cfg = TrainConfig()
+    assert cfg.epochs == 3
+    assert cfg.train_batch_size == 8
+    assert cfg.eval_batch_size == 4
+    assert cfg.learning_rate == pytest.approx(5e-5)
+    assert cfg.do_train is True and cfg.do_eval is True
+
+
+def test_learning_rate_is_float_not_str():
+    # the reference's --learning_rate was type=str: "5e-5" * 8 = string
+    # repetition (scripts/train.py:43,112). Ours parses to float.
+    cfg = parse_args(["--learning_rate", "5e-5"])
+    assert isinstance(cfg.learning_rate, float)
+    assert cfg.learning_rate * 8 == pytest.approx(4e-4)
+
+
+def test_bool_flags_actually_turn_off():
+    # reference: bool("False") is True so --do_train False couldn't disable
+    # training (scripts/train.py:44-45). Ours can.
+    cfg = parse_args(["--do_train", "False", "--do_eval", "0"])
+    assert cfg.do_train is False and cfg.do_eval is False
+
+
+def test_sm_env_contract(monkeypatch):
+    monkeypatch.setenv("SM_OUTPUT_DATA_DIR", "/tmp/sm_out")
+    monkeypatch.setenv("SM_MODEL_DIR", "/tmp/sm_model")
+    cfg = parse_args([])
+    assert cfg.output_data_dir == "/tmp/sm_out"
+    assert cfg.model_dir == "/tmp/sm_model"
+
+
+def test_tpu_env_overrides_sm(monkeypatch):
+    monkeypatch.setenv("SM_OUTPUT_DATA_DIR", "/tmp/sm_out")
+    monkeypatch.setenv("TPU_OUTPUT_DATA_DIR", "/tmp/tpu_out")
+    cfg = parse_args([])
+    assert cfg.output_data_dir == "/tmp/tpu_out"
+
+
+def test_unknown_args_tolerated():
+    # parse_known_args parity (scripts/train.py:52)
+    cfg = parse_args(["--epochs", "1", "--platform_injected_junk", "x"])
+    assert cfg.epochs == 1
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        TrainConfig(task="nope")
+    with pytest.raises(ValueError):
+        TrainConfig(learning_rate=-1.0)
+    with pytest.raises(ValueError):
+        TrainConfig(tp=0)
+
+
+def test_roundtrip():
+    cfg = TrainConfig(epochs=5, tp=2)
+    assert TrainConfig.from_dict(cfg.to_dict()) == cfg

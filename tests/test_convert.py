@@ -1,0 +1,64 @@
+"""Forward/reverse name-table consistency: params → HF layout → params
+must be the identity for every family and head, with no silently dropped
+tensors (SURVEY.md §7 hard-part 1)."""
+
+import numpy as np
+import jax
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import build_model, init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import bert_config_from_hf
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.convert import (
+    hf_to_params,
+    merge_into,
+    params_to_hf,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.distilbert import (
+    distilbert_config_from_hf,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.roberta import (
+    roberta_config_from_hf,
+)
+
+_HF_CFGS = {
+    "bert": (bert_config_from_hf, {
+        "vocab_size": 64, "hidden_size": 16, "num_hidden_layers": 2,
+        "num_attention_heads": 2, "intermediate_size": 32,
+        "max_position_embeddings": 32}),
+    "roberta": (roberta_config_from_hf, {
+        "vocab_size": 64, "hidden_size": 16, "num_hidden_layers": 2,
+        "num_attention_heads": 2, "intermediate_size": 32,
+        "max_position_embeddings": 34, "pad_token_id": 1}),
+    "distilbert": (distilbert_config_from_hf, {
+        "vocab_size": 64, "dim": 16, "n_layers": 2, "n_heads": 2,
+        "hidden_dim": 32, "max_position_embeddings": 32}),
+}
+
+
+def _count_leaves(tree):
+    return len(jax.tree.leaves(tree))
+
+
+@pytest.mark.parametrize("family", ["bert", "roberta", "distilbert"])
+@pytest.mark.parametrize("task", ["seq-cls", "token-cls", "qa"])
+def test_roundtrip_identity(family, task):
+    builder, hf_cfg = _HF_CFGS[family]
+    overrides = {}
+    if family == "bert" and task != "seq-cls":
+        overrides["use_pooler"] = False
+    config = builder(hf_cfg, **overrides)
+    model = build_model(family, task, config, num_labels=3)
+    params = init_params(model, config, seed=0)
+
+    state = params_to_hf(params, family)
+    # every leaf must survive the forward translation
+    assert len(state) == _count_leaves(params), (
+        f"{family}/{task}: {_count_leaves(params)} params but "
+        f"{len(state)} exported tensors — a reverse rule is missing")
+
+    back = hf_to_params(state, family)
+    merged, missing = merge_into(params, back)
+    assert missing == [], f"{family}/{task}: unmapped on re-import: {missing}"
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(merged)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

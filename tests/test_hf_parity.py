@@ -1,0 +1,135 @@
+"""Model-numerics parity vs HF torch implementations (SURVEY.md §7
+stage 2: tolerance ~1e-4 on CPU fp32). Tiny configs are instantiated
+locally — no network. Covers checkpoint conversion fidelity (hard-part 1)
+in both directions: HF→ours (from_pretrained) and ours→HF (export)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models  # noqa: E402
+
+TOL = 2e-4
+
+
+def _inputs(vocab, batch=3, seq=12, pad_id=0, seed=0):
+    r = np.random.RandomState(seed)
+    ids = r.randint(pad_id + 1, vocab, (batch, seq))
+    mask = np.ones((batch, seq), np.int64)
+    mask[1, 8:] = 0
+    ids[1, 8:] = pad_id
+    return ids, mask
+
+
+def _compare(tiny_torch, model_dir, task, ids, mask, extra_tol=1.0):
+    model, params, family, cfg = auto_models.from_pretrained(
+        model_dir, task=task, num_labels=2)
+    with torch.no_grad():
+        t_out = tiny_torch(input_ids=torch.tensor(ids),
+                           attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+                        deterministic=True)
+    if task == "qa":
+        for t, j in [(t_out.start_logits, j_out[0]), (t_out.end_logits, j_out[1])]:
+            np.testing.assert_allclose(np.asarray(j), t.numpy(),
+                                       atol=TOL * extra_tol, rtol=1e-3)
+    else:
+        np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                                   atol=TOL * extra_tol, rtol=1e-3)
+    return model, params, family, cfg
+
+
+@pytest.fixture(scope="module")
+def bert_dir(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=3,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    d = str(tmp_path_factory.mktemp("bert"))
+    m = transformers.BertForSequenceClassification(cfg).eval()
+    m.save_pretrained(d)
+    return d, m, cfg
+
+
+def test_bert_seq_cls_parity(bert_dir):
+    d, m, _ = bert_dir
+    ids, mask = _inputs(128)
+    _compare(m, d, "seq-cls", ids, mask)
+
+
+def test_bert_qa_parity(bert_dir, tmp_path):
+    _, _, cfg = bert_dir
+    torch.manual_seed(1)
+    m = transformers.BertForQuestionAnswering(cfg).eval()
+    m.save_pretrained(str(tmp_path))
+    ids, mask = _inputs(128, seed=1)
+    _compare(m, str(tmp_path), "qa", ids, mask)
+
+
+def test_bert_token_cls_parity(bert_dir, tmp_path):
+    _, _, cfg = bert_dir
+    torch.manual_seed(2)
+    m = transformers.BertForTokenClassification(cfg).eval()
+    m.save_pretrained(str(tmp_path))
+    ids, mask = _inputs(128, seed=2)
+    _compare(m, str(tmp_path), "token-cls", ids, mask)
+
+
+def test_roberta_seq_cls_parity(tmp_path):
+    torch.manual_seed(3)
+    cfg = transformers.RobertaConfig(
+        vocab_size=100, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=70, type_vocab_size=1, pad_token_id=1,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    m = transformers.RobertaForSequenceClassification(cfg).eval()
+    m.save_pretrained(str(tmp_path))
+    ids, mask = _inputs(100, pad_id=1, seed=3)
+    _compare(m, str(tmp_path), "seq-cls", ids, mask)
+
+
+def test_distilbert_seq_cls_parity(tmp_path):
+    torch.manual_seed(4)
+    cfg = transformers.DistilBertConfig(
+        vocab_size=120, dim=32, n_layers=2, n_heads=2, hidden_dim=64,
+        max_position_embeddings=64, dropout=0.0, attention_dropout=0.0,
+        seq_classif_dropout=0.0)
+    m = transformers.DistilBertForSequenceClassification(cfg).eval()
+    m.save_pretrained(str(tmp_path))
+    ids, mask = _inputs(120, seed=4)
+    _compare(m, str(tmp_path), "seq-cls", ids, mask)
+
+
+def test_export_roundtrip_loads_in_hf(bert_dir, tmp_path):
+    """save_pretrained parity (reference train.py:182-183): our export
+    must be loadable by HF transformers and produce identical logits."""
+    d, m, _ = bert_dir
+    ids, mask = _inputs(128, seed=5)
+    model, params, family, cfg = auto_models.from_pretrained(d, task="seq-cls")
+    out_dir = str(tmp_path / "export")
+    auto_models.save_pretrained(out_dir, params, family, cfg)
+    reloaded = transformers.BertForSequenceClassification.from_pretrained(out_dir).eval()
+    with torch.no_grad():
+        a = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)).logits
+        b = reloaded(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)).logits
+    np.testing.assert_allclose(b.numpy(), a.numpy(), atol=1e-5)
+
+
+def test_fresh_head_when_checkpoint_lacks_it(tmp_path):
+    """Loading a bare backbone for a new task initializes the head fresh
+    (HF from_pretrained behavior at reference train.py:117)."""
+    torch.manual_seed(6)
+    cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32, max_position_embeddings=32)
+    m = transformers.BertModel(cfg).eval()
+    d = str(tmp_path)
+    m.save_pretrained(d)
+    # state dict has no "bert." prefix and no classifier — both handled
+    model, params, family, _ = auto_models.from_pretrained(d, task="seq-cls")
+    assert "classifier" in params

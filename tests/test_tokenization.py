@@ -1,0 +1,66 @@
+"""Tokenization interface tests (reference contract: input_ids +
+attention_mask, static [N, max_length] shapes — scripts/train.py:75-83)."""
+
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
+    WordHashTokenizer,
+    load_tokenizer,
+)
+
+
+def test_shapes_and_mask():
+    tok = WordHashTokenizer(vocab_size=1000)
+    out = tok(["hello world", "a much longer sentence with more words"],
+              max_length=16)
+    assert out["input_ids"].shape == (2, 16)
+    assert out["attention_mask"].shape == (2, 16)
+    assert out["attention_mask"][0].sum() == 4  # CLS hello world SEP
+    assert out["input_ids"][0, 0] == tok.cls_token_id
+    # padding is pad_token_id where mask is 0
+    assert (out["input_ids"][out["attention_mask"] == 0] == tok.pad_token_id).all()
+
+
+def test_determinism_across_instances():
+    a = WordHashTokenizer()(["some review text"], max_length=8)
+    b = WordHashTokenizer()(["some review text"], max_length=8)
+    np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+
+
+def test_truncation():
+    tok = WordHashTokenizer()
+    out = tok(["w " * 100], max_length=10)
+    assert out["input_ids"].shape == (1, 10)
+    assert out["attention_mask"].sum() == 10
+
+
+def test_padding_longest():
+    tok = WordHashTokenizer()
+    out = tok(["a b", "a b c d"], padding="longest", max_length=512)
+    assert out["input_ids"].shape[1] == 6  # CLS a b c d SEP
+
+
+def test_text_pairs():
+    tok = WordHashTokenizer()
+    out = tok(["question here"], text_pairs=["context here"], max_length=16)
+    # CLS q here SEP c here SEP = 7 tokens
+    assert out["attention_mask"][0].sum() == 7
+    # segment ids: 0 for first sentence incl. its SEP, 1 for the pair
+    np.testing.assert_array_equal(out["token_type_ids"][0][:7],
+                                  [0, 0, 0, 0, 1, 1, 1])
+
+
+def test_save_load_roundtrip(tmp_path):
+    tok = WordHashTokenizer(vocab_size=555)
+    tok.save_pretrained(str(tmp_path))
+    tok2 = load_tokenizer(str(tmp_path))
+    assert isinstance(tok2, WordHashTokenizer)
+    assert tok2.vocab_size == 555
+    a = tok(["same text"], max_length=8)
+    b = tok2(["same text"], max_length=8)
+    np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+
+
+def test_fallback_for_missing_dir():
+    tok = load_tokenizer("not-a-local-dir-hub-name")
+    assert isinstance(tok, WordHashTokenizer)

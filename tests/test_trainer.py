@@ -1,0 +1,154 @@
+"""Trainer engine tests: end-to-end learning, single-device vs 8-way DP
+parity (same seed → same loss curve, SURVEY.md §4), results-file
+contract, eval aggregation with padded tails."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+    ArrayDataset,
+    ShardedBatcher,
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+    synthetic_text_classification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+    BertForSequenceClassification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import MeshConfig, build_mesh
+from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+from huggingface_sagemaker_tensorflow_distributed_tpu.utils.results import read_results_file
+
+SEQ = 32
+
+
+def _tiny_model(seed=0, vocab=512):
+    cfg = EncoderConfig(vocab_size=vocab, hidden_size=32, num_layers=2,
+                        num_heads=2, intermediate_size=64,
+                        max_position_embeddings=SEQ)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    return model, init_params(model, cfg, seed=seed)
+
+
+def _data(n=256, seed=0, vocab=512):
+    tok = WordHashTokenizer(vocab_size=vocab)
+    texts, labels = synthetic_text_classification(n, seed=seed)
+    return ArrayDataset.from_texts(tok, texts, labels, max_length=SEQ)
+
+
+def test_training_learns(tmp_path):
+    cfg = TrainConfig(epochs=3, train_batch_size=2, dtype="float32",
+                      learning_rate=1e-3, scale_lr_by_world_size=False,
+                      output_data_dir=str(tmp_path), log_every_steps=0)
+    mesh = build_mesh(MeshConfig())
+    model, params = _tiny_model()
+    trainer = Trainer(cfg, model, params, mesh)
+    batcher = ShardedBatcher(_data(), 16, mesh, shuffle=True, seed=0)
+    hist = trainer.fit(batcher)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.95
+    assert hist["sparse_categorical_accuracy"][-1] > 0.8
+    assert hist["train_runtime"] > 0
+
+
+def test_dp8_matches_dp1_loss_curve(devices8):
+    """The distributed-parity test the reference could never run without a
+    cluster (SURVEY.md §4): same global batch + seed on a 1-device mesh vs
+    an 8-way DP mesh must give the same loss sequence (fp32)."""
+    losses = {}
+    for n_dev in (1, 8):
+        mesh = build_mesh(MeshConfig(), devices=devices8[:n_dev])
+        cfg = TrainConfig(epochs=1, dtype="float32", learning_rate=1e-3,
+                          scale_lr_by_world_size=False, log_every_steps=0)
+        model, params = _tiny_model(seed=0)
+        trainer = Trainer(cfg, model, params, mesh)
+        batcher = ShardedBatcher(_data(n=64, seed=0), 16, mesh,
+                                 shuffle=True, seed=0)
+        run = []
+        for batch in batcher.global_arrays(0):
+            trainer.state, metrics = trainer._train_step(trainer.state, batch)
+            run.append(float(jax.device_get(metrics["loss"])))
+        losses[n_dev] = run
+    np.testing.assert_allclose(losses[8], losses[1], atol=1e-5)
+
+
+def test_lr_world_size_scaling():
+    # reference semantics: lr × hvd.size() (scripts/train.py:112)
+    mesh = build_mesh(MeshConfig())  # 8 devices
+    cfg = TrainConfig(dtype="float32")
+    model, params = _tiny_model()
+    trainer = Trainer(cfg, model, params, mesh)
+    assert trainer.scaled_lr == pytest.approx(5e-5 * 8)
+    cfg2 = TrainConfig(dtype="float32", scale_lr_by_world_size=False)
+    trainer2 = Trainer(cfg2, model, params, mesh)
+    assert trainer2.scaled_lr == pytest.approx(5e-5)
+
+
+def test_eval_with_padded_tail_is_exact():
+    """Eval over a non-divisible dataset must average over exactly N
+    examples (padded rows masked out) — the XLA static-shape answer to
+    tf.data's ragged final batch (reference train.py:98-100)."""
+    mesh = build_mesh(MeshConfig())
+    cfg = TrainConfig(dtype="float32", log_every_steps=0)
+    model, params = _tiny_model()
+    trainer = Trainer(cfg, model, params, mesh)
+    ds = _data(n=40)  # 40 % 16 = 8 → padded tail
+    full = trainer.evaluate(ShardedBatcher(ds, 16, mesh, shuffle=False,
+                                           drop_remainder=False))
+    # brute-force reference: per-example loss over all 40, no padding
+    ids = jnp.asarray(ds.columns["input_ids"])
+    mask = jnp.asarray(ds.columns["attention_mask"])
+    labels = jnp.asarray(ds.columns["labels"])
+    logits = model.apply({"params": trainer.state.params}, ids, mask,
+                         deterministic=True)
+    logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    ce = logz - jnp.take_along_axis(logits.astype(jnp.float32),
+                                    labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    expected_loss = float(jnp.mean(ce))
+    expected_acc = float(jnp.mean((jnp.argmax(logits, -1) == labels)))
+    assert full["eval_loss"] == pytest.approx(expected_loss, abs=1e-5)
+    assert full["eval_accuracy"] == pytest.approx(expected_acc, abs=1e-6)
+
+
+def test_results_files_contract(tmp_path):
+    """train_results.txt / eval_results.txt key = value emission
+    (reference train.py:157-179)."""
+    cfg = TrainConfig(epochs=1, dtype="float32", learning_rate=1e-3,
+                      output_data_dir=str(tmp_path), log_every_steps=0)
+    mesh = build_mesh(MeshConfig())
+    model, params = _tiny_model()
+    trainer = Trainer(cfg, model, params, mesh)
+    batcher = ShardedBatcher(_data(n=64), 16, mesh, seed=0)
+    hist = trainer.fit(batcher)
+    trainer.write_train_results(hist)
+    trainer.write_eval_results(trainer.evaluate(
+        ShardedBatcher(_data(n=32, seed=5), 16, mesh, shuffle=False,
+                       drop_remainder=False)))
+    train_res = read_results_file(str(tmp_path / "train_results.txt"))
+    assert "loss" in train_res and "train_runtime" in train_res
+    assert "train_samples_per_second_per_chip" in train_res
+    eval_res = read_results_file(str(tmp_path / "eval_results.txt"))
+    assert "eval_loss" in eval_res and "eval_accuracy" in eval_res
+
+
+def test_bf16_compute_runs():
+    mesh = build_mesh(MeshConfig())
+    cfg = TrainConfig(dtype="bfloat16", log_every_steps=0)
+    mcfg = EncoderConfig(vocab_size=512, hidden_size=32, num_layers=1,
+                         num_heads=2, intermediate_size=64,
+                         max_position_embeddings=SEQ, dtype=jnp.bfloat16)
+    model = BertForSequenceClassification(mcfg, num_labels=2)
+    params = init_params(model, mcfg)
+    trainer = Trainer(cfg, model, params, mesh)
+    batcher = ShardedBatcher(_data(n=32), 16, mesh, seed=0)
+    batch = next(batcher.global_arrays(0))
+    trainer.state, metrics = trainer._train_step(trainer.state, batch)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    # params stay fp32 (param_dtype) under bf16 compute
+    leaf = jax.tree.leaves(trainer.state.params)[0]
+    assert leaf.dtype == jnp.float32
