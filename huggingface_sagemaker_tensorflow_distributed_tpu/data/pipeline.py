@@ -65,6 +65,38 @@ class ArrayDataset:
             cols["labels"] = np.asarray(labels, np.int32)
         return cls(cols)
 
+    @classmethod
+    def from_token_classification(cls, tokenizer, sentences, word_tags,
+                                  max_length: int = 512) -> "ArrayDataset":
+        """Word-level NER → token-level labels, -100 on specials/pads and
+        on continuation subwords (label only the first subword of each
+        word — the HF convention the token-cls loss masks on)."""
+        enc = tokenizer.encode_words(sentences, max_length=max_length)
+        word_ids = enc["word_ids"]
+        n, L = word_ids.shape
+        labels = np.full((n, L), -100, np.int32)
+        for r in range(n):
+            tags = word_tags[r]
+            prev = -1
+            for t in range(L):
+                w = word_ids[r, t]
+                if w < 0 or w >= len(tags):
+                    continue
+                if w != prev:
+                    labels[r, t] = tags[w]
+                prev = w
+        return cls({"input_ids": enc["input_ids"],
+                    "attention_mask": enc["attention_mask"],
+                    "labels": labels})
+
+    @classmethod
+    def from_qa(cls, tokenizer, questions, contexts, start_chars, answer_texts,
+                max_length: int = 512) -> "ArrayDataset":
+        """SQuAD-style spans → start/end token positions."""
+        enc = tokenizer.encode_qa(questions, contexts, start_chars,
+                                  answer_texts, max_length=max_length)
+        return cls(dict(enc))
+
 
 class ShardedBatcher:
     """Iterates global batches, yielding this host's shard of each.

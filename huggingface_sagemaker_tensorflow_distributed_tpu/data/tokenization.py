@@ -90,6 +90,75 @@ class WordHashTokenizer:
             out["token_type_ids"] = token_type_ids
         return out
 
+    def encode_words(self, word_lists, max_length: int | None = None):
+        """Pre-split words → ids with word alignment (NER path).
+
+        Returns input_ids, attention_mask, and ``word_ids`` (same shape;
+        -1 for CLS/SEP/PAD) mapping each token to its source word — the
+        alignment HF fast tokenizers expose via ``word_ids()``. One token
+        per word here, so alignment is the identity.
+        """
+        max_length = max_length or self.model_max_length
+        n = len(word_lists)
+        input_ids = np.full((n, max_length), self.pad_token_id, np.int32)
+        attention_mask = np.zeros((n, max_length), np.int32)
+        word_ids = np.full((n, max_length), -1, np.int32)
+        for r, words in enumerate(word_lists):
+            if self.lowercase:
+                words = [w.lower() for w in words]
+            ids = [self.cls_token_id] + [self._word_id(w) for w in words] + [self.sep_token_id]
+            wids = [-1] + list(range(len(words))) + [-1]
+            ids, wids = ids[:max_length], wids[:max_length]
+            input_ids[r, : len(ids)] = ids
+            attention_mask[r, : len(ids)] = 1
+            word_ids[r, : len(wids)] = wids
+        return {"input_ids": input_ids, "attention_mask": attention_mask,
+                "word_ids": word_ids}
+
+    def encode_qa(self, questions, contexts, start_chars, answer_texts,
+                  max_length: int | None = None):
+        """Question+context pairs → ids with answer span token positions.
+
+        Char-offset → token-index mapping via the same regex the word
+        hashing uses; spans truncated away land on position 0 (CLS), the
+        HF convention for unanswerable-after-truncation.
+        """
+        max_length = max_length or self.model_max_length
+        n = len(questions)
+        input_ids = np.full((n, max_length), self.pad_token_id, np.int32)
+        attention_mask = np.zeros((n, max_length), np.int32)
+        token_type_ids = np.zeros((n, max_length), np.int32)
+        start_positions = np.zeros(n, np.int32)
+        end_positions = np.zeros(n, np.int32)
+        for r in range(n):
+            q = questions[r].lower() if self.lowercase else questions[r]
+            c = contexts[r].lower() if self.lowercase else contexts[r]
+            q_ids = [self._word_id(w) for w in re.findall(r"\w+|[^\w\s]", q)]
+            ctx_spans = [(m.group(0), m.start(), m.end())
+                         for m in re.finditer(r"\w+|[^\w\s]", c)]
+            c_ids = [self._word_id(w) for w, _, _ in ctx_spans]
+            ids = [self.cls_token_id] + q_ids + [self.sep_token_id] + c_ids + [self.sep_token_id]
+            segs = [0] * (len(q_ids) + 2) + [1] * (len(c_ids) + 1)
+            ctx_offset = len(q_ids) + 2  # token index of first context token
+            a_start = start_chars[r]
+            a_end = a_start + len(answer_texts[r])
+            tok_start = tok_end = None
+            for t, (_, s, e) in enumerate(ctx_spans):
+                if s < a_end and e > a_start:  # overlap
+                    if tok_start is None:
+                        tok_start = ctx_offset + t
+                    tok_end = ctx_offset + t
+            ids, segs = ids[:max_length], segs[:max_length]
+            input_ids[r, : len(ids)] = ids
+            attention_mask[r, : len(ids)] = 1
+            token_type_ids[r, : len(segs)] = segs
+            if tok_start is not None and tok_end < max_length:
+                start_positions[r] = tok_start
+                end_positions[r] = tok_end
+        return {"input_ids": input_ids, "attention_mask": attention_mask,
+                "token_type_ids": token_type_ids,
+                "start_positions": start_positions, "end_positions": end_positions}
+
     def save_pretrained(self, output_dir: str) -> None:
         os.makedirs(output_dir, exist_ok=True)
         with open(os.path.join(output_dir, "word_hash_tokenizer.json"), "w") as f:
@@ -125,6 +194,59 @@ class HFTokenizer:
             res["token_type_ids"] = out["token_type_ids"].astype(np.int32)
         return res
 
+
+    def encode_words(self, word_lists, max_length: int | None = None):
+        """Pre-split words → subword ids + word alignment (fast-tokenizer
+        ``word_ids()``; -1 for specials/pads)."""
+        max_length = max_length or self.model_max_length
+        out = self._tok(word_lists, is_split_into_words=True, truncation=True,
+                        padding="max_length", max_length=max_length,
+                        return_tensors="np")
+        n = len(word_lists)
+        word_ids = np.full((n, max_length), -1, np.int32)
+        for r in range(n):
+            for t, w in enumerate(out.word_ids(r)):
+                if w is not None:
+                    word_ids[r, t] = w
+        return {"input_ids": out["input_ids"].astype(np.int32),
+                "attention_mask": out["attention_mask"].astype(np.int32),
+                "word_ids": word_ids}
+
+    def encode_qa(self, questions, contexts, start_chars, answer_texts,
+                  max_length: int | None = None):
+        """Question+context → ids + answer token span via offset mapping."""
+        max_length = max_length or self.model_max_length
+        out = self._tok(questions, contexts, truncation="only_second",
+                        padding="max_length", max_length=max_length,
+                        return_offsets_mapping=True, return_tensors="np")
+        n = len(questions)
+        start_positions = np.zeros(n, np.int32)
+        end_positions = np.zeros(n, np.int32)
+        offsets = out["offset_mapping"]
+        for r in range(n):
+            a_start = start_chars[r]
+            a_end = a_start + len(answer_texts[r])
+            seq_ids = out.sequence_ids(r)
+            tok_start = tok_end = None
+            for t, (s, e) in enumerate(offsets[r]):
+                if seq_ids[t] != 1 or e == s:
+                    continue
+                if s < a_end and e > a_start:
+                    if tok_start is None:
+                        tok_start = t
+                    tok_end = t
+            # only label spans that contain the FULL answer; partially
+            # truncated answers fall back to (0,0)/CLS like the WordHash
+            # path and HF's run_qa convention
+            if tok_start is not None and offsets[r][tok_end][1] >= a_end:
+                start_positions[r] = tok_start
+                end_positions[r] = tok_end
+        res = {"input_ids": out["input_ids"].astype(np.int32),
+               "attention_mask": out["attention_mask"].astype(np.int32),
+               "start_positions": start_positions, "end_positions": end_positions}
+        if "token_type_ids" in out:
+            res["token_type_ids"] = out["token_type_ids"].astype(np.int32)
+        return res
 
     def save_pretrained(self, output_dir: str) -> None:
         self._tok.save_pretrained(output_dir)

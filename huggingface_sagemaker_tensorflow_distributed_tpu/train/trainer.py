@@ -115,8 +115,9 @@ def qa_loss(apply_fn, params, batch, rngs, train: bool):
     valid = batch.get("valid", jnp.ones(start_logits.shape[0]))
     s_ce = softmax_cross_entropy_with_integer_labels(start_logits, batch["start_positions"])
     e_ce = softmax_cross_entropy_with_integer_labels(end_logits, batch["end_positions"])
-    s_ok = jnp.argmax(start_logits, -1) == batch["start_positions"]
-    e_ok = jnp.argmax(end_logits, -1) == batch["end_positions"]
+    # cast before adding: bool + bool is logical OR, not arithmetic
+    s_ok = (jnp.argmax(start_logits, -1) == batch["start_positions"]).astype(jnp.float32)
+    e_ok = (jnp.argmax(end_logits, -1) == batch["end_positions"]).astype(jnp.float32)
     return _masked_sums(0.5 * (s_ce + e_ce), 0.5 * (s_ok + e_ok), valid)
 
 

@@ -32,7 +32,11 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
     ShardedBatcher,
     load_tokenizer,
 )
-from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import load_text_classification
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+    load_qa,
+    load_text_classification,
+    load_token_classification,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
     MeshConfig,
@@ -50,6 +54,38 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.utils import (
 import jax.numpy as jnp
 
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}
+
+
+def _check_num_labels(labels, num_labels: int, task: str) -> None:
+    """Out-of-range labels would be silently clamped by the gather inside
+    the jitted CE loss — fail loudly at data-build time instead."""
+    top = max((l for l in labels if l >= 0), default=0)
+    if top >= num_labels:
+        raise ValueError(
+            f"{task}: dataset contains label {top} but --num_labels is "
+            f"{num_labels}; pass --num_labels {top + 1} (conll2003 needs 9)")
+
+
+def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
+                  max_samples) -> ArrayDataset:
+    """Task-specific load+tokenize: seq-cls (reference parity), token-cls
+    (CoNLL), extractive QA (SQuAD) — each with a synthetic offline tier."""
+    kw = dict(dataset_path=config.dataset_path, max_samples=max_samples,
+              seed=config.seed)
+    if config.task == "seq-cls":
+        texts, labels = load_text_classification(config.dataset, split, **kw)
+        _check_num_labels(labels, config.num_labels, config.task)
+        return ArrayDataset.from_texts(tokenizer, texts, labels, max_len)
+    if config.task == "token-cls":
+        sents, tags = load_token_classification(config.dataset, split, **kw)
+        _check_num_labels([t for ts in tags for t in ts], config.num_labels,
+                          config.task)
+        return ArrayDataset.from_token_classification(tokenizer, sents, tags, max_len)
+    if config.task == "qa":
+        questions, contexts, starts, answers = load_qa(config.dataset, split, **kw)
+        return ArrayDataset.from_qa(tokenizer, questions, contexts, starts,
+                                    answers, max_len)
+    raise ValueError(f"no data path for task {config.task!r}")
 
 
 def main(argv=None) -> dict:
@@ -78,16 +114,12 @@ def main(argv=None) -> dict:
     tokenizer = load_tokenizer(config.model_name_or_path,
                                vocab_size=model_config.vocab_size)
 
-    # --- data (reference train.py:72-100), per-host sharded ---
+    # --- data (reference train.py:72-100), per-host sharded, task-aware ---
     max_len = min(config.max_seq_length, model_config.max_position_embeddings)
-    train_texts, train_labels = load_text_classification(
-        config.dataset, "train", config.dataset_path,
-        config.max_train_samples, seed=config.seed)
-    eval_texts, eval_labels = load_text_classification(
-        config.dataset, "test", config.dataset_path,
-        config.max_eval_samples, seed=config.seed)
-    train_ds = ArrayDataset.from_texts(tokenizer, train_texts, train_labels, max_len)
-    eval_ds = ArrayDataset.from_texts(tokenizer, eval_texts, eval_labels, max_len)
+    train_ds = build_dataset(config, tokenizer, "train", max_len,
+                             config.max_train_samples)
+    eval_ds = build_dataset(config, tokenizer, "test", max_len,
+                            config.max_eval_samples)
 
     # Global batch = per-replica batch × data-parallel replicas (reference
     # semantics at train.py:143-144). tp/sp devices within a replica do

@@ -1,0 +1,106 @@
+"""Task-breadth tests: token-classification (CoNLL-shaped) and extractive
+QA (SQuAD-shaped) — alignment correctness and end-to-end learning on the
+synthetic offline tier (BASELINE.json breadth configs)."""
+
+import numpy as np
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+    ArrayDataset,
+    ShardedBatcher,
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+    synthetic_qa,
+    synthetic_token_classification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+    BertForQuestionAnswering,
+    BertForTokenClassification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import MeshConfig, build_mesh
+from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+SEQ = 48
+
+
+def _cfg(task, **kw):
+    base = dict(task=task, dtype="float32", learning_rate=1e-3,
+                scale_lr_by_world_size=False, log_every_steps=0, epochs=3)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _model_cfg(vocab=512, use_pooler=False):
+    return EncoderConfig(vocab_size=vocab, hidden_size=32, num_layers=2,
+                         num_heads=2, intermediate_size=64,
+                         max_position_embeddings=SEQ, use_pooler=use_pooler)
+
+
+def test_token_cls_label_alignment():
+    tok = WordHashTokenizer(vocab_size=512)
+    sents = [["alice", "went", "to", "paris"]]
+    tags = [[1, 0, 0, 2]]
+    ds = ArrayDataset.from_token_classification(tok, sents, tags, max_length=8)
+    labels = ds.columns["labels"][0]
+    # CLS=-100, then word tags, SEP/PAD=-100
+    np.testing.assert_array_equal(labels, [-100, 1, 0, 0, 2, -100, -100, -100])
+
+
+def test_qa_span_positions():
+    tok = WordHashTokenizer(vocab_size=512)
+    q = ["which place ?"]
+    ctx = ["we went to paris yesterday"]
+    start = [ctx[0].index("paris")]
+    ds = ArrayDataset.from_qa(tok, q, ctx, start, ["paris"], max_length=16)
+    # tokens: CLS which place ? SEP we went to paris ...
+    s = int(ds.columns["start_positions"][0])
+    e = int(ds.columns["end_positions"][0])
+    assert s == e == 8
+    assert ds.columns["token_type_ids"][0][s] == 1
+
+
+def test_qa_span_truncated_falls_back_to_cls():
+    tok = WordHashTokenizer(vocab_size=512)
+    ctx = " ".join(["word"] * 50) + " paris"
+    ds = ArrayDataset.from_qa(tok, ["which place ?"], [ctx],
+                              [ctx.index("paris")], ["paris"], max_length=16)
+    assert int(ds.columns["start_positions"][0]) == 0
+
+
+def test_token_cls_learns():
+    mesh = build_mesh(MeshConfig())
+    cfg = _cfg("token-cls")
+    mcfg = _model_cfg()
+    model = BertForTokenClassification(mcfg, num_labels=4)
+    trainer = Trainer(cfg, model, init_params(model, mcfg), mesh)
+    tok = WordHashTokenizer(vocab_size=512)
+    sents, tags = synthetic_token_classification(256, seed=0)
+    ds = ArrayDataset.from_token_classification(tok, sents, tags, max_length=SEQ)
+    hist = trainer.fit(ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0))
+    assert hist["sparse_categorical_accuracy"][-1] > 0.9
+    assert hist["loss"][-1] < hist["loss"][0]
+
+    e_sents, e_tags = synthetic_token_classification(64, seed=5)
+    eds = ArrayDataset.from_token_classification(tok, e_sents, e_tags, max_length=SEQ)
+    res = trainer.evaluate(ShardedBatcher(eds, 16, mesh, shuffle=False,
+                                          drop_remainder=False))
+    assert res["eval_accuracy"] > 0.9
+
+
+def test_qa_learns():
+    mesh = build_mesh(MeshConfig())
+    cfg = _cfg("qa", epochs=4)
+    mcfg = _model_cfg(vocab=1024)
+    model = BertForQuestionAnswering(mcfg)
+    trainer = Trainer(cfg, model, init_params(model, mcfg), mesh)
+    tok = WordHashTokenizer(vocab_size=1024)
+    q, c, s, a = synthetic_qa(384, seed=0, ctx_len=(10, 30))
+    ds = ArrayDataset.from_qa(tok, q, c, s, a, max_length=SEQ)
+    hist = trainer.fit(ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0))
+    # span accuracy: argmax start/end both right counts 1.0
+    assert hist["sparse_categorical_accuracy"][-1] > 0.6
+    assert hist["loss"][-1] < hist["loss"][0] * 0.7
