@@ -66,6 +66,7 @@ class TrainConfig:
     task: str = "seq-cls"          # seq-cls | token-cls | qa | seq2seq
     num_labels: int = 2
     max_seq_length: int = 512      # reference pads to tokenizer.model_max_length=512 (train.py:81)
+    max_target_length: int = 64    # seq2seq decoder length (summaries are short)
     from_scratch: bool = False     # random init instead of pretrained weights
 
     # --- data ---

@@ -97,6 +97,48 @@ class ArrayDataset:
                                   answer_texts, max_length=max_length)
         return cls(dict(enc))
 
+    @classmethod
+    def from_seq2seq(cls, tokenizer, sources, targets,
+                     max_source_length: int = 512,
+                     max_target_length: int = 64,
+                     decoder_start_token_id: int = 0,
+                     pad_token_id: int = 0,
+                     eos_token_id: int = 1) -> "ArrayDataset":
+        """Source/target text pairs → encoder inputs + teacher-forcing
+        decoder inputs + ``-100``-masked LM labels (T5 shift-right
+        convention; the seq2seq breadth config of BASELINE.json).
+
+        Targets are encoded LM-style — raw tokens + the MODEL's EOS, no
+        CLS/SEP wrapping — so generation's stop condition matches what the
+        decoder was trained to emit regardless of tokenizer flavor.
+        """
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+            shift_right,
+        )
+        enc = tokenizer(sources, truncation=True, padding="max_length",
+                        max_length=max_source_length)
+        tgt = tokenizer(targets, truncation=True, padding="max_length",
+                        max_length=max_target_length - 1,
+                        add_special_tokens=False)
+        raw_ids = tgt["input_ids"].astype(np.int32)
+        raw_mask = tgt["attention_mask"].astype(np.int32)
+        n = raw_ids.shape[0]
+        tgt_ids = np.full((n, max_target_length), pad_token_id, np.int32)
+        tgt_mask = np.zeros((n, max_target_length), np.int32)
+        tgt_ids[:, :-1] = np.where(raw_mask > 0, raw_ids, pad_token_id)
+        tgt_mask[:, :-1] = raw_mask
+        lengths = raw_mask.sum(axis=1)
+        tgt_ids[np.arange(n), lengths] = eos_token_id
+        tgt_mask[np.arange(n), lengths] = 1
+        labels = np.where(tgt_mask > 0, tgt_ids, -100).astype(np.int32)
+        dec_in = np.asarray(shift_right(labels, decoder_start_token_id,
+                                        pad_token_id), np.int32)
+        return cls({"input_ids": enc["input_ids"],
+                    "attention_mask": enc["attention_mask"],
+                    "decoder_input_ids": dec_in,
+                    "decoder_attention_mask": tgt_mask,
+                    "labels": labels})
+
 
 class ShardedBatcher:
     """Iterates global batches, yielding this host's shard of each.

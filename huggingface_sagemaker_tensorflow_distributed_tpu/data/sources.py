@@ -214,6 +214,71 @@ def load_qa(
     return questions, contexts, starts, answers
 
 
+# --- seq2seq (summarization) ----------------------------------------------
+
+def synthetic_summarization(
+    n: int, seed: int = 0, doc_len: tuple[int, int] = (60, 160)
+) -> tuple[list[str], list[str]]:
+    """CNN/DM-shaped synthetic summarization: (documents, summaries).
+
+    Each document plants 3 salient entity words in word noise; the target
+    is those words in order — extractive enough to be learnable offline,
+    abstractive in form (the summary is not a contiguous span).
+    """
+    rng = random.Random(seed)
+    all_entities = [w for ws in _ENTITY_WORDS.values() for w in ws]
+    docs, summaries = [], []
+    for _ in range(n):
+        length = rng.randint(*doc_len)
+        keys = rng.sample(all_entities, 3)
+        words = [rng.choice(_NOISE_WORDS) for _ in range(length)]
+        positions = sorted(rng.sample(range(length), 3))
+        for pos, key in zip(positions, keys):
+            words[pos] = key
+        docs.append(" ".join(words))
+        summaries.append(" ".join(keys))
+    return docs, summaries
+
+
+def load_seq2seq(
+    dataset: str,
+    split: str,
+    dataset_path: Optional[str] = None,
+    max_samples: Optional[int] = None,
+    seed: int = 0,
+) -> tuple[list[str], list[str]]:
+    """Seq2seq data as (source texts, target texts)."""
+    if dataset == "synthetic":
+        n = max_samples or (2000 if split == "train" else 400)
+        return synthetic_summarization(n, seed=seed + (0 if split == "train" else 1))
+    if dataset == "cnn_dailymail":
+        from datasets import load_dataset
+        ds = load_dataset("cnn_dailymail", "3.0.0",
+                          split="validation" if split == "test" else split)
+        if max_samples is not None:
+            ds = ds.select(range(min(max_samples, len(ds))))
+        return list(ds["article"]), list(ds["highlights"])
+    if dataset == "xsum":
+        from datasets import load_dataset
+        ds = load_dataset("xsum", split="validation" if split == "test" else split,
+                          trust_remote_code=True)
+        if max_samples is not None:
+            ds = ds.select(range(min(max_samples, len(ds))))
+        return list(ds["document"]), list(ds["summary"])
+    if dataset_path:
+        jsonl = os.path.join(dataset_path, f"{split}.jsonl")
+        sources, targets = [], []
+        with open(jsonl) as f:
+            for line in f:
+                rec = json.loads(line)
+                sources.append(rec["source"])
+                targets.append(rec["target"])
+        if max_samples is not None:
+            sources, targets = sources[:max_samples], targets[:max_samples]
+        return sources, targets
+    raise ValueError(f"unknown seq2seq dataset {dataset!r}")
+
+
 def load_text_classification(
     dataset: str,
     split: str,

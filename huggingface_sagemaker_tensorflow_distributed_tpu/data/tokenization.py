@@ -55,7 +55,8 @@ class WordHashTokenizer:
         return 4 + bucket
 
     def __call__(self, texts, truncation: bool = True, padding: str = "max_length",
-                 max_length: int | None = None, text_pairs=None):
+                 max_length: int | None = None, text_pairs=None,
+                 add_special_tokens: bool = True):
         if isinstance(texts, str):
             texts = [texts]
         max_length = max_length or self.model_max_length
@@ -64,7 +65,9 @@ class WordHashTokenizer:
             if self.lowercase:
                 text = text.lower()
             words = re.findall(r"\w+|[^\w\s]", text)
-            ids = [self.cls_token_id] + [self._word_id(w) for w in words] + [self.sep_token_id]
+            ids = [self._word_id(w) for w in words]
+            if add_special_tokens:
+                ids = [self.cls_token_id] + ids + [self.sep_token_id]
             segs = [0] * len(ids)
             if text_pairs is not None:
                 pair = text_pairs[i].lower() if self.lowercase else text_pairs[i]
@@ -184,10 +187,12 @@ class HFTokenizer:
         self.pad_token_id = hf_tokenizer.pad_token_id or 0
 
     def __call__(self, texts, truncation: bool = True, padding: str = "max_length",
-                 max_length: int | None = None, text_pairs=None):
+                 max_length: int | None = None, text_pairs=None,
+                 add_special_tokens: bool = True):
         out = self._tok(
             texts, text_pairs, truncation=truncation, padding=padding,
-            max_length=max_length or self.model_max_length, return_tensors="np")
+            max_length=max_length or self.model_max_length,
+            add_special_tokens=add_special_tokens, return_tensors="np")
         res = {"input_ids": out["input_ids"].astype(np.int32),
                "attention_mask": out["attention_mask"].astype(np.int32)}
         if "token_type_ids" in out and text_pairs is not None:

@@ -7,3 +7,9 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (  # no
     from_pretrained,
     save_pretrained,
 )
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (  # noqa: F401
+    T5Config,
+    T5ForConditionalGeneration,
+)
+# the submodule is the API: models.generate.generate(...); importing the
+# function here would shadow the module with the same name

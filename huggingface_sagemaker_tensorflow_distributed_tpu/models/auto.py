@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from huggingface_sagemaker_tensorflow_distributed_tpu.models import bert, distilbert, roberta
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import bert, distilbert, roberta, t5
 from huggingface_sagemaker_tensorflow_distributed_tpu.models.convert import (
     hf_to_params,
     load_hf_config,
@@ -51,12 +51,14 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("distilbert", "seq-cls"): distilbert.DistilBertForSequenceClassification,
     ("distilbert", "token-cls"): distilbert.DistilBertForTokenClassification,
     ("distilbert", "qa"): distilbert.DistilBertForQuestionAnswering,
+    ("t5", "seq2seq"): t5.T5ForConditionalGeneration,
 }
 
 CONFIG_BUILDERS = {
     "bert": bert.bert_config_from_hf,
     "roberta": roberta.roberta_config_from_hf,
     "distilbert": distilbert.distilbert_config_from_hf,
+    "t5": t5.t5_config_from_hf,
 }
 
 # Our config → HF config.json for export
@@ -95,6 +97,21 @@ _HF_CONFIG_EXPORTERS = {
         "attention_dropout": c.attention_dropout,
         "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
     },
+    "t5": lambda c: {
+        "model_type": "t5", "architectures": ["T5ForConditionalGeneration"],
+        "vocab_size": c.vocab_size, "d_model": c.d_model, "d_kv": c.d_kv,
+        "d_ff": c.d_ff, "num_layers": c.num_layers,
+        "num_decoder_layers": c.num_decoder_layers, "num_heads": c.num_heads,
+        "relative_attention_num_buckets": c.relative_attention_num_buckets,
+        "relative_attention_max_distance": c.relative_attention_max_distance,
+        "dropout_rate": c.dropout_rate,
+        "layer_norm_epsilon": c.layer_norm_epsilon,
+        "feed_forward_proj": c.feed_forward_proj,
+        "tie_word_embeddings": c.tie_word_embeddings,
+        "pad_token_id": c.pad_token_id, "eos_token_id": c.eos_token_id,
+        "decoder_start_token_id": c.decoder_start_token_id,
+        "initializer_factor": c.initializer_factor,
+    },
 }
 
 
@@ -109,15 +126,19 @@ def build_model(family: str, task: str, config: EncoderConfig, num_labels: int =
     cls = MODEL_REGISTRY.get((family, task))
     if cls is None:
         raise ValueError(f"no model for family={family!r} task={task!r}")
-    if task == "qa":
+    if task in ("qa", "seq2seq"):
         return cls(config)
     return cls(config, num_labels=num_labels)
 
 
-def init_params(model, config: EncoderConfig, seed: int = 0, seq_len: int = 8):
+def init_params(model, config=None, seed: int = 0, seq_len: int = 8):
     rng = jax.random.PRNGKey(seed)
     dummy = jnp.ones((1, seq_len), jnp.int32)
-    variables = model.init(rng, dummy, jnp.ones((1, seq_len), jnp.int32))
+    mask = jnp.ones((1, seq_len), jnp.int32)
+    if getattr(model, "is_encoder_decoder", False):
+        variables = model.init(rng, dummy, mask, dummy, mask)
+    else:
+        variables = model.init(rng, dummy, mask)
     return variables["params"]
 
 
@@ -140,6 +161,12 @@ def from_pretrained(
             "`save_pretrained` or an HF download.")
     hf_config = load_hf_config(model_name_or_path)
     family = detect_family(hf_config)
+    if family == "t5" and task != "seq2seq":
+        # failing loudly here beats a TypeError deep inside jit tracing
+        # when the seq-cls loss feeds an encoder-decoder model
+        raise ValueError(
+            f"{model_name_or_path!r} is a T5 (encoder-decoder) checkpoint; "
+            f"it only supports task='seq2seq', got task={task!r}")
     if family == "bert" and task != "seq-cls":
         # HF Bert QA/token-cls models are built with add_pooling_layer=False;
         # only the seq-cls head consumes the pooler.

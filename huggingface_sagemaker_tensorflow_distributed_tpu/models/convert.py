@@ -88,10 +88,41 @@ _DISTILBERT_RULES = [
     (r"^classifier$", r"classifier"),
 ]
 
+# T5 layer indices: encoder layer.0=self-attn layer.1=FF;
+# decoder layer.0=self-attn layer.1=cross-attn layer.2=FF.
+_T5_RULES = [
+    (r"^shared$", r"shared"),
+    (r"^(?:encoder|decoder)\.embed_tokens$", r"shared"),  # alias of shared
+    (r"^(encoder|decoder)\.block\.(\d+)\.layer\.0\.SelfAttention\.q$", r"\1/block_\2/self_attn/query"),
+    (r"^(encoder|decoder)\.block\.(\d+)\.layer\.0\.SelfAttention\.k$", r"\1/block_\2/self_attn/key"),
+    (r"^(encoder|decoder)\.block\.(\d+)\.layer\.0\.SelfAttention\.v$", r"\1/block_\2/self_attn/value"),
+    (r"^(encoder|decoder)\.block\.(\d+)\.layer\.0\.SelfAttention\.o$", r"\1/block_\2/self_attn/attention_out"),
+    (r"^(encoder|decoder)\.block\.(\d+)\.layer\.0\.SelfAttention\.relative_attention_bias$", r"\1/block_\2/self_attn/rel_bias"),
+    (r"^(encoder|decoder)\.block\.(\d+)\.layer\.0\.layer_norm$", r"\1/block_\2/attn_ln"),
+    (r"^decoder\.block\.(\d+)\.layer\.1\.EncDecAttention\.q$", r"decoder/block_\1/cross_attn/query"),
+    (r"^decoder\.block\.(\d+)\.layer\.1\.EncDecAttention\.k$", r"decoder/block_\1/cross_attn/key"),
+    (r"^decoder\.block\.(\d+)\.layer\.1\.EncDecAttention\.v$", r"decoder/block_\1/cross_attn/value"),
+    (r"^decoder\.block\.(\d+)\.layer\.1\.EncDecAttention\.o$", r"decoder/block_\1/cross_attn/attention_out"),
+    (r"^decoder\.block\.(\d+)\.layer\.1\.layer_norm$", r"decoder/block_\1/cross_ln"),
+    (r"^encoder\.block\.(\d+)\.layer\.1\.DenseReluDense\.wi$", r"encoder/block_\1/ffn/wi"),
+    (r"^encoder\.block\.(\d+)\.layer\.1\.DenseReluDense\.wi_0$", r"encoder/block_\1/ffn/wi_0"),
+    (r"^encoder\.block\.(\d+)\.layer\.1\.DenseReluDense\.wi_1$", r"encoder/block_\1/ffn/wi_1"),
+    (r"^encoder\.block\.(\d+)\.layer\.1\.DenseReluDense\.wo$", r"encoder/block_\1/ffn/wo"),
+    (r"^encoder\.block\.(\d+)\.layer\.1\.layer_norm$", r"encoder/block_\1/ffn_ln"),
+    (r"^decoder\.block\.(\d+)\.layer\.2\.DenseReluDense\.wi$", r"decoder/block_\1/ffn/wi"),
+    (r"^decoder\.block\.(\d+)\.layer\.2\.DenseReluDense\.wi_0$", r"decoder/block_\1/ffn/wi_0"),
+    (r"^decoder\.block\.(\d+)\.layer\.2\.DenseReluDense\.wi_1$", r"decoder/block_\1/ffn/wi_1"),
+    (r"^decoder\.block\.(\d+)\.layer\.2\.DenseReluDense\.wo$", r"decoder/block_\1/ffn/wo"),
+    (r"^decoder\.block\.(\d+)\.layer\.2\.layer_norm$", r"decoder/block_\1/ffn_ln"),
+    (r"^(encoder|decoder)\.final_layer_norm$", r"\1/final_ln"),
+    (r"^lm_head$", r"lm_head"),
+]
+
 RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_RULES,
     "roberta": _ROBERTA_RULES,
     "distilbert": _DISTILBERT_RULES,
+    "t5": _T5_RULES,
 }
 
 
@@ -125,7 +156,8 @@ def translate_key(torch_key: str, family: str) -> str | None:
             base = m.expand(template)
             leaf_name = base.rsplit("/", 1)[-1]
             is_embed = "word_embeddings" in base or "position_embeddings" in base \
-                or "token_type_embeddings" in base
+                or "token_type_embeddings" in base or "rel_bias" in base \
+                or base == "shared"
             is_ln = leaf_name.endswith("_ln") or "layernorm" in leaf_name.lower()
             if kind == "weight":
                 leaf = "embedding" if is_embed else ("scale" if is_ln else "kernel")
@@ -182,7 +214,8 @@ def merge_into(template: Any, loaded: dict, strict_backbone: bool = True) -> tup
 
     merged = walk(template, loaded, ())
     if missing:
-        backbone_missing = [m for m in missing if m.startswith("backbone/")]
+        _backbone_prefixes = ("backbone/", "encoder/", "decoder/", "shared/")
+        backbone_missing = [m for m in missing if m.startswith(_backbone_prefixes)]
         if backbone_missing and strict_backbone:
             raise ValueError(f"backbone params missing from checkpoint: {backbone_missing[:8]}")
         logger.info("convert: freshly initialized head params: %s", missing)
@@ -247,10 +280,32 @@ _DISTILBERT_REVERSE = [
     (r"^classifier$", "classifier"),
 ]
 
+_T5_REVERSE = [
+    (r"^shared$", "shared"),
+    (r"^(encoder|decoder)/block_(\d+)/self_attn/query$", "{}.block.{}.layer.0.SelfAttention.q"),
+    (r"^(encoder|decoder)/block_(\d+)/self_attn/key$", "{}.block.{}.layer.0.SelfAttention.k"),
+    (r"^(encoder|decoder)/block_(\d+)/self_attn/value$", "{}.block.{}.layer.0.SelfAttention.v"),
+    (r"^(encoder|decoder)/block_(\d+)/self_attn/attention_out$", "{}.block.{}.layer.0.SelfAttention.o"),
+    (r"^(encoder|decoder)/block_(\d+)/self_attn/rel_bias$", "{}.block.{}.layer.0.SelfAttention.relative_attention_bias"),
+    (r"^(encoder|decoder)/block_(\d+)/attn_ln$", "{}.block.{}.layer.0.layer_norm"),
+    (r"^decoder/block_(\d+)/cross_attn/query$", "decoder.block.{}.layer.1.EncDecAttention.q"),
+    (r"^decoder/block_(\d+)/cross_attn/key$", "decoder.block.{}.layer.1.EncDecAttention.k"),
+    (r"^decoder/block_(\d+)/cross_attn/value$", "decoder.block.{}.layer.1.EncDecAttention.v"),
+    (r"^decoder/block_(\d+)/cross_attn/attention_out$", "decoder.block.{}.layer.1.EncDecAttention.o"),
+    (r"^decoder/block_(\d+)/cross_ln$", "decoder.block.{}.layer.1.layer_norm"),
+    (r"^encoder/block_(\d+)/ffn/(wi|wi_0|wi_1|wo)$", "encoder.block.{}.layer.1.DenseReluDense.{}"),
+    (r"^encoder/block_(\d+)/ffn_ln$", "encoder.block.{}.layer.1.layer_norm"),
+    (r"^decoder/block_(\d+)/ffn/(wi|wi_0|wi_1|wo)$", "decoder.block.{}.layer.2.DenseReluDense.{}"),
+    (r"^decoder/block_(\d+)/ffn_ln$", "decoder.block.{}.layer.2.layer_norm"),
+    (r"^(encoder|decoder)/final_ln$", "{}.final_layer_norm"),
+    (r"^lm_head$", "lm_head"),
+]
+
 REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_REVERSE,
     "roberta": _ROBERTA_REVERSE,
     "distilbert": _DISTILBERT_REVERSE,
+    "t5": _T5_REVERSE,
 }
 
 

@@ -1,0 +1,96 @@
+"""Autoregressive generation for encoder-decoder models.
+
+TPU-native replacement for the ``model.generate`` capability the
+reference's model surface carries via HF ``transformers`` (SURVEY.md D7;
+the reference itself only fine-tunes, reference ``scripts/train.py:145``,
+but its model objects expose generation — parity requires it for the
+seq2seq task family).
+
+Design: the encoder runs once; the decoder runs inside a single jitted
+``lax.scan`` over time steps with an incremental KV cache (created on a
+zero-length init pass, updated per step with ``dynamic_update_slice`` —
+see ``T5Attention``). Static shapes throughout: output length is fixed at
+``max_new_tokens`` and finished sequences emit ``pad_token_id``, so one
+compilation serves every batch. Greedy and temperature sampling; beam
+search is deliberately deferred until a workload needs it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def init_cache(model, params, encoder_hidden, encoder_attention_mask,
+               max_decoder_length: int):
+    """Create the zero-filled decoder KV cache for ``max_decoder_length``.
+
+    Runs the decoder once over a dummy full-length input with an
+    uninitialized ``"cache"`` collection: each attention module allocates
+    its buffers at full k/v shape but performs no writes (cache_index
+    stays 0), so the returned cache is ready for step-wise decode.
+    """
+    batch = encoder_hidden.shape[0]
+    dummy = jnp.ones((batch, max_decoder_length), jnp.int32)
+    _, variables = model.apply(
+        {"params": params}, dummy, encoder_hidden, encoder_attention_mask,
+        decode=True, deterministic=True, mutable=["cache"],
+        method=model.decode)
+    return variables["cache"]
+
+
+@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
+                                             "temperature"))
+def _generate_jit(model, params, input_ids, attention_mask, max_new_tokens,
+                  temperature, rng):
+    cfg = model.config
+    encoder_hidden = model.apply({"params": params}, input_ids,
+                                 attention_mask, deterministic=True,
+                                 method=model.encode)
+    cache = init_cache(model, params, encoder_hidden, attention_mask,
+                       max_new_tokens)
+    batch = input_ids.shape[0]
+    start = jnp.full((batch, 1), cfg.decoder_start_token_id, jnp.int32)
+
+    def step(carry, _):
+        token, cache, finished, rng = carry
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, token, encoder_hidden,
+            attention_mask, decode=True, deterministic=True,
+            mutable=["cache"], method=model.decode)
+        logits = logits[:, -1, :].astype(jnp.float32)
+        if temperature == 0.0:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            nxt = nxt.astype(jnp.int32)
+        nxt = jnp.where(finished, jnp.int32(cfg.pad_token_id), nxt)
+        finished = finished | (nxt == cfg.eos_token_id)
+        return (nxt[:, None], mutated["cache"], finished, rng), nxt
+
+    carry = (start, cache, jnp.zeros((batch,), bool), rng)
+    _, tokens = lax.scan(step, carry, None, length=max_new_tokens)
+    return tokens.T  # [batch, max_new_tokens]
+
+
+def generate(model, params, input_ids, attention_mask=None,
+             max_new_tokens: int = 64, temperature: float = 0.0,
+             seed: int = 0) -> jax.Array:
+    """Generate output ids for a batch of source sequences.
+
+    ``temperature=0`` → greedy; otherwise softmax sampling at that
+    temperature. Returns [batch, max_new_tokens] ids, padded with
+    ``pad_token_id`` after EOS.
+    """
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if attention_mask is None:
+        attention_mask = jnp.ones_like(input_ids)
+    attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    return _generate_jit(model, params, input_ids, attention_mask,
+                         int(max_new_tokens), float(temperature),
+                         jax.random.PRNGKey(seed))

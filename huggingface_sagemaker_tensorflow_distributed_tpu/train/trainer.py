@@ -121,10 +121,29 @@ def qa_loss(apply_fn, params, batch, rngs, train: bool):
     return _masked_sums(0.5 * (s_ce + e_ce), 0.5 * (s_ok + e_ok), valid)
 
 
+def seq2seq_loss(apply_fn, params, batch, rngs, train: bool):
+    """Teacher-forced LM cross-entropy over non-pad target tokens
+    (labels == -100 ignored, HF convention); covers the T5/CNN-DM
+    breadth config. Metric is next-token accuracy."""
+    logits = apply_fn({"params": params}, batch["input_ids"],
+                      batch["attention_mask"], batch["decoder_input_ids"],
+                      batch.get("decoder_attention_mask"),
+                      deterministic=not train, rngs=rngs)
+    labels = batch["labels"]
+    token_valid = labels != -100
+    if "valid" in batch:
+        token_valid = token_valid & (batch["valid"][:, None] > 0)
+    safe_labels = jnp.maximum(labels, 0)
+    per_tok = softmax_cross_entropy_with_integer_labels(logits, safe_labels)
+    correct = jnp.argmax(logits, -1) == safe_labels
+    return _masked_sums(per_tok, correct, token_valid)
+
+
 TASK_LOSSES: dict[str, Callable] = {
     "seq-cls": seq_cls_loss,
     "token-cls": token_cls_loss,
     "qa": qa_loss,
+    "seq2seq": seq2seq_loss,
 }
 
 

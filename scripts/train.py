@@ -34,6 +34,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
     load_qa,
+    load_seq2seq,
     load_text_classification,
     load_token_classification,
 )
@@ -67,9 +68,10 @@ def _check_num_labels(labels, num_labels: int, task: str) -> None:
 
 
 def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
-                  max_samples) -> ArrayDataset:
+                  max_samples, model_config=None) -> ArrayDataset:
     """Task-specific load+tokenize: seq-cls (reference parity), token-cls
-    (CoNLL), extractive QA (SQuAD) — each with a synthetic offline tier."""
+    (CoNLL), extractive QA (SQuAD), seq2seq (CNN-DM) — each with a
+    synthetic offline tier."""
     kw = dict(dataset_path=config.dataset_path, max_samples=max_samples,
               seed=config.seed)
     if config.task == "seq-cls":
@@ -85,6 +87,15 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
         questions, contexts, starts, answers = load_qa(config.dataset, split, **kw)
         return ArrayDataset.from_qa(tokenizer, questions, contexts, starts,
                                     answers, max_len)
+    if config.task == "seq2seq":
+        sources, targets = load_seq2seq(config.dataset, split, **kw)
+        return ArrayDataset.from_seq2seq(
+            tokenizer, sources, targets, max_source_length=max_len,
+            max_target_length=config.max_target_length,
+            decoder_start_token_id=getattr(model_config,
+                                           "decoder_start_token_id", 0),
+            pad_token_id=getattr(model_config, "pad_token_id", 0),
+            eos_token_id=getattr(model_config, "eos_token_id", 1))
     raise ValueError(f"no data path for task {config.task!r}")
 
 
@@ -115,11 +126,13 @@ def main(argv=None) -> dict:
                                vocab_size=model_config.vocab_size)
 
     # --- data (reference train.py:72-100), per-host sharded, task-aware ---
-    max_len = min(config.max_seq_length, model_config.max_position_embeddings)
+    max_len = min(config.max_seq_length,
+                  getattr(model_config, "max_position_embeddings",
+                          config.max_seq_length))
     train_ds = build_dataset(config, tokenizer, "train", max_len,
-                             config.max_train_samples)
+                             config.max_train_samples, model_config)
     eval_ds = build_dataset(config, tokenizer, "test", max_len,
-                            config.max_eval_samples)
+                            config.max_eval_samples, model_config)
 
     # Global batch = per-replica batch × data-parallel replicas (reference
     # semantics at train.py:143-144). tp/sp devices within a replica do
