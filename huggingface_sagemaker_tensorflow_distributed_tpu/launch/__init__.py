@@ -1,0 +1,11 @@
+from huggingface_sagemaker_tensorflow_distributed_tpu.launch.launcher import (  # noqa: F401
+    JobHandle,
+    LocalBackend,
+    TPUJob,
+    TPUVMBackend,
+    make_job_name,
+    to_argv,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.launch.slice import (  # noqa: F401
+    SliceConfig,
+)

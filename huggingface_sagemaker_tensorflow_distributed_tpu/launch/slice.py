@@ -1,0 +1,61 @@
+"""TPU slice topology descriptions.
+
+TPU-native replacement for the reference's instance-type knob
+(``instance_type="ml.p3.2xlarge"`` / ``instance_count`` at reference
+``launch.py:27-29,42,45``): instead of naming a GPU box, a job names a
+TPU slice (accelerator type + chip count) and the launcher derives the
+host topology — one worker process per host, each owning its local
+chips, coordinated by the JAX distributed service (SURVEY.md D4/D11).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+# accelerator generation → chips per host (TPU-VM worker). Slices smaller
+# than one full host (e.g. v5e-4) are a single worker with fewer chips.
+_CHIPS_PER_HOST = {
+    "v4": 4,
+    "v5e": 4,
+    "v5p": 4,
+    "v6e": 4,
+}
+
+
+@dataclass(frozen=True)
+class SliceConfig:
+    """A TPU slice: e.g. ``v5e-32`` = 8 hosts × 4 chips."""
+
+    accelerator: str       # v4 | v5e | v5p | v6e | cpu (local simulator)
+    num_chips: int
+
+    @classmethod
+    def parse(cls, spec: str) -> "SliceConfig":
+        """Parse ``"v5e-32"`` / ``"v4-8"`` / ``"cpu-8"`` slice names."""
+        m = re.fullmatch(r"(v\d+[a-z]*|cpu)-(\d+)", spec.strip().lower())
+        if not m:
+            raise ValueError(
+                f"bad slice spec {spec!r}; expected e.g. 'v5e-32' or 'cpu-8'")
+        return cls(accelerator=m.group(1), num_chips=int(m.group(2)))
+
+    @property
+    def chips_per_host(self) -> int:
+        if self.accelerator == "cpu":
+            return self.num_chips  # simulator: one "host" per process is chosen by num_hosts
+        per = _CHIPS_PER_HOST.get(self.accelerator)
+        if per is None:
+            raise ValueError(f"unknown accelerator {self.accelerator!r} "
+                             f"(known: {sorted(_CHIPS_PER_HOST)} + cpu)")
+        return per
+
+    @property
+    def num_hosts(self) -> int:
+        if self.accelerator == "cpu":
+            return 1
+        per = self.chips_per_host
+        return max(1, -(-self.num_chips // per))
+
+    @property
+    def name(self) -> str:
+        return f"{self.accelerator}-{self.num_chips}"

@@ -200,7 +200,10 @@ def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderCo
         # spanning hosts): gather to fully-replicated host arrays first.
         # Collective — every host must participate before the host-0 gate.
         from jax.experimental import multihost_utils
-        params = multihost_utils.process_allgather(params)
+        # tiled=True: reassemble each param's GLOBAL value (tiled=False
+        # stacks per-process copies, and is unsupported for arrays whose
+        # shards span processes)
+        params = multihost_utils.process_allgather(params, tiled=True)
     if host0_only and jax.process_index() != 0:
         return
     os.makedirs(output_dir, exist_ok=True)

@@ -1,0 +1,76 @@
+"""Submit a fine-tuning job — reference ``launch.py`` parity, TPU-native.
+
+The reference builds a SageMaker ``HuggingFace`` estimator with a
+hyperparameter dict, an instance type, and a distribution knob, then
+calls ``fit()`` (reference ``launch.py:13-55``). Here the same shape of
+script targets a TPU slice (or the local slice simulator) through the
+in-repo launcher: same hyperparameter contract (serialized to
+``--key value`` argv), same job-name + artifact-dir semantics, no cloud
+SDK in the loop.
+
+Examples:
+    # local slice simulator: 2 simulated hosts × 4 CPU "chips"
+    python launch.py --slice cpu-8 --num_hosts 2 --epochs 1 \
+        --dataset synthetic --from_scratch true
+
+    # print the gcloud command for a real v5e-32 slice
+    python launch.py --slice v5e-32
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.launch import TPUJob
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(allow_abbrev=False)
+    parser.add_argument("--slice", default="cpu-8",
+                        help="TPU slice spec (v5e-32, v4-8, ...) or cpu-N "
+                             "for the local simulator")
+    parser.add_argument("--num_hosts", type=int, default=None,
+                        help="simulated host count (local backend)")
+    parser.add_argument("--entry_point", default="scripts/train.py")
+    parser.add_argument("--base_job_name", default="huggingface-tpu")
+    parser.add_argument("--job_root", default="/tmp/tpu_jobs")
+    # hyperparameters (reference launch.py:13-18 defaults)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--train_batch_size", type=int, default=8)
+    parser.add_argument("--eval_batch_size", type=int, default=4)
+    parser.add_argument("--model_name_or_path",
+                        default="bert-large-uncased-whole-word-masking")
+    parser.add_argument("--learning_rate", type=float, default=5e-5)
+    ns, extra = parser.parse_known_args(argv)
+
+    hp = {
+        "epochs": ns.epochs,
+        "train_batch_size": ns.train_batch_size,
+        "eval_batch_size": ns.eval_batch_size,
+        "model_name_or_path": ns.model_name_or_path,
+        "learning_rate": ns.learning_rate,
+    }
+    # pass-through extras: --key value pairs land in the training config;
+    # a bare --flag (next token is another option) means boolean true
+    i = 0
+    while i < len(extra):
+        tok = extra[i]
+        if tok.startswith("--"):
+            if i + 1 < len(extra) and not extra[i + 1].startswith("--"):
+                hp[tok[2:]] = extra[i + 1]
+                i += 2
+                continue
+            hp[tok[2:]] = "true"
+        i += 1
+
+    job = TPUJob(entry_point=ns.entry_point, slice_spec=ns.slice,
+                 num_hosts=ns.num_hosts, hyperparameters=hp,
+                 base_job_name=ns.base_job_name, job_root=ns.job_root)
+    handle = job.fit(wait=True)
+    print(f"job {handle.job_name} done; artifacts in {handle.job_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
