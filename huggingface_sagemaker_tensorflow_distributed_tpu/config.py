@@ -97,6 +97,10 @@ class TrainConfig:
     tp: int = 1
     sp: int = 1                    # sequence/context parallel (ring attention)
 
+    # --- kernels / memory ---
+    attention_impl: str = "xla"    # xla | flash (pallas) | ring (auto when sp>1)
+    remat: bool = False            # rematerialize encoder layers (FLOPs for HBM)
+
     # --- control flags (reference train.py:44-45, typed correctly here) ---
     do_train: bool = True
     do_eval: bool = True

@@ -31,7 +31,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
-from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import batch_sharding
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
+    batch_column_sharding,
+)
 
 
 @dataclass
@@ -173,7 +175,6 @@ class ShardedBatcher:
                 f"global batch {global_batch_size} not divisible by "
                 f"{self.process_count} hosts")
         self.per_host = global_batch_size // self.process_count
-        self._sharding = batch_sharding(mesh)
 
     def steps_per_epoch(self) -> int:
         n = len(self.dataset)
@@ -209,9 +210,15 @@ class ShardedBatcher:
             yield batch
 
     def global_arrays(self, epoch: int = 0, start_step: int = 0) -> Iterator[dict[str, jax.Array]]:
-        """Yield batches as globally-sharded jax.Arrays on the mesh."""
+        """Yield batches as globally-sharded jax.Arrays on the mesh.
+
+        Token-dimension columns additionally shard over the ``seq`` axis
+        when the mesh has one (sequence parallelism)."""
         for batch in self.local_batches(epoch, start_step):
             yield {
-                k: jax.make_array_from_process_local_data(self._sharding, v)
+                k: jax.make_array_from_process_local_data(
+                    batch_column_sharding(
+                        self.mesh, v.ndim, v.shape[1] if v.ndim >= 2 else None),
+                    v)
                 for k, v in batch.items()
             }

@@ -63,6 +63,10 @@ class T5Config:
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     remat: bool = False
+    # Accepted for config-surface uniformity with EncoderConfig; T5's
+    # relative-attention bias is a general [b,h,q,k] mask, which only the
+    # XLA tier supports, so this field is currently inert.
+    attention_impl: str = "xla"
 
     @property
     def is_gated_act(self) -> bool:

@@ -39,14 +39,21 @@ def xla_attention(q, k, v, mask=None, scale=None):
 
 
 def dot_product_attention(q, k, v, mask=None, scale=None, impl: str = "xla"):
-    """Dispatch on implementation tier. ``impl='flash'`` requires TPU."""
+    """Dispatch on implementation tier. ``impl='flash'`` requires TPU;
+    ``impl='ring'`` requires an ambient mesh with a ``seq`` axis
+    (``parallel.mesh.use_mesh`` / Trainer sets it)."""
     if impl == "flash":
         from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_attention import (
             flash_attention,
         )
         return flash_attention(q, k, v, mask=mask, scale=scale)
+    if impl == "ring":
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.ring_attention import (
+            ring_attention_or_fallback,
+        )
+        return ring_attention_or_fallback(q, k, v, mask=mask, scale=scale)
     if impl != "xla":
-        raise ValueError(f"unknown attention impl {impl!r} (xla | flash)")
+        raise ValueError(f"unknown attention impl {impl!r} (xla | flash | ring)")
     return xla_attention(q, k, v, mask=mask, scale=scale)
 
 

@@ -6,8 +6,15 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (  # 
     AXIS_TENSOR,
     AXIS_SEQ,
     data_axis_names,
+    current_mesh,
+    maybe_current_mesh,
+    use_mesh,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (  # noqa: F401
+    batch_column_sharding,
     batch_sharding,
     named_sharding,
     param_shardings,

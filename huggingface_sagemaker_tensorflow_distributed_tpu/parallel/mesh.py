@@ -72,6 +72,43 @@ class MeshConfig:
         return (dp, self.fsdp, self.sp, self.tp)
 
 
+# Ambient mesh: modules deep inside a model (e.g. the ring-attention
+# dispatch in ops/attention.py) need the mesh without threading it
+# through every Flax call signature. The Trainer enters ``use_mesh``
+# around every jitted-step call (tracing happens at first call), so the
+# mesh a step traces with is always the trainer's own — the same ambient
+# model as the reference's strategy scope
+# (``scripts/singe_node_train.py:41``). Strictly LIFO: use the context
+# manager, never mutate the stack directly.
+_CURRENT_MESH: list[Mesh] = []
+
+
+def current_mesh() -> Mesh:
+    if not _CURRENT_MESH:
+        raise RuntimeError(
+            "no ambient mesh set — use parallel.mesh.use_mesh(mesh) "
+            "around tracing (the Trainer does this for its steps)")
+    return _CURRENT_MESH[-1]
+
+
+def maybe_current_mesh() -> Mesh | None:
+    return _CURRENT_MESH[-1] if _CURRENT_MESH else None
+
+
+class use_mesh:
+    """Push an ambient mesh for the duration of a block (LIFO)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        _CURRENT_MESH.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _CURRENT_MESH.pop()
+
+
 def build_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     """Build the global mesh over all addressable devices.
 

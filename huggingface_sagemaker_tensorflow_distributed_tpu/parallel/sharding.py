@@ -107,6 +107,35 @@ def batch_sharding(mesh: Mesh, seq_axis: bool = False) -> NamedSharding:
     return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP)))
 
 
+def seq_axis_is_process_local(mesh: Mesh) -> bool:
+    """True iff every run of devices along the ``seq`` axis lives in one
+    process. The batcher hands ``make_array_from_process_local_data``
+    full-sequence host arrays, which is only a valid process-local shard
+    when no seq run crosses a process boundary."""
+    axes = list(mesh.axis_names)
+    devs = np.moveaxis(mesh.devices, axes.index(AXIS_SEQ), -1)
+    procs = np.vectorize(lambda d: d.process_index)(devs)
+    procs = procs.reshape(-1, procs.shape[-1])
+    return bool(np.all(procs == procs[:, :1]))
+
+
+def batch_column_sharding(mesh: Mesh, ndim: int, dim1: int | None = None) -> NamedSharding:
+    """Sharding for one batch column: batch dim over (data, fsdp); token
+    dim additionally over ``seq`` when the mesh has a seq axis and the
+    column has a compatible token dimension (sequence parallelism — the
+    long-context axis the reference lacks, SURVEY.md §5.7).
+
+    When the seq axis crosses process boundaries the token dim stays
+    unsharded (each host holds the full sequence and GSPMD reshards on
+    entry to the step) — ``make_array_from_process_local_data`` cannot
+    express a dim the host only partially holds."""
+    seq_size = mesh.shape.get(AXIS_SEQ, 1)
+    if (seq_size > 1 and ndim >= 2 and dim1 is not None
+            and dim1 % seq_size == 0 and seq_axis_is_process_local(mesh)):
+        return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ))
+    return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP)))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -40,7 +40,6 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
     world_size,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
-    batch_sharding,
     param_shardings,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.train.optim import build_optimizer
@@ -187,20 +186,37 @@ class Trainer:
         # (adam mu/nu paths contain the param path, so the same rules hit).
         self.state_shardings = param_shardings(state, mesh)
         self.state = jax.device_put(state, self.state_shardings)
-        self.batch_sharding = batch_sharding(mesh)
         self._base_rng = jax.random.PRNGKey(config.seed)
 
-        self._train_step = jax.jit(
+        # Batch shardings are inherited from the arrays the batcher
+        # device_puts (batch dim over data axes; token dims over ``seq``
+        # when present — the pipeline decides per column). Each jitted
+        # call runs under use_mesh so trace-time mesh consumers (ring
+        # attention) always see THIS trainer's mesh, regardless of other
+        # trainers constructed in the same process.
+        self._train_step = self._with_mesh(jax.jit(
             self._train_step_impl,
-            in_shardings=(self.state_shardings, self.batch_sharding),
+            in_shardings=(self.state_shardings, None),
             out_shardings=(self.state_shardings, None),
             donate_argnums=(0,),
-        )
-        self._eval_step = jax.jit(
+        ))
+        self._eval_step = self._with_mesh(jax.jit(
             self._eval_step_impl,
-            in_shardings=(self.state_shardings.params, self.batch_sharding),
+            in_shardings=(self.state_shardings.params, None),
             out_shardings=None,
+        ))
+
+    def _with_mesh(self, fn):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+            use_mesh,
         )
+
+        @functools.wraps(fn)
+        def wrapped(*args):
+            with use_mesh(self.mesh):
+                return fn(*args)
+
+        return wrapped
 
     # -- jitted bodies ------------------------------------------------------
 

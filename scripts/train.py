@@ -113,6 +113,9 @@ def main(argv=None) -> dict:
     logger.info("mesh: %s", dict(mesh.shape))
 
     # --- model + tokenizer (reference train.py:69,117) ---
+    attention_impl = config.attention_impl
+    if config.sp > 1 and attention_impl == "xla":
+        attention_impl = "ring"  # an sp axis implies sequence-parallel attention
     model, params, family, model_config = auto_models.from_pretrained(
         config.model_name_or_path,
         task=config.task,
@@ -121,7 +124,17 @@ def main(argv=None) -> dict:
         param_dtype=_DTYPES[config.param_dtype],
         seed=config.seed,
         from_scratch=config.from_scratch,
+        attention_impl=attention_impl,
+        remat=config.remat,
     )
+    if attention_impl == "ring":
+        if family == "t5":
+            logger.warning(
+                "sp=%d with a T5 model: T5's relative-attention bias runs "
+                "the XLA path (no ring attention); the seq axis still "
+                "shards activations via GSPMD", config.sp)
+        else:
+            logger.info("sp=%d: ring attention selected", config.sp)
     tokenizer = load_tokenizer(config.model_name_or_path,
                                vocab_size=model_config.vocab_size)
 

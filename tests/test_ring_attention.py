@@ -1,0 +1,146 @@
+"""Ring attention (sequence parallelism) vs the XLA reference kernel.
+
+Runs on the 8-fake-CPU-device mesh (conftest): the REAL shard_map /
+ppermute code path, no TPU needed — the long-context capability the
+reference lacks entirely (SURVEY.md §5.7: it truncates to 512).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+    make_attention_mask,
+    xla_attention,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+    MeshConfig,
+    build_mesh,
+    ring_attention,
+    use_mesh,
+)
+
+
+def _qkv(b=4, h=2, s=32, d=8, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, h, s, d), dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.fixture(scope="module")
+def sp_mesh(devices8):
+    # data=2 × seq=4: batch and sequence sharded simultaneously
+    return build_mesh(MeshConfig(dp=2, sp=4), devices=devices8)
+
+
+def test_ring_matches_xla_no_mask(sp_mesh):
+    q, k, v = _qkv()
+    ref = xla_attention(q, k, v)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh=sp_mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_matches_xla_padding_mask(sp_mesh):
+    q, k, v = _qkv()
+    rng = np.random.RandomState(1)
+    am = (rng.rand(4, 32) > 0.3).astype(np.int32)
+    am[:, :4] = 1  # no fully-masked rows
+    mask = make_attention_mask(jnp.asarray(am))
+    ref = xla_attention(q, k, v, mask=mask)
+    out = jax.jit(
+        lambda q, k, v, m: ring_attention(q, k, v, mask=m, mesh=sp_mesh)
+    )(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_causal(sp_mesh):
+    q, k, v = _qkv(seed=2)
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        make_causal_mask,
+    )
+    ref = xla_attention(q, k, v, mask=make_causal_mask(32))
+    out = jax.jit(
+        lambda q, k, v: ring_attention(q, k, v, mesh=sp_mesh, causal=True)
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_gradients_match(sp_mesh):
+    q, k, v = _qkv(seed=3)
+    am = np.ones((4, 32), np.int32)
+    am[:, 28:] = 0
+    mask = make_attention_mask(jnp.asarray(am))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, mask=mask) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mask=mask, mesh=sp_mesh) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-4)
+
+
+def test_ring_bf16_close_to_fp32_reference(sp_mesh):
+    q, k, v = _qkv(seed=4)
+    ref = xla_attention(q, k, v)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh=sp_mesh))(qb, kb, vb)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=3e-2)
+
+
+def test_ring_rejects_indivisible_seq(sp_mesh):
+    q, k, v = _qkv(s=30)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k, v, mesh=sp_mesh)
+
+
+def test_bert_train_step_with_ring_attention(devices8):
+    """End-to-end: BERT forward+backward+update on a dp×sp mesh with
+    attention_impl='ring' matches the same step with impl='xla'."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+        BertForSequenceClassification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+    seq_len = 32
+    losses = {}
+    for impl, mesh_cfg in (("xla", MeshConfig(dp=-1)),
+                           ("ring", MeshConfig(dp=2, sp=4))):
+        mesh = build_mesh(mesh_cfg, devices=devices8)
+        cfg = EncoderConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                            num_heads=4, intermediate_size=64,
+                            max_position_embeddings=seq_len,
+                            hidden_dropout=0.0, attention_dropout=0.0,
+                            attention_impl=impl)
+        model = BertForSequenceClassification(cfg, num_labels=2)
+        params = init_params(model, cfg, seed=0)
+        tcfg = TrainConfig(dtype="float32", train_batch_size=1,
+                           max_seq_length=seq_len, log_every_steps=0)
+        trainer = Trainer(tcfg, model, params, mesh)
+        tok = WordHashTokenizer(vocab_size=128)
+        texts, labels = synthetic_text_classification(16, seed=0)
+        ds = ArrayDataset.from_texts(tok, texts, labels, max_length=seq_len)
+        batcher = ShardedBatcher(ds, 8, mesh, shuffle=False, seed=0)
+        batch = next(batcher.global_arrays(0))
+        trainer.state, metrics = trainer._train_step(trainer.state, batch)
+        losses[impl] = float(jax.device_get(metrics["loss"]))
+
+    assert np.isfinite(losses["ring"])
+    np.testing.assert_allclose(losses["ring"], losses["xla"], atol=1e-5)
