@@ -2,6 +2,9 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
     load_tokenizer,
     WordHashTokenizer,
 )
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.wordpiece import (  # noqa: F401
+    WordPieceTokenizer,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (  # noqa: F401
     load_text_classification,
 )

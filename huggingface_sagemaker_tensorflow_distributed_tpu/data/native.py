@@ -1,0 +1,309 @@
+"""ctypes bindings for the native (C++) runtime components.
+
+The reference's data path rests on three native libraries: HF tokenizers
+(Rust, reference ``scripts/train.py:69``), Arrow (C++, behind
+``load_dataset`` at ``scripts/train.py:72``) and tf.data (C++,
+``scripts/train.py:84-86``). This framework's equivalents live in
+``native/*.cc`` (SURVEY.md D8-D10) and are bound here with ctypes (no
+pybind11 in the image). Everything degrades gracefully: if the shared
+library cannot be built (no compiler), callers fall back to the
+pure-Python twins with identical semantics.
+
+- :class:`CppWordPieceTokenizer` — WordPiece tokenizer whose per-char hot
+  path runs in multithreaded C++ (``native/wordpiece.cc``); assembly is
+  inherited from the Python twin so both produce identical arrays.
+- :func:`native_permutation` — deterministic cross-platform epoch shuffle
+  (``native/dataloader.cc``).
+- :func:`native_gather` — parallel batch row-gather into a contiguous
+  staging buffer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.wordpiece import (
+    WordPieceTokenizer,
+    tokenize_batch_py,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhstd_native.so")
+_SOURCES = ("wordpiece.cc", "dataloader.cc")
+
+_lib = None
+_build_failed = False
+_lib_lock = threading.Lock()
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(_NATIVE_DIR, s)) > lib_mtime
+        for s in _SOURCES if os.path.exists(os.path.join(_NATIVE_DIR, s))
+    )
+
+
+def ensure_built(force: bool = False) -> Optional[str]:
+    """Compile native/*.cc → libhstd_native.so if missing or stale.
+    Returns the library path, or None when no toolchain is available."""
+    if not force and not _stale():
+        return _LIB_PATH
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in _SOURCES]
+    # compile to a process-unique temp path, then atomic-rename into place:
+    # concurrent builders (the local slice simulator runs several worker
+    # processes) never observe a half-written .so
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           "-o", tmp_path] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, cwd=_NATIVE_DIR)
+        os.replace(tmp_path, _LIB_PATH)
+    except (OSError, subprocess.CalledProcessError) as e:
+        detail = getattr(e, "stderr", b"") or b""
+        logger.warning("native build failed (%s); using pure-Python fallbacks",
+                       detail.decode(errors="replace")[:500] or e)
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        return None
+    return _LIB_PATH
+
+
+def load_native():
+    """Load (building if needed) the native library; None if unavailable.
+    A failed build is cached — the input hot path must not re-spawn g++
+    per batch on toolchain-less hosts."""
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        path = ensure_built()
+        if path is None:
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            # a stale/foreign-arch prebuilt .so: rebuild from source once,
+            # then give up gracefully (pure-Python twins take over)
+            path = ensure_built(force=True)
+            try:
+                lib = ctypes.CDLL(path) if path else None
+            except OSError:
+                lib = None
+            if lib is None:
+                logger.warning("native library unloadable; using pure-Python fallbacks")
+                _build_failed = True
+                return None
+        lib.wp_new.restype = ctypes.c_void_p
+        lib.wp_new.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+                               ctypes.c_int32]
+        lib.wp_free.argtypes = [ctypes.c_void_p]
+        lib.wp_vocab_size.restype = ctypes.c_int32
+        lib.wp_vocab_size.argtypes = [ctypes.c_void_p]
+        lib.wp_token_id.restype = ctypes.c_int32
+        lib.wp_token_id.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.wp_tokenize_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, _i64p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            _i32p, _i32p, _i32p, _i32p, _i32p]
+        lib.dl_permutation.argtypes = [ctypes.c_int64, ctypes.c_uint64, _i64p]
+        lib.dl_gather.argtypes = [_i32p, ctypes.c_int64, _i64p, ctypes.c_int64,
+                                  _i32p, ctypes.c_int32]
+        lib.dl_row_lengths.argtypes = [_i32p, ctypes.c_int64, ctypes.c_int64,
+                                       _i32p, ctypes.c_int32]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def _default_threads() -> int:
+    return max(1, min(os.cpu_count() or 1, 16))
+
+
+# ---------------------------------------------------------------------------
+# WordPiece (C++-backed)
+# ---------------------------------------------------------------------------
+
+# The C++ core's Unicode tables are verified identical to the Python twin
+# (unicodedata) for code points below this boundary: ASCII, Latin-1
+# supplement, Latin Extended-A — which covers BERT-uncased English and
+# Western-European corpora. Rows containing ANY code point at or above it
+# are routed to the Python twin, so C++-vs-Python parity holds for every
+# input by construction, not by table completeness (a host that failed to
+# build the library and one that built it always produce identical ids —
+# the cross-host input-divergence guarantee multi-host training needs).
+_CPP_SAFE_BOUNDARY = 0x0180
+
+
+class CppWordPieceTokenizer(WordPieceTokenizer):
+    """WordPiece tokenizer with the char-level core in C++.
+
+    Drop-in for :class:`WordPieceTokenizer` (assembly inherited); raises
+    at construction if the native library is unavailable — use
+    :func:`load_wordpiece` for automatic fallback.
+    """
+
+    def __init__(self, vocab: dict[str, int], lowercase: bool = True,
+                 n_threads: Optional[int] = None, **kw):
+        super().__init__(vocab, lowercase=lowercase, **kw)
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable; use WordPieceTokenizer")
+        if sorted(vocab.values()) != list(range(len(vocab))):
+            # the C API numbers tokens by position in the blob; a vocab with
+            # gaps/duplicate ids would silently shift ids in C++ only
+            raise RuntimeError("native tokenizer needs contiguous vocab ids 0..n-1")
+        self._lib = lib
+        self.n_threads = n_threads or _default_threads()
+        inv = sorted(vocab.items(), key=lambda kv: kv[1])
+        blob = "\n".join(token for token, _ in inv).encode("utf-8")
+        self._handle = lib.wp_new(blob, len(blob), int(lowercase),
+                                  self.unk_token_id)
+        if lib.wp_vocab_size(self._handle) != len(vocab):
+            raise RuntimeError("native vocab size mismatch")
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle and getattr(self, "_lib", None):
+            self._lib.wp_free(handle)
+            self._handle = None
+
+    def _tokenize_batch(self, texts: Sequence[str], cap: int):
+        n = len(texts)
+        encoded = [t.encode("utf-8") for t in texts]
+        # rows with code points beyond the verified C++ table boundary take
+        # the Python twin (identical output guaranteed); ASCII bytes are
+        # < 0x80 so a cheap max-byte scan decides most rows
+        py_rows = [r for r, b in enumerate(encoded)
+                   if (max(b) >= 0xC0 if b else False)
+                   and any(ord(c) >= _CPP_SAFE_BOUNDARY for c in texts[r])]
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(b) for b in encoded], out=offsets[1:])
+        blob = b"".join(encoded)
+        ids = np.zeros((n, cap), np.int32)
+        word_ids = np.full((n, cap), -1, np.int32)
+        starts = np.zeros((n, cap), np.int32)
+        ends = np.zeros((n, cap), np.int32)
+        counts = np.zeros(n, np.int32)
+        if n:
+            self._lib.wp_tokenize_batch(
+                self._handle, blob, offsets, n, cap,
+                min(self.n_threads, n), ids, word_ids, starts, ends, counts)
+        if py_rows:
+            p_ids, p_wids, p_starts, p_ends, p_cnt = tokenize_batch_py(
+                self.vocab, [texts[r] for r in py_rows], self.lowercase,
+                self.unk_token_id, cap)
+            rows = np.asarray(py_rows)
+            ids[rows], word_ids[rows] = p_ids, p_wids
+            starts[rows], ends[rows], counts[rows] = p_starts, p_ends, p_cnt
+        return ids, word_ids, starts, ends, counts
+
+
+def load_wordpiece(path: str, prefer_native: bool = True, **kw):
+    """vocab.txt dir/file → native-backed tokenizer, Python twin fallback
+    (non-contiguous vocab ids or a missing toolchain fall through)."""
+    if prefer_native and native_available():
+        try:
+            return CppWordPieceTokenizer.from_pretrained(path, **kw)
+        except RuntimeError:
+            pass
+    return WordPieceTokenizer.from_pretrained(path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Data-loader primitives (C++-backed with numpy fallback)
+# ---------------------------------------------------------------------------
+
+def _py_permutation(n: int, seed: int) -> np.ndarray:
+    """Vectorized numpy twin of dl_permutation: indices stably sorted by a
+    per-index splitmix64 key — bit-identical to the C++ implementation."""
+    u = np.uint64
+    m64 = u(0xFFFFFFFFFFFFFFFF)
+    seedmix = u((seed * 0xD1342543DE82EF95 + 0x2545F4914F6CDD1D) & int(m64))
+    idx = np.arange(n, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        z = (seedmix ^ (idx * u(0x9E3779B97F4A7C15))) + u(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> u(30))) * u(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> u(27))) * u(0x94D049BB133111EB)
+        z = z ^ (z >> u(31))
+    return np.argsort(z, kind="stable").astype(np.int64)
+
+
+def native_permutation(n: int, seed: int) -> np.ndarray:
+    """Deterministic epoch permutation — identical on every host and
+    platform (the cross-host agreement ShardedBatcher relies on)."""
+    lib = load_native()
+    if lib is None:
+        return _py_permutation(n, seed)
+    out = np.empty(n, np.int64)
+    lib.dl_permutation(n, ctypes.c_uint64(seed & ((1 << 64) - 1)), out)
+    return out
+
+
+def native_gather(src: np.ndarray, idx: np.ndarray,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+    """out[b] = src[idx[b]] for 1-D/2-D int32 src, multithreaded memcpy
+    (the tf.data batch-gather step). Falls back to numpy fancy indexing."""
+    lib = load_native()
+    idx = np.asarray(idx)
+    if (lib is None or src.dtype != np.int32 or not src.flags.c_contiguous
+            or idx.dtype == np.bool_):
+        result = src[idx]
+        if out is not None:
+            out[...] = result
+            return out
+        return result
+    idx = np.ascontiguousarray(idx, np.int64)
+    if len(idx) and (idx.min() < 0 or idx.max() >= src.shape[0]):
+        # preserve numpy's failure mode (dl_gather is unchecked memcpy);
+        # negative indices fall back to fancy indexing semantics
+        if idx.min() < 0:
+            result = src[idx]
+            if out is not None:
+                out[...] = result
+                return out
+            return result
+        raise IndexError(
+            f"index {int(idx.max())} out of bounds for axis 0 with size {src.shape[0]}")
+    row_elems = int(np.prod(src.shape[1:], dtype=np.int64)) if src.ndim > 1 else 1
+    shape = (len(idx),) + src.shape[1:]
+    if out is None:
+        out = np.empty(shape, np.int32)
+    lib.dl_gather(src.reshape(src.shape[0], -1) if src.ndim > 1 else src,
+                  row_elems, idx, len(idx), out.reshape(len(idx), -1)
+                  if out.ndim > 1 else out, _default_threads())
+    return out
+
+
+def native_row_lengths(mask: np.ndarray) -> np.ndarray:
+    """Token count per row of an attention-mask matrix (bucketing support)."""
+    lib = load_native()
+    mask = np.ascontiguousarray(mask, np.int32)
+    if lib is None:
+        return (mask != 0).sum(axis=1).astype(np.int32)
+    n, L = mask.shape
+    out = np.empty(n, np.int32)
+    lib.dl_row_lengths(mask, n, L, out, _default_threads())
+    return out

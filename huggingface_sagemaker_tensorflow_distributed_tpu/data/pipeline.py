@@ -51,6 +51,13 @@ class ArrayDataset:
         return len(next(iter(self.columns.values())))
 
     def __getitem__(self, idx) -> dict[str, np.ndarray]:
+        if isinstance(idx, np.ndarray) and idx.ndim == 1:
+            # batch gather through the native loader (parallel memcpy,
+            # native/dataloader.cc) — falls back to numpy fancy indexing
+            from huggingface_sagemaker_tensorflow_distributed_tpu.data.native import (
+                native_gather,
+            )
+            return {k: native_gather(v, idx) for k, v in self.columns.items()}
         return {k: v[idx] for k, v in self.columns.items()}
 
     @classmethod
@@ -175,6 +182,9 @@ class ShardedBatcher:
                 f"global batch {global_batch_size} not divisible by "
                 f"{self.process_count} hosts")
         self.per_host = global_batch_size // self.process_count
+        # column shardings depend only on (ndim, token dim): compute once,
+        # not per column per step (mesh scans are host-side hot-path work)
+        self._sharding_cache: dict[tuple, NamedSharding] = {}
 
     def steps_per_epoch(self) -> int:
         n = len(self.dataset)
@@ -191,7 +201,13 @@ class ShardedBatcher:
         n = len(self.dataset)
         order = np.arange(n)
         if self.shuffle:
-            order = np.random.RandomState(self.seed + epoch).permutation(n)
+            # platform-independent epoch permutation (native/dataloader.cc;
+            # Python twin gives the identical order without the toolchain) —
+            # every host derives the same global order with no communication
+            from huggingface_sagemaker_tensorflow_distributed_tpu.data.native import (
+                native_permutation,
+            )
+            order = native_permutation(n, self.seed + epoch)
         steps = self.steps_per_epoch()
         for s in range(start_step, steps):
             lo = s * self.global_batch_size
@@ -217,8 +233,14 @@ class ShardedBatcher:
         for batch in self.local_batches(epoch, start_step):
             yield {
                 k: jax.make_array_from_process_local_data(
-                    batch_column_sharding(
-                        self.mesh, v.ndim, v.shape[1] if v.ndim >= 2 else None),
-                    v)
+                    self._column_sharding(v), v)
                 for k, v in batch.items()
             }
+
+    def _column_sharding(self, v: np.ndarray) -> NamedSharding:
+        key = (v.ndim, v.shape[1] if v.ndim >= 2 else None)
+        sharding = self._sharding_cache.get(key)
+        if sharding is None:
+            sharding = batch_column_sharding(self.mesh, *key)
+            self._sharding_cache[key] = sharding
+        return sharding

@@ -263,11 +263,27 @@ class HFTokenizer:
 
 
 def load_tokenizer(model_name_or_path: str, vocab_size: int = 30522):
-    """Tokenizer factory: HF files if present locally, hash fallback otherwise."""
+    """Tokenizer factory, best implementation first: a bare ``vocab.txt``
+    loads our in-repo WordPiece (C++ core when built, Python twin
+    otherwise); other local HF tokenizer files load through HF; no files
+    at all falls back to the hash tokenizer."""
     if os.path.isdir(model_name_or_path):
         if os.path.exists(os.path.join(model_name_or_path, "word_hash_tokenizer.json")):
             return WordHashTokenizer.from_pretrained(model_name_or_path)
-        if any(os.path.exists(os.path.join(model_name_or_path, f))
-               for f in ("tokenizer.json", "vocab.txt", "spiece.model", "tokenizer_config.json")):
+        has_vocab = os.path.exists(os.path.join(model_name_or_path, "vocab.txt"))
+        has_other = any(os.path.exists(os.path.join(model_name_or_path, f))
+                        for f in ("tokenizer.json", "spiece.model"))
+        if has_vocab and not has_other:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.data.native import (
+                load_wordpiece,
+            )
+            try:
+                return load_wordpiece(model_name_or_path)
+            except (ValueError, OSError):
+                # e.g. non-BERT special tokens in vocab.txt — let HF's
+                # tokenizer classes interpret the directory instead
+                pass
+        if has_vocab or has_other or os.path.exists(
+                os.path.join(model_name_or_path, "tokenizer_config.json")):
             return HFTokenizer.from_pretrained(model_name_or_path)
     return WordHashTokenizer(vocab_size=vocab_size)

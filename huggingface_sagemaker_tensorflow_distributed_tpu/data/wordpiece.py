@@ -1,0 +1,390 @@
+"""WordPiece tokenizer: vocab-true BERT tokenization, pure-Python twin.
+
+The reference gets real WordPiece tokenization from HF ``tokenizers``
+(Rust) via ``AutoTokenizer`` (reference ``scripts/train.py:69,75,90``;
+SURVEY.md D8). This module is the framework's in-repo equivalent:
+
+- :func:`tokenize_batch_py` — the pure-Python tokenization core
+  (BasicTokenizer + greedy longest-match WordPiece, HF semantics),
+  emitting per-row token streams of (id, word_index, char_start,
+  char_end). The C++ core in ``native/wordpiece.cc`` implements the same
+  contract multithreaded; tests assert they agree token-for-token.
+- :class:`WordPieceTokenizer` — the full tokenizer interface
+  (``__call__`` / ``encode_words`` / ``encode_qa`` / ``save_pretrained``,
+  same surface as ``tokenization.WordHashTokenizer``), with assembly
+  (specials, pair segments, truncation, static-shape padding) done once
+  here in numpy and shared by the native-backed subclass
+  (``data.native.CppWordPieceTokenizer``).
+
+Offsets are code-point positions in the raw input string (HF
+``offset_mapping`` semantics) so QA char spans map exactly.
+"""
+
+from __future__ import annotations
+
+import os
+import unicodedata
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+MAX_WORD_CHARS = 100  # HF max_input_chars_per_word
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python tokenization core (the oracle the C++ core is tested against)
+# ---------------------------------------------------------------------------
+
+def _is_whitespace(ch: str) -> bool:
+    if ch in (" ", "\t", "\n", "\r"):
+        return True
+    return unicodedata.category(ch) == "Zs"
+
+
+def _is_control(ch: str) -> bool:
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch).startswith("C")
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or (123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_cjk(cp: int) -> bool:
+    return (
+        0x4E00 <= cp <= 0x9FFF or 0x3400 <= cp <= 0x4DBF
+        or 0x20000 <= cp <= 0x2A6DF or 0x2A700 <= cp <= 0x2B73F
+        or 0x2B740 <= cp <= 0x2B81F or 0x2B820 <= cp <= 0x2CEAF
+        or 0xF900 <= cp <= 0xFAFF or 0x2F800 <= cp <= 0x2FA1F
+    )
+
+
+def _clean_char(ch: str, lowercase: bool) -> str:
+    """lowercase + NFD accent strip of one char; '' to drop it."""
+    if lowercase:
+        ch = ch.lower()
+        out = []
+        for d in unicodedata.normalize("NFD", ch):
+            if unicodedata.category(d) != "Mn":
+                out.append(d)
+        ch = "".join(out)
+    return ch
+
+
+def tokenize_text_py(vocab: dict[str, int], text: str, lowercase: bool,
+                     unk_id: int, cap: int) -> list[tuple[int, int, int, int]]:
+    """One text → [(token_id, word_index, char_start, char_end)], at most
+    ``cap`` tokens. Matches native/wordpiece.cc `tokenize_one`."""
+    # basic tokenize: words of (cleaned_text, start, end, word_index)
+    words: list[tuple[str, int, int, int]] = []
+    cur: list[str] = []
+    cur_start = -1
+    word_index = -1
+    in_space = True
+
+    def flush(end_pos: int):
+        nonlocal cur, cur_start
+        if cur:
+            words.append(("".join(cur), cur_start, end_pos, word_index))
+            cur = []
+        cur_start = -1
+
+    for pos, ch in enumerate(text):
+        if ch == "\0" or ch == "�" or _is_control(ch):
+            continue
+        if _is_whitespace(ch):
+            flush(pos)
+            in_space = True
+            continue
+        if in_space:
+            word_index += 1
+            in_space = False
+        if lowercase:
+            ch = _clean_char(ch, True)
+            if not ch:
+                continue
+        # after folding, a char may expand (e.g. ß → ss is NOT in NFD; ß
+        # stays) or become punctuation-bearing; treat each produced char
+        if len(ch) == 1 and (_is_punctuation(ch) or _is_cjk(ord(ch))):
+            flush(pos)
+            words.append((ch, pos, pos + 1, word_index))
+            continue
+        if not cur:
+            cur_start = pos
+        cur.append(ch)
+    flush(len(text))
+
+    # wordpiece
+    out: list[tuple[int, int, int, int]] = []
+    for wtext, wstart, wend, widx in words:
+        if len(out) >= cap:
+            break
+        if len(wtext) > MAX_WORD_CHARS:
+            out.append((unk_id, widx, wstart, wend))
+            continue
+        exact = len(wtext) == wend - wstart
+        pieces: list[tuple[int, int, int]] = []
+        start = 0
+        ok = True
+        while start < len(wtext):
+            end = len(wtext)
+            found = -1
+            while end > start:
+                probe = ("##" if start else "") + wtext[start:end]
+                pid = vocab.get(probe)
+                if pid is not None:
+                    found = pid
+                    break
+                end -= 1
+            if found < 0:
+                ok = False
+                break
+            pieces.append((found, start, end))
+            start = end
+        if not ok:
+            out.append((unk_id, widx, wstart, wend))
+            continue
+        for pid, s, e in pieces:
+            if len(out) >= cap:
+                break
+            if exact:
+                out.append((pid, widx, wstart + s, wstart + e))
+            else:
+                out.append((pid, widx, wstart, wend))
+    return out[:cap]
+
+
+def tokenize_batch_py(vocab, texts: Sequence[str], lowercase: bool,
+                      unk_id: int, cap: int):
+    """Batch version of :func:`tokenize_text_py` with the array contract the
+    native core uses: (ids, word_ids, starts, ends) int32 [n, cap] + counts."""
+    n = len(texts)
+    ids = np.zeros((n, cap), np.int32)
+    word_ids = np.full((n, cap), -1, np.int32)
+    starts = np.zeros((n, cap), np.int32)
+    ends = np.zeros((n, cap), np.int32)
+    counts = np.zeros(n, np.int32)
+    for r, text in enumerate(texts):
+        toks = tokenize_text_py(vocab, text, lowercase, unk_id, cap)
+        counts[r] = len(toks)
+        for t, (pid, widx, s, e) in enumerate(toks):
+            ids[r, t] = pid
+            word_ids[r, t] = widx
+            starts[r, t] = s
+            ends[r, t] = e
+    return ids, word_ids, starts, ends, counts
+
+
+# ---------------------------------------------------------------------------
+# Full tokenizer interface (assembly shared with the native subclass)
+# ---------------------------------------------------------------------------
+
+class WordPieceTokenizer:
+    """Vocab-true BERT tokenizer (pure Python core).
+
+    Same interface as ``tokenization.WordHashTokenizer`` /
+    ``tokenization.HFTokenizer``; construct from a BERT ``vocab.txt``
+    (one token per line, line number = id).
+    """
+
+    model_max_length = 512
+
+    def __init__(self, vocab: dict[str, int], lowercase: bool = True,
+                 unk_token: str = "[UNK]", cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]"):
+        self.vocab = vocab
+        self.lowercase = lowercase
+        self.unk_token, self.cls_token = unk_token, cls_token
+        self.sep_token, self.pad_token = sep_token, pad_token
+        for name in (unk_token, cls_token, sep_token, pad_token):
+            if name not in vocab:
+                raise ValueError(f"special token {name!r} missing from vocab")
+        self.unk_token_id = vocab[unk_token]
+        self.cls_token_id = vocab[cls_token]
+        self.sep_token_id = vocab[sep_token]
+        self.pad_token_id = vocab[pad_token]
+        self.vocab_size = len(vocab)
+
+    # -- core: overridden by the C++-backed subclass ------------------------
+
+    def _tokenize_batch(self, texts: Sequence[str], cap: int):
+        return tokenize_batch_py(self.vocab, texts, self.lowercase,
+                                 self.unk_token_id, cap)
+
+    # -- interface ----------------------------------------------------------
+
+    def __call__(self, texts, truncation: bool = True, padding: str = "max_length",
+                 max_length: int | None = None, text_pairs=None,
+                 add_special_tokens: bool = True):
+        if isinstance(texts, str):
+            texts = [texts]
+        max_length = max_length or self.model_max_length
+        n = len(texts)
+        cap = max_length if truncation else max(max_length, 1 << 16)
+        a_ids, _, _, _, a_cnt = self._tokenize_batch(texts, cap)
+        if text_pairs is not None:
+            b_ids, _, _, _, b_cnt = self._tokenize_batch(list(text_pairs), cap)
+
+        rows, segs = [], []
+        for r in range(n):
+            a = list(a_ids[r, :a_cnt[r]])
+            if text_pairs is None:
+                if truncation and add_special_tokens:
+                    a = a[:max_length - 2]
+                ids = ([self.cls_token_id] + a + [self.sep_token_id]
+                       if add_special_tokens else a[:max_length] if truncation else a)
+                seg = [0] * len(ids)
+            else:
+                b = list(b_ids[r, :b_cnt[r]])
+                n_special = 3 if add_special_tokens else 0
+                if truncation:
+                    # HF longest_first: drop tail tokens from whichever
+                    # segment is currently longer until the pair fits,
+                    # keeping both separators
+                    # ties drop from the pair side, per HF truncate_sequences
+                    budget = max_length - n_special
+                    while len(a) + len(b) > budget and (a or b):
+                        if len(a) > len(b):
+                            a.pop()
+                        else:
+                            b.pop()
+                if add_special_tokens:
+                    ids = ([self.cls_token_id] + a + [self.sep_token_id]
+                           + b + [self.sep_token_id])
+                    seg = [0] * (len(a) + 2) + [1] * (len(b) + 1)
+                else:
+                    ids = a + b
+                    seg = [0] * len(a) + [1] * len(b)
+            if truncation and len(ids) > max_length:
+                ids, seg = ids[:max_length], seg[:max_length]
+            rows.append(ids)
+            segs.append(seg)
+
+        if padding == "longest":
+            max_length = min(max_length, max((len(i) for i in rows), default=1))
+        input_ids = np.full((n, max_length), self.pad_token_id, np.int32)
+        attention_mask = np.zeros((n, max_length), np.int32)
+        token_type_ids = np.zeros((n, max_length), np.int32)
+        for r, (ids, seg) in enumerate(zip(rows, segs)):
+            ids, seg = ids[:max_length], seg[:max_length]
+            input_ids[r, :len(ids)] = ids
+            attention_mask[r, :len(ids)] = 1
+            token_type_ids[r, :len(seg)] = seg
+        out = {"input_ids": input_ids, "attention_mask": attention_mask}
+        if text_pairs is not None:
+            out["token_type_ids"] = token_type_ids
+        return out
+
+    def encode_words(self, word_lists, max_length: int | None = None):
+        """Pre-split words → subword ids + word alignment (NER path;
+        fast-tokenizer ``word_ids()`` contract, -1 on specials/pads)."""
+        max_length = max_length or self.model_max_length
+        n = len(word_lists)
+        # Tokenize each row's words joined by spaces: word_index from the
+        # core is then exactly the source-word index (words contain no
+        # whitespace in token-classification corpora).
+        joined = [" ".join(words) for words in word_lists]
+        ids, wids, _, _, cnt = self._tokenize_batch(joined, max_length)
+        input_ids = np.full((n, max_length), self.pad_token_id, np.int32)
+        attention_mask = np.zeros((n, max_length), np.int32)
+        word_ids = np.full((n, max_length), -1, np.int32)
+        for r in range(n):
+            k = min(int(cnt[r]), max_length - 2)
+            row = [self.cls_token_id] + list(ids[r, :k]) + [self.sep_token_id]
+            wrow = [-1] + list(wids[r, :k]) + [-1]
+            input_ids[r, :len(row)] = row
+            attention_mask[r, :len(row)] = 1
+            word_ids[r, :len(wrow)] = wrow
+        return {"input_ids": input_ids, "attention_mask": attention_mask,
+                "word_ids": word_ids}
+
+    def encode_qa(self, questions, contexts, start_chars, answer_texts,
+                  max_length: int | None = None):
+        """Question+context pairs → ids + answer token spans via the
+        code-point offsets the core emits (HF offset_mapping semantics,
+        truncation="only_second")."""
+        max_length = max_length or self.model_max_length
+        n = len(questions)
+        q_ids, _, _, _, q_cnt = self._tokenize_batch(list(questions), max_length)
+        c_ids, _, c_starts, c_ends, c_cnt = self._tokenize_batch(
+            list(contexts), max_length)
+
+        input_ids = np.full((n, max_length), self.pad_token_id, np.int32)
+        attention_mask = np.zeros((n, max_length), np.int32)
+        token_type_ids = np.zeros((n, max_length), np.int32)
+        start_positions = np.zeros(n, np.int32)
+        end_positions = np.zeros(n, np.int32)
+        for r in range(n):
+            # only_second truncation: question keeps its tokens (capped so
+            # CLS/q/SEP/SEP still fit), context gets the remaining room
+            nq = min(int(q_cnt[r]), max_length - 3)
+            room = max_length - nq - 3
+            nc = min(int(c_cnt[r]), max(room, 0))
+            ids = ([self.cls_token_id] + list(q_ids[r, :nq]) + [self.sep_token_id]
+                   + list(c_ids[r, :nc]) + [self.sep_token_id])
+            seg = [0] * (nq + 2) + [1] * (nc + 1)
+            input_ids[r, :len(ids)] = ids
+            attention_mask[r, :len(ids)] = 1
+            token_type_ids[r, :len(seg)] = seg
+            ctx_offset = nq + 2
+            a_start = start_chars[r]
+            a_end = a_start + len(answer_texts[r])
+            tok_start = tok_end = None
+            last_end = 0
+            for t in range(nc):
+                s, e = int(c_starts[r, t]), int(c_ends[r, t])
+                if e == s:
+                    continue
+                if s < a_end and e > a_start:
+                    if tok_start is None:
+                        tok_start = ctx_offset + t
+                    tok_end = ctx_offset + t
+                    last_end = e
+            # label only spans containing the FULL answer (HF run_qa
+            # convention); truncated-away answers → (0, 0) = CLS
+            if tok_start is not None and last_end >= a_end:
+                start_positions[r] = tok_start
+                end_positions[r] = tok_end
+        return {"input_ids": input_ids, "attention_mask": attention_mask,
+                "token_type_ids": token_type_ids,
+                "start_positions": start_positions,
+                "end_positions": end_positions}
+
+    # -- persistence (HF vocab.txt layout: save_pretrained parity,
+    #    reference scripts/train.py:183) -----------------------------------
+
+    def save_pretrained(self, output_dir: str) -> None:
+        os.makedirs(output_dir, exist_ok=True)
+        inv = sorted(self.vocab.items(), key=lambda kv: kv[1])
+        with open(os.path.join(output_dir, "vocab.txt"), "w", encoding="utf-8") as f:
+            for token, _ in inv:
+                f.write(token + "\n")
+        import json
+        with open(os.path.join(output_dir, "tokenizer_config.json"), "w") as f:
+            json.dump({"tokenizer_class": "BertTokenizer",
+                       "do_lower_case": self.lowercase,
+                       "model_max_length": self.model_max_length}, f)
+
+    @classmethod
+    def from_pretrained(cls, path: str, lowercase: Optional[bool] = None,
+                        **kw) -> "WordPieceTokenizer":
+        vocab_file = path if path.endswith(".txt") else os.path.join(path, "vocab.txt")
+        vocab: dict[str, int] = {}
+        with open(vocab_file, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                vocab[line.rstrip("\r\n")] = i
+        cfg = {}
+        cfg_path = os.path.join(os.path.dirname(vocab_file), "tokenizer_config.json")
+        if os.path.exists(cfg_path):
+            import json
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+        if lowercase is None:
+            lowercase = bool(cfg.get("do_lower_case", True))
+        tok = cls(vocab, lowercase=lowercase, **kw)
+        tok.model_max_length = int(cfg.get("model_max_length", cls.model_max_length))
+        return tok

@@ -1,0 +1,101 @@
+// Data-loader core — C++ native runtime component.
+//
+// TPU-native framework equivalent of the native machinery under the
+// reference's data path: Apache Arrow's C++ column store behind HF
+// `datasets` (reference scripts/train.py:72) and tf.data's C++ batching
+// iterator (reference scripts/train.py:84-86,98; SURVEY.md D9/D10).
+// Three primitives, all operating on host int32 column arrays:
+//
+//  - dl_permutation: deterministic keyed-hash shuffle (splitmix64 keys,
+//    stable sort) — the epoch-order agreement every host computes
+//    identically, the input-pipeline analogue of the reference's rank-0
+//    broadcast discipline. Key-sorted rather than Fisher-Yates so the
+//    Python twin is a vectorized numpy argsort producing bit-identical
+//    orders (data/native.py::_py_permutation).
+//  - dl_gather: parallel row gather of a batch's indices into a contiguous
+//    output buffer (the from_tensor_slices→batch step, done zero-copy into
+//    a caller-owned staging buffer that jax can ingest directly).
+//  - dl_row_lengths: token count per row (length-bucketed batching support).
+//
+// Python binding: data/native.py (ctypes).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t mix64(uint64_t z) {
+  z += 0x9E3779B97F4A7C15ull;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[0..n) = seeded permutation of [0, n): indices stably sorted by a
+// per-index splitmix64 key. Same (n, seed) -> same result on every host
+// and platform; mirrored exactly (vectorized) in data/native.py.
+void dl_permutation(int64_t n, uint64_t seed, int64_t* out) {
+  uint64_t seedmix = seed * 0xD1342543DE82EF95ull + 0x2545F4914F6CDD1Dull;
+  std::vector<uint64_t> keys((size_t)n);
+  for (int64_t i = 0; i < n; i++)
+    keys[i] = mix64(seedmix ^ ((uint64_t)i * 0x9E3779B97F4A7C15ull));
+  for (int64_t i = 0; i < n; i++) out[i] = i;
+  std::stable_sort(out, out + n, [&](int64_t a, int64_t b) {
+    return keys[a] < keys[b];
+  });
+}
+
+// Gather rows: out[b, :] = src[idx[b], :], row_elems int32 elements per row.
+// Parallel memcpy over batch rows.
+void dl_gather(const int32_t* src, int64_t row_elems, const int64_t* idx,
+               int64_t n_idx, int32_t* out, int32_t n_threads) {
+  const size_t row_bytes = (size_t)row_elems * sizeof(int32_t);
+  auto copy_range = [&](int64_t lo, int64_t hi) {
+    for (int64_t b = lo; b < hi; b++)
+      memcpy(out + b * row_elems, src + idx[b] * row_elems, row_bytes);
+  };
+  if (n_threads <= 1 || n_idx < 256) { copy_range(0, n_idx); return; }
+  n_threads = std::min<int64_t>(n_threads, n_idx);
+  std::vector<std::thread> threads;
+  int64_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; t++) {
+    int64_t lo = t * chunk, hi = std::min<int64_t>(lo + chunk, n_idx);
+    if (lo >= hi) break;
+    threads.emplace_back(copy_range, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// lengths[r] = number of nonzero entries in mask row r (token count);
+// used for length-bucketed batching.
+void dl_row_lengths(const int32_t* mask, int64_t n_rows, int64_t row_elems,
+                    int32_t* lengths, int32_t n_threads) {
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; r++) {
+      const int32_t* row = mask + r * row_elems;
+      int32_t c = 0;
+      for (int64_t j = 0; j < row_elems; j++) c += (row[j] != 0);
+      lengths[r] = c;
+    }
+  };
+  if (n_threads <= 1 || n_rows < 1024) { work(0, n_rows); return; }
+  n_threads = std::min<int64_t>(n_threads, n_rows);
+  std::vector<std::thread> threads;
+  int64_t chunk = (n_rows + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; t++) {
+    int64_t lo = t * chunk, hi = std::min<int64_t>(lo + chunk, n_rows);
+    if (lo >= hi) break;
+    threads.emplace_back(work, lo, hi);
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

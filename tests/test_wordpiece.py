@@ -1,0 +1,273 @@
+"""WordPiece tokenizer + native data-loader tests.
+
+Three-way parity: the C++ core (native/wordpiece.cc) against the
+pure-Python twin (data/wordpiece.py), and both against HF's
+``BertTokenizer`` — the actual implementation the reference uses via
+``AutoTokenizer.from_pretrained`` (reference ``scripts/train.py:69``) —
+built from a local vocab file (offline).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.native import (
+    CppWordPieceTokenizer,
+    _py_permutation,
+    native_available,
+    native_gather,
+    native_permutation,
+    native_row_lengths,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.wordpiece import (
+    WordPieceTokenizer,
+)
+
+VOCAB_TOKENS = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over", "lazy",
+    "dog", "un", "##aff", "##able", "run", "!", ",", ".", "-", "hello",
+    "world", "re", "##sum", "##e", "2023", "#", "is", "a", "b", "##c",
+    "ab", "##b", "new", "york", "city", "in", "of", "what", "?", "and",
+    "to", "it", "was", "big", "small", "##ly", "##ing", "work", "play",
+]
+
+TEXTS = [
+    "The quick brown fox jumped over the lazy dog!",
+    "Hello, WORLD! unaffable résumé abc",
+    "runs runs RUNS",
+    "#2023 is a, b !!",
+    "ab abc bc",
+    "",
+    "   \t\n  ",
+    "newly working PLAYING bigly",
+    "New York City -- in 2023?",
+    "a" * 150 + " ok",            # > max_input_chars_per_word → UNK
+    "naïve café über señor",
+    "über-big small.and.quick",
+    "日本語 text 中文",            # CJK chars split standalone
+    "what is this",      # unicode spaces
+    "zero​width and bell\x07char",  # control chars dropped
+    "co­operate soft­hyphen",   # Cf chars (soft hyphen) dropped
+    "a⁠b c‎d ⁦e⁩",    # word joiner, LRM, isolates: all Cf
+    # beyond the C++ boundary: routed to the Python twin inside the native
+    # tokenizer, so parity must still hold exactly
+    "ёлка and ЁЛКА",            # Cyrillic with NFD-decomposable ё
+    "άλφα ΆΛΦΑ βήτα",           # accented Greek
+    "што؟ arabic ، question",   # Arabic punctuation
+    "mixed ascii then ελληνικά",
+]
+
+
+@pytest.fixture(scope="module")
+def vocab():
+    return {t: i for i, t in enumerate(VOCAB_TOKENS)}
+
+
+@pytest.fixture(scope="module")
+def py_tok(vocab):
+    return WordPieceTokenizer(vocab)
+
+
+@pytest.fixture(scope="module")
+def cc_tok(vocab):
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    return CppWordPieceTokenizer(vocab)
+
+
+@pytest.fixture(scope="module")
+def hf_tok(vocab, tmp_path_factory):
+    transformers = pytest.importorskip("transformers")
+    path = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    path.write_text("\n".join(VOCAB_TOKENS) + "\n", encoding="utf-8")
+    return transformers.BertTokenizer(str(path), do_lower_case=True)
+
+
+def test_cpp_python_parity(py_tok, cc_tok):
+    for max_length in (8, 32, 128):
+        a = py_tok(TEXTS, max_length=max_length)
+        b = cc_tok(TEXTS, max_length=max_length)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=f"{k} @ {max_length}")
+
+
+def test_cpp_python_parity_token_streams(py_tok, cc_tok):
+    """Token-for-token: ids, word alignment, AND char offsets agree."""
+    a = py_tok._tokenize_batch(TEXTS, 64)
+    b = cc_tok._tokenize_batch(TEXTS, 64)
+    for name, x, y in zip(("ids", "word_ids", "starts", "ends", "counts"), a, b):
+        np.testing.assert_array_equal(x, y, err_msg=name)
+
+
+def test_hf_parity(py_tok, hf_tok):
+    for text in TEXTS:
+        ours = [int(i) for i in py_tok([text], max_length=64)["input_ids"][0] if i != 0]
+        theirs = hf_tok(text, max_length=64, truncation=True)["input_ids"]
+        assert ours == list(theirs), text
+
+
+def test_hf_parity_pairs(py_tok, hf_tok):
+    qs = ["what is big?", "the fox runs"]
+    cs = ["a big dog runs over the lazy fox", "hello world and new york"]
+    ours = py_tok(qs, text_pairs=cs, max_length=32)
+    theirs = hf_tok(qs, cs, max_length=32, truncation=True,
+                    padding="max_length", return_tensors="np")
+    np.testing.assert_array_equal(ours["input_ids"], theirs["input_ids"])
+    np.testing.assert_array_equal(ours["token_type_ids"], theirs["token_type_ids"])
+    np.testing.assert_array_equal(ours["attention_mask"], theirs["attention_mask"])
+
+
+def test_hf_parity_pairs_truncated(py_tok, hf_tok):
+    """longest_first truncation: final [SEP] kept, longer side trimmed."""
+    qs = ["what is big and small and quick and lazy?",
+          "a b", "the quick brown fox jumped over the lazy dog and ran"]
+    cs = ["a big dog runs over the lazy fox in new york city and plays",
+          "hello world and new york city in 2023 and the fox", "it was big"]
+    for max_length in (8, 12, 16):
+        ours = py_tok(qs, text_pairs=cs, max_length=max_length)
+        theirs = hf_tok(qs, cs, max_length=max_length, truncation=True,
+                        padding="max_length", return_tensors="np")
+        np.testing.assert_array_equal(ours["input_ids"], theirs["input_ids"])
+        np.testing.assert_array_equal(ours["token_type_ids"],
+                                      theirs["token_type_ids"])
+
+
+def test_encode_words_alignment(cc_tok):
+    words = [["newly", "working", "dog"], ["unaffable", "fox"]]
+    out = cc_tok.encode_words(words, max_length=16)
+    # row 0: CLS new ##ly work ##ing dog SEP → word ids -1 0 0 1 1 2 -1
+    assert out["word_ids"][0, :7].tolist() == [-1, 0, 0, 1, 1, 2, -1]
+    # row 1: unaffable = un ##aff ##able (word 0), fox (word 1)
+    assert out["word_ids"][1, :6].tolist() == [-1, 0, 0, 0, 1, -1]
+    assert out["input_ids"][1, 4] == cc_tok.vocab["fox"]
+
+
+def test_encode_qa_span(cc_tok):
+    q = ["what is the dog?"]
+    c = ["the quick brown fox jumped over the lazy dog in New York City"]
+    ans = "lazy dog"
+    start = c[0].index(ans)
+    out = cc_tok.encode_qa(q, c, [start], [ans], max_length=64)
+    s, e = int(out["start_positions"][0]), int(out["end_positions"][0])
+    assert 0 < s <= e
+    ids = out["input_ids"][0]
+    assert ids[s] == cc_tok.vocab["lazy"]
+    assert ids[e] == cc_tok.vocab["dog"]
+    assert out["token_type_ids"][0, s] == 1
+
+
+def test_encode_qa_truncated_answer_is_cls(cc_tok):
+    c = ["the quick brown fox " * 40 + "hidden answer dog"]
+    start = c[0].index("dog")
+    out = cc_tok.encode_qa(["what?"], c, [start], ["dog"], max_length=32)
+    assert int(out["start_positions"][0]) == 0
+    assert int(out["end_positions"][0]) == 0
+
+
+def test_encode_qa_long_question(cc_tok):
+    """Question longer than max_length-3: question truncated, no crash."""
+    q = ["what is the quick brown fox and the lazy dog " * 4]
+    out = cc_tok.encode_qa(q, ["the dog"], [4], ["dog"], max_length=16)
+    assert out["input_ids"].shape == (1, 16)
+    assert int(out["attention_mask"][0].sum()) == 16
+    assert int(out["start_positions"][0]) == 0  # answer truncated away
+
+
+def test_model_max_length_roundtrip(tmp_path, py_tok):
+    py_tok.model_max_length = 128
+    py_tok.save_pretrained(str(tmp_path))
+    again = WordPieceTokenizer.from_pretrained(str(tmp_path))
+    assert again.model_max_length == 128
+    py_tok.model_max_length = 512  # restore module-scoped fixture
+
+
+def test_native_gather_bool_mask():
+    src = np.arange(12, dtype=np.int32).reshape(4, 3)
+    mask = np.array([True, False, True, False])
+    np.testing.assert_array_equal(native_gather(src, mask), src[mask])
+
+
+def test_cpp_rejects_noncontiguous_vocab():
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    bad = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3, "a": 5}  # gap at 4
+    with pytest.raises(RuntimeError):
+        CppWordPieceTokenizer(bad)
+
+
+def test_load_tokenizer_non_bert_specials_falls_back(tmp_path):
+    (tmp_path / "vocab.txt").write_text("<pad>\n<unk>\n<s>\n</s>\nhello\n")
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
+        load_tokenizer,
+    )
+    tok = load_tokenizer(str(tmp_path))  # must not raise
+    assert tok is not None
+
+
+def test_native_gather_bounds():
+    src = np.arange(20, dtype=np.int32).reshape(10, 2)
+    with pytest.raises(IndexError):
+        native_gather(src, np.array([0, 10]))
+    # negative indices keep numpy fancy-indexing semantics
+    np.testing.assert_array_equal(native_gather(src, np.array([-1, 0])),
+                                  src[np.array([-1, 0])])
+
+
+def test_save_load_roundtrip(tmp_path, py_tok, cc_tok):
+    cc_tok.save_pretrained(str(tmp_path))
+    assert (tmp_path / "vocab.txt").exists()
+    re_py = WordPieceTokenizer.from_pretrained(str(tmp_path))
+    a = py_tok(TEXTS[:4], max_length=32)
+    b = re_py(TEXTS[:4], max_length=32)
+    np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
+        load_tokenizer,
+    )
+    auto = load_tokenizer(str(tmp_path))
+    assert isinstance(auto, WordPieceTokenizer)  # includes the Cpp subclass
+    np.testing.assert_array_equal(
+        auto(TEXTS[:4], max_length=32)["input_ids"], a["input_ids"])
+
+
+def test_threading_determinism(vocab):
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    texts = [f"the quick brown fox {i} runs over {i*7} lazy dogs!" for i in range(257)]
+    one = CppWordPieceTokenizer(vocab, n_threads=1)(texts, max_length=32)
+    many = CppWordPieceTokenizer(vocab, n_threads=8)(texts, max_length=32)
+    np.testing.assert_array_equal(one["input_ids"], many["input_ids"])
+
+
+# -- data-loader primitives --------------------------------------------------
+
+def test_native_permutation_deterministic():
+    a = native_permutation(10_000, seed=123)
+    b = native_permutation(10_000, seed=123)
+    np.testing.assert_array_equal(a, b)
+    assert sorted(a.tolist()) == list(range(10_000))
+    assert not np.array_equal(a, native_permutation(10_000, seed=124))
+
+
+def test_native_permutation_matches_python_twin():
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    for n, seed in ((1, 0), (17, 5), (1000, 42)):
+        np.testing.assert_array_equal(native_permutation(n, seed),
+                                      _py_permutation(n, seed))
+
+
+def test_native_gather_matches_numpy(rng):
+    src = rng.randint(0, 1000, size=(500, 64)).astype(np.int32)
+    idx = rng.permutation(500)[:300]
+    np.testing.assert_array_equal(native_gather(src, idx), src[idx])
+    # 1-D (labels) path
+    labels = rng.randint(0, 2, size=500).astype(np.int32)
+    np.testing.assert_array_equal(native_gather(labels, idx), labels[idx])
+
+
+def test_native_row_lengths(rng):
+    mask = (rng.rand(100, 32) > 0.5).astype(np.int32)
+    np.testing.assert_array_equal(native_row_lengths(mask), mask.sum(axis=1))
