@@ -24,6 +24,8 @@ async), replacing tf.data's prefetch.
 
 from __future__ import annotations
 
+import queue
+import threading
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
@@ -149,6 +151,73 @@ class ArrayDataset:
                     "labels": labels})
 
 
+_PREFETCH_END = object()
+
+
+def _prefetch_producer(it, q: queue.Queue, stop: threading.Event) -> None:
+    # module-level target: the thread must NOT strongly reference the
+    # PrefetchIterator, or threading's live-thread registry would keep it
+    # reachable and the GC finalizer could never fire
+    try:
+        for item in it:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if stop.is_set():
+                return
+        q.put(_PREFETCH_END)
+    except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+        if not stop.is_set():
+            q.put(e)
+
+
+def _drain_and_stop(q: queue.Queue, stop: threading.Event) -> None:
+    stop.set()
+    # drain so a producer blocked on put() observes the stop flag
+    try:
+        while True:
+            q.get_nowait()
+    except queue.Empty:
+        pass
+
+
+class PrefetchIterator:
+    """Iterator wrapper that materializes up to ``depth`` items ahead on a
+    daemon thread. Exceptions from the producer re-raise at the consumer;
+    ``close()`` stops the producer promptly, and dropping the iterator
+    without closing triggers the same cleanup via ``weakref.finalize`` so
+    abandoned iterators don't pin prefetched device batches."""
+
+    def __init__(self, it: Iterator, depth: int = 2):
+        import weakref
+
+        self._queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=_prefetch_producer, args=(it, self._queue, self._stop),
+            daemon=True)
+        self._finalizer = weakref.finalize(
+            self, _drain_and_stop, self._queue, self._stop)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is _PREFETCH_END:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self):
+        self._finalizer()
+
+
 class ShardedBatcher:
     """Iterates global batches, yielding this host's shard of each.
 
@@ -225,11 +294,24 @@ class ShardedBatcher:
                                    (self.process_index + 1) * self.per_host]
             yield batch
 
-    def global_arrays(self, epoch: int = 0, start_step: int = 0) -> Iterator[dict[str, jax.Array]]:
+    def global_arrays(self, epoch: int = 0, start_step: int = 0,
+                      prefetch: int = 2):
         """Yield batches as globally-sharded jax.Arrays on the mesh.
 
         Token-dimension columns additionally shard over the ``seq`` axis
-        when the mesh has one (sequence parallelism)."""
+        when the mesh has one (sequence parallelism). With ``prefetch > 0``
+        (the default) gather + host→device transfer of the next batches
+        runs on a background thread — the tf.data prefetch the reference
+        gets for free (``scripts/train.py:84-86``), and essential when the
+        device is reached over a network tunnel where each transfer has
+        real latency. The returned iterator has ``close()`` for early exit.
+        """
+        it = self._device_batches(epoch, start_step)
+        if prefetch > 0:
+            return PrefetchIterator(it, depth=prefetch)
+        return it
+
+    def _device_batches(self, epoch: int, start_step: int) -> Iterator[dict[str, jax.Array]]:
         for batch in self.local_batches(epoch, start_step):
             yield {
                 k: jax.make_array_from_process_local_data(

@@ -284,43 +284,49 @@ class Trainer:
                 device_metrics: list = []
                 losses, accs = [], []
 
-                for step, batch in enumerate(
-                        train_batcher.global_arrays(epoch, start_step),
-                        start=start_step):
-                    if step >= steps_per_epoch:
-                        break
-                    if cfg.profile and not profiling and epoch == start_epoch \
-                            and step - start_step == 3:
-                        jax.profiler.start_trace(cfg.profile_dir)
-                        profiling = True
-                    self.state, metrics = self._train_step(self.state, batch)
-                    device_metrics.append(metrics)
-                    meter.window_step(gbs)
-                    if first_step:
-                        # exclude XLA compile from the throughput window
-                        jax.block_until_ready(metrics["loss"])
-                        meter.begin_window()
-                        first_step = False
-                    if profiling and step - start_step == 6:
-                        jax.block_until_ready(metrics["loss"])
-                        jax.profiler.stop_trace()
-                        profiling = False
-                    want_log = cfg.log_every_steps and step % cfg.log_every_steps == 0
-                    want_ckpt = (checkpointer is not None and cfg.checkpoint_every_steps
-                                 and (step + 1) % cfg.checkpoint_every_steps == 0)
-                    if want_log or want_ckpt:
-                        for m in sync(device_metrics):
-                            losses.append(float(m["loss"]))
-                            accs.append(float(m["accuracy"]))
-                        device_metrics = []
-                    if want_log:
-                        logger.info(
-                            "epoch %d step %d/%d loss %.4f acc %.4f (%.1f samples/s/chip)",
-                            epoch, step, steps_per_epoch, losses[-1], accs[-1],
-                            meter.samples_per_sec_per_chip)
-                    if want_ckpt:
-                        checkpointer.save(self.state, epoch=epoch,
-                                          step_in_epoch=step + 1)
+                # close() in finally: early exit (steps_per_epoch cap) and
+                # exceptions (OOM, failed checkpoint save) must both stop
+                # the prefetch thread, or it keeps transferring batches
+                batch_iter = train_batcher.global_arrays(epoch, start_step)
+                try:
+                    for step, batch in enumerate(batch_iter, start=start_step):
+                        if step >= steps_per_epoch:
+                            break
+                        if cfg.profile and not profiling and epoch == start_epoch \
+                                and step - start_step == 3:
+                            jax.profiler.start_trace(cfg.profile_dir)
+                            profiling = True
+                        self.state, metrics = self._train_step(self.state, batch)
+                        device_metrics.append(metrics)
+                        meter.window_step(gbs)
+                        if first_step:
+                            # exclude XLA compile from the throughput window
+                            jax.block_until_ready(metrics["loss"])
+                            meter.begin_window()
+                            first_step = False
+                        if profiling and step - start_step == 6:
+                            jax.block_until_ready(metrics["loss"])
+                            jax.profiler.stop_trace()
+                            profiling = False
+                        want_log = cfg.log_every_steps and step % cfg.log_every_steps == 0
+                        want_ckpt = (checkpointer is not None and cfg.checkpoint_every_steps
+                                     and (step + 1) % cfg.checkpoint_every_steps == 0)
+                        if want_log or want_ckpt:
+                            for m in sync(device_metrics):
+                                losses.append(float(m["loss"]))
+                                accs.append(float(m["accuracy"]))
+                            device_metrics = []
+                        if want_log:
+                            logger.info(
+                                "epoch %d step %d/%d loss %.4f acc %.4f (%.1f samples/s/chip)",
+                                epoch, step, steps_per_epoch, losses[-1], accs[-1],
+                                meter.samples_per_sec_per_chip)
+                        if want_ckpt:
+                            checkpointer.save(self.state, epoch=epoch,
+                                              step_in_epoch=step + 1)
+                finally:
+                    if hasattr(batch_iter, "close"):
+                        batch_iter.close()
 
                 for m in sync(device_metrics):
                     losses.append(float(m["loss"]))

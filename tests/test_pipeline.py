@@ -1,6 +1,9 @@
 """Input-pipeline tests: per-host sharding math (fixing the reference's
 every-rank-sees-all-data bug, SURVEY.md §2) and eval tail padding."""
 
+import gc
+import threading
+
 import numpy as np
 import pytest
 
@@ -89,3 +92,49 @@ def test_indivisible_global_batch_rejected():
     mesh = build_mesh(MeshConfig())
     with pytest.raises(ValueError):
         ShardedBatcher(_dataset(16), 6, mesh, process_index=0, process_count=4)
+
+
+def test_prefetch_iterator_values_and_exceptions():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (
+        PrefetchIterator,
+    )
+
+    assert list(PrefetchIterator(iter(range(7)), depth=2)) == list(range(7))
+
+    def boom():
+        yield 1
+        raise RuntimeError("producer failed")
+
+    it = PrefetchIterator(boom(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="producer failed"):
+        next(it)
+
+
+def test_prefetch_iterator_close_stops_thread():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (
+        PrefetchIterator,
+    )
+
+    it = PrefetchIterator(iter(range(10_000)), depth=2)
+    assert next(it) == 0
+    thread = it._thread
+    it.close()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
+
+
+def test_prefetch_iterator_gc_reclaims_thread():
+    """Dropping the iterator without close() must stop the producer (the
+    thread target must not keep the wrapper alive)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (
+        PrefetchIterator,
+    )
+
+    it = PrefetchIterator(iter(range(10_000)), depth=2)
+    assert next(it) == 0
+    thread = it._thread
+    del it
+    gc.collect()
+    thread.join(timeout=5)
+    assert not thread.is_alive()
