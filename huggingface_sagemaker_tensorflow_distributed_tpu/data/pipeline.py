@@ -194,6 +194,7 @@ class PrefetchIterator:
     def __init__(self, it: Iterator, depth: int = 2):
         import weakref
 
+        self._done = False
         self._queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -207,14 +208,19 @@ class PrefetchIterator:
         return self
 
     def __next__(self):
+        if self._done:
+            raise StopIteration
         item = self._queue.get()
         if item is _PREFETCH_END:
+            self._done = True
             raise StopIteration
         if isinstance(item, BaseException):
+            self._done = True
             raise item
         return item
 
     def close(self):
+        self._done = True
         self._finalizer()
 
 

@@ -79,7 +79,12 @@ def _flash_call(q, k, v, mask, scale, block_q, interpret):
 def flash_attention(q, k, v, mask=None, scale=None, block_q: int = 128,
                     interpret: bool | None = None):
     """Fused attention. q,k,v: [B, H, S, D]; mask additive, broadcastable
-    to [B, 1, 1, S] (padding masks; [B,H,Q,K] masks fall back to XLA)."""
+    to [B, 1, 1, S] (padding masks; [B,H,Q,K] masks fall back to XLA).
+
+    Differentiable: the backward pass recomputes attention via the XLA
+    expression and takes its VJP (flash-style recompute — no O(S²)
+    residuals are ever stored), so ``impl='flash'`` works in training.
+    """
     from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import xla_attention
 
     head_dim = q.shape[-1]
@@ -91,4 +96,32 @@ def flash_attention(q, k, v, mask=None, scale=None, block_q: int = 128,
         return xla_attention(q, k, v, mask=mask, scale=scale)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    return _flash_vjp(q, k, v, mask, scale, block_q, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_vjp(q, k, v, mask, scale, block_q, interpret):
     return _flash_call(q, k, v, mask, scale, block_q, interpret)
+
+
+def _flash_vjp_fwd(q, k, v, mask, scale, block_q, interpret):
+    return _flash_call(q, k, v, mask, scale, block_q, interpret), (q, k, v, mask)
+
+
+def _flash_vjp_bwd(scale, block_q, interpret, res, g):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import xla_attention
+
+    q, k, v, mask = res
+    if mask is None:
+        _, vjp = jax.vjp(
+            lambda q, k, v: xla_attention(q, k, v, scale=scale), q, k, v)
+        return (*vjp(g), None)
+    # mask is a differentiable input (learned additive biases are valid):
+    # include it in the recomputed VJP
+    _, vjp = jax.vjp(
+        lambda q, k, v, m: xla_attention(q, k, v, mask=m, scale=scale),
+        q, k, v, mask)
+    return vjp(g)
+
+
+_flash_vjp.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -186,7 +186,8 @@ class Trainer:
         # (adam mu/nu paths contain the param path, so the same rules hit).
         self.state_shardings = param_shardings(state, mesh)
         self.state = jax.device_put(state, self.state_shardings)
-        self._base_rng = jax.random.PRNGKey(config.seed)
+        # rbg = TPU hardware RNG for dropout keys (config.rng_impl docs)
+        self._base_rng = jax.random.key(config.seed, impl=config.rng_impl)
 
         # Batch shardings are inherited from the arrays the batcher
         # device_puts (batch dim over data axes; token dims over ``seq``

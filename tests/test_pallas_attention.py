@@ -59,3 +59,28 @@ def test_fallback_on_general_mask():
     out = flash_attention(q, k, v, mask=full, interpret=True)
     ref = xla_attention(q, k, v, mask=full)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_flash_mask_gradient_nonzero():
+    """The additive mask is a differentiable input (learned biases)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        make_attention_mask,
+        xla_attention,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 2, 128, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 2, 128, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 2, 128, 16), jnp.float32)
+    mask = make_attention_mask(jnp.ones((2, 128), jnp.int32)) * 0.0
+    gf = jax.grad(lambda m: jnp.sum(flash_attention(q, k, v, m) ** 2))(mask)
+    gx = jax.grad(lambda m: jnp.sum(xla_attention(q, k, v, m) ** 2))(mask)
+    assert float(jnp.max(jnp.abs(gf))) > 0
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gx), atol=1e-4)

@@ -138,3 +138,16 @@ def test_prefetch_iterator_gc_reclaims_thread():
     gc.collect()
     thread.join(timeout=5)
     assert not thread.is_alive()
+
+
+def test_prefetch_iterator_exhaustion_is_sticky():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (
+        PrefetchIterator,
+    )
+
+    it = PrefetchIterator(iter(range(3)), depth=2)
+    assert list(it) == [0, 1, 2]
+    assert next(it, "default") == "default"   # must not block
+    it2 = PrefetchIterator(iter(range(3)), depth=2)
+    it2.close()
+    assert next(it2, None) is None
