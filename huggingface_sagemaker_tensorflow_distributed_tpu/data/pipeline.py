@@ -243,6 +243,8 @@ class ShardedBatcher:
         drop_remainder: bool = True,
         process_index: Optional[int] = None,
         process_count: Optional[int] = None,
+        bucket_sizes: Optional[list[int]] = None,
+        bucket_window: int = 16,
     ):
         self.dataset = dataset
         self.global_batch_size = global_batch_size
@@ -250,6 +252,18 @@ class ShardedBatcher:
         self.shuffle = shuffle
         self.seed = seed
         self.drop_remainder = drop_remainder
+        self.bucket_sizes = sorted(bucket_sizes) if bucket_sizes else None
+        self.bucket_window = bucket_window
+        self._lengths: dict[str, np.ndarray] = {}
+        if self.bucket_sizes:
+            # token count per row, per mask column (native/dataloader.cc):
+            # encoder and decoder widths bucket independently
+            from huggingface_sagemaker_tensorflow_distributed_tpu.data.native import (
+                native_row_lengths,
+            )
+            for name in ("attention_mask", "decoder_attention_mask"):
+                if name in dataset.columns:
+                    self._lengths[name] = native_row_lengths(dataset.columns[name])
         self.process_index = jax.process_index() if process_index is None else process_index
         self.process_count = jax.process_count() if process_count is None else process_count
         if global_batch_size % self.process_count != 0:
@@ -283,6 +297,8 @@ class ShardedBatcher:
                 native_permutation,
             )
             order = native_permutation(n, self.seed + epoch)
+        if self.bucket_sizes:
+            order = self._length_sorted_windows(order)
         steps = self.steps_per_epoch()
         for s in range(start_step, steps):
             lo = s * self.global_batch_size
@@ -298,7 +314,56 @@ class ShardedBatcher:
             valid[:valid_n] = 1
             batch["valid"] = valid[self.process_index * self.per_host:
                                    (self.process_index + 1) * self.per_host]
+            if self.bucket_sizes:
+                batch = self._trim_to_buckets(batch, global_idx[:valid_n])
             yield batch
+
+    # -- length bucketing (the tf.data bucket_by_sequence_length capability
+    #    the reference forgoes by padding everything to 512,
+    #    scripts/train.py:80-83) ------------------------------------------
+
+    def _bucket_for(self, max_len: int, full: int) -> int:
+        for b in self.bucket_sizes:
+            if b >= max_len:
+                return min(b, full)
+        return full
+
+    def _length_sorted_windows(self, order: np.ndarray) -> np.ndarray:
+        """Sort by length inside windows of ``bucket_window`` batches: like
+        batches get like lengths (less padding waste) while the epoch stays
+        approximately shuffled. Deterministic — every host agrees."""
+        key = self._lengths.get("attention_mask")
+        if key is None or not self.shuffle:
+            return order
+        w = max(1, self.bucket_window) * self.global_batch_size
+        out = order.copy()
+        for lo in range(0, len(order), w):
+            window = out[lo:lo + w]
+            window.sort(kind="stable")  # determinism of ties
+            out[lo:lo + w] = window[np.argsort(key[window], kind="stable")]
+        return out
+
+    def _trim_to_buckets(self, batch: dict[str, np.ndarray],
+                         real_idx: np.ndarray) -> dict[str, np.ndarray]:
+        """Slice token-width column groups down to the smallest bucket that
+        holds the GLOBAL batch's longest row (all hosts agree: bucket
+        choice derives from the shared order), so XLA compiles once per
+        bucket size instead of padding every batch to the full width."""
+        trims: dict[int, int] = {}  # original width -> bucket width
+        for mask_name, lengths in self._lengths.items():
+            width = self.dataset.columns[mask_name].shape[1]
+            max_len = int(lengths[real_idx].max()) if len(real_idx) else 1
+            bucket = self._bucket_for(max(max_len, 1), width)
+            # encoder/decoder columns with the SAME width share one trim:
+            # take the safer (wider) bucket
+            trims[width] = max(trims.get(width, 0), bucket)
+        out = {}
+        for k, v in batch.items():
+            if v.ndim >= 2 and v.shape[1] in trims:
+                out[k] = np.ascontiguousarray(v[:, :trims[v.shape[1]]])
+            else:
+                out[k] = v
+        return out
 
     def global_arrays(self, epoch: int = 0, start_step: int = 0,
                       prefetch: int = 2):

@@ -21,5 +21,6 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
     replicated,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.distributed import (  # noqa: F401
+    enable_compilation_cache,
     initialize_distributed,
 )

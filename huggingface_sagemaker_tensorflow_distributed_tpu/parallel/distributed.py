@@ -29,6 +29,20 @@ logger = get_logger(__name__)
 _INITIALIZED = False
 
 
+def enable_compilation_cache(cache_dir: str) -> None:
+    """Persistent XLA compilation cache (capability the reference gets
+    implicitly from TF's graph caching): recompiles across runs, resumes
+    and length-bucket widths become disk hits (~3x warm startup on TPU).
+    """
+    if not cache_dir:
+        return
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def initialize_distributed() -> tuple[int, int]:
     """Initialize multi-host JAX if the env asks for it.
 

@@ -42,6 +42,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
     MeshConfig,
     build_mesh,
+    enable_compilation_cache,
     initialize_distributed,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
@@ -102,6 +103,7 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
 def main(argv=None) -> dict:
     config = parse_args(argv)
     process_index, process_count = initialize_distributed()
+    enable_compilation_cache(config.compilation_cache_dir)
     setup_logging(process_index=process_index, all_hosts=config.log_all_hosts)
     logger = get_logger("train")
     logger.info("config: %s", config.to_json())
@@ -156,10 +158,17 @@ def main(argv=None) -> dict:
     dp_size = data_parallel_size(mesh)
     global_train_batch = config.train_batch_size * dp_size
     global_eval_batch = config.eval_batch_size * dp_size
+    buckets = None
+    if config.bucket_multiple:
+        buckets = list(range(config.bucket_multiple, max_len + 1,
+                             config.bucket_multiple))
+        logger.info("length bucketing at widths %s", buckets)
     train_batcher = ShardedBatcher(train_ds, global_train_batch, mesh,
-                                   shuffle=True, seed=config.seed)
+                                   shuffle=True, seed=config.seed,
+                                   bucket_sizes=buckets)
     eval_batcher = ShardedBatcher(eval_ds, global_eval_batch, mesh,
-                                  shuffle=False, drop_remainder=False)
+                                  shuffle=False, drop_remainder=False,
+                                  bucket_sizes=buckets)
 
     total_steps = train_batcher.steps_per_epoch() * config.epochs
     trainer = Trainer(config, model, params, mesh, total_steps=total_steps)
