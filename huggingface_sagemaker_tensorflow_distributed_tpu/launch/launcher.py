@@ -53,6 +53,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def cpu_sim_env(n_devices: int, base: Optional[dict] = None) -> dict:
+    """Env overrides that force a virtual ``n_devices``-device CPU JAX
+    backend in a child process — the slice-simulator recipe shared by
+    ``LocalBackend`` and ``__graft_entry__.dryrun_multichip``."""
+    env = dict(os.environ if base is None else base)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # this container's sitecustomize force-registers the axon
+        # TPU backend unless the pool-IP list is explicitly empty
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
+                      f" --xla_force_host_platform_device_count={n_devices}"),
+    })
+    return env
+
+
 def to_argv(hyperparameters: dict) -> list[str]:
     """Serialize a hyperparameter dict to ``--key value`` CLI strings —
     the platform contract of reference ``launch.py:51`` (every value
@@ -188,13 +204,8 @@ class LocalBackend:
         for host in range(n_hosts):
             env = dict(os.environ)
             env.update(job.env)
+            env = cpu_sim_env(chips_per_host, base=env)
             env.update({
-                "JAX_PLATFORMS": "cpu",
-                # this container's sitecustomize force-registers the axon
-                # TPU backend unless the pool-IP list is explicitly empty
-                "PALLAS_AXON_POOL_IPS": "",
-                "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
-                              f" --xla_force_host_platform_device_count={chips_per_host}"),
                 "TPU_COORDINATOR_ADDRESS": coord,
                 "TPU_NUM_PROCESSES": str(n_hosts),
                 "TPU_PROCESS_ID": str(host),
