@@ -254,6 +254,16 @@ class ShardedBatcher:
         self.drop_remainder = drop_remainder
         self.bucket_sizes = sorted(bucket_sizes) if bucket_sizes else None
         self.bucket_window = bucket_window
+        if self.bucket_sizes:
+            # token columns shard over the ``seq`` mesh axis when present:
+            # every bucket width must divide evenly or device_put fails
+            # mid-epoch with an opaque sharding error
+            sp = dict(mesh.shape).get("seq", 1)
+            bad = [b for b in self.bucket_sizes if b % sp != 0]
+            if bad:
+                raise ValueError(
+                    f"bucket_sizes {bad} not divisible by the mesh seq axis "
+                    f"({sp}); pad bucket widths to multiples of sp")
         self._lengths: dict[str, np.ndarray] = {}
         if self.bucket_sizes:
             # token count per row, per mask column (native/dataloader.cc):

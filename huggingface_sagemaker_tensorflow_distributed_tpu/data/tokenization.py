@@ -262,6 +262,32 @@ class HFTokenizer:
         return cls(AutoTokenizer.from_pretrained(path, local_files_only=True))
 
 
+def _wordpiece_config_supported(path: str) -> bool:
+    """True when ``tokenizer_config.json`` (if any) only uses options the
+    in-repo WordPiece implements. Configs that customise behavior it does
+    not support (``strip_accents``, ``do_basic_tokenize=False``,
+    ``never_split``, ``tokenize_chinese_chars=False``) must route to HF so
+    users keep the exact semantics they asked for."""
+    cfg_path = os.path.join(path, "tokenizer_config.json")
+    if not os.path.exists(cfg_path):
+        return True
+    try:
+        import json
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+    except (OSError, ValueError):
+        return True
+    if cfg.get("strip_accents") is not None:      # HF default None = follow
+        return False                              # do_lower_case; ours does
+    if not cfg.get("do_basic_tokenize", True):
+        return False
+    if cfg.get("never_split"):
+        return False
+    if not cfg.get("tokenize_chinese_chars", True):
+        return False
+    return True
+
+
 def load_tokenizer(model_name_or_path: str, vocab_size: int = 30522):
     """Tokenizer factory, best implementation first: a bare ``vocab.txt``
     loads our in-repo WordPiece (C++ core when built, Python twin
@@ -273,7 +299,8 @@ def load_tokenizer(model_name_or_path: str, vocab_size: int = 30522):
         has_vocab = os.path.exists(os.path.join(model_name_or_path, "vocab.txt"))
         has_other = any(os.path.exists(os.path.join(model_name_or_path, f))
                         for f in ("tokenizer.json", "spiece.model"))
-        if has_vocab and not has_other:
+        if has_vocab and not has_other and _wordpiece_config_supported(
+                model_name_or_path):
             from huggingface_sagemaker_tensorflow_distributed_tpu.data.native import (
                 load_wordpiece,
             )

@@ -264,8 +264,19 @@ class WordPieceTokenizer:
             rows.append(ids)
             segs.append(seg)
 
-        if padding == "longest":
-            max_length = min(max_length, max((len(i) for i in rows), default=1))
+        longest = max((len(i) for i in rows), default=1)
+        if not truncation and longest > max_length:
+            # HF semantics: truncation=False means rows are never clipped —
+            # grow the padded width to the longest row, or refuse when the
+            # caller pinned the width with padding="max_length"
+            if padding == "max_length":
+                raise ValueError(
+                    f"row of {longest} tokens exceeds max_length={max_length} "
+                    "and truncation is disabled; pass truncation=True or "
+                    "padding='longest'")
+            max_length = longest
+        elif padding == "longest":
+            max_length = min(max_length, longest)
         input_ids = np.full((n, max_length), self.pad_token_id, np.int32)
         attention_mask = np.zeros((n, max_length), np.int32)
         token_type_ids = np.zeros((n, max_length), np.int32)

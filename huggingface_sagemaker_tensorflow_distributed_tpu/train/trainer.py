@@ -354,13 +354,35 @@ class Trainer:
         """`model.evaluate` parity (reference train.py:170) with exact
         cross-host aggregation: sums are reduced globally inside jit, so
         every host reports identical numbers (the reference instead
-        evaluates the full test set redundantly on every rank)."""
+        evaluates the full test set redundantly on every rank).
+
+        Steps are async-dispatched so batch prep overlaps device compute
+        like ``fit``, with results drained in fixed-size chunks — the
+        dispatch backlog (and the device memory its queued input batches
+        pin) stays bounded on arbitrarily large eval sets. The ``finally``
+        stops the prefetch producer on any mid-eval failure."""
+        chunk = 64
         loss_sum = correct = count = 0.0
-        for batch in eval_batcher.global_arrays(epoch=0):
-            sums = jax.device_get(self._eval_step(self.state.params, batch))
-            loss_sum += float(sums["loss_sum"])
-            correct += float(sums["correct"])
-            count += float(sums["count"])
+
+        def drain(device_sums):
+            nonlocal loss_sum, correct, count
+            for sums in jax.device_get(device_sums):
+                loss_sum += float(sums["loss_sum"])
+                correct += float(sums["correct"])
+                count += float(sums["count"])
+
+        device_sums: list = []
+        batch_iter = eval_batcher.global_arrays(epoch=0)
+        try:
+            for batch in batch_iter:
+                device_sums.append(self._eval_step(self.state.params, batch))
+                if len(device_sums) >= chunk:
+                    drain(device_sums)
+                    device_sums = []
+        finally:
+            if hasattr(batch_iter, "close"):
+                batch_iter.close()
+        drain(device_sums)
         count = max(count, 1.0)
         return {"eval_loss": loss_sum / count, "eval_accuracy": correct / count}
 

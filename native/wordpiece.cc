@@ -146,6 +146,10 @@ inline bool is_combining_mark(uint32_t cp) {
 inline uint32_t to_lower(uint32_t cp) {
   if (cp >= 'A' && cp <= 'Z') return cp + 0x20;
   if (cp >= 0x00C0 && cp <= 0x00DE && cp != 0x00D7) return cp + 0x20;
+  // İ (U+0130) lowercases to i + combining-dot (which NFD strips): NOT
+  // to dotless ı — the cp|1 pairing below would silently produce ı and
+  // break parity with Python's 'İ'.lower() + strip-Mn
+  if (cp == 0x0130) return 'i';
   if (cp >= 0x0100 && cp <= 0x0137) return (cp | 1);
   if (cp >= 0x0139 && cp <= 0x0148) return ((cp - 1) | 1) + 1;
   if (cp >= 0x014A && cp <= 0x0177) return (cp | 1);
@@ -175,19 +179,23 @@ inline uint32_t fold_accent(uint32_t cp) {
   // Latin Extended-A lowercase (odd code points pair with base letters)
   if (cp >= 0x0100 && cp <= 0x0105) return 'a';
   if (cp >= 0x0106 && cp <= 0x010D) return 'c';
-  if (cp >= 0x010E && cp <= 0x0111) return 'd';
+  // Ranges keep ONLY code points with a canonical NFD decomposition —
+  // stroke/bar/eng/dotless letters (Đđ Ħħ ı ĸ Ŀŀ Łł ŉ Ŋŋ Ŧŧ) do not
+  // decompose, so HF's NFD+strip-Mn (and our Python twin) keep them;
+  // folding them here would break the C++/Python/HF parity contract.
+  if (cp >= 0x010E && cp <= 0x010F) return 'd';
   if (cp >= 0x0112 && cp <= 0x011B) return 'e';
   if (cp >= 0x011C && cp <= 0x0123) return 'g';
-  if (cp >= 0x0124 && cp <= 0x0127) return 'h';
-  if (cp >= 0x0128 && cp <= 0x0131) return 'i';
+  if (cp >= 0x0124 && cp <= 0x0125) return 'h';
+  if (cp >= 0x0128 && cp <= 0x012F) return 'i';  // 0x130 handled in to_lower
   if (cp >= 0x0134 && cp <= 0x0135) return 'j';
-  if (cp >= 0x0136 && cp <= 0x0138) return 'k';
-  if (cp >= 0x0139 && cp <= 0x0142) return 'l';
-  if (cp >= 0x0143 && cp <= 0x014B) return 'n';
+  if (cp >= 0x0136 && cp <= 0x0137) return 'k';
+  if (cp >= 0x0139 && cp <= 0x013E) return 'l';
+  if (cp >= 0x0143 && cp <= 0x0148) return 'n';
   if (cp >= 0x014C && cp <= 0x0151) return 'o';
   if (cp >= 0x0154 && cp <= 0x0159) return 'r';
   if (cp >= 0x015A && cp <= 0x0161) return 's';
-  if (cp >= 0x0162 && cp <= 0x0167) return 't';
+  if (cp >= 0x0162 && cp <= 0x0165) return 't';
   if (cp >= 0x0168 && cp <= 0x0173) return 'u';
   if (cp >= 0x0174 && cp <= 0x0175) return 'w';
   if (cp >= 0x0176 && cp <= 0x0177) return 'y';

@@ -211,6 +211,39 @@ def test_bucketing_window_sort_is_permutation():
     assert np.mean(widths) < 64
 
 
+def test_bucketing_eval_tail_buckets_from_valid_rows_only():
+    """drop_remainder=False pads the tail with dataset row 0; the bucket
+    width must derive from the REAL rows, and the valid mask must still
+    mark exactly the real ones after trimming."""
+    ds, lengths = _ragged_dataset(20)
+    # make the pad source (row 0) the longest row: a naive bucket choice
+    # over all rows would widen the tail batch because of padding copies
+    ds.columns["attention_mask"][0, :] = 1
+    ds.columns["input_ids"][0, :] = 7
+    lengths[0] = 64
+    mesh = build_mesh(MeshConfig())
+    b = ShardedBatcher(ds, 8, mesh, shuffle=False, drop_remainder=False,
+                       bucket_sizes=[16, 32, 48, 64],
+                       process_index=0, process_count=1)
+    batches = list(b.local_batches(0))
+    assert len(batches) == 3
+    tail = batches[-1]
+    assert tail["valid"].sum() == 4              # 20 = 8+8+4
+    real_max = lengths[16:20].max()
+    bucket = min(bkt for bkt in [16, 32, 48, 64] if bkt >= real_max)
+    assert tail["input_ids"].shape == (8, bucket)
+    # every real token of the real rows survived the trim
+    assert tail["attention_mask"][:4].sum() == lengths[16:20].sum()
+
+
+def test_bucketing_rejects_widths_indivisible_by_seq_axis(devices8):
+    mesh = build_mesh(MeshConfig(dp=-1, sp=2), devices=devices8)
+    ds, _ = _ragged_dataset(16)
+    with pytest.raises(ValueError, match="seq axis"):
+        ShardedBatcher(ds, 8, mesh, bucket_sizes=[15, 32],
+                       process_index=0, process_count=1)
+
+
 def test_bucketing_seq2seq_independent_widths():
     mesh = build_mesh(MeshConfig())
     rng = np.random.RandomState(0)

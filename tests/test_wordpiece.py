@@ -53,6 +53,15 @@ TEXTS = [
     "a⁠b c‎d ⁦e⁩",    # word joiner, LRM, isolates: all Cf
     # beyond the C++ boundary: routed to the Python twin inside the native
     # tokenizer, so parity must still hold exactly
+    # non-decomposing Latin-Ext-A (stroke/bar/eng/dotless): NFD keeps
+    # these, so fold_accent must NOT map them to base letters — parity
+    # between C++ (below the 0x0180 routing boundary) and Python/HF
+    "Łódź złoty ŁÓDŹ",          # Polish l-stroke
+    "Đorđe đak Ħal ħobża",      # d-stroke, h-bar
+    "kapalı ılık TOPKAPı",      # Turkish dotless i
+    "İSTANBUL İzmir diyarbakır",  # dotted capital İ lowers to plain i
+    "ŋoro ŧavle ĸra ŉgawe",     # eng, t-stroke, kra, apostrophe-n
+    "Ŀlull l·l paral·lel",      # l-middle-dot
     "ёлка and ЁЛКА",            # Cyrillic with NFD-decomposable ё
     "άλφα ΆΛΦΑ βήτα",           # accented Greek
     "што؟ arabic ، question",   # Arabic punctuation
