@@ -122,6 +122,14 @@ class TrainConfig:
     checkpoint_every_steps: int = 0      # 0: per-epoch only
     resume: bool = True                   # resume from latest checkpoint if present
     keep_checkpoints: int = 3
+    async_checkpointing: bool = True      # overlap checkpoint writes with steps
+
+    # --- replica-divergence detection (SURVEY.md §5.2): verify at every
+    #     checkpoint boundary that parameter replicas across the data/seq
+    #     mesh axes still agree (the consistency Horovod's broadcast only
+    #     establishes at start, reference train.py:127-134). ---
+    check_divergence: bool = True
+    divergence_tol: float = 1e-6          # relative; replicas should be bit-equal
 
     # --- output contract (reference train.py:48-50) ---
     output_data_dir: str = field(

@@ -258,12 +258,15 @@ class ShardedBatcher:
             # token columns shard over the ``seq`` mesh axis when present:
             # every bucket width must divide evenly or device_put fails
             # mid-epoch with an opaque sharding error
-            sp = dict(mesh.shape).get("seq", 1)
+            from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+                AXIS_SEQ,
+            )
+            sp = dict(mesh.shape).get(AXIS_SEQ, 1)
             bad = [b for b in self.bucket_sizes if b % sp != 0]
             if bad:
                 raise ValueError(
                     f"bucket_sizes {bad} not divisible by the mesh seq axis "
-                    f"({sp}); pad bucket widths to multiples of sp")
+                    f"(size {sp}); pad bucket widths to multiples of {sp}")
         self._lengths: dict[str, np.ndarray] = {}
         if self.bucket_sizes:
             # token count per row, per mask column (native/dataloader.cc):

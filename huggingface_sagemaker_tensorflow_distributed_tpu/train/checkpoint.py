@@ -28,15 +28,26 @@ logger = get_logger(__name__)
 
 
 class Checkpointer:
-    """Thin Orbax CheckpointManager wrapper bound to a state template."""
+    """Thin Orbax CheckpointManager wrapper bound to a state template.
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    ``async_save=True`` (default): ``save`` returns once the state is
+    staged to host memory and the serialisation/write runs on Orbax's
+    background thread, overlapping with subsequent training steps — a
+    save no longer stalls the step loop for the write duration. Orbax
+    itself serialises overlapping saves (a new save waits for the
+    previous one), and ``wait_until_finished``/``close`` make completion
+    explicit at sync points (terminal export, restore-after-save tests).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=False),
+                max_to_keep=max_to_keep, create=True,
+                enable_async_checkpointing=async_save),
         )
 
     def save(self, state: Any, epoch: int = 0, step_in_epoch: int = 0,
@@ -53,12 +64,16 @@ class Checkpointer:
             ),
             force=force,
         )
-        self._mgr.wait_until_finished()
         if saved:
-            logger.info("checkpoint saved at step %d (epoch %d, step-in-epoch %d) → %s",
+            logger.info("checkpoint save started at step %d (epoch %d, "
+                        "step-in-epoch %d) → %s",
                         step, epoch, step_in_epoch, self.directory)
         else:
             logger.info("checkpoint at step %d already exists — skipped", step)
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has been committed."""
+        self._mgr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -69,6 +84,7 @@ class Checkpointer:
         Returns (state, epoch, step_in_epoch) or None when no checkpoint
         exists.
         """
+        self._mgr.wait_until_finished()   # a just-started async save counts
         step = self._mgr.latest_step()
         if step is None:
             return None
@@ -87,4 +103,5 @@ class Checkpointer:
         return restored["state"], epoch, step_in_epoch
 
     def close(self) -> None:
+        self._mgr.wait_until_finished()
         self._mgr.close()

@@ -188,6 +188,7 @@ class Trainer:
         self.state = jax.device_put(state, self.state_shardings)
         # rbg = TPU hardware RNG for dropout keys (config.rng_impl docs)
         self._base_rng = jax.random.key(config.seed, impl=config.rng_impl)
+        self._divergence_fn = None  # built lazily, compiled once
 
         # Batch shardings are inherited from the arrays the batcher
         # device_puts (batch dim over data axes; token dims over ``seq``
@@ -206,6 +207,29 @@ class Trainer:
             in_shardings=(self.state_shardings.params, None),
             out_shardings=None,
         ))
+
+    def check_replica_divergence(self) -> float:
+        """Verify parameter replicas agree across the data/seq mesh axes
+        (SURVEY.md §5.2). Returns the relative deviation; raises
+        ``ReplicaDivergenceError`` beyond ``config.divergence_tol``.
+        Called at checkpoint boundaries so a divergent replica can never
+        be persisted silently."""
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.collectives import (
+            ReplicaDivergenceError,
+            make_replica_divergence_fn,
+        )
+
+        if self._divergence_fn is None:
+            # compiled once; reused at every checkpoint boundary
+            self._divergence_fn = self._with_mesh(make_replica_divergence_fn(
+                self.mesh, self.state_shardings.params))
+        rel = float(jax.device_get(self._divergence_fn(self.state.params)))
+        if rel > self.config.divergence_tol:
+            raise ReplicaDivergenceError(
+                f"parameter replicas diverge (relative deviation {rel:.3e} > "
+                f"tol {self.config.divergence_tol:.1e}); refusing to "
+                "checkpoint — restore from the last good checkpoint")
+        return rel
 
     def _with_mesh(self, fn):
         from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
@@ -323,6 +347,8 @@ class Trainer:
                                 epoch, step, steps_per_epoch, losses[-1], accs[-1],
                                 meter.samples_per_sec_per_chip)
                         if want_ckpt:
+                            if cfg.check_divergence:
+                                self.check_replica_divergence()
                             checkpointer.save(self.state, epoch=epoch,
                                               step_in_epoch=step + 1)
                 finally:
@@ -339,6 +365,8 @@ class Trainer:
                             history["loss"][-1],
                             history["sparse_categorical_accuracy"][-1])
                 if checkpointer is not None:
+                    if cfg.check_divergence:
+                        self.check_replica_divergence()
                     checkpointer.save(self.state, epoch=epoch + 1)
             if profiling:  # epoch shorter than the profiled step range
                 jax.profiler.stop_trace()

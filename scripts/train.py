@@ -179,7 +179,8 @@ def main(argv=None) -> dict:
     start_step_in_epoch = 0
     if config.checkpoint_dir:
         checkpointer = Checkpointer(config.checkpoint_dir,
-                                    max_to_keep=config.keep_checkpoints)
+                                    max_to_keep=config.keep_checkpoints,
+                                    async_save=config.async_checkpointing)
         if config.resume:
             restored = checkpointer.restore(trainer.state)
             if restored is not None:
@@ -188,27 +189,31 @@ def main(argv=None) -> dict:
                             start_epoch, start_step_in_epoch)
 
     results: dict = {}
-    if config.do_train:
-        logger.info("*** Train ***")
-        history = trainer.fit(train_batcher, checkpointer=checkpointer,
-                              start_epoch=start_epoch,
-                              start_step_in_epoch=start_step_in_epoch)
-        trainer.write_train_results(history)
-        results["train"] = history
+    try:
+        if config.do_train:
+            logger.info("*** Train ***")
+            history = trainer.fit(train_batcher, checkpointer=checkpointer,
+                                  start_epoch=start_epoch,
+                                  start_step_in_epoch=start_step_in_epoch)
+            trainer.write_train_results(history)
+            results["train"] = history
 
-    if config.do_eval:
-        logger.info("*** Evaluate ***")
-        eval_results = trainer.evaluate(eval_batcher)
-        trainer.write_eval_results(eval_results)
-        results["eval"] = eval_results
+        if config.do_eval:
+            logger.info("*** Evaluate ***")
+            eval_results = trainer.evaluate(eval_batcher)
+            trainer.write_eval_results(eval_results)
+            results["eval"] = eval_results
 
-    # --- terminal export, HF layout (reference train.py:182-183) ---
-    auto_models.save_pretrained(config.model_dir, trainer.state.params,
-                                family, model_config)
-    if jax.process_index() == 0:
-        tokenizer.save_pretrained(config.model_dir)
-    if checkpointer is not None:
-        checkpointer.close()
+        # --- terminal export, HF layout (reference train.py:182-183) ---
+        auto_models.save_pretrained(config.model_dir, trainer.state.params,
+                                    family, model_config)
+        if jax.process_index() == 0:
+            tokenizer.save_pretrained(config.model_dir)
+    finally:
+        # commits any in-flight ASYNC checkpoint write even when fit/eval
+        # raise — a crash after "save started" must not lose the checkpoint
+        if checkpointer is not None:
+            checkpointer.close()
     return results
 
 
