@@ -152,3 +152,39 @@ def test_bf16_compute_runs():
     # params stay fp32 (param_dtype) under bf16 compute
     leaf = jax.tree.leaves(trainer.state.params)[0]
     assert leaf.dtype == jnp.float32
+
+
+def test_bf16_training_quality_matches_fp32(tmp_path):
+    """SURVEY.md §7 hard-part 5: bf16 matmuls with fp32 params, layernorm
+    statistics, softmax, and loss must train to the same quality as pure
+    fp32 — the mixed-precision discipline is the claim, this is the
+    evidence. Same data, same seeds, only the compute dtype differs."""
+
+    def run(dtype):
+        cfg = TrainConfig(epochs=3, train_batch_size=2, dtype=dtype,
+                          learning_rate=1e-3, scale_lr_by_world_size=False,
+                          output_data_dir=str(tmp_path), log_every_steps=0)
+        mcfg = EncoderConfig(
+            vocab_size=512, hidden_size=32, num_layers=2, num_heads=2,
+            intermediate_size=64, max_position_embeddings=SEQ,
+            dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+        mesh = build_mesh(MeshConfig())
+        model = BertForSequenceClassification(mcfg, num_labels=2)
+        trainer = Trainer(cfg, model, init_params(model, mcfg, seed=0), mesh)
+        batcher = ShardedBatcher(_data(), 16, mesh, shuffle=True, seed=0)
+        hist = trainer.fit(batcher)
+        ev = trainer.evaluate(ShardedBatcher(_data(n=64, seed=5), 16, mesh,
+                                             shuffle=False,
+                                             drop_remainder=False))
+        return hist, ev
+
+    hist16, ev16 = run("bfloat16")
+    hist32, ev32 = run("float32")
+    # both reach the fp32 learning bar…
+    assert hist16["sparse_categorical_accuracy"][-1] > 0.8
+    # …and end-of-training quality agrees within 2 points (train) /
+    # 3 points (held-out eval)
+    assert abs(hist16["sparse_categorical_accuracy"][-1]
+               - hist32["sparse_categorical_accuracy"][-1]) < 0.02
+    assert abs(ev16["eval_accuracy"] - ev32["eval_accuracy"]) < 0.03
+    assert abs(ev16["eval_loss"] - ev32["eval_loss"]) < 0.1
