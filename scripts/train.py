@@ -115,9 +115,7 @@ def main(argv=None) -> dict:
     logger.info("mesh: %s", dict(mesh.shape))
 
     # --- model + tokenizer (reference train.py:69,117) ---
-    attention_impl = config.attention_impl
-    if config.sp > 1 and attention_impl == "xla":
-        attention_impl = "ring"  # an sp axis implies sequence-parallel attention
+    attention_impl = config.resolve_attention_impl(jax.devices()[0].platform)
     model, params, family, model_config = auto_models.from_pretrained(
         config.model_name_or_path,
         task=config.task,

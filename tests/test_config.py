@@ -65,3 +65,19 @@ def test_validation_errors():
 def test_roundtrip():
     cfg = TrainConfig(epochs=5, tp=2)
     assert TrainConfig.from_dict(cfg.to_dict()) == cfg
+
+
+def test_attention_impl_auto_resolution():
+    # auto → flash on TPU, xla on CPU (Pallas would interpret there),
+    # ring whenever the mesh has a seq axis; explicit values pass through
+    assert TrainConfig().resolve_attention_impl("tpu") == "flash"
+    assert TrainConfig().resolve_attention_impl("cpu") == "xla"
+    assert TrainConfig(sp=2).resolve_attention_impl("tpu") == "ring"
+    # sp>1 forces ring even for explicit xla (per-shard attention over a
+    # sharded seq axis is wrong); explicit flash + sp>1 is an error
+    assert TrainConfig(sp=2, attention_impl="xla").resolve_attention_impl("tpu") == "ring"
+    with pytest.raises(ValueError):
+        TrainConfig(sp=2, attention_impl="flash").resolve_attention_impl("tpu")
+    assert TrainConfig(attention_impl="xla").resolve_attention_impl("tpu") == "xla"
+    with pytest.raises(ValueError):
+        TrainConfig(attention_impl="nope")
