@@ -1,33 +1,57 @@
-"""Headline benchmark: BERT-base fine-tune samples/sec/chip.
+"""Benchmarks on the real jitted training path (same code as
+``scripts/train.py``).
 
-Runs the real jitted training step (same code path as ``scripts/train.py``)
-on the available TPU chip(s): BERT-base, seq 512, bf16 compute, Pallas
-flash attention, per-chip batch 64 — the reference's default workload
-shape (BERT-family, IMDb padded to 512; reference ``launch.py:13-18``,
-``scripts/train.py:81-86``) on synthetic IMDb-shaped data (zero-egress
-environment). The reference pins batch 8/worker; per-chip batch is a
-free throughput knob here, and 64 is the measured v5e sweet spot
-(8→221, 32→247, 64→251, 96→231 samples/s/chip; 128 OOMs on 16G HBM).
+Default (no args) — the headline metric, ONE JSON line:
+BERT-base fine-tune, seq 512, bf16, Pallas flash attention, per-chip
+batch 64 — the reference's default workload shape (BERT-family, IMDb
+padded to 512; reference ``launch.py:13-18``, ``scripts/train.py:81-86``)
+on synthetic IMDb-shaped data (zero-egress environment). The reference
+pins batch 8/worker; per-chip batch is a free throughput knob here, and
+64 is the measured v5e sweet spot (8→221, 32→247, 64→251, 96→231
+samples/s/chip; 128 OOMs on 16G HBM).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
 comparison point is the reference's default hardware envelope — BERT-base
 fine-tuning at seq 512 / batch 8 / mixed precision on the ml.p3.2xlarge
 V100, ≈32 samples/s (public MLPerf-era V100 BERT fine-tune throughput);
 vs_baseline = our samples/sec/chip ÷ 32.
+
+Extra modes (each also prints one JSON line per run):
+  --model bert-large   the reference's actual default model
+                       (bert-large-uncased-whole-word-masking shape:
+                       24L/1024H/16 heads; reference ``launch.py:17``),
+                       seq 512, per-chip batch 8.
+  --buckets            headline workload with length bucketing enabled
+                       on a realistic length distribution (vs pad-to-512).
+  --mesh               scaling-efficiency instrument: per-step collective
+                       vs compute time from a profiler trace.
+
+Results across rounds are recorded in BENCH_EXTRA.md.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 
 V100_BASELINE_SAMPLES_PER_SEC = 32.0
+# BERT-large at seq 512 / bs 8 / mixed precision on one V100 runs ≈1/4 of
+# BERT-base throughput — public MLPerf-era fine-tune numbers put it ≈8
+# samples/s; same caveat as above: a literature anchor, not a measurement.
+V100_BERT_LARGE_SAMPLES_PER_SEC = 8.0
+
+BERT_LARGE = dict(hidden_size=1024, num_layers=24, num_heads=16,
+                  intermediate_size=4096)
 
 
-def main() -> None:
+def build_harness(model_kwargs: dict, per_chip_batch: int, seq_len: int = 512,
+                  remat: bool = False, bucket_multiple: int = 0,
+                  min_len: int = 300, max_len: int = 600, batches: int = 14):
+    """(trainer, batcher) for one BERT-family benchmark config — the ONE
+    place every bench mode builds its harness, so --mesh/--buckets always
+    measure the same configuration the headline does."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
     from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
@@ -51,41 +75,97 @@ def main() -> None:
 
     n_chips = len(jax.devices())
     on_tpu = jax.devices()[0].platform == "tpu"
-    seq_len = 512
-    per_chip_batch = 64 if on_tpu else 8
     global_batch = per_chip_batch * n_chips
 
     mesh = build_mesh(MeshConfig(dp=-1))
     config = TrainConfig(dtype="bfloat16" if on_tpu else "float32",
                          train_batch_size=per_chip_batch,
-                         max_seq_length=seq_len, log_every_steps=0)
+                         max_seq_length=seq_len, log_every_steps=0,
+                         remat=remat, bucket_multiple=bucket_multiple)
     model_cfg = EncoderConfig(
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-        max_position_embeddings=512,  # BERT-base
+        max_position_embeddings=512,
         attention_impl=config.resolve_attention_impl(
-            jax.devices()[0].platform))
+            jax.devices()[0].platform),
+        remat=remat,
+        **model_kwargs)
     model = BertForSequenceClassification(model_cfg, num_labels=2)
     params = init_params(model, model_cfg, seed=0)
     trainer = Trainer(config, model, params, mesh)
 
     tok = WordHashTokenizer()
-    n_examples = global_batch * 14
-    texts, labels = synthetic_text_classification(n_examples, seed=0,
-                                                  min_len=300, max_len=600)
+    texts, labels = synthetic_text_classification(
+        global_batch * batches, seed=0, min_len=min_len, max_len=max_len)
     ds = ArrayDataset.from_texts(tok, texts, labels, max_length=seq_len)
-    batcher = ShardedBatcher(ds, global_batch, mesh, shuffle=False, seed=0)
+    batcher = ShardedBatcher(ds, global_batch, mesh, shuffle=False, seed=0,
+                             bucket_sizes=config.bucket_sizes(seq_len))
+    return trainer, batcher
 
-    # measure through the REAL fit loop (async dispatch, background
-    # prefetch, no per-step host sync): the same path scripts/train.py
-    # runs, minus logging — the meter excludes the first (compile) step
-    history = trainer.fit(batcher, epochs=2)
-    value = round(history["train_samples_per_second_per_chip"], 3)
+
+def run_finetune(model_kwargs: dict, per_chip_batch: int,
+                 epochs: int = 2, warmup_epochs: int = 0, **harness_kwargs):
+    """Train-loop throughput for one BERT-family config; returns the fit
+    history (the meter excludes the first, compiling, step and runs the
+    REAL fit loop: async dispatch, background prefetch, no per-step host
+    sync). ``warmup_epochs`` runs an unmeasured fit first so every bucket
+    width compiles before the measured pass (the meter only skips the
+    first step, which covers a single static shape)."""
+    trainer, batcher = build_harness(model_kwargs, per_chip_batch,
+                                     **harness_kwargs)
+    if warmup_epochs:
+        trainer.fit(batcher, epochs=warmup_epochs)
+    return trainer.fit(batcher, epochs=epochs)
+
+
+def emit(metric: str, value: float, baseline: float) -> None:
     print(json.dumps({
-        "metric": "bert_base_finetune_samples_per_sec_per_chip",
-        "value": value,
+        "metric": metric,
+        "value": round(value, 3),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(value / V100_BASELINE_SAMPLES_PER_SEC, 3),
+        "vs_baseline": round(value / baseline, 3),
     }))
+
+
+def bench_headline() -> None:
+    history = run_finetune({}, per_chip_batch=64)
+    emit("bert_base_finetune_samples_per_sec_per_chip",
+         history["train_samples_per_second_per_chip"],
+         V100_BASELINE_SAMPLES_PER_SEC)
+
+
+def bench_bert_large() -> None:
+    # the reference's default workload at its default size: bs 8/worker
+    # (reference launch.py:13-18); 340M params + fp32 Adam state fit one
+    # 16G chip without encoder remat
+    history = run_finetune(BERT_LARGE, per_chip_batch=8)
+    emit("bert_large_wwm_finetune_samples_per_sec_per_chip",
+         history["train_samples_per_second_per_chip"],
+         V100_BERT_LARGE_SAMPLES_PER_SEC)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", choices=["bert-base", "bert-large"],
+                        default=None)
+    parser.add_argument("--buckets", action="store_true")
+    parser.add_argument("--mesh", action="store_true")
+    args = parser.parse_args()
+    picked = [n for n, on in [("--model", args.model is not None),
+                              ("--buckets", args.buckets),
+                              ("--mesh", args.mesh)] if on]
+    if len(picked) > 1:
+        parser.error(f"pick one mode, got {' and '.join(picked)}")
+
+    if args.mesh:
+        from benchmarks.mesh_bench import bench_mesh
+        bench_mesh()
+    elif args.buckets:
+        from benchmarks.bucket_bench import bench_buckets
+        bench_buckets()
+    elif args.model == "bert-large":
+        bench_bert_large()
+    else:
+        bench_headline()
 
 
 if __name__ == "__main__":

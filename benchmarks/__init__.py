@@ -1,0 +1,1 @@
+"""Extra benchmark modes for ``bench.py`` (--buckets, --mesh)."""

@@ -1,0 +1,168 @@
+"""Scaling-efficiency instrument (bench.py --mesh).
+
+The north-star scaling target (BASELINE.md: ≥90% efficiency at 8→32
+chips) cannot be measured on a 1-chip host, but this mode builds the
+measurement: it traces a few real training steps with ``jax.profiler``
+and reports where the step time goes — compute vs collective
+communication — by parsing the XPlane protobuf the profiler writes.
+On a multi-chip slice the collective share IS the scaling loss (the
+reference delegates the equivalent NCCL timing to ``--NCCL_DEBUG=INFO``,
+reference ``launch.py:22``); on one chip it degenerates to 0 and the
+mode still validates the instrument end to end.
+
+Also usable on the virtual CPU mesh (tests): the CPU backend emits HLO
+op events on its executor threads, including the same
+all-reduce/all-gather/collective-permute names XLA uses on TPU.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import tempfile
+
+_COLLECTIVE_MARKERS = (
+    "all-reduce", "allreduce", "all-gather", "allgather",
+    "reduce-scatter", "collective-permute", "all-to-all", "alltoall",
+    "collective-broadcast", "ragged-all-to-all",
+)
+
+# host-side runtime/bookkeeping events on CPU executor lines — not HLO ops
+_RUNTIME_NOISE = (
+    "threadpoollistener", "pjrtcpuexecutable", "handle inputs",
+    "commonpjrtclient", "parsearguments", "pythonrefmanager",
+    "collectgarbage", "xla launch", "end:",
+)
+
+
+def classify_event(name: str) -> str | None:
+    """'collective' | 'compute' | None (runtime noise / python frames)."""
+    low = name.lower()
+    if any(m in low for m in _COLLECTIVE_MARKERS):
+        return "collective"
+    if any(m in low for m in _RUNTIME_NOISE) or low.startswith("$"):
+        return None
+    return "compute"
+
+
+def summarize_xspace(path: str) -> dict:
+    """Sum device-op durations in an .xplane.pb, split compute/collective.
+
+    Device planes (``/device:TPU:*``) are preferred; without any (CPU
+    backend) the XLA executor threads of the host plane are used.
+    Durations are picoseconds in the proto; returned in milliseconds.
+    """
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    space = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+
+    device_planes = [p for p in space.planes
+                     if p.name.startswith("/device:")]
+    host_fallback = not device_planes
+    if host_fallback:
+        device_planes = [p for p in space.planes if p.name == "/host:CPU"]
+
+    compute_ps = 0
+    collective_ps = 0
+    per_op: dict[str, int] = {}
+    for plane in device_planes:
+        for line in plane.lines:
+            if host_fallback and not line.name.startswith("tf_"):
+                continue  # python / gc lines on the host plane
+            for event in line.events:
+                name = plane.event_metadata[event.metadata_id].name
+                kind = classify_event(name)
+                if kind is None:
+                    continue
+                dur = event.duration_ps
+                if kind == "collective":
+                    collective_ps += dur
+                    per_op[name] = per_op.get(name, 0) + dur
+                else:
+                    compute_ps += dur
+    total_ps = compute_ps + collective_ps
+    return {
+        "compute_ms": compute_ps / 1e9,
+        "collective_ms": collective_ps / 1e9,
+        "collective_fraction": (collective_ps / total_ps) if total_ps else 0.0,
+        "top_collectives": dict(sorted(per_op.items(),
+                                       key=lambda kv: -kv[1])[:5]),
+    }
+
+
+def profile_train_steps(trainer, batcher, steps: int = 4,
+                        trace_dir: str | None = None) -> dict:
+    """Run ``steps`` pre-compiled train steps under jax.profiler and
+    return the compute/collective breakdown plus wall step time."""
+    import time
+
+    import jax
+
+    it = batcher.global_arrays(0)
+    first = next(it)
+    if hasattr(it, "close"):
+        it.close()  # stop the prefetch thread pinning extra device batches
+    batches = [first] * steps
+
+    # compile outside the trace window
+    trainer.state, _ = trainer._train_step(trainer.state, first)
+    jax.block_until_ready(trainer.state.params)
+
+    trace_dir = trace_dir or tempfile.mkdtemp(prefix="meshbench_")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir):
+        for b in batches:
+            trainer.state, metrics = trainer._train_step(trainer.state, b)
+        jax.block_until_ready(trainer.state.params)
+    wall = time.perf_counter() - t0
+
+    pbs = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                           recursive=True))
+    summary = summarize_xspace(pbs[-1]) if pbs else {
+        "compute_ms": 0.0, "collective_ms": 0.0,
+        "collective_fraction": 0.0, "top_collectives": {},
+        "error": "no xplane.pb produced"}
+    summary["wall_step_ms"] = wall / steps * 1e3
+    summary["steps"] = steps
+    return summary
+
+
+def bench_mesh() -> None:
+    """Trace the headline BERT-base step on the current devices and print
+    one JSON line: collective fraction of device time (+ breakdown)."""
+    import jax
+
+    from bench import build_harness
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # the headline config, sized down off-TPU so the CPU backend can
+    # trace it in seconds; built by the same harness as the headline
+    trainer, batcher = build_harness(
+        {}, per_chip_batch=16 if on_tpu else 1,
+        seq_len=512 if on_tpu else 64,
+        min_len=100, max_len=300, batches=2)
+    mesh = trainer.mesh
+
+    summary = profile_train_steps(trainer, batcher)
+    print(json.dumps({
+        "metric": "train_step_collective_fraction",
+        "value": round(summary["collective_fraction"], 4),
+        "unit": "fraction_of_device_time",
+        "vs_baseline": 0.0,  # no reference comparison point (BASELINE.md)
+        "detail": {
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+            "compute_ms": round(summary["compute_ms"], 2),
+            "collective_ms": round(summary["collective_ms"], 2),
+            "wall_step_ms": round(summary["wall_step_ms"], 2),
+            "top_collectives": {
+                k: round(v / 1e9, 3)
+                for k, v in summary["top_collectives"].items()},
+        },
+    }))
+
+
+if __name__ == "__main__":
+    bench_mesh()

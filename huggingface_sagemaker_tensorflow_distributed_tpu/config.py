@@ -178,23 +178,34 @@ class TrainConfig:
             raise ValueError("bucket_multiple must divide evenly over sp shards")
         if self.attention_impl not in ("auto", "xla", "flash", "ring"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.attention_impl == "flash" and self.sp > 1:
+            raise ValueError(
+                "attention_impl='flash' cannot run over a sequence-sharded "
+                "axis (sp>1); use 'ring' or 'auto'")
 
     def resolve_attention_impl(self, platform: str) -> str:
         """Single source of truth for the attention kernel choice.
 
         A seq mesh axis (sp > 1) forces ring attention — xla/flash compute
-        per-shard attention over a sharded seq axis, which is wrong.
-        ``auto`` then picks flash (Pallas) on real TPU and xla elsewhere
-        (on CPU the Pallas kernels would run in slow interpret mode)."""
+        per-shard attention over a sharded seq axis, which is wrong
+        (flash+sp is already rejected at construction). ``auto`` then
+        picks flash (Pallas) on real TPU and xla elsewhere (on CPU the
+        Pallas kernels would run in slow interpret mode)."""
         if self.sp > 1:
-            if self.attention_impl == "flash":
-                raise ValueError(
-                    "attention_impl='flash' cannot run over a sequence-"
-                    "sharded axis (sp>1); use 'ring' or 'auto'")
             return "ring"
         if self.attention_impl != "auto":
             return self.attention_impl
         return "flash" if platform == "tpu" else "xla"
+
+    def bucket_sizes(self, max_len: int) -> Optional[list[int]]:
+        """The length-bucket width schedule ``bucket_multiple`` implies:
+        multiples of it up to ``max_len`` (validated sp-divisible in
+        ``__post_init__``). None when bucketing is off. Shared by
+        ``scripts/train.py`` and ``bench.py --buckets``."""
+        if not self.bucket_multiple:
+            return None
+        return list(range(self.bucket_multiple, max_len + 1,
+                          self.bucket_multiple))
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -156,10 +156,8 @@ def main(argv=None) -> dict:
     dp_size = data_parallel_size(mesh)
     global_train_batch = config.train_batch_size * dp_size
     global_eval_batch = config.eval_batch_size * dp_size
-    buckets = None
-    if config.bucket_multiple:
-        buckets = list(range(config.bucket_multiple, max_len + 1,
-                             config.bucket_multiple))
+    buckets = config.bucket_sizes(max_len)
+    if buckets:
         logger.info("length bucketing at widths %s", buckets)
     train_batcher = ShardedBatcher(train_ds, global_train_batch, mesh,
                                    shuffle=True, seed=config.seed,

@@ -1,0 +1,74 @@
+"""Child process for test_mesh32: runs under a forced 32-virtual-CPU-device
+backend (cpu_sim_env(32)) and checks 4-axis mesh correctness.
+
+Same seed + same global batches on a 1-device mesh vs the full
+dp4 x fsdp2 x tp2 x sp2 mesh (every parallelism axis exercised at once:
+data, parameter sharding, tensor heads, ring-attention sequence shards)
+must produce the same fp32 loss sequence — the 32-chip analogue of
+tests/test_trainer.py::test_dp8_matches_dp1_loss_curve.
+"""
+
+import sys
+
+import numpy as np
+import jax
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+    ArrayDataset,
+    ShardedBatcher,
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+    synthetic_text_classification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+    BertForSequenceClassification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+    MeshConfig,
+    build_mesh,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+SEQ = 32
+
+
+def run(mesh_cfg: MeshConfig, devices, attention_impl: str) -> list[float]:
+    mesh = build_mesh(mesh_cfg, devices=devices)
+    enc = EncoderConfig(vocab_size=512, hidden_size=32, num_layers=2,
+                        num_heads=2, intermediate_size=64,
+                        max_position_embeddings=SEQ,
+                        attention_impl=attention_impl)
+    model = BertForSequenceClassification(enc, num_labels=2)
+    params = init_params(model, enc, seed=0)
+    cfg = TrainConfig(dtype="float32", learning_rate=1e-3,
+                      scale_lr_by_world_size=False, log_every_steps=0)
+    trainer = Trainer(cfg, model, params, mesh)
+    tok = WordHashTokenizer(vocab_size=512)
+    texts, labels = synthetic_text_classification(128, seed=0)
+    ds = ArrayDataset.from_texts(tok, texts, labels, max_length=SEQ)
+    batcher = ShardedBatcher(ds, 32, mesh, shuffle=True, seed=0)
+    losses = []
+    for batch in batcher.global_arrays(0):
+        trainer.state, metrics = trainer._train_step(trainer.state, batch)
+        losses.append(float(jax.device_get(metrics["loss"])))
+    return losses
+
+
+def main() -> None:
+    devices = jax.devices()
+    assert len(devices) == 32 and devices[0].platform == "cpu", (
+        f"expected 32 CPU devices, got {len(devices)} {devices[0].platform}")
+    ref = run(MeshConfig(), devices[:1], attention_impl="xla")
+    full = run(MeshConfig(dp=4, fsdp=2, tp=2, sp=2), devices,
+               attention_impl="ring")
+    np.testing.assert_allclose(full, ref, atol=1e-5)
+    print(f"mesh32 ok: {len(ref)} steps, final loss {ref[-1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
