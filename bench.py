@@ -126,8 +126,14 @@ def emit(metric: str, value: float, baseline: float) -> None:
     }))
 
 
+def _on_tpu() -> bool:
+    import jax
+    return jax.devices()[0].platform == "tpu"
+
+
 def bench_headline() -> None:
-    history = run_finetune({}, per_chip_batch=64)
+    # batch 8 off-TPU keeps the CPU smoke run tractable
+    history = run_finetune({}, per_chip_batch=64 if _on_tpu() else 8)
     emit("bert_base_finetune_samples_per_sec_per_chip",
          history["train_samples_per_second_per_chip"],
          V100_BASELINE_SAMPLES_PER_SEC)
@@ -137,7 +143,7 @@ def bench_bert_large() -> None:
     # the reference's default workload at its default size: bs 8/worker
     # (reference launch.py:13-18); 340M params + fp32 Adam state fit one
     # 16G chip without encoder remat
-    history = run_finetune(BERT_LARGE, per_chip_batch=8)
+    history = run_finetune(BERT_LARGE, per_chip_batch=8 if _on_tpu() else 1)
     emit("bert_large_wwm_finetune_samples_per_sec_per_chip",
          history["train_samples_per_second_per_chip"],
          V100_BERT_LARGE_SAMPLES_PER_SEC)

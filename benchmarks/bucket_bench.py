@@ -21,10 +21,10 @@ from __future__ import annotations
 
 
 def bench_buckets() -> None:
-    from bench import emit, run_finetune
+    from bench import _on_tpu, emit, run_finetune
 
-    kwargs = dict(model_kwargs={}, per_chip_batch=64, min_len=50,
-                  max_len=600, batches=14, warmup_epochs=1)
+    kwargs = dict(model_kwargs={}, per_chip_batch=64 if _on_tpu() else 8,
+                  min_len=50, max_len=600, batches=14, warmup_epochs=1)
     padded = run_finetune(**kwargs)
     bucketed = run_finetune(bucket_multiple=128, **kwargs)
     emit("bert_base_bucketed_samples_per_sec_per_chip",
@@ -33,4 +33,9 @@ def bench_buckets() -> None:
 
 
 if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # repo root, for `from bench import ...`
     bench_buckets()

@@ -165,4 +165,8 @@ def bench_mesh() -> None:
 
 
 if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # repo root, for `from bench import ...`
     bench_mesh()

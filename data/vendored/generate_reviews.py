@@ -1,0 +1,250 @@
+"""Generator for the vendored sentiment corpus (`train.jsonl`/`test.jsonl`).
+
+PROVENANCE: this environment is zero-egress — the IMDb dataset the
+reference trains on (reference ``scripts/train.py:72``) is unreachable,
+and no labeled corpus ships with the image. This corpus is therefore
+AUTHORED IN-REPO: every sentence below was written by hand for this
+file; reviews are seeded, deterministic compositions of those sentences.
+It is natural English with the failure modes real sentiment data has
+(negation, concession, mixed opinions, shared vocabulary across
+classes) — but it is NOT IMDb and accuracy on it is not an IMDb number.
+When the HF hub is reachable, `--dataset imdb` runs the real thing.
+
+Hard-case design (what keeps a keyword counter from acing it):
+- negated cues: "not great", "never boring", "couldn't call it a failure"
+  appear with BOTH labels' vocabulary;
+- concessive reviews: a minority-polarity clause precedes the dominant
+  one ("the effects are shoddy, yet the story lands") in ~35%% of rows;
+- neutral filler sentences shared verbatim across classes;
+- the same nouns/slots (acting, script, pacing, score, ending...) fill
+  both positive and negative frames.
+
+Regenerate with:  python data/vendored/generate_reviews.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+# --- hand-authored sentence banks -----------------------------------------
+
+SLOTS = {
+    "aspect": [
+        "the acting", "the script", "the pacing", "the cinematography",
+        "the score", "the dialogue", "the ending", "the direction",
+        "the casting", "the editing", "the premise", "the soundtrack",
+        "the lead performance", "the supporting cast", "the final act",
+        "the opening sequence", "the character work", "the camera work",
+        "the production design", "the humor",
+    ],
+    "person": [
+        "the director", "the lead actor", "the lead actress",
+        "the screenwriter", "the composer", "the whole cast",
+        "the cinematographer", "the editor",
+    ],
+    "genre": [
+        "thriller", "drama", "comedy", "romance", "mystery", "western",
+        "horror picture", "war film", "character study", "family film",
+        "courtroom drama", "road movie", "heist picture", "biopic",
+    ],
+    "time": [
+        "two hours", "an entire afternoon", "a rainy Sunday",
+        "the whole runtime", "ninety minutes",
+    ],
+}
+
+POS_FRAMES = [
+    "{aspect} is simply outstanding",
+    "{aspect} carries the whole picture",
+    "{aspect} had me hooked from the first minute",
+    "{aspect} deserves every award it can get",
+    "{aspect} is handled with real care and intelligence",
+    "{aspect} builds to something genuinely moving",
+    "{aspect} is the best I have seen in years",
+    "{aspect} crackles with wit and energy",
+    "{aspect} rewards your full attention",
+    "{aspect} is quietly devastating in the best way",
+    "{aspect} never puts a foot wrong",
+    "{aspect} elevates familiar material into something special",
+    "{person} delivers career-best work here",
+    "{person} clearly poured heart and soul into this",
+    "{person} finds grace notes in every scene",
+    "{person} makes brave choices that pay off beautifully",
+    "i was moved to tears more than once",
+    "i left the theater grinning like an idiot",
+    "i cannot remember the last time a {genre} felt this alive",
+    "this is the rare {genre} that trusts its audience",
+    "every frame feels purposeful and alive",
+    "it earns its emotional climax honestly",
+    "the twists land because the characters are real",
+    "scene after scene lands with surprising force",
+    "it is funny, tender, and wise all at once",
+    "a masterpiece, plain and simple",
+    "an absolute triumph from start to finish",
+    "you will want to watch it twice, immediately",
+    "it repays {time} with interest",
+    "easily the highlight of the season, and it is not close",
+    "the film finds something true about ordinary life",
+    "even the small roles are cast to perfection",
+    "the climax is staged with breathtaking confidence",
+    "it balances humor and heartbreak effortlessly",
+    "this one stays with you for days",
+]
+
+NEG_FRAMES = [
+    "{aspect} is an outright disaster",
+    "{aspect} drags the whole picture down",
+    "{aspect} put me to sleep twice",
+    "{aspect} feels phoned in from another, worse movie",
+    "{aspect} is handled with stunning carelessness",
+    "{aspect} builds to absolutely nothing",
+    "{aspect} is the weakest element by far",
+    "{aspect} lands with a dull thud",
+    "{aspect} insults the audience's patience",
+    "{aspect} collapses under the slightest scrutiny",
+    "{aspect} never rises above tired cliche",
+    "{aspect} squanders a promising setup",
+    "{person} sleepwalks through the entire film",
+    "{person} has never seemed so lost",
+    "{person} mistakes volume for emotion",
+    "{person} makes baffling choices that never pay off",
+    "i checked my watch every ten minutes",
+    "i walked out feeling cheated",
+    "i cannot remember a {genre} this inert",
+    "this is the kind of {genre} that gives the genre a bad name",
+    "every frame feels recycled and tired",
+    "it begs for an emotional response it never earns",
+    "the twists are visible from a mile away",
+    "scene after scene lands with a thud",
+    "it is loud, shallow, and endless",
+    "a mess, plain and simple",
+    "an absolute slog from start to finish",
+    "you will want those {time} back",
+    "it wastes {time} and your goodwill",
+    "easily the low point of the season, and it is not close",
+    "the film has nothing to say and takes forever to say it",
+    "even the small roles are miscast",
+    "the climax is staged with baffling clumsiness",
+    "it mistakes misery for depth",
+    "this one evaporates from memory before the credits end",
+]
+
+# negation flips: positive-label sentences built from "bad" vocabulary and
+# vice versa — a bag-of-words model pays for these
+POS_NEGATED = [
+    "it is never boring, not even for a second",
+    "nothing about it feels fake or forced",
+    "i expected a disaster and could not have been more wrong",
+    "this is not the tired {genre} the trailer promised",
+    "there is not a wasted scene anywhere",
+    "nobody phones it in, least of all {person}",
+    "it never drags, despite the long runtime",
+    "you could not call a single performance weak",
+    "far from a mess, it is meticulously constructed",
+    "i kept waiting for it to fall apart, and it never did",
+]
+
+NEG_NEGATED = [
+    "it is never exciting, not even for a second",
+    "nothing about it feels honest or earned",
+    "i expected a masterpiece and could not have been more wrong",
+    "this is not the smart {genre} the reviews promised",
+    "there is not a memorable scene anywhere",
+    "nobody brings any spark, least of all {person}",
+    "it never builds momentum, despite the frantic editing",
+    "you could not call a single performance convincing",
+    "far from a triumph, it is barely coherent",
+    "i kept waiting for it to come alive, and it never did",
+]
+
+NEUTRAL = [
+    "i saw this at a matinee with maybe ten other people",
+    "the film runs just over {time}",
+    "it is based, loosely, on true events",
+    "this is the director's third feature",
+    "the trailer gives away more than it should",
+    "i went in knowing almost nothing about it",
+    "it opened against much bigger releases",
+    "the screening i attended was nearly sold out",
+    "my expectations were set mostly by word of mouth",
+    "it follows the usual beats of a {genre}",
+    "the cast is a mix of veterans and newcomers",
+    "there is a brief scene after the credits",
+    "i watched it again at home a week later",
+    "the setting shifts between two timelines",
+    "much of it was shot on location",
+]
+
+CONCESSION_JOINERS = ["that said,", "even so,", "still,", "and yet,",
+                      "in the end though,", "but"]
+
+
+def _fill(rng: random.Random, frame: str) -> str:
+    out = frame
+    for slot, options in SLOTS.items():
+        while "{" + slot + "}" in out:
+            out = out.replace("{" + slot + "}", rng.choice(options), 1)
+    return out
+
+
+def _sentence(rng, bank):
+    return _fill(rng, rng.choice(bank))
+
+
+MIXED_RATE = 0.45
+
+
+def make_review(rng: random.Random, label: int) -> str:
+    """Two review shapes:
+
+    - ~45% "mixed": 1-2 concession units, each a minority-polarity clause
+      rebutted by a dominant one after a concessive joiner ("the pacing
+      drags. even so, the ending lands"). Both polarities contribute the
+      SAME number of opinion clauses, so bag-of-words carries no signal —
+      the label rides entirely on which clause follows the joiner.
+    - else "clear": 2-4 dominant sentences (~35% of them negated
+      minority-vocabulary frames, blurring the exclusive-word signal),
+      plus neutral filler.
+    """
+    main = POS_FRAMES if label == 1 else NEG_FRAMES
+    main_neg = POS_NEGATED if label == 1 else NEG_NEGATED
+    other = NEG_FRAMES if label == 1 else POS_FRAMES
+
+    sentences = []
+    if rng.random() < MIXED_RATE:
+        for _ in range(rng.randint(1, 2)):
+            concession = _sentence(rng, other)
+            joiner = rng.choice(CONCESSION_JOINERS)
+            rebuttal = _sentence(rng, main)
+            sentences.append(f"{concession}. {joiner} {rebuttal}")
+    else:
+        for _ in range(rng.randint(2, 4)):
+            bank = main_neg if rng.random() < 0.35 else main
+            sentences.append(_sentence(rng, bank))
+    for _ in range(rng.randint(0, 3)):
+        sentences.insert(rng.randrange(len(sentences) + 1),
+                         _sentence(rng, NEUTRAL))
+    rng.shuffle(sentences)
+    text = ". ".join(s.rstrip(".") for s in sentences) + "."
+    return text[0].upper() + text[1:]
+
+
+def generate(n_train: int = 4000, n_test: int = 1000, seed: int = 0) -> None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_dir = os.path.join(here, "reviews")
+    os.makedirs(out_dir, exist_ok=True)
+    for split, n, split_seed in (("train", n_train, seed),
+                                 ("test", n_test, seed + 1)):
+        rng = random.Random(split_seed)
+        with open(os.path.join(out_dir, f"{split}.jsonl"), "w") as f:
+            for i in range(n):
+                label = i % 2
+                f.write(json.dumps({"text": make_review(rng, label),
+                                    "label": label}) + "\n")
+    print(f"wrote {n_train}+{n_test} reviews to {out_dir}")
+
+
+if __name__ == "__main__":
+    generate()

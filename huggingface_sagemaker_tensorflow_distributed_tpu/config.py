@@ -135,6 +135,14 @@ class TrainConfig:
     divergence_tol: float = 1e-6          # relative; replicas should be bit-equal
 
     # --- output contract (reference train.py:48-50) ---
+    # accelerator-count env parity (reference SM_NUM_GPUS, train.py:50):
+    # informational — the real device count comes from jax.devices();
+    # scripts/train.py warns when the platform-declared count disagrees.
+    num_chips: Optional[int] = field(
+        default_factory=lambda: (
+            int(v) if (v := _env("TPU_NUM_CHIPS", "SM_NUM_GPUS",
+                                 default="")).isdigit() else None)
+    )
     output_data_dir: str = field(
         default_factory=lambda: _env("TPU_OUTPUT_DATA_DIR", "SM_OUTPUT_DATA_DIR", default="/tmp/output")
     )

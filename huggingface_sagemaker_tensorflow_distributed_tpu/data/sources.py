@@ -290,6 +290,13 @@ def load_text_classification(
     if dataset == "synthetic":
         n = max_samples or (2000 if split == "train" else 400)
         return synthetic_text_classification(n, seed=seed + (0 if split == "train" else 1))
+    if dataset == "vendored_reviews" and not dataset_path:
+        # the in-repo authored sentiment corpus (data/vendored/README.md):
+        # natural-English reviews with negation/concession hard cases,
+        # for offline end-to-end accuracy evidence (EVAL_REALDATA.md)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        dataset_path = os.path.join(repo_root, "data", "vendored", "reviews")
     if dataset_path:
         jsonl = os.path.join(dataset_path, f"{split}.jsonl")
         if os.path.exists(jsonl):

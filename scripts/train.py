@@ -109,6 +109,14 @@ def main(argv=None) -> dict:
     logger.info("config: %s", config.to_json())
     logger.info("process %d/%d, %d devices", process_index, process_count,
                 len(jax.devices()))
+    # per-host contract, like the reference's SM_NUM_GPUS (train.py:50) —
+    # so compare against this host's devices, not the global mesh
+    n_local = len(jax.local_devices())
+    if config.num_chips is not None and config.num_chips != n_local:
+        logger.warning(
+            "platform declared %d accelerators (TPU_NUM_CHIPS/SM_NUM_GPUS) "
+            "but %d local JAX devices are visible; using the visible devices",
+            config.num_chips, n_local)
 
     mesh = build_mesh(MeshConfig(dp=config.dp, fsdp=config.fsdp,
                                  tp=config.tp, sp=config.sp))

@@ -81,3 +81,18 @@ def test_attention_impl_auto_resolution():
     assert TrainConfig(attention_impl="xla").resolve_attention_impl("tpu") == "xla"
     with pytest.raises(ValueError):
         TrainConfig(attention_impl="nope")
+
+
+def test_num_chips_env_parity(monkeypatch):
+    # SM_NUM_GPUS-style accelerator-count contract (reference train.py:50)
+    monkeypatch.delenv("TPU_NUM_CHIPS", raising=False)
+    monkeypatch.delenv("SM_NUM_GPUS", raising=False)
+    assert TrainConfig().num_chips is None
+    monkeypatch.setenv("SM_NUM_GPUS", "8")
+    assert parse_args([]).num_chips == 8
+    monkeypatch.setenv("TPU_NUM_CHIPS", "32")
+    assert parse_args([]).num_chips == 32
+    # an advisory field must tolerate garbage platform values
+    monkeypatch.setenv("TPU_NUM_CHIPS", "not-a-number")
+    monkeypatch.delenv("SM_NUM_GPUS", raising=False)
+    assert TrainConfig().num_chips is None

@@ -264,3 +264,17 @@ def test_bucketing_seq2seq_independent_widths():
     assert batch["input_ids"].shape == (8, 16)           # 10 → bucket 16
     assert batch["decoder_input_ids"].shape == (8, 8)    # 5 → bucket 8
     assert batch["labels"].shape == (8, 8)               # decoder width group
+
+
+def test_vendored_reviews_loads():
+    # the in-repo authored corpus (data/vendored/README.md) resolves by
+    # name, both splits, balanced labels, natural multi-sentence text
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        load_text_classification,
+    )
+    for split, n in (("train", 4000), ("test", 1000)):
+        texts, labels = load_text_classification("vendored_reviews", split)
+        assert len(texts) == len(labels) == n
+        assert set(labels) == {0, 1}
+        assert sum(labels) == n // 2
+        assert all("." in t and len(t.split()) >= 8 for t in texts[:50])
