@@ -13,8 +13,10 @@ When the HF hub is reachable, `--dataset imdb` runs the real thing.
 Hard-case design (what keeps a keyword counter from acing it):
 - negated cues: "not great", "never boring", "couldn't call it a failure"
   appear with BOTH labels' vocabulary;
-- concessive reviews: a minority-polarity clause precedes the dominant
-  one ("the effects are shoddy, yet the story lands") in ~35%% of rows;
+- concessive reviews (~45% of rows, MIXED_RATE): minority- and
+  dominant-polarity clauses in EQUAL number, the label decided only by
+  which clause follows the joiner ("the effects are shoddy, yet the
+  story lands");
 - neutral filler sentences shared verbatim across classes;
 - the same nouns/slots (acting, script, pacing, score, ending...) fill
   both positive and negative frames.
@@ -224,8 +226,7 @@ def make_review(rng: random.Random, label: int) -> str:
             bank = main_neg if rng.random() < 0.35 else main
             sentences.append(_sentence(rng, bank))
     for _ in range(rng.randint(0, 3)):
-        sentences.insert(rng.randrange(len(sentences) + 1),
-                         _sentence(rng, NEUTRAL))
+        sentences.append(_sentence(rng, NEUTRAL))  # shuffle places them
     rng.shuffle(sentences)
     text = ". ".join(s.rstrip(".") for s in sentences) + "."
     return text[0].upper() + text[1:]

@@ -73,3 +73,41 @@ def make_causal_mask(q_len: int, kv_len: int | None = None, dtype=jnp.float32, n
     i = jnp.arange(q_len)[:, None]
     j = jnp.arange(kv_len)[None, :]
     return jnp.where(j <= i, 0.0, neg).astype(dtype)[None, None, :, :]
+
+
+def relative_position_bucket(relative_position, bidirectional: bool,
+                             num_buckets: int, max_distance: int):
+    """HF ``T5Attention._relative_position_bucket`` semantics: log-spaced
+    buckets beyond ``num_buckets // 2``, sign split when bidirectional.
+    Lives here (dep-free) so both the T5 model and the ring-attention
+    kernel can bucket from global positions."""
+    import math
+
+    ret = jnp.zeros_like(relative_position)
+    if bidirectional:
+        num_buckets //= 2
+        ret += (relative_position > 0).astype(jnp.int32) * num_buckets
+        rp = jnp.abs(relative_position)
+    else:
+        rp = -jnp.minimum(relative_position, 0)
+    max_exact = num_buckets // 2
+    is_small = rp < max_exact
+    large = max_exact + (
+        jnp.log(rp.astype(jnp.float32) / max_exact + 1e-9)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, rp, large)
+
+
+def relative_position_bias(table, q_pos, kv_pos, bidirectional: bool,
+                           num_buckets: int, max_distance: int):
+    """[1, heads, q, kv] fp32 additive bias from a [num_buckets, heads]
+    embedding table and global position grids ``q_pos`` [q, 1] /
+    ``kv_pos`` [1, kv] — the tile form ring attention computes per step."""
+    buckets = relative_position_bucket(
+        kv_pos - q_pos, bidirectional=bidirectional,
+        num_buckets=num_buckets, max_distance=max_distance)
+    values = jnp.take(table.astype(jnp.float32), buckets, axis=0)  # [q, kv, h]
+    return values.transpose(2, 0, 1)[None]

@@ -44,8 +44,14 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
 _NEG_INF = float("-inf")
 
 
-def _ring_body(q32, scale, axis_name, n, causal, sq, my_idx):
-    """Returns the fori_loop body folding one KV block into the stats."""
+def _ring_body(q32, scale, axis_name, n, causal, sq, my_idx, rel=None,
+               rel_table=None):
+    """Returns the fori_loop body folding one KV block into the stats.
+
+    ``rel`` = (bidirectional, num_buckets, max_distance) + ``rel_table``
+    [num_buckets, local_heads] enables T5-style relative-position bias:
+    the [sq, sk] bias tile for the current ring step is recomputed from
+    global positions, so the full [S, S] bias never materializes."""
 
     perm = [(i, (i - 1) % n) for i in range(n)]
 
@@ -56,12 +62,22 @@ def _ring_body(q32, scale, axis_name, n, causal, sq, my_idx):
             preferred_element_type=jnp.float32) * scale
         if mask is not None:
             logits = logits + mask.astype(jnp.float32)
-        if causal:
+        needs_pos = causal or rel is not None
+        if needs_pos:
             # global positions: our Q block is fixed at my_idx; the KV
             # block we hold at ring step i started at shard (my_idx + i).
             kv_idx = jax.lax.rem(my_idx + i, n)
             q_pos = my_idx * sq + jnp.arange(sq)[:, None]
             kv_pos = kv_idx * k.shape[2] + jnp.arange(k.shape[2])[None, :]
+        if rel is not None:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+                relative_position_bias,
+            )
+            bidirectional, num_buckets, max_distance = rel
+            logits = logits + relative_position_bias(
+                rel_table, q_pos, kv_pos, bidirectional=bidirectional,
+                num_buckets=num_buckets, max_distance=max_distance)
+        if causal:
             logits = jnp.where(q_pos >= kv_pos, logits, _NEG_INF)
         blk_max = jnp.max(logits, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
@@ -83,9 +99,11 @@ def _ring_body(q32, scale, axis_name, n, causal, sq, my_idx):
     return body
 
 
-def _ring_shard(q, k, v, mask, *, scale, axis_name, causal):
+def _ring_shard(q, k, v, mask, rel_table=None, *, scale, axis_name, causal,
+                rel=None):
     """Per-shard ring attention. q/k/v: local [b, h, s_local, d]; mask:
-    local additive [b, 1, 1, kv_local] or None. Stats kept in fp32."""
+    local additive [b, 1, 1, kv_local] or None; rel_table: local
+    [num_buckets, h] bias table or None. Stats kept in fp32."""
     n = jax.lax.axis_size(axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
@@ -93,21 +111,27 @@ def _ring_shard(q, k, v, mask, *, scale, axis_name, causal):
     m0 = jnp.full((b, h, sq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
     o0 = jnp.zeros((b, h, sq, d), jnp.float32)
-    body = _ring_body(q32, scale, axis_name, n, causal, sq, my_idx)
+    body = _ring_body(q32, scale, axis_name, n, causal, sq, my_idx,
+                      rel=rel, rel_table=rel_table)
     m, l, o, *_ = jax.lax.fori_loop(0, n, body, (m0, l0, o0, k, v, mask))
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mask=None, scale=None, *, mesh: Mesh,
-                   causal: bool = False):
+                   causal: bool = False, rel_bias_table=None,
+                   rel_bias_spec: tuple | None = None):
     """Exact attention with the sequence dim sharded over the ``seq`` axis.
 
     q, k, v: GLOBAL [batch, heads, seq, head_dim] (inside jit).
     mask: optional additive padding mask broadcastable to
     [batch, 1, 1, seq] (the ``ops.attention.make_attention_mask``
     contract). General [b, h, q, k] masks are not supported here — use
-    ``causal=True`` for autoregressive masking (computed from global
-    positions per ring step, so it stays O(local²) per shard).
+    ``causal=True`` for autoregressive masking, and
+    ``rel_bias_table`` [num_buckets, heads] +
+    ``rel_bias_spec`` (bidirectional, num_buckets, max_distance) for
+    T5-style relative-position bias; both are recomputed per ring step
+    from global positions, so they stay O(local²) per shard and the full
+    [S, S] mask/bias never materializes.
 
     Returns GLOBAL [batch, heads, seq, head_dim], sequence-sharded.
     """
@@ -122,17 +146,26 @@ def ring_attention(q, k, v, mask=None, scale=None, *, mesh: Mesh,
     qkv_spec = P(batch_axes, AXIS_TENSOR, AXIS_SEQ, None)
     in_specs = [qkv_spec, qkv_spec, qkv_spec]
     args = [q, k, v]
-    if mask is not None:
+    has_mask = mask is not None
+    has_rel = rel_bias_table is not None
+    if has_mask:
         mask = jnp.broadcast_to(
             mask, (q.shape[0], 1, 1, k.shape[2])).astype(jnp.float32)
         in_specs.append(P(batch_axes, None, None, AXIS_SEQ))
         args.append(mask)
-        fn = functools.partial(_ring_shard, scale=scale, axis_name=AXIS_SEQ,
-                               causal=causal)
-    else:
-        fn = functools.partial(
-            lambda q_, k_, v_, **kw: _ring_shard(q_, k_, v_, None, **kw),
-            scale=scale, axis_name=AXIS_SEQ, causal=causal)
+    if has_rel:
+        # heads dim sharded like q's heads dim (tensor axis)
+        in_specs.append(P(None, AXIS_TENSOR))
+        args.append(rel_bias_table)
+
+    kw = dict(scale=scale, axis_name=AXIS_SEQ, causal=causal,
+              rel=rel_bias_spec if has_rel else None)
+
+    def fn(q_, k_, v_, *rest):
+        rest = list(rest)
+        m_ = rest.pop(0) if has_mask else None
+        t_ = rest.pop(0) if has_rel else None
+        return _ring_shard(q_, k_, v_, m_, t_, **kw)
 
     return jax.shard_map(
         fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=qkv_spec,
@@ -141,10 +174,12 @@ def ring_attention(q, k, v, mask=None, scale=None, *, mesh: Mesh,
 
 
 def ring_attention_or_fallback(q, k, v, mask=None, scale=None,
-                               causal: bool = False):
+                               causal: bool = False, rel_bias_table=None,
+                               rel_bias_spec: tuple | None = None):
     """Model-facing ring dispatch: run ring attention when the ambient
     mesh (``parallel.mesh``) has an active ``seq`` axis and the shapes
-    divide it; otherwise fall back to the numerics-identical XLA kernel.
+    divide it; otherwise fall back to the numerics-identical XLA kernel
+    (materializing the relative bias globally when one is requested).
 
     The fallback is principled, not a silent downgrade: ring attention is
     a *layout* choice (sequence sharding + ppermute schedule) over the
@@ -153,23 +188,41 @@ def ring_attention_or_fallback(q, k, v, mask=None, scale=None,
     eval/export) where sequence sharding is meaningless.
     """
     from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        make_causal_mask,
+        relative_position_bias,
         xla_attention,
     )
     from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
         maybe_current_mesh,
     )
 
+    def xla_path():
+        full_mask = mask
+        if rel_bias_table is not None:
+            bidirectional, num_buckets, max_distance = rel_bias_spec
+            bias = relative_position_bias(
+                rel_bias_table, jnp.arange(q.shape[2])[:, None],
+                jnp.arange(k.shape[2])[None, :], bidirectional=bidirectional,
+                num_buckets=num_buckets, max_distance=max_distance)
+            full_mask = bias if full_mask is None else full_mask + bias
+        if causal:
+            cm = make_causal_mask(q.shape[2], k.shape[2])
+            full_mask = cm if full_mask is None else full_mask + cm
+        return xla_attention(q, k, v, mask=full_mask, scale=scale)
+
     mesh = maybe_current_mesh()
     if mesh is None or mesh.shape.get(AXIS_SEQ, 1) <= 1:
-        return xla_attention(q, k, v, mask=mask, scale=scale)
+        return xla_path()
     b, h, s, _ = q.shape
     dp = mesh.shape.get(AXIS_DATA, 1) * mesh.shape.get(AXIS_FSDP, 1)
     tp = mesh.shape.get(AXIS_TENSOR, 1)
     sp = mesh.shape[AXIS_SEQ]
-    # general [b,h,q,k] masks (causal/relative-bias) have no ring form
-    # here — only broadcastable padding masks ride the ring
+    # general [b,h,q,k] masks have no ring form — only broadcastable
+    # padding masks ride the ring (causal + relative bias are recomputed
+    # per ring step instead)
     general_mask = mask is not None and (mask.shape[-2] != 1 or mask.shape[1] != 1)
     if general_mask or b % dp or h % tp or s % sp or k.shape[2] % sp:
-        return xla_attention(q, k, v, mask=mask, scale=scale)
+        return xla_path()
     return ring_attention(q, k, v, mask=mask, scale=scale, mesh=mesh,
-                          causal=causal)
+                          causal=causal, rel_bias_table=rel_bias_table,
+                          rel_bias_spec=rel_bias_spec)

@@ -137,10 +137,10 @@ def main(argv=None) -> dict:
     )
     if attention_impl == "ring":
         if family == "t5":
-            logger.warning(
-                "sp=%d with a T5 model: T5's relative-attention bias runs "
-                "the XLA path (no ring attention); the seq axis still "
-                "shards activations via GSPMD", config.sp)
+            logger.info(
+                "sp=%d: ring attention on the T5 encoder (relative bias "
+                "re-tiled per ring step); decoder/cross attention run XLA "
+                "with seq-sharded activations", config.sp)
         else:
             logger.info("sp=%d: ring attention selected", config.sp)
     tokenizer = load_tokenizer(config.model_name_or_path,
