@@ -11,8 +11,9 @@ Design: the encoder runs once; the decoder runs inside a single jitted
 zero-length init pass, updated per step with ``dynamic_update_slice`` —
 see ``T5Attention``). Static shapes throughout: output length is fixed at
 ``max_new_tokens`` and finished sequences emit ``pad_token_id``, so one
-compilation serves every batch. Greedy and temperature sampling; beam
-search is deliberately deferred until a workload needs it.
+compilation serves every batch. Greedy, temperature sampling, and beam
+search (beams flattened into the batch dim so every step stays one
+batched decoder call — the TPU-friendly layout).
 """
 
 from __future__ import annotations
@@ -94,3 +95,107 @@ def generate(model, params, input_ids, attention_mask=None,
     return _generate_jit(model, params, input_ids, attention_mask,
                          int(max_new_tokens), float(temperature),
                          jax.random.PRNGKey(seed))
+
+
+_NEG = jnp.float32(-1e9)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "num_beams",
+                                             "max_new_tokens"))
+def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
+                     max_new_tokens, length_penalty):
+    """Beam search with beams flattened into the batch dimension.
+
+    Per step: one decoder call over [batch*beams], log-probs folded into
+    running beam scores, top-``num_beams`` of the ``beams × vocab``
+    candidate grid kept, KV cache re-gathered by winning beam. A beam
+    that emits EOS freezes: its only continuation is ``pad`` at zero
+    additional log-prob, so its score stays fixed while live beams keep
+    competing (the frozen-beam formulation — exact for the winning beam,
+    no separate finished pool). Final pick per batch row maximizes
+    ``score / length**length_penalty`` (HF semantics: penalty 1.0 =
+    length-normalized, 0.0 = raw sum log-prob).
+    """
+    cfg = model.config
+    B = input_ids.shape[0]
+    K = num_beams
+    V = cfg.vocab_size
+
+    encoder_hidden = model.apply({"params": params}, input_ids,
+                                 attention_mask, deterministic=True,
+                                 method=model.encode)
+    # beams ride the batch dim: [B, ...] -> [B*K, ...]
+    enc = jnp.repeat(encoder_hidden, K, axis=0)
+    enc_mask = jnp.repeat(attention_mask, K, axis=0)
+    cache = init_cache(model, params, enc, enc_mask, max_new_tokens)
+
+    token = jnp.full((B * K, 1), cfg.decoder_start_token_id, jnp.int32)
+    # beam 0 starts live, beams 1..K-1 at -inf so step 0 fans out from a
+    # single root instead of K identical copies
+    scores = jnp.tile(jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32),
+         jnp.full((K - 1,), _NEG, jnp.float32)]), (B, 1))      # [B, K]
+    finished = jnp.zeros((B, K), bool)
+    lengths = jnp.zeros((B, K), jnp.int32)
+    tokens = jnp.full((B, K, max_new_tokens), cfg.pad_token_id, jnp.int32)
+
+    def step(carry, t):
+        token, cache, scores, finished, lengths, tokens = carry
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, token, enc, enc_mask,
+            decode=True, deterministic=True, mutable=["cache"],
+            method=model.decode)
+        logp = jax.nn.log_softmax(
+            logits[:, -1, :].astype(jnp.float32)).reshape(B, K, V)
+        # frozen beams: pad continues at zero cost, everything else -inf
+        frozen = jnp.full((V,), _NEG).at[cfg.pad_token_id].set(0.0)
+        logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
+        cand = scores[:, :, None] + logp                       # [B, K, V]
+        top_scores, flat_idx = lax.top_k(cand.reshape(B, K * V), K)
+        beam_idx = flat_idx // V                               # [B, K]
+        next_tok = (flat_idx % V).astype(jnp.int32)
+
+        # re-gather every per-beam state by winning parent beam
+        gather = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+        cache = jax.tree.map(
+            # k/v buffers are [B*K, ...]; cache_index is a shared scalar
+            lambda x: x if x.ndim == 0 else jnp.take(x, gather, axis=0),
+            mutated["cache"])
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        tokens = jnp.take_along_axis(tokens, beam_idx[:, :, None], axis=1)
+
+        emit = jnp.where(finished, jnp.int32(cfg.pad_token_id), next_tok)
+        tokens = lax.dynamic_update_index_in_dim(tokens, emit, t, axis=2)
+        lengths = lengths + (~finished).astype(jnp.int32)
+        finished = finished | (emit == cfg.eos_token_id)
+        return ((emit.reshape(B * K, 1), cache, top_scores, finished,
+                 lengths, tokens), None)
+
+    carry = (token, cache, scores, finished, lengths, tokens)
+    (_, _, scores, finished, lengths, tokens), _ = lax.scan(
+        step, carry, jnp.arange(max_new_tokens))
+
+    norm = scores / jnp.maximum(lengths, 1).astype(
+        jnp.float32) ** length_penalty
+    best = jnp.argmax(norm, axis=1)                            # [B]
+    return jnp.take_along_axis(
+        tokens, best[:, None, None], axis=1)[:, 0], jnp.take_along_axis(
+        norm, best[:, None], axis=1)[:, 0]
+
+
+def beam_search_generate(model, params, input_ids, attention_mask=None,
+                         num_beams: int = 4, max_new_tokens: int = 64,
+                         length_penalty: float = 1.0,
+                         return_scores: bool = False):
+    """Beam-search decode. Returns [batch, max_new_tokens] ids (padded
+    after EOS); with ``return_scores``, also the winning beams'
+    length-penalized log-prob scores."""
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if attention_mask is None:
+        attention_mask = jnp.ones_like(input_ids)
+    attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    ids, scores = _beam_search_jit(model, params, input_ids, attention_mask,
+                                   int(num_beams), int(max_new_tokens),
+                                   jnp.float32(length_penalty))
+    return (ids, scores) if return_scores else ids

@@ -155,6 +155,18 @@ class T5Attention(nn.Module):
                         param_dtype=cfg.param_dtype,
                         kernel_init=nn.initializers.normal(std), name=name)
 
+    def _rel_bias_embed(self) -> nn.Embed:
+        """The ONE construction of the rel_bias embedding — xla mode
+        gathers dense bias through it, ring mode materializes its raw
+        table; both modes must create the identical param
+        (tests/test_t5_ring.py::test_t5_ring_param_tree_matches_xla)."""
+        cfg = self.config
+        return nn.Embed(cfg.relative_attention_num_buckets, cfg.num_heads,
+                        embedding_init=nn.initializers.normal(
+                            cfg.initializer_factor * cfg.d_model ** -0.5),
+                        dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                        name="rel_bias")
+
     def _position_bias(self, q_len: int, kv_len: int, offset=None):
         """[1, heads, q_len, kv_len] learned bias from bucketed relative
         positions. ``offset`` shifts query positions (decode with cache)."""
@@ -167,11 +179,7 @@ class T5Attention(nn.Module):
             mem - ctx, bidirectional=not self.causal,
             num_buckets=cfg.relative_attention_num_buckets,
             max_distance=cfg.relative_attention_max_distance)
-        values = nn.Embed(cfg.relative_attention_num_buckets, cfg.num_heads,
-                          embedding_init=nn.initializers.normal(
-                              cfg.initializer_factor * cfg.d_model ** -0.5),
-                          dtype=jnp.float32, param_dtype=cfg.param_dtype,
-                          name="rel_bias")(buckets)
+        values = self._rel_bias_embed()(buckets)
         return values.transpose(2, 0, 1)[None]
 
     @nn.compact
@@ -223,12 +231,8 @@ class T5Attention(nn.Module):
         # table and run XLA attention, numerics-identical.
         ring = cfg.attention_impl == "ring"
         if ring and position_bias is None and self.has_rel_bias:
-            position_bias = nn.Embed(
-                cfg.relative_attention_num_buckets, cfg.num_heads,
-                embedding_init=nn.initializers.normal(
-                    cfg.initializer_factor * cfg.d_model ** -0.5),
-                dtype=jnp.float32, param_dtype=cfg.param_dtype,
-                name="rel_bias")(jnp.arange(cfg.relative_attention_num_buckets))
+            position_bias = self._rel_bias_embed()(
+                jnp.arange(cfg.relative_attention_num_buckets))
 
         if ring and kv_hidden is None and not decode and not self.causal:
             # encoder self-attention: padding mask rides the ring, the

@@ -22,6 +22,8 @@ SURVEY.md §7 hard-part 5).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -81,8 +83,6 @@ def relative_position_bucket(relative_position, bidirectional: bool,
     buckets beyond ``num_buckets // 2``, sign split when bidirectional.
     Lives here (dep-free) so both the T5 model and the ring-attention
     kernel can bucket from global positions."""
-    import math
-
     ret = jnp.zeros_like(relative_position)
     if bidirectional:
         num_buckets //= 2

@@ -199,3 +199,61 @@ def test_t5_greedy_generate_matches_hf(hf_t5_dir):
         hf_seq = theirs[b][1:]  # drop decoder_start
         n = min(len(hf_seq), ours.shape[1])
         np.testing.assert_array_equal(ours[b, :n], hf_seq[:n])
+
+
+def test_beam_search_beam1_matches_greedy():
+    model, params = _tiny_model(seed=3)
+    src, mask, _ = _batch(TINY, seed=3)
+    greedy = np.asarray(gen.generate(model, params, src, mask, max_new_tokens=6))
+    beam1 = np.asarray(gen.beam_search_generate(model, params, src, mask,
+                                                num_beams=1, max_new_tokens=6))
+    np.testing.assert_array_equal(beam1, greedy)
+
+
+def test_beam_search_score_at_least_greedy():
+    """With length_penalty=0 the winning beam's raw sum-log-prob must be
+    >= the greedy path's (greedy is one member of the search space)."""
+    model, params = _tiny_model(seed=4)
+    src, mask, _ = _batch(TINY, seed=4)
+    T = 6
+    _, s1 = gen.beam_search_generate(model, params, src, mask, num_beams=1,
+                                     max_new_tokens=T, length_penalty=0.0,
+                                     return_scores=True)
+    _, s4 = gen.beam_search_generate(model, params, src, mask, num_beams=4,
+                                     max_new_tokens=T, length_penalty=0.0,
+                                     return_scores=True)
+    assert np.all(np.asarray(s4) >= np.asarray(s1) - 1e-5)
+
+
+def test_beam_search_pads_after_eos():
+    model, params = _tiny_model(seed=5)
+    src, mask, _ = _batch(TINY, seed=5)
+    out = np.asarray(gen.beam_search_generate(model, params, src, mask,
+                                              num_beams=3, max_new_tokens=8))
+    assert out.shape == (2, 8)
+    for row in out:
+        if TINY.eos_token_id in row:
+            after = row[list(row).index(TINY.eos_token_id) + 1:]
+            assert np.all(after == TINY.pad_token_id)
+
+
+def test_t5_beam_search_matches_hf(hf_t5_dir):
+    """Beam-4 decode vs HF transformers beam search on the same weights.
+    HF keeps a finished-hypothesis pool; ours freezes finished beams in
+    place — both exact for the winning hypothesis under length penalty
+    1.0 on these short sequences, so outputs must agree token-for-token."""
+    d, m = hf_t5_dir
+    model, params, _, cfg = auto_models.from_pretrained(d, task="seq2seq")
+    src, mask, _ = _batch(cfg, seed=6)
+    ours = np.asarray(gen.beam_search_generate(model, params, src, mask,
+                                               num_beams=4, max_new_tokens=6,
+                                               length_penalty=1.0))
+    with torch.no_grad():
+        theirs = m.generate(input_ids=torch.tensor(src.astype(np.int64)),
+                            attention_mask=torch.tensor(mask.astype(np.int64)),
+                            max_new_tokens=6, do_sample=False, num_beams=4,
+                            length_penalty=1.0, early_stopping=False).numpy()
+    for b in range(src.shape[0]):
+        hf_seq = theirs[b][1:]  # drop decoder_start
+        n = min(len(hf_seq), ours.shape[1])
+        np.testing.assert_array_equal(ours[b][:n], hf_seq[:n])
