@@ -27,7 +27,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from huggingface_sagemaker_tensorflow_distributed_tpu.models import bert, distilbert, roberta, t5
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import (
+    bert,
+    distilbert,
+    electra,
+    roberta,
+    t5,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.models.convert import (
     hf_to_params,
     load_hf_config,
@@ -51,6 +57,9 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("distilbert", "seq-cls"): distilbert.DistilBertForSequenceClassification,
     ("distilbert", "token-cls"): distilbert.DistilBertForTokenClassification,
     ("distilbert", "qa"): distilbert.DistilBertForQuestionAnswering,
+    ("electra", "seq-cls"): electra.ElectraForSequenceClassification,
+    ("electra", "token-cls"): electra.ElectraForTokenClassification,
+    ("electra", "qa"): electra.ElectraForQuestionAnswering,
     ("t5", "seq2seq"): t5.T5ForConditionalGeneration,
 }
 
@@ -58,6 +67,7 @@ CONFIG_BUILDERS = {
     "bert": bert.bert_config_from_hf,
     "roberta": roberta.roberta_config_from_hf,
     "distilbert": distilbert.distilbert_config_from_hf,
+    "electra": electra.electra_config_from_hf,
     "t5": t5.t5_config_from_hf,
 }
 
@@ -95,6 +105,19 @@ _HF_CONFIG_EXPORTERS = {
         "max_position_embeddings": c.max_position_embeddings,
         "activation": c.hidden_act, "dropout": c.hidden_dropout,
         "attention_dropout": c.attention_dropout,
+        "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
+    },
+    "electra": lambda c: {
+        "model_type": "electra", "architectures": ["ElectraForSequenceClassification"],
+        "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
+        "embedding_size": c.embedding_size or c.hidden_size,
+        "num_hidden_layers": c.num_layers, "num_attention_heads": c.num_heads,
+        "intermediate_size": c.intermediate_size,
+        "max_position_embeddings": c.max_position_embeddings,
+        "type_vocab_size": c.type_vocab_size, "hidden_act": c.hidden_act,
+        "layer_norm_eps": c.layer_norm_eps,
+        "hidden_dropout_prob": c.hidden_dropout,
+        "attention_probs_dropout_prob": c.attention_dropout,
         "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
     },
     "t5": lambda c: {

@@ -118,10 +118,32 @@ _T5_RULES = [
     (r"^lm_head$", r"lm_head"),
 ]
 
+_ELECTRA_RULES = [
+    (r"^(?:electra\.)?embeddings\.word_embeddings$", r"backbone/embeddings/word_embeddings"),
+    (r"^(?:electra\.)?embeddings\.position_embeddings$", r"backbone/embeddings/position_embeddings"),
+    (r"^(?:electra\.)?embeddings\.token_type_embeddings$", r"backbone/embeddings/token_type_embeddings"),
+    (r"^(?:electra\.)?embeddings\.LayerNorm$", r"backbone/embeddings/embeddings_ln"),
+    (r"^(?:electra\.)?embeddings_project$", r"backbone/embeddings_project"),
+    (r"^(?:electra\.)?encoder\.layer\.(\d+)\.attention\.self\.query$", r"backbone/encoder/layer_\1/attention/query"),
+    (r"^(?:electra\.)?encoder\.layer\.(\d+)\.attention\.self\.key$", r"backbone/encoder/layer_\1/attention/key"),
+    (r"^(?:electra\.)?encoder\.layer\.(\d+)\.attention\.self\.value$", r"backbone/encoder/layer_\1/attention/value"),
+    (r"^(?:electra\.)?encoder\.layer\.(\d+)\.attention\.output\.dense$", r"backbone/encoder/layer_\1/attention/attention_out"),
+    (r"^(?:electra\.)?encoder\.layer\.(\d+)\.attention\.output\.LayerNorm$", r"backbone/encoder/layer_\1/attention_ln"),
+    (r"^(?:electra\.)?encoder\.layer\.(\d+)\.intermediate\.dense$", r"backbone/encoder/layer_\1/ffn/intermediate"),
+    (r"^(?:electra\.)?encoder\.layer\.(\d+)\.output\.dense$", r"backbone/encoder/layer_\1/ffn/ffn_out"),
+    (r"^(?:electra\.)?encoder\.layer\.(\d+)\.output\.LayerNorm$", r"backbone/encoder/layer_\1/ffn_ln"),
+    # ElectraClassificationHead
+    (r"^classifier\.dense$", r"head/head_dense"),
+    (r"^classifier\.out_proj$", r"head/classifier"),
+    (r"^qa_outputs$", r"qa_outputs"),
+    (r"^classifier$", r"classifier"),  # token-cls head (no sub-keys)
+]
+
 RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_RULES,
     "roberta": _ROBERTA_RULES,
     "distilbert": _DISTILBERT_RULES,
+    "electra": _ELECTRA_RULES,
     "t5": _T5_RULES,
 }
 
@@ -301,10 +323,31 @@ _T5_REVERSE = [
     (r"^lm_head$", "lm_head"),
 ]
 
+_ELECTRA_REVERSE = [
+    (r"^backbone/embeddings/word_embeddings$", "electra.embeddings.word_embeddings"),
+    (r"^backbone/embeddings/position_embeddings$", "electra.embeddings.position_embeddings"),
+    (r"^backbone/embeddings/token_type_embeddings$", "electra.embeddings.token_type_embeddings"),
+    (r"^backbone/embeddings/embeddings_ln$", "electra.embeddings.LayerNorm"),
+    (r"^backbone/embeddings_project$", "electra.embeddings_project"),
+    (r"^backbone/encoder/layer_(\d+)/attention/query$", "electra.encoder.layer.{}.attention.self.query"),
+    (r"^backbone/encoder/layer_(\d+)/attention/key$", "electra.encoder.layer.{}.attention.self.key"),
+    (r"^backbone/encoder/layer_(\d+)/attention/value$", "electra.encoder.layer.{}.attention.self.value"),
+    (r"^backbone/encoder/layer_(\d+)/attention/attention_out$", "electra.encoder.layer.{}.attention.output.dense"),
+    (r"^backbone/encoder/layer_(\d+)/attention_ln$", "electra.encoder.layer.{}.attention.output.LayerNorm"),
+    (r"^backbone/encoder/layer_(\d+)/ffn/intermediate$", "electra.encoder.layer.{}.intermediate.dense"),
+    (r"^backbone/encoder/layer_(\d+)/ffn/ffn_out$", "electra.encoder.layer.{}.output.dense"),
+    (r"^backbone/encoder/layer_(\d+)/ffn_ln$", "electra.encoder.layer.{}.output.LayerNorm"),
+    (r"^head/head_dense$", "classifier.dense"),
+    (r"^head/classifier$", "classifier.out_proj"),
+    (r"^qa_outputs$", "qa_outputs"),
+    (r"^classifier$", "classifier"),
+]
+
 REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_REVERSE,
     "roberta": _ROBERTA_REVERSE,
     "distilbert": _DISTILBERT_REVERSE,
+    "electra": _ELECTRA_REVERSE,
     "t5": _T5_REVERSE,
 }
 

@@ -1,0 +1,104 @@
+"""ELECTRA models + task heads.
+
+Widens the model zoo the reference reaches implicitly through
+``TFAutoModelForSequenceClassification.from_pretrained`` accepting any
+HF encoder name (reference ``scripts/train.py:117``; SURVEY.md D7).
+
+ELECTRA's discriminator is a BERT-shaped encoder with two differences
+reproduced here: factorized embeddings (``embedding_size`` may be
+smaller than ``hidden_size``, with a learned ``embeddings_project``
+dense in the backbone — ``models/layers.py``), and no pooler — the
+seq-cls head is dense→GeLU→out_proj on the CLS token
+(HF ``ElectraClassificationHead``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    EncoderBackbone,
+    EncoderConfig,
+    _dense,
+)
+
+
+def electra_config_from_hf(hf_config: dict, **overrides) -> EncoderConfig:
+    kw = dict(
+        vocab_size=hf_config["vocab_size"],
+        hidden_size=hf_config["hidden_size"],
+        embedding_size=hf_config.get("embedding_size",
+                                     hf_config["hidden_size"]),
+        num_layers=hf_config["num_hidden_layers"],
+        num_heads=hf_config["num_attention_heads"],
+        intermediate_size=hf_config["intermediate_size"],
+        max_position_embeddings=hf_config["max_position_embeddings"],
+        type_vocab_size=hf_config.get("type_vocab_size", 2),
+        hidden_act=hf_config.get("hidden_act", "gelu"),
+        layer_norm_eps=hf_config.get("layer_norm_eps", 1e-12),
+        hidden_dropout=hf_config.get("hidden_dropout_prob", 0.1),
+        attention_dropout=hf_config.get("attention_probs_dropout_prob", 0.1),
+        pad_token_id=hf_config.get("pad_token_id", 0),
+        initializer_range=hf_config.get("initializer_range", 0.02),
+        use_pooler=False,
+    )
+    kw.update(overrides)
+    return EncoderConfig(**kw)
+
+
+class ElectraClassificationHead(nn.Module):
+    """dropout → dense → GeLU → dropout → out_proj on CLS (HF parity)."""
+
+    config: EncoderConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, seq, deterministic: bool = True):
+        cfg = self.config
+        x = seq[:, 0]
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        x = jax.nn.gelu(_dense(cfg, cfg.hidden_size, "head_dense")(x),
+                        approximate=False)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        return _dense(cfg, self.num_labels, "classifier")(x)
+
+
+class ElectraForSequenceClassification(nn.Module):
+    config: EncoderConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        return ElectraClassificationHead(self.config, self.num_labels,
+                                         name="head")(seq, deterministic)
+
+
+class ElectraForTokenClassification(nn.Module):
+    config: EncoderConfig
+    num_labels: int = 9
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        x = nn.Dropout(self.config.hidden_dropout)(seq, deterministic=deterministic)
+        return _dense(self.config, self.num_labels, "classifier")(x)
+
+
+class ElectraForQuestionAnswering(nn.Module):
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        logits = _dense(self.config, 2, "qa_outputs")(seq)
+        start, end = jnp.split(logits, 2, axis=-1)
+        return start[..., 0], end[..., 0]

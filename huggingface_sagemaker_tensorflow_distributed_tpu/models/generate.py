@@ -104,22 +104,25 @@ _NEG = jnp.float32(-1e9)
                                              "max_new_tokens"))
 def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
                      max_new_tokens, length_penalty):
-    """Beam search with beams flattened into the batch dimension.
+    """Beam search with beams flattened into the batch dimension,
+    HF-equivalent (``BeamSearchScorer`` semantics, the flax/t5x shape):
 
-    Per step: one decoder call over [batch*beams], log-probs folded into
-    running beam scores, top-``num_beams`` of the ``beams × vocab``
-    candidate grid kept, KV cache re-gathered by winning beam. A beam
-    that emits EOS freezes: its only continuation is ``pad`` at zero
-    additional log-prob, so its score stays fixed while live beams keep
-    competing (the frozen-beam formulation — exact for the winning beam,
-    no separate finished pool). Final pick per batch row maximizes
-    ``score / length**length_penalty`` (HF semantics: penalty 1.0 =
-    length-normalized, 0.0 = raw sum log-prob).
+    per step one decoder call over [batch*beams], then the top ``2K`` of
+    the ``K × vocab`` candidate grid. EOS candidates are banked into a
+    K-slot finished pool with their length penalty applied at add time
+    (hypothesis length = generated tokens before EOS + 1 for the start
+    token, exactly HF's ``sum_logprobs / len(hyp)**penalty``); the best
+    K non-EOS candidates continue as live beams (KV cache re-gathered by
+    parent). A row stops banking once HF's ``is_done`` criterion holds
+    (worst pooled score >= best attainable at the current length). At
+    the end, rows not done bank their live beams at length
+    ``max_new_tokens + 1``; the best pooled hypothesis wins.
     """
     cfg = model.config
     B = input_ids.shape[0]
     K = num_beams
     V = cfg.vocab_size
+    T = max_new_tokens
 
     encoder_hidden = model.apply({"params": params}, input_ids,
                                  attention_mask, deterministic=True,
@@ -127,61 +130,86 @@ def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
     # beams ride the batch dim: [B, ...] -> [B*K, ...]
     enc = jnp.repeat(encoder_hidden, K, axis=0)
     enc_mask = jnp.repeat(attention_mask, K, axis=0)
-    cache = init_cache(model, params, enc, enc_mask, max_new_tokens)
+    cache = init_cache(model, params, enc, enc_mask, T)
 
     token = jnp.full((B * K, 1), cfg.decoder_start_token_id, jnp.int32)
     # beam 0 starts live, beams 1..K-1 at -inf so step 0 fans out from a
     # single root instead of K identical copies
-    scores = jnp.tile(jnp.concatenate(
+    live_scores = jnp.tile(jnp.concatenate(
         [jnp.zeros((1,), jnp.float32),
          jnp.full((K - 1,), _NEG, jnp.float32)]), (B, 1))      # [B, K]
-    finished = jnp.zeros((B, K), bool)
-    lengths = jnp.zeros((B, K), jnp.int32)
-    tokens = jnp.full((B, K, max_new_tokens), cfg.pad_token_id, jnp.int32)
+    live_tok = jnp.full((B, K, T), cfg.pad_token_id, jnp.int32)
+    fin_scores = jnp.full((B, K), _NEG, jnp.float32)           # penalized
+    fin_tok = jnp.full((B, K, T), cfg.pad_token_id, jnp.int32)
+    done = jnp.zeros((B,), bool)
+
+    def pool_merge(fin_scores, fin_tok, cand_scores, cand_tok):
+        """Keep the best K of (current pool) ∪ (candidates)."""
+        all_scores = jnp.concatenate([fin_scores, cand_scores], axis=1)
+        all_tok = jnp.concatenate([fin_tok, cand_tok], axis=1)
+        new_scores, idx = lax.top_k(all_scores, K)
+        return new_scores, jnp.take_along_axis(all_tok, idx[:, :, None],
+                                               axis=1)
 
     def step(carry, t):
-        token, cache, scores, finished, lengths, tokens = carry
+        (token, cache, live_scores, live_tok, fin_scores, fin_tok,
+         done) = carry
         logits, mutated = model.apply(
             {"params": params, "cache": cache}, token, enc, enc_mask,
             decode=True, deterministic=True, mutable=["cache"],
             method=model.decode)
         logp = jax.nn.log_softmax(
             logits[:, -1, :].astype(jnp.float32)).reshape(B, K, V)
-        # frozen beams: pad continues at zero cost, everything else -inf
-        frozen = jnp.full((V,), _NEG).at[cfg.pad_token_id].set(0.0)
-        logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
-        cand = scores[:, :, None] + logp                       # [B, K, V]
-        top_scores, flat_idx = lax.top_k(cand.reshape(B, K * V), K)
-        beam_idx = flat_idx // V                               # [B, K]
-        next_tok = (flat_idx % V).astype(jnp.int32)
+        cand = live_scores[:, :, None] + logp                  # [B, K, V]
+        top2k, flat = lax.top_k(cand.reshape(B, K * V), 2 * K)
+        parent = flat // V                                     # [B, 2K]
+        tok2k = (flat % V).astype(jnp.int32)
+        is_eos = tok2k == cfg.eos_token_id
 
-        # re-gather every per-beam state by winning parent beam
-        gather = (jnp.arange(B)[:, None] * K + beam_idx).reshape(-1)
+        # candidate sequences: parent history + this token at position t
+        seq2k = jnp.take_along_axis(live_tok, parent[:, :, None], axis=1)
+        seq2k = lax.dynamic_update_index_in_dim(seq2k, tok2k, t, axis=2)
+
+        # bank EOS candidates (HF hypothesis length: t generated tokens
+        # before EOS + decoder_start = t + 1); done rows bank nothing
+        cur_len = (t + 1).astype(jnp.float32)
+        eos_norm = jnp.where(is_eos & ~done[:, None],
+                             top2k / cur_len ** length_penalty, _NEG)
+        fin_scores, fin_tok = pool_merge(fin_scores, fin_tok, eos_norm,
+                                         seq2k)
+
+        # best K non-EOS candidates continue as live beams
+        live_cand = jnp.where(is_eos, _NEG, top2k)
+        live_scores, keep = lax.top_k(live_cand, K)            # [B, K]
+        emit = jnp.take_along_axis(tok2k, keep, axis=1)
+        live_tok = jnp.take_along_axis(seq2k, keep[:, :, None], axis=1)
+        parent_k = jnp.take_along_axis(parent, keep, axis=1)
+        gather = (jnp.arange(B)[:, None] * K + parent_k).reshape(-1)
         cache = jax.tree.map(
             # k/v buffers are [B*K, ...]; cache_index is a shared scalar
             lambda x: x if x.ndim == 0 else jnp.take(x, gather, axis=0),
             mutated["cache"])
-        finished = jnp.take_along_axis(finished, beam_idx, axis=1)
-        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
-        tokens = jnp.take_along_axis(tokens, beam_idx[:, :, None], axis=1)
 
-        emit = jnp.where(finished, jnp.int32(cfg.pad_token_id), next_tok)
-        tokens = lax.dynamic_update_index_in_dim(tokens, emit, t, axis=2)
-        lengths = lengths + (~finished).astype(jnp.int32)
-        finished = finished | (emit == cfg.eos_token_id)
-        return ((emit.reshape(B * K, 1), cache, top_scores, finished,
-                 lengths, tokens), None)
+        # HF BeamHypotheses.is_done (early_stopping=False): the pool is
+        # final once its worst member beats the best attainable score
+        attainable = top2k[:, 0] / cur_len ** length_penalty
+        done = done | (jnp.min(fin_scores, axis=1) >= attainable)
+        return ((emit.reshape(B * K, 1), cache, live_scores, live_tok,
+                 fin_scores, fin_tok, done), None)
 
-    carry = (token, cache, scores, finished, lengths, tokens)
-    (_, _, scores, finished, lengths, tokens), _ = lax.scan(
-        step, carry, jnp.arange(max_new_tokens))
+    carry = (token, cache, live_scores, live_tok, fin_scores, fin_tok, done)
+    (_, _, live_scores, live_tok, fin_scores, fin_tok, done), _ = lax.scan(
+        step, carry, jnp.arange(T))
 
-    norm = scores / jnp.maximum(lengths, 1).astype(
-        jnp.float32) ** length_penalty
-    best = jnp.argmax(norm, axis=1)                            # [B]
-    return jnp.take_along_axis(
-        tokens, best[:, None, None], axis=1)[:, 0], jnp.take_along_axis(
-        norm, best[:, None], axis=1)[:, 0]
+    # rows not done bank their live beams (HF finalize: hypothesis length
+    # = decoder_start + all max_new_tokens generated = T + 1)
+    live_norm = jnp.where(done[:, None], _NEG,
+                          live_scores / jnp.float32(T + 1) ** length_penalty)
+    fin_scores, fin_tok = pool_merge(fin_scores, fin_tok, live_norm, live_tok)
+
+    best = jnp.argmax(fin_scores, axis=1)                      # [B]
+    return (jnp.take_along_axis(fin_tok, best[:, None, None], axis=1)[:, 0],
+            jnp.take_along_axis(fin_scores, best[:, None], axis=1)[:, 0])
 
 
 def beam_search_generate(model, params, input_ids, attention_mask=None,

@@ -43,6 +43,9 @@ class EncoderConfig:
 
     vocab_size: int = 30522
     hidden_size: int = 768
+    # ELECTRA-style factorized embeddings: embed at this width, project
+    # to hidden_size in the backbone. None = hidden_size (BERT/RoBERTa).
+    embedding_size: Optional[int] = None
     num_layers: int = 12
     num_heads: int = 12
     intermediate_size: int = 3072
@@ -99,7 +102,8 @@ class Embeddings(nn.Module):
     def __call__(self, input_ids, token_type_ids=None, position_ids=None,
                  attention_mask=None, deterministic: bool = True):
         cfg = self.config
-        word = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+        emb = cfg.embedding_size or cfg.hidden_size
+        word = nn.Embed(cfg.vocab_size, emb,
                         embedding_init=nn.initializers.normal(cfg.initializer_range),
                         dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                         name="word_embeddings")(input_ids)
@@ -114,7 +118,7 @@ class Embeddings(nn.Module):
             else:
                 position_ids = jnp.arange(cfg.position_offset,
                                           seq_len + cfg.position_offset)[None, :]
-        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+        pos = nn.Embed(cfg.max_position_embeddings, emb,
                        embedding_init=nn.initializers.normal(cfg.initializer_range),
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        name="position_embeddings")(position_ids)
@@ -122,7 +126,7 @@ class Embeddings(nn.Module):
         if cfg.use_token_type:
             if token_type_ids is None:
                 token_type_ids = jnp.zeros_like(input_ids)
-            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+            x = x + nn.Embed(cfg.type_vocab_size, emb,
                              embedding_init=nn.initializers.normal(cfg.initializer_range),
                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                              name="token_type_embeddings")(token_type_ids)
@@ -240,6 +244,10 @@ class EncoderBackbone(nn.Module):
         additive_mask = make_attention_mask(attention_mask)
         x = Embeddings(cfg, name="embeddings")(
             input_ids, token_type_ids, position_ids, attention_mask, deterministic)
+        if cfg.embedding_size and cfg.embedding_size != cfg.hidden_size:
+            # ELECTRA factorized-embedding projection (HF
+            # ``ElectraModel.embeddings_project``)
+            x = _dense(cfg, cfg.hidden_size, "embeddings_project")(x)
         x = Encoder(cfg, name="encoder")(x, additive_mask, deterministic)
         pooled = Pooler(cfg, name="pooler")(x) if cfg.use_pooler else None
         return x, pooled

@@ -133,3 +133,58 @@ def test_fresh_head_when_checkpoint_lacks_it(tmp_path):
     # state dict has no "bert." prefix and no classifier — both handled
     model, params, family, _ = auto_models.from_pretrained(d, task="seq-cls")
     assert "classifier" in params
+
+
+@pytest.fixture(scope="module")
+def electra_dir(tmp_path_factory):
+    torch.manual_seed(5)
+    # embedding_size != hidden_size exercises the factorized-embedding
+    # projection path (models/layers.py embeddings_project)
+    cfg = transformers.ElectraConfig(
+        vocab_size=128, embedding_size=16, hidden_size=32,
+        num_hidden_layers=3, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    d = str(tmp_path_factory.mktemp("electra"))
+    m = transformers.ElectraForSequenceClassification(cfg).eval()
+    m.save_pretrained(d)
+    return d, m, cfg
+
+
+def test_electra_seq_cls_parity(electra_dir):
+    d, m, _ = electra_dir
+    ids, mask = _inputs(128, seed=5)
+    _compare(m, d, "seq-cls", ids, mask)
+
+
+def test_electra_qa_parity(electra_dir, tmp_path):
+    _, _, cfg = electra_dir
+    torch.manual_seed(6)
+    m = transformers.ElectraForQuestionAnswering(cfg).eval()
+    m.save_pretrained(str(tmp_path))
+    ids, mask = _inputs(128, seed=6)
+    _compare(m, str(tmp_path), "qa", ids, mask)
+
+
+def test_electra_token_cls_parity(electra_dir, tmp_path):
+    _, _, cfg = electra_dir
+    torch.manual_seed(7)
+    m = transformers.ElectraForTokenClassification(cfg).eval()
+    m.save_pretrained(str(tmp_path))
+    ids, mask = _inputs(128, seed=7)
+    _compare(m, str(tmp_path), "token-cls", ids, mask)
+
+
+def test_electra_export_roundtrip_loads_in_hf(electra_dir, tmp_path):
+    d, m, _ = electra_dir
+    model, params, family, cfg = auto_models.from_pretrained(
+        d, task="seq-cls", num_labels=2)
+    out = str(tmp_path / "export")
+    auto_models.save_pretrained(out, params, family, cfg)
+    reloaded = transformers.ElectraForSequenceClassification.from_pretrained(out).eval()
+    ids, mask = _inputs(128, seed=8)
+    with torch.no_grad():
+        a = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)).logits
+        b = reloaded(input_ids=torch.tensor(ids),
+                     attention_mask=torch.tensor(mask)).logits
+    np.testing.assert_allclose(b.numpy(), a.numpy(), atol=1e-5)

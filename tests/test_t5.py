@@ -205,24 +205,35 @@ def test_beam_search_beam1_matches_greedy():
     model, params = _tiny_model(seed=3)
     src, mask, _ = _batch(TINY, seed=3)
     greedy = np.asarray(gen.generate(model, params, src, mask, max_new_tokens=6))
-    beam1 = np.asarray(gen.beam_search_generate(model, params, src, mask,
-                                                num_beams=1, max_new_tokens=6))
-    np.testing.assert_array_equal(beam1, greedy)
-
-
-def test_beam_search_score_at_least_greedy():
-    """With length_penalty=0 the winning beam's raw sum-log-prob must be
-    >= the greedy path's (greedy is one member of the search space)."""
-    model, params = _tiny_model(seed=4)
-    src, mask, _ = _batch(TINY, seed=4)
-    T = 6
+    # greedy's exact path is in beam-1's search space: at length_penalty
+    # 0 the pooled winner's raw sum-log-prob must be at least greedy's
     _, s1 = gen.beam_search_generate(model, params, src, mask, num_beams=1,
-                                     max_new_tokens=T, length_penalty=0.0,
+                                     max_new_tokens=6, length_penalty=0.0,
                                      return_scores=True)
-    _, s4 = gen.beam_search_generate(model, params, src, mask, num_beams=4,
-                                     max_new_tokens=T, length_penalty=0.0,
-                                     return_scores=True)
-    assert np.all(np.asarray(s4) >= np.asarray(s1) - 1e-5)
+    logp = _sequence_logprob(model, params, src, mask, greedy)
+    assert np.all(np.asarray(s1) >= logp - 1e-4)
+
+
+def _sequence_logprob(model, params, src, mask, out_tokens):
+    """Teacher-forced raw sum log-prob of generated tokens up to and
+    including EOS (the quantity beam search maximizes at penalty 0)."""
+    import jax.numpy as jnp
+
+    B, T = out_tokens.shape
+    dec_in = np.concatenate(
+        [np.full((B, 1), TINY.decoder_start_token_id, np.int32),
+         out_tokens[:, :-1]], axis=1)
+    logits = model.apply({"params": params}, src, mask,
+                         jnp.asarray(dec_in), deterministic=True)
+    logp = np.asarray(jax.nn.log_softmax(logits.astype(jnp.float32)))
+    tok_lp = np.take_along_axis(logp, out_tokens[:, :, None], axis=-1)[..., 0]
+    total = np.zeros(B)
+    for b in range(B):
+        for t in range(T):
+            total[b] += tok_lp[b, t]
+            if out_tokens[b, t] == TINY.eos_token_id:
+                break
+    return total
 
 
 def test_beam_search_pads_after_eos():
@@ -237,22 +248,26 @@ def test_beam_search_pads_after_eos():
             assert np.all(after == TINY.pad_token_id)
 
 
-def test_t5_beam_search_matches_hf(hf_t5_dir):
-    """Beam-4 decode vs HF transformers beam search on the same weights.
-    HF keeps a finished-hypothesis pool; ours freezes finished beams in
-    place — both exact for the winning hypothesis under length penalty
-    1.0 on these short sequences, so outputs must agree token-for-token."""
+@pytest.mark.parametrize("num_beams,length_penalty,seed",
+                         [(4, 1.0, 6), (2, 0.0, 7), (4, 2.0, 8), (3, 1.0, 9)])
+def test_t5_beam_search_matches_hf(hf_t5_dir, num_beams, length_penalty, seed):
+    """Beam decode vs HF transformers on the same weights: same
+    algorithm (2K candidates, finished-hypothesis pool with add-time
+    length penalty, is_done early-stop bookkeeping), so outputs must
+    agree token-for-token across beam widths and penalties."""
     d, m = hf_t5_dir
     model, params, _, cfg = auto_models.from_pretrained(d, task="seq2seq")
-    src, mask, _ = _batch(cfg, seed=6)
-    ours = np.asarray(gen.beam_search_generate(model, params, src, mask,
-                                               num_beams=4, max_new_tokens=6,
-                                               length_penalty=1.0))
+    src, mask, _ = _batch(cfg, seed=seed)
+    ours = np.asarray(gen.beam_search_generate(
+        model, params, src, mask, num_beams=num_beams, max_new_tokens=6,
+        length_penalty=length_penalty))
     with torch.no_grad():
         theirs = m.generate(input_ids=torch.tensor(src.astype(np.int64)),
                             attention_mask=torch.tensor(mask.astype(np.int64)),
-                            max_new_tokens=6, do_sample=False, num_beams=4,
-                            length_penalty=1.0, early_stopping=False).numpy()
+                            max_new_tokens=6, do_sample=False,
+                            num_beams=num_beams,
+                            length_penalty=length_penalty,
+                            early_stopping=False).numpy()
     for b in range(src.shape[0]):
         hf_seq = theirs[b][1:]  # drop decoder_start
         n = min(len(hf_seq), ours.shape[1])
