@@ -84,6 +84,9 @@ class TrainConfig:
     warmup_ratio: float = 0.0
     weight_decay: float = 0.0
     max_grad_norm: float = 0.0     # 0 disables clipping (reference has none)
+    # micro-batches averaged per optimizer update (1 = off): grows the
+    # effective batch beyond HBM limits (e.g. BERT-large past bs 8/chip)
+    gradient_accumulation_steps: int = 1
     steps_per_epoch: Optional[int] = None
     seed: int = 42
     # dropout-key PRNG. "rbg" uses the TPU's hardware RNG instruction —
@@ -175,6 +178,8 @@ class TrainConfig:
             raise ValueError(f"unknown rng_impl {self.rng_impl!r}")
         if self.epochs < 0 or self.train_batch_size <= 0 or self.eval_batch_size <= 0:
             raise ValueError("epochs must be >= 0 and batch sizes positive")
+        if self.gradient_accumulation_steps < 1:
+            raise ValueError("gradient_accumulation_steps must be >= 1")
         if self.learning_rate <= 0:
             raise ValueError("learning_rate must be positive")
         for ax in ("fsdp", "tp", "sp"):

@@ -44,10 +44,30 @@ def init_cache(model, params, encoder_hidden, encoder_attention_mask,
     return variables["cache"]
 
 
+def _filter_top_k(logits, top_k: int):
+    """Keep the ``top_k`` highest logits, mask the rest to -inf
+    (oversized ``top_k`` keeps everything, HF TopKLogitsWarper)."""
+    kth = lax.top_k(logits, min(top_k, logits.shape[-1]))[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _filter_top_p(logits, top_p: float):
+    """Nucleus filtering: keep the smallest prefix of the sorted
+    distribution whose cumulative probability exceeds ``top_p`` (the
+    first token past the threshold is kept, HF semantics)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+    keep_sorted = cum - jax.nn.softmax(sorted_logits, axis=-1) < top_p
+    # threshold logit = smallest kept logit
+    kth = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                  axis=-1, keepdims=True)
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
 @functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
-                                             "temperature"))
+                                             "temperature", "top_k", "top_p"))
 def _generate_jit(model, params, input_ids, attention_mask, max_new_tokens,
-                  temperature, rng):
+                  temperature, rng, top_k=0, top_p=0.0):
     cfg = model.config
     encoder_hidden = model.apply({"params": params}, input_ids,
                                  attention_mask, deterministic=True,
@@ -67,8 +87,13 @@ def _generate_jit(model, params, input_ids, attention_mask, max_new_tokens,
         if temperature == 0.0:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
+            logits = logits / temperature
+            if top_k:
+                logits = _filter_top_k(logits, top_k)
+            if top_p:
+                logits = _filter_top_p(logits, top_p)
             rng, sub = jax.random.split(rng)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            nxt = jax.random.categorical(sub, logits, axis=-1)
             nxt = nxt.astype(jnp.int32)
         nxt = jnp.where(finished, jnp.int32(cfg.pad_token_id), nxt)
         finished = finished | (nxt == cfg.eos_token_id)
@@ -81,12 +106,14 @@ def _generate_jit(model, params, input_ids, attention_mask, max_new_tokens,
 
 def generate(model, params, input_ids, attention_mask=None,
              max_new_tokens: int = 64, temperature: float = 0.0,
-             seed: int = 0) -> jax.Array:
+             top_k: int = 0, top_p: float = 0.0, seed: int = 0) -> jax.Array:
     """Generate output ids for a batch of source sequences.
 
     ``temperature=0`` → greedy; otherwise softmax sampling at that
-    temperature. Returns [batch, max_new_tokens] ids, padded with
-    ``pad_token_id`` after EOS.
+    temperature, optionally truncated to the ``top_k`` most likely
+    tokens and/or the ``top_p`` probability nucleus (0 disables each).
+    Returns [batch, max_new_tokens] ids, padded with ``pad_token_id``
+    after EOS.
     """
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if attention_mask is None:
@@ -94,7 +121,8 @@ def generate(model, params, input_ids, attention_mask=None,
     attention_mask = jnp.asarray(attention_mask, jnp.int32)
     return _generate_jit(model, params, input_ids, attention_mask,
                          int(max_new_tokens), float(temperature),
-                         jax.random.PRNGKey(seed))
+                         jax.random.PRNGKey(seed), top_k=int(top_k),
+                         top_p=float(top_p))
 
 
 _NEG = jnp.float32(-1e9)
@@ -108,15 +136,17 @@ def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
     HF-equivalent (``BeamSearchScorer`` semantics, the flax/t5x shape):
 
     per step one decoder call over [batch*beams], then the top ``2K`` of
-    the ``K × vocab`` candidate grid. EOS candidates are banked into a
-    K-slot finished pool with their length penalty applied at add time
-    (hypothesis length = generated tokens before EOS + 1 for the start
-    token, exactly HF's ``sum_logprobs / len(hyp)**penalty``); the best
-    K non-EOS candidates continue as live beams (KV cache re-gathered by
-    parent). A row stops banking once HF's ``is_done`` criterion holds
-    (worst pooled score >= best attainable at the current length). At
-    the end, rows not done bank their live beams at length
-    ``max_new_tokens + 1``; the best pooled hypothesis wins.
+    the ``K × vocab`` candidate grid. EOS candidates ranked within the
+    top K are banked into a K-slot finished pool with their length
+    penalty applied at add time (generated length = tokens before EOS +
+    the start token, HF's ``process``); lower-ranked EOS candidates are
+    dropped, exactly as HF's ``is_beam_token_worse_than_top_num_beams``.
+    The best K non-EOS candidates continue as live beams (KV cache
+    re-gathered by parent). A row stops banking once HF's ``is_done``
+    criterion holds (worst pooled score >= best attainable at the
+    current length). At the end, rows not done bank their live beams at
+    generated length ``max_new_tokens`` (decoder start excluded, HF's
+    ``finalize``); the best pooled hypothesis wins.
     """
     cfg = model.config
     B = input_ids.shape[0]
@@ -171,9 +201,13 @@ def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
         seq2k = lax.dynamic_update_index_in_dim(seq2k, tok2k, t, axis=2)
 
         # bank EOS candidates (HF hypothesis length: t generated tokens
-        # before EOS + decoder_start = t + 1); done rows bank nothing
+        # before EOS + decoder_start = t + 1); done rows bank nothing,
+        # and HF only banks EOS candidates ranked within the top K of
+        # the sorted 2K list (BeamSearchScorer.process:
+        # is_beam_token_worse_than_top_num_beams drops the rest)
         cur_len = (t + 1).astype(jnp.float32)
-        eos_norm = jnp.where(is_eos & ~done[:, None],
+        rank_ok = jnp.arange(2 * K)[None, :] < K
+        eos_norm = jnp.where(is_eos & rank_ok & ~done[:, None],
                              top2k / cur_len ** length_penalty, _NEG)
         fin_scores, fin_tok = pool_merge(fin_scores, fin_tok, eos_norm,
                                          seq2k)
@@ -201,10 +235,10 @@ def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
     (_, _, live_scores, live_tok, fin_scores, fin_tok, done), _ = lax.scan(
         step, carry, jnp.arange(T))
 
-    # rows not done bank their live beams (HF finalize: hypothesis length
-    # = decoder_start + all max_new_tokens generated = T + 1)
+    # rows not done bank their live beams (HF finalize: generated_len =
+    # final_tokens minus the decoder prompt = T, decoder_start excluded)
     live_norm = jnp.where(done[:, None], _NEG,
-                          live_scores / jnp.float32(T + 1) ** length_penalty)
+                          live_scores / jnp.float32(T) ** length_penalty)
     fin_scores, fin_tok = pool_merge(fin_scores, fin_tok, live_norm, live_tok)
 
     best = jnp.argmax(fin_scores, axis=1)                      # [B]

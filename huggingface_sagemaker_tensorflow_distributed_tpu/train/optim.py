@@ -28,12 +28,16 @@ def build_optimizer(
 ) -> tuple[optax.GradientTransformation, float]:
     """Returns (optax transformation, scaled base lr)."""
     lr = config.learning_rate * (world_size if config.scale_lr_by_world_size else 1.0)
+    accum = config.gradient_accumulation_steps
 
     if config.warmup_ratio > 0 and total_steps:
-        warmup = max(1, int(total_steps * config.warmup_ratio))
+        # the schedule advances once per optimizer UPDATE, of which there
+        # are total_steps // accum (micro-steps in between don't count)
+        updates = max(1, total_steps // accum)
+        warmup = max(1, int(updates * config.warmup_ratio))
         schedule = optax.schedules.warmup_linear_decay_schedule(
             init_value=0.0, peak_value=lr, warmup_steps=warmup,
-            decay_steps=total_steps, end_value=0.0)
+            decay_steps=updates, end_value=0.0)
     else:
         schedule = lr  # constant — reference behavior (train.py:113)
 
@@ -46,4 +50,9 @@ def build_optimizer(
     if config.max_grad_norm > 0:
         parts.append(optax.clip_by_global_norm(config.max_grad_norm))
     parts.append(core)
-    return optax.chain(*parts), lr
+    tx = optax.chain(*parts)
+    if accum > 1:
+        # mean-of-micro-grads every `accum` steps: same update as one
+        # step at accum× the batch (tests/test_trainer.py asserts this)
+        tx = optax.MultiSteps(tx, every_k_schedule=accum)
+    return tx, lr

@@ -201,7 +201,7 @@ def test_t5_greedy_generate_matches_hf(hf_t5_dir):
         np.testing.assert_array_equal(ours[b, :n], hf_seq[:n])
 
 
-def test_beam_search_beam1_matches_greedy():
+def test_beam1_score_dominates_greedy():
     model, params = _tiny_model(seed=3)
     src, mask, _ = _batch(TINY, seed=3)
     greedy = np.asarray(gen.generate(model, params, src, mask, max_new_tokens=6))
@@ -272,3 +272,34 @@ def test_t5_beam_search_matches_hf(hf_t5_dir, num_beams, length_penalty, seed):
         hf_seq = theirs[b][1:]  # drop decoder_start
         n = min(len(hf_seq), ours.shape[1])
         np.testing.assert_array_equal(ours[b][:n], hf_seq[:n])
+
+
+def test_sampling_filters():
+    """top_k / top_p logit filters: exact mask semantics on a known
+    distribution (HF TopK/TopPLogitsWarper parity)."""
+    import jax.numpy as jnp
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        _filter_top_k,
+        _filter_top_p,
+    )
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    k2 = np.asarray(_filter_top_k(logits, 2))
+    assert np.isfinite(k2[0, :2]).all() and np.isinf(k2[0, 2:]).all()
+    # top_p=0.8: 0.5 (cum-before 0) + 0.3 (cum-before 0.5) kept, 0.15
+    # (cum-before 0.8, not < 0.8) dropped
+    p8 = np.asarray(_filter_top_p(logits, 0.8))
+    assert np.isfinite(p8[0, :2]).all() and np.isinf(p8[0, 2:]).all()
+    # top_p=0.81 keeps the third token (cum-before 0.8 < 0.81)
+    p81 = np.asarray(_filter_top_p(logits, 0.81))
+    assert np.isfinite(p81[0, :3]).all() and np.isinf(p81[0, 3:]).all()
+
+
+def test_sampled_generation_respects_top_k():
+    """With top_k=1, sampling at any temperature degenerates to greedy."""
+    model, params = _tiny_model(seed=6)
+    src, mask, _ = _batch(TINY, seed=6)
+    greedy = np.asarray(gen.generate(model, params, src, mask, max_new_tokens=5))
+    topk1 = np.asarray(gen.generate(model, params, src, mask, max_new_tokens=5,
+                                    temperature=1.7, top_k=1, seed=9))
+    np.testing.assert_array_equal(topk1, greedy)

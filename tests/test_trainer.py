@@ -188,3 +188,36 @@ def test_bf16_training_quality_matches_fp32(tmp_path):
                - hist32["sparse_categorical_accuracy"][-1]) < 0.02
     assert abs(ev16["eval_accuracy"] - ev32["eval_accuracy"]) < 0.03
     assert abs(ev16["eval_loss"] - ev32["eval_loss"]) < 0.1
+
+
+def test_gradient_accumulation_matches_big_batch():
+    """accum=2 at global batch 8 must produce the same parameters as one
+    step at global batch 16 (MultiSteps averages micro-grads; fp32)."""
+    data = _data(n=64, seed=7)
+    final = {}
+
+    def dropout_free_model(seed=0):
+        cfg = EncoderConfig(vocab_size=512, hidden_size=32, num_layers=2,
+                            num_heads=2, intermediate_size=64,
+                            max_position_embeddings=SEQ,
+                            hidden_dropout=0.0, attention_dropout=0.0)
+        model = BertForSequenceClassification(cfg, num_labels=2)
+        return model, init_params(model, cfg, seed=seed)
+
+    for accum, gb in ((1, 16), (2, 8)):
+        mesh = build_mesh(MeshConfig())
+        cfg = TrainConfig(epochs=1, dtype="float32", learning_rate=1e-3,
+                          scale_lr_by_world_size=False, log_every_steps=0,
+                          gradient_accumulation_steps=accum)
+        model, params = dropout_free_model(seed=0)
+        trainer = Trainer(cfg, model, params, mesh)
+        batcher = ShardedBatcher(data, gb, mesh, shuffle=False, seed=0)
+        for batch in batcher.global_arrays(0):
+            trainer.state, _ = trainer._train_step(trainer.state, batch)
+        final[accum] = jax.device_get(trainer.state.params)
+    a = jax.tree.leaves(final[1])
+    b = jax.tree.leaves(final[2])
+    for x, y in zip(a, b):
+        # fp32 mean-of-means vs one mean: reduction-order noise only
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                                   atol=1e-5, rtol=1e-4)
