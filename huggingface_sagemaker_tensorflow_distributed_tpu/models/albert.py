@@ -1,0 +1,132 @@
+"""ALBERT models + task heads.
+
+Model-zoo breadth (SURVEY.md D7; the reference reaches any HF encoder
+through ``TFAutoModelForSequenceClassification``, reference
+``scripts/train.py:117``). ALBERT = a BERT-shaped post-LN encoder with
+two twists, both natural here:
+
+- factorized embeddings: embed at ``embedding_size`` then project to
+  ``hidden_size`` (``embedding_hidden_mapping_in`` — ALBERT puts the
+  projection in the encoder, unlike ELECTRA's backbone projection);
+- cross-layer parameter sharing: ONE ``EncoderLayer`` module instance
+  applied ``num_layers`` times — in Flax, repeated calls to the same
+  bound submodule share parameters, so sharing costs one line (the HF
+  torch version needs layer-group machinery for the same thing).
+
+Only the common deployment shape is supported: ``num_hidden_groups=1``,
+``inner_group_num=1`` (every public ALBERT v1/v2 checkpoint).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    EncoderConfig,
+    EncoderLayer,
+    Embeddings,
+    Pooler,
+    _dense,
+    head_dropout_rate,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+    make_attention_mask,
+)
+
+
+def albert_config_from_hf(hf_config: dict, **overrides) -> EncoderConfig:
+    if hf_config.get("num_hidden_groups", 1) != 1 or \
+            hf_config.get("inner_group_num", 1) != 1:
+        raise ValueError(
+            "ALBERT with num_hidden_groups/inner_group_num != 1 is not "
+            "supported (no public checkpoint uses it)")
+    kw = dict(
+        vocab_size=hf_config["vocab_size"],
+        hidden_size=hf_config["hidden_size"],
+        embedding_size=hf_config.get("embedding_size", 128),
+        num_layers=hf_config["num_hidden_layers"],
+        num_heads=hf_config["num_attention_heads"],
+        intermediate_size=hf_config["intermediate_size"],
+        max_position_embeddings=hf_config["max_position_embeddings"],
+        type_vocab_size=hf_config.get("type_vocab_size", 2),
+        hidden_act=hf_config.get("hidden_act", "gelu_new"),
+        layer_norm_eps=hf_config.get("layer_norm_eps", 1e-12),
+        hidden_dropout=hf_config.get("hidden_dropout_prob", 0.0),
+        classifier_dropout=hf_config.get("classifier_dropout_prob", 0.1),
+        attention_dropout=hf_config.get("attention_probs_dropout_prob", 0.0),
+        pad_token_id=hf_config.get("pad_token_id", 0),
+        initializer_range=hf_config.get("initializer_range", 0.02),
+    )
+    kw.update(overrides)
+    return EncoderConfig(**kw)
+
+
+class AlbertBackbone(nn.Module):
+    """Embeddings → hidden projection → one shared layer × num_layers
+    (+ pooler)."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic: bool = True):
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        additive_mask = make_attention_mask(attention_mask)
+        x = Embeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, position_ids, attention_mask,
+            deterministic)
+        x = _dense(cfg, cfg.hidden_size, "embedding_hidden_mapping_in")(x)
+        shared = EncoderLayer(cfg, name="shared_layer")
+        for _ in range(cfg.num_layers):
+            x = shared(x, additive_mask, deterministic)
+        pooled = Pooler(cfg, name="pooler")(x) if cfg.use_pooler else None
+        return x, pooled
+
+
+class AlbertForSequenceClassification(nn.Module):
+    """pooled → dropout → classifier (HF head parity)."""
+
+    config: EncoderConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        _, pooled = AlbertBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+        x = nn.Dropout(head_dropout_rate(self.config))(
+            pooled, deterministic=deterministic)
+        return _dense(self.config, self.num_labels, "classifier")(x)
+
+
+class AlbertForTokenClassification(nn.Module):
+    config: EncoderConfig
+    num_labels: int = 9
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = AlbertBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+        x = nn.Dropout(head_dropout_rate(self.config))(
+            seq, deterministic=deterministic)
+        return _dense(self.config, self.num_labels, "classifier")(x)
+
+
+class AlbertForQuestionAnswering(nn.Module):
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = AlbertBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids,
+            deterministic=deterministic)
+        logits = _dense(self.config, 2, "qa_outputs")(seq)
+        start, end = jnp.split(logits, 2, axis=-1)
+        return start[..., 0], end[..., 0]

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.models import (
+    albert,
     bert,
     distilbert,
     electra,
@@ -60,6 +61,9 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("electra", "seq-cls"): electra.ElectraForSequenceClassification,
     ("electra", "token-cls"): electra.ElectraForTokenClassification,
     ("electra", "qa"): electra.ElectraForQuestionAnswering,
+    ("albert", "seq-cls"): albert.AlbertForSequenceClassification,
+    ("albert", "token-cls"): albert.AlbertForTokenClassification,
+    ("albert", "qa"): albert.AlbertForQuestionAnswering,
     ("t5", "seq2seq"): t5.T5ForConditionalGeneration,
 }
 
@@ -68,6 +72,7 @@ CONFIG_BUILDERS = {
     "roberta": roberta.roberta_config_from_hf,
     "distilbert": distilbert.distilbert_config_from_hf,
     "electra": electra.electra_config_from_hf,
+    "albert": albert.albert_config_from_hf,
     "t5": t5.t5_config_from_hf,
 }
 
@@ -105,6 +110,23 @@ _HF_CONFIG_EXPORTERS = {
         "max_position_embeddings": c.max_position_embeddings,
         "activation": c.hidden_act, "dropout": c.hidden_dropout,
         "attention_dropout": c.attention_dropout,
+        "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
+    },
+    "albert": lambda c: {
+        "model_type": "albert", "architectures": ["AlbertForSequenceClassification"],
+        "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
+        "embedding_size": c.embedding_size or c.hidden_size,
+        "num_hidden_layers": c.num_layers, "num_attention_heads": c.num_heads,
+        "num_hidden_groups": 1, "inner_group_num": 1,
+        "classifier_dropout_prob": (
+            c.classifier_dropout if c.classifier_dropout is not None
+            else c.hidden_dropout),
+        "intermediate_size": c.intermediate_size,
+        "max_position_embeddings": c.max_position_embeddings,
+        "type_vocab_size": c.type_vocab_size, "hidden_act": c.hidden_act,
+        "layer_norm_eps": c.layer_norm_eps,
+        "hidden_dropout_prob": c.hidden_dropout,
+        "attention_probs_dropout_prob": c.attention_dropout,
         "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
     },
     "electra": lambda c: {
@@ -190,9 +212,9 @@ def from_pretrained(
         raise ValueError(
             f"{model_name_or_path!r} is a T5 (encoder-decoder) checkpoint; "
             f"it only supports task='seq2seq', got task={task!r}")
-    if family == "bert" and task != "seq-cls":
-        # HF Bert QA/token-cls models are built with add_pooling_layer=False;
-        # only the seq-cls head consumes the pooler.
+    if family in ("bert", "albert") and task != "seq-cls":
+        # HF Bert/Albert QA/token-cls models are built with
+        # add_pooling_layer=False; only the seq-cls head uses the pooler.
         config_overrides.setdefault("use_pooler", False)
     config = CONFIG_BUILDERS[family](
         hf_config, dtype=dtype, param_dtype=param_dtype, **config_overrides)

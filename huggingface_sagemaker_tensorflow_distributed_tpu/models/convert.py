@@ -139,11 +139,31 @@ _ELECTRA_RULES = [
     (r"^classifier$", r"classifier"),  # token-cls head (no sub-keys)
 ]
 
+_ALBERT_RULES = [
+    (r"^(?:albert\.)?embeddings\.word_embeddings$", r"backbone/embeddings/word_embeddings"),
+    (r"^(?:albert\.)?embeddings\.position_embeddings$", r"backbone/embeddings/position_embeddings"),
+    (r"^(?:albert\.)?embeddings\.token_type_embeddings$", r"backbone/embeddings/token_type_embeddings"),
+    (r"^(?:albert\.)?embeddings\.LayerNorm$", r"backbone/embeddings/embeddings_ln"),
+    (r"^(?:albert\.)?encoder\.embedding_hidden_mapping_in$", r"backbone/embedding_hidden_mapping_in"),
+    (r"^(?:albert\.)?encoder\.albert_layer_groups\.0\.albert_layers\.0\.attention\.query$", r"backbone/shared_layer/attention/query"),
+    (r"^(?:albert\.)?encoder\.albert_layer_groups\.0\.albert_layers\.0\.attention\.key$", r"backbone/shared_layer/attention/key"),
+    (r"^(?:albert\.)?encoder\.albert_layer_groups\.0\.albert_layers\.0\.attention\.value$", r"backbone/shared_layer/attention/value"),
+    (r"^(?:albert\.)?encoder\.albert_layer_groups\.0\.albert_layers\.0\.attention\.dense$", r"backbone/shared_layer/attention/attention_out"),
+    (r"^(?:albert\.)?encoder\.albert_layer_groups\.0\.albert_layers\.0\.attention\.LayerNorm$", r"backbone/shared_layer/attention_ln"),
+    (r"^(?:albert\.)?encoder\.albert_layer_groups\.0\.albert_layers\.0\.ffn$", r"backbone/shared_layer/ffn/intermediate"),
+    (r"^(?:albert\.)?encoder\.albert_layer_groups\.0\.albert_layers\.0\.ffn_output$", r"backbone/shared_layer/ffn/ffn_out"),
+    (r"^(?:albert\.)?encoder\.albert_layer_groups\.0\.albert_layers\.0\.full_layer_layer_norm$", r"backbone/shared_layer/ffn_ln"),
+    (r"^(?:albert\.)?pooler$", r"backbone/pooler/pooler"),
+    (r"^qa_outputs$", r"qa_outputs"),
+    (r"^classifier$", r"classifier"),
+]
+
 RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_RULES,
     "roberta": _ROBERTA_RULES,
     "distilbert": _DISTILBERT_RULES,
     "electra": _ELECTRA_RULES,
+    "albert": _ALBERT_RULES,
     "t5": _T5_RULES,
 }
 
@@ -343,11 +363,31 @@ _ELECTRA_REVERSE = [
     (r"^classifier$", "classifier"),
 ]
 
+_ALBERT_REVERSE = [
+    (r"^backbone/embeddings/word_embeddings$", "albert.embeddings.word_embeddings"),
+    (r"^backbone/embeddings/position_embeddings$", "albert.embeddings.position_embeddings"),
+    (r"^backbone/embeddings/token_type_embeddings$", "albert.embeddings.token_type_embeddings"),
+    (r"^backbone/embeddings/embeddings_ln$", "albert.embeddings.LayerNorm"),
+    (r"^backbone/embedding_hidden_mapping_in$", "albert.encoder.embedding_hidden_mapping_in"),
+    (r"^backbone/shared_layer/attention/query$", "albert.encoder.albert_layer_groups.0.albert_layers.0.attention.query"),
+    (r"^backbone/shared_layer/attention/key$", "albert.encoder.albert_layer_groups.0.albert_layers.0.attention.key"),
+    (r"^backbone/shared_layer/attention/value$", "albert.encoder.albert_layer_groups.0.albert_layers.0.attention.value"),
+    (r"^backbone/shared_layer/attention/attention_out$", "albert.encoder.albert_layer_groups.0.albert_layers.0.attention.dense"),
+    (r"^backbone/shared_layer/attention_ln$", "albert.encoder.albert_layer_groups.0.albert_layers.0.attention.LayerNorm"),
+    (r"^backbone/shared_layer/ffn/intermediate$", "albert.encoder.albert_layer_groups.0.albert_layers.0.ffn"),
+    (r"^backbone/shared_layer/ffn/ffn_out$", "albert.encoder.albert_layer_groups.0.albert_layers.0.ffn_output"),
+    (r"^backbone/shared_layer/ffn_ln$", "albert.encoder.albert_layer_groups.0.albert_layers.0.full_layer_layer_norm"),
+    (r"^backbone/pooler/pooler$", "albert.pooler"),
+    (r"^qa_outputs$", "qa_outputs"),
+    (r"^classifier$", "classifier"),
+]
+
 REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_REVERSE,
     "roberta": _ROBERTA_REVERSE,
     "distilbert": _DISTILBERT_REVERSE,
     "electra": _ELECTRA_REVERSE,
+    "albert": _ALBERT_REVERSE,
     "t5": _T5_REVERSE,
 }
 

@@ -55,6 +55,10 @@ class EncoderConfig:
     layer_norm_eps: float = 1e-12
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1
+    # task-head dropout; None = hidden_dropout (BERT/ELECTRA semantics).
+    # ALBERT's HF default genuinely differs (classifier_dropout_prob=0.1
+    # with hidden_dropout_prob=0.0), so it needs its own knob.
+    classifier_dropout: Optional[float] = None
     pad_token_id: int = 0
     position_offset: int = 0      # RoBERTa: pad_token_id + 1
     use_token_type: bool = True   # DistilBERT: False
@@ -79,6 +83,13 @@ def _dense(cfg: EncoderConfig, features: int, name: str) -> nn.Dense:
         kernel_init=nn.initializers.normal(cfg.initializer_range),
         name=name,
     )
+
+
+def head_dropout_rate(cfg: EncoderConfig) -> float:
+    """Dropout rate for task heads (classifier_dropout falling back to
+    hidden_dropout, HF semantics)."""
+    return (cfg.classifier_dropout if cfg.classifier_dropout is not None
+            else cfg.hidden_dropout)
 
 
 def _layernorm(cfg: EncoderConfig, name: str) -> nn.LayerNorm:

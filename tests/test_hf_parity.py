@@ -188,3 +188,77 @@ def test_electra_export_roundtrip_loads_in_hf(electra_dir, tmp_path):
         b = reloaded(input_ids=torch.tensor(ids),
                      attention_mask=torch.tensor(mask)).logits
     np.testing.assert_allclose(b.numpy(), a.numpy(), atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def albert_dir(tmp_path_factory):
+    torch.manual_seed(9)
+    # embedding_size != hidden_size + cross-layer sharing: one shared
+    # flax EncoderLayer must reproduce HF's layer-group stack
+    cfg = transformers.AlbertConfig(
+        vocab_size=128, embedding_size=16, hidden_size=32,
+        num_hidden_layers=3, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, classifier_dropout_prob=0.0)
+    d = str(tmp_path_factory.mktemp("albert"))
+    m = transformers.AlbertForSequenceClassification(cfg).eval()
+    m.save_pretrained(d)
+    return d, m, cfg
+
+
+def test_albert_seq_cls_parity(albert_dir):
+    d, m, _ = albert_dir
+    ids, mask = _inputs(128, seed=9)
+    _compare(m, d, "seq-cls", ids, mask)
+
+
+def test_albert_qa_parity(albert_dir, tmp_path):
+    _, _, cfg = albert_dir
+    torch.manual_seed(10)
+    m = transformers.AlbertForQuestionAnswering(cfg).eval()
+    m.save_pretrained(str(tmp_path))
+    ids, mask = _inputs(128, seed=10)
+    _compare(m, str(tmp_path), "qa", ids, mask)
+
+
+def test_albert_token_cls_parity(albert_dir, tmp_path):
+    _, _, cfg = albert_dir
+    torch.manual_seed(11)
+    m = transformers.AlbertForTokenClassification(cfg).eval()
+    m.save_pretrained(str(tmp_path))
+    ids, mask = _inputs(128, seed=11)
+    _compare(m, str(tmp_path), "token-cls", ids, mask)
+
+
+def test_albert_export_roundtrip_loads_in_hf(albert_dir, tmp_path):
+    d, m, _ = albert_dir
+    model, params, family, cfg = auto_models.from_pretrained(
+        d, task="seq-cls", num_labels=2)
+    out = str(tmp_path / "export")
+    auto_models.save_pretrained(out, params, family, cfg)
+    reloaded = transformers.AlbertForSequenceClassification.from_pretrained(out).eval()
+    ids, mask = _inputs(128, seed=12)
+    with torch.no_grad():
+        a = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)).logits
+        b = reloaded(input_ids=torch.tensor(ids),
+                     attention_mask=torch.tensor(mask)).logits
+    np.testing.assert_allclose(b.numpy(), a.numpy(), atol=1e-5)
+
+
+def test_albert_head_dropout_follows_classifier_dropout_prob():
+    # albert-base-v2 shape: hidden_dropout 0 but classifier_dropout 0.1 —
+    # the head must regularize where HF does (inference parity can't see
+    # this; assert the config mapping directly)
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.albert import (
+        albert_config_from_hf,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+        head_dropout_rate,
+    )
+    cfg = albert_config_from_hf({
+        "vocab_size": 128, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 64, "hidden_dropout_prob": 0.0,
+        "classifier_dropout_prob": 0.1})
+    assert cfg.hidden_dropout == 0.0
+    assert head_dropout_rate(cfg) == 0.1
