@@ -102,8 +102,17 @@ class TrainConfig:
     # --- parallelism mesh (reference supports DP only; see SURVEY.md §2) ---
     dp: int = -1                   # -1: use all remaining devices on the data axis
     fsdp: int = 1
+    ep: int = 1                    # expert parallel (MoE expert sharding)
     tp: int = 1
     sp: int = 1                    # sequence/context parallel (ring attention)
+
+    # --- Mixture-of-Experts (models/moe.py; beyond-parity — the
+    #     reference has no MoE). 0 = dense FFN everywhere. MoE weights
+    #     are always fresh-initialized (HF BERT-family checkpoints have
+    #     no experts); use with --from_scratch or for upcycling. ---
+    num_experts: int = 0
+    expert_top_k: int = 2
+    moe_every: int = 2
 
     # --- kernels / memory ---
     # auto: flash (Pallas) on TPU, xla elsewhere, ring when sp > 1.
@@ -174,7 +183,9 @@ class TrainConfig:
             raise ValueError(f"unknown task {self.task!r}")
         if self.dtype not in ("bfloat16", "float32", "float16"):
             raise ValueError(f"unknown dtype {self.dtype!r}")
-        if self.rng_impl not in ("rbg", "threefry"):
+        if self.rng_impl == "threefry":   # JAX's registry name for it
+            self.rng_impl = "threefry2x32"
+        if self.rng_impl not in ("rbg", "threefry2x32"):
             raise ValueError(f"unknown rng_impl {self.rng_impl!r}")
         if self.epochs < 0 or self.train_batch_size <= 0 or self.eval_batch_size <= 0:
             raise ValueError("epochs must be >= 0 and batch sizes positive")
@@ -182,9 +193,18 @@ class TrainConfig:
             raise ValueError("gradient_accumulation_steps must be >= 1")
         if self.learning_rate <= 0:
             raise ValueError("learning_rate must be positive")
-        for ax in ("fsdp", "tp", "sp"):
+        for ax in ("fsdp", "ep", "tp", "sp"):
             if getattr(self, ax) <= 0:
                 raise ValueError(f"mesh axis {ax} must be positive")
+        if self.num_experts < 0 or self.expert_top_k < 1 or self.moe_every < 1:
+            raise ValueError("num_experts >= 0, expert_top_k >= 1, moe_every >= 1")
+        if self.ep > 1 and self.num_experts == 0:
+            raise ValueError("ep > 1 requires num_experts > 0 (MoE model)")
+        if self.num_experts and self.num_experts % self.ep:
+            raise ValueError(
+                f"num_experts={self.num_experts} must divide over ep={self.ep}")
+        if self.num_experts and self.expert_top_k > self.num_experts:
+            raise ValueError("expert_top_k cannot exceed num_experts")
         if self.bucket_multiple < 0:
             raise ValueError("bucket_multiple must be >= 0")
         if self.bucket_multiple and self.sp > 1 and self.bucket_multiple % self.sp:

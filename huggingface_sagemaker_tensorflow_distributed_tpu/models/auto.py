@@ -160,6 +160,13 @@ _HF_CONFIG_EXPORTERS = {
 }
 
 
+# families whose Encoder stack supports per-layer MoE FFNs
+_MOE_FAMILIES = ("bert", "roberta", "distilbert", "electra")
+
+_MOE_CONFIG_KEYS = ("num_experts", "expert_top_k", "moe_every",
+                    "expert_capacity_factor", "router_aux_coef")
+
+
 def detect_family(hf_config: dict) -> str:
     mt = hf_config.get("model_type", "")
     if mt in CONFIG_BUILDERS:
@@ -206,6 +213,14 @@ def from_pretrained(
             "`save_pretrained` or an HF download.")
     hf_config = load_hf_config(model_name_or_path)
     family = detect_family(hf_config)
+    wants_moe = (config_overrides.get("num_experts", 0)
+                 or hf_config.get("num_experts", 0))
+    if wants_moe and family not in _MOE_FAMILIES:
+        # T5 has its own config class (no MoE fields) and ALBERT shares
+        # ONE layer across the stack (per-layer expert banks can't exist)
+        raise ValueError(
+            f"MoE (num_experts={wants_moe}) is not supported for "
+            f"family {family!r}; supported: {sorted(_MOE_FAMILIES)}")
     if family == "t5" and task != "seq2seq":
         # failing loudly here beats a TypeError deep inside jit tracing
         # when the seq-cls loss feeds an encoder-decoder model
@@ -216,6 +231,12 @@ def from_pretrained(
         # HF Bert/Albert QA/token-cls models are built with
         # add_pooling_layer=False; only the seq-cls head uses the pooler.
         config_overrides.setdefault("use_pooler", False)
+    if family in _MOE_FAMILIES:
+        # a config.json we exported for an MoE model carries the MoE
+        # fields — honour them so the expert bank is rebuilt on reload
+        for key in _MOE_CONFIG_KEYS:
+            if key in hf_config:
+                config_overrides.setdefault(key, hf_config[key])
     config = CONFIG_BUILDERS[family](
         hf_config, dtype=dtype, param_dtype=param_dtype, **config_overrides)
     model = build_model(family, task, config, num_labels)
@@ -228,9 +249,49 @@ def from_pretrained(
         params, missing = merge_into(params, loaded)
         logger.info("loaded %s (%s) — %d fresh head params", model_name_or_path,
                     family, len(missing))
+        moe_path = os.path.join(model_name_or_path, "moe.safetensors")
+        if os.path.exists(moe_path):
+            # sidecar written by save_pretrained for MoE models: expert/
+            # router weights under their native param paths
+            from safetensors.numpy import load_file
+            params = _overlay_flat(params, load_file(moe_path))
+            logger.info("loaded MoE expert weights from %s", moe_path)
     else:
         logger.info("initialized %s (%s) from scratch", model_name_or_path, family)
     return model, params, family, config
+
+
+def _flatten_params(params: Any) -> dict[str, np.ndarray]:
+    flat: dict[str, np.ndarray] = {}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, path + (k,))
+        else:
+            flat["/".join(path)] = np.asarray(node)
+
+    walk(params, ())
+    return flat
+
+
+def _overlay_flat(params: Any, flat: dict[str, np.ndarray]) -> Any:
+    """Overlay a {native-path: array} dict onto a param tree."""
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        key = "/".join(path)
+        if key in flat:
+            src = flat[key]
+            if tuple(np.shape(src)) != tuple(np.shape(node)):
+                raise ValueError(
+                    f"shape mismatch at {key}: sidecar {np.shape(src)} "
+                    f"vs model {np.shape(node)}")
+            return jnp.asarray(src, dtype=jnp.asarray(node).dtype)
+        return node
+
+    return walk(params, ())
 
 
 def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderConfig,
@@ -252,11 +313,23 @@ def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderCo
     if host0_only and jax.process_index() != 0:
         return
     os.makedirs(output_dir, exist_ok=True)
-    state = params_to_hf(jax.device_get(params), family)
+    params = jax.device_get(params)
+    state = params_to_hf(params, family)
     state = {k: np.ascontiguousarray(v) for k, v in state.items()}
     from safetensors.numpy import save_file
     save_file(state, os.path.join(output_dir, "model.safetensors"),
               metadata={"format": "pt"})
+    cfg_dict = _HF_CONFIG_EXPORTERS[family](config)
+    if getattr(config, "num_experts", 0):
+        # expert/router weights have no HF-layout counterpart: persist
+        # them in a sidecar under native paths, and record the MoE shape
+        # in config.json so from_pretrained rebuilds the expert bank
+        moe_state = {k: np.ascontiguousarray(v)
+                     for k, v in _flatten_params(params).items()
+                     if "/moe/" in k}
+        save_file(moe_state, os.path.join(output_dir, "moe.safetensors"))
+        for key in _MOE_CONFIG_KEYS:
+            cfg_dict[key] = getattr(config, key)
     with open(os.path.join(output_dir, "config.json"), "w") as f:
-        json.dump(_HF_CONFIG_EXPORTERS[family](config), f, indent=2)
+        json.dump(cfg_dict, f, indent=2)
     logger.info("exported HF-layout checkpoint to %s", output_dir)

@@ -257,7 +257,13 @@ def merge_into(template: Any, loaded: dict, strict_backbone: bool = True) -> tup
     merged = walk(template, loaded, ())
     if missing:
         _backbone_prefixes = ("backbone/", "encoder/", "decoder/", "shared/")
-        backbone_missing = [m for m in missing if m.startswith(_backbone_prefixes)]
+        # MoE params are legitimately fresh when upcycling a dense
+        # checkpoint (HF BERT-family checkpoints have no experts); the
+        # sidecar loader in auto.from_pretrained overlays them when a
+        # moe.safetensors exists.
+        backbone_missing = [m for m in missing
+                            if m.startswith(_backbone_prefixes)
+                            and "/moe/" not in m]
         if backbone_missing and strict_backbone:
             raise ValueError(f"backbone params missing from checkpoint: {backbone_missing[:8]}")
         logger.info("convert: freshly initialized head params: %s", missing)

@@ -68,6 +68,15 @@ class EncoderConfig:
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"   # xla | flash (pallas)
     remat: bool = False           # rematerialize encoder layers (trade FLOPs for HBM)
+    # Mixture-of-Experts (models/moe.py): 0 = dense FFN everywhere.
+    # When > 0, every ``moe_every``-th layer (the 2nd, 4th, ... — GShard
+    # placement) swaps its FFN for a token-routed expert bank sharded
+    # over the ``expert`` mesh axis.
+    num_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
+    moe_every: int = 2
+    router_aux_coef: float = 0.01
     # Rematerialize the attention core only: the fp32 [B,H,S,S] softmax
     # residuals XLA otherwise saves (and copies) for backward dominate HBM
     # traffic at seq 512 — recomputing them in backward is measurably
@@ -199,18 +208,34 @@ class FeedForward(nn.Module):
 
 
 class EncoderLayer(nn.Module):
-    """Post-LN transformer layer (BERT family ordering)."""
+    """Post-LN transformer layer (BERT family ordering). ``use_moe``
+    swaps the dense FFN for the expert-parallel MoE bank."""
 
     config: EncoderConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
         cfg = self.config
         attn = SelfAttention(cfg, name="attention")(hidden, attn_mask, deterministic)
         hidden = _layernorm(cfg, "attention_ln")(hidden + attn)
-        ffn = FeedForward(cfg, name="ffn")(hidden, deterministic)
+        if self.use_moe:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.moe import (
+                MoeFeedForward,
+            )
+
+            ffn = MoeFeedForward(cfg, name="moe")(hidden, deterministic)
+        else:
+            ffn = FeedForward(cfg, name="ffn")(hidden, deterministic)
         hidden = _layernorm(cfg, "ffn_ln")(hidden + ffn)
         return hidden
+
+
+def is_moe_layer(cfg: EncoderConfig, layer_index: int) -> bool:
+    """GShard placement: every ``moe_every``-th layer, starting with the
+    2nd (index 1 when moe_every=2)."""
+    return (cfg.num_experts > 0
+            and layer_index % cfg.moe_every == cfg.moe_every - 1)
 
 
 class Encoder(nn.Module):
@@ -225,7 +250,8 @@ class Encoder(nn.Module):
         if cfg.remat:
             layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
         for i in range(cfg.num_layers):
-            hidden = layer_cls(cfg, name=f"layer_{i}")(hidden, attn_mask, deterministic)
+            hidden = layer_cls(cfg, use_moe=is_moe_layer(cfg, i),
+                               name=f"layer_{i}")(hidden, attn_mask, deterministic)
         return hidden
 
 

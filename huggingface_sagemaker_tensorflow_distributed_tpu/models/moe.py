@@ -1,0 +1,153 @@
+"""Mixture-of-Experts feed-forward with expert parallelism.
+
+Beyond-parity capability (the reference has no MoE or expert
+parallelism, SURVEY.md §2 parallelism inventory): a GShard/Switch-style
+token-routed MoE FFN designed TPU-first —
+
+- **Dense dispatch/combine einsums**, no scatter/gather: routing is
+  expressed as one-hot dispatch tensors contracted on the MXU, the only
+  MoE formulation that maps onto XLA's static-shape compilation model.
+- **Expert parallelism via sharding annotations**: expert weights carry
+  ``PartitionSpec("expert", ...)`` (``parallel/sharding.py``) and the
+  dispatched activations are constrained expert-major, so XLA inserts
+  the token all-to-alls over the ``expert`` mesh axis — no hand-written
+  collectives, same ambient-distribution stance as the rest of the
+  framework.
+- **Static capacity**: each expert processes a fixed ``capacity`` slots
+  per group (batch row); over-capacity tokens fall through on the
+  residual path (standard GShard semantics, no dynamic shapes).
+
+The router computes in fp32 (softmax over expert logits is precision
+-sensitive); expert matmuls run in the model compute dtype (bf16 on
+TPU). The Switch load-balance auxiliary loss is sowed into the
+``losses`` collection; the Trainer adds every sowed value to the task
+loss (``train/trainer.py``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import ACT2FN, EncoderConfig
+
+
+def expert_capacity(cfg: EncoderConfig, seq_len: int) -> int:
+    """Static per-group expert capacity: ceil(k·S·factor / E), rounded up
+    to a multiple of 4 so the slot dim tiles onto the VPU lanes."""
+    raw = cfg.expert_capacity_factor * cfg.expert_top_k * seq_len / cfg.num_experts
+    return max(4, 4 * math.ceil(raw / 4))
+
+
+def _constrain(x, *spec):
+    """Pin an intermediate's sharding when an ambient mesh is present
+    (training under the Trainer); no-op in meshless traces (init,
+    single-device tools)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+        maybe_current_mesh,
+    )
+
+    mesh = maybe_current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+class MoeFeedForward(nn.Module):
+    """Drop-in replacement for ``FeedForward`` on MoE layers.
+
+    Input/output: [batch, seq, hidden]. Each batch row is a routing
+    group (tokens compete for expert slots within their own row — keeps
+    the dispatch tensor O(S·E·C) per row and routing independent of the
+    data sharding).
+    """
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, hidden, deterministic: bool = True):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+            AXIS_EXPERT,
+            AXIS_FSDP,
+            AXIS_TENSOR,
+            data_axis_names,
+        )
+
+        cfg = self.config
+        E, k = cfg.num_experts, cfg.expert_top_k
+        B, S, H = hidden.shape
+        F = cfg.intermediate_size
+        C = expert_capacity(cfg, S)
+        batch_axes = data_axis_names()
+
+        router = self.param(
+            "router", nn.initializers.normal(cfg.initializer_range), (H, E),
+            jnp.float32)
+        # fp32 router: logits/softmax precision decides routing stability
+        logits = jnp.einsum("bsh,he->bse", hidden.astype(jnp.float32), router)
+        probs = jax.nn.softmax(logits, axis=-1)                    # [B,S,E]
+
+        # --- top-k greedy assignment with per-expert capacity ----------
+        remaining = probs
+        counts = jnp.zeros((B, E), jnp.float32)    # slots used per expert
+        combine = jnp.zeros((B, S, E, C), jnp.float32)
+        gate_kept = jnp.zeros((B, S), jnp.float32)
+        gate_total = jnp.zeros((B, S), jnp.float32)
+        top1_mask = None
+        for _ in range(k):
+            idx = jnp.argmax(remaining, axis=-1)                   # [B,S]
+            mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)       # [B,S,E]
+            gate = jnp.sum(remaining * mask, axis=-1)              # [B,S]
+            remaining = remaining * (1.0 - mask)
+            if top1_mask is None:
+                top1_mask = mask
+            # slot index within the expert buffer: earlier tokens first
+            pos = jnp.cumsum(mask, axis=1) - 1.0 + counts[:, None, :]
+            counts = counts + jnp.sum(mask, axis=1)
+            slot = jnp.sum(pos * mask, axis=-1)                    # [B,S]
+            kept = (slot < C) & (gate > 0.0)
+            slot_oh = jax.nn.one_hot(jnp.where(kept, slot, 0).astype(jnp.int32),
+                                     C, dtype=jnp.float32)         # [B,S,C]
+            disp = (mask[..., None] * slot_oh[:, :, None, :]
+                    * kept[:, :, None, None].astype(jnp.float32))  # [B,S,E,C]
+            combine = combine + gate[:, :, None, None] * disp
+            gate_kept = gate_kept + gate * kept.astype(jnp.float32)
+            gate_total = gate_total + gate
+
+        # normalize kept gates over the selected top-k mass (Mixtral/HF
+        # convention); tokens with every choice dropped contribute 0 and
+        # ride the residual connection
+        denom = jnp.where(gate_total > 0.0, gate_total, 1.0)
+        combine = combine / denom[:, :, None, None]
+        dispatch = (combine > 0.0).astype(cfg.dtype)               # [B,S,E,C]
+
+        # --- Switch load-balance loss (top-1 fractions × mean probs) ---
+        frac = jnp.mean(top1_mask, axis=(0, 1))                    # [E]
+        mean_prob = jnp.mean(probs, axis=(0, 1))                   # [E]
+        aux = cfg.router_aux_coef * E * jnp.sum(frac * mean_prob)
+        self.sow("losses", "moe_aux", aux)
+
+        # --- dispatch → expert FFN → combine ---------------------------
+        x = hidden.astype(cfg.dtype)
+        # [E,B,C,H]: E sharded over ``expert``, B over the other data
+        # axes — the resharding from token-major is the all-to-all
+        expert_in = jnp.einsum("bsec,bsh->ebch", dispatch, x)
+        expert_in = _constrain(expert_in, AXIS_EXPERT, batch_axes[:2])
+
+        wi = self.param("wi", nn.initializers.normal(cfg.initializer_range),
+                        (E, H, F), cfg.param_dtype)
+        wo = self.param("wo", nn.initializers.normal(cfg.initializer_range),
+                        (E, F, H), cfg.param_dtype)
+        h = jnp.einsum("ebch,ehf->ebcf", expert_in, wi.astype(cfg.dtype))
+        h = ACT2FN[cfg.hidden_act](h)
+        out = jnp.einsum("ebcf,efh->ebch", h, wo.astype(cfg.dtype))
+        out = _constrain(out, AXIS_EXPERT, batch_axes[:2])
+
+        y = jnp.einsum("bsec,ebch->bsh", combine.astype(cfg.dtype), out)
+        y = nn.Dropout(cfg.hidden_dropout)(y, deterministic=deterministic)
+        return y

@@ -80,11 +80,12 @@ def make_replica_divergence_fn(mesh, shardings):
     per call of the returned fn: one elementwise pass over the local
     params + one tiny cross-device comparison; only a scalar leaves the
     device."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
         AXIS_DATA,
+        AXIS_EXPERT,
         AXIS_SEQ,
     )
 
@@ -92,20 +93,43 @@ def make_replica_divergence_fn(mesh, shardings):
     in_specs = jax.tree.map(lambda s: s.spec, shardings,
                             is_leaf=lambda x: isinstance(x, NamedSharding))
 
+    def _mentions_expert(spec) -> bool:
+        for entry in spec:
+            entry = entry if isinstance(entry, tuple) else (entry,)
+            if AXIS_EXPERT in entry:
+                return True
+        return False
+
+    # Expert-sharded leaves (MoE weights) legitimately differ along the
+    # ``expert`` axis, so they get their own checksum grid checked over
+    # data/seq only; everything else is replicated along expert too and
+    # is checked along all three.
     def local_checksum(p):
-        return param_fingerprint(p).reshape((1,) * len(axes))
+        plain, expert = [], []
+        for leaf, spec in zip(jax.tree.leaves(p),
+                              jax.tree.leaves(in_specs,
+                                              is_leaf=lambda s: isinstance(s, P))):
+            (expert if _mentions_expert(spec) else plain).append(leaf)
+        shape = (1,) * len(axes)
+        return (param_fingerprint(plain).reshape(shape),
+                param_fingerprint(expert).reshape(shape))
 
     @jax.jit
     def compute(p):
-        grid = shard_map(local_checksum, mesh=mesh, in_specs=(in_specs,),
-                         out_specs=P(*axes))(p)
+        plain_grid, expert_grid = shard_map(
+            local_checksum, mesh=mesh,
+            in_specs=(in_specs,), out_specs=(P(*axes), P(*axes)))(p)
         dev = jnp.zeros((), jnp.float32)
-        for ax in (AXIS_DATA, AXIS_SEQ):
-            if ax in axes and mesh.shape[ax] > 1:
-                i = axes.index(ax)
-                mean = jnp.mean(grid, axis=i, keepdims=True)
-                dev = jnp.maximum(dev, jnp.max(jnp.abs(grid - mean)))
-        scale = jnp.maximum(jnp.max(jnp.abs(grid)), 1e-30)
+        for grid, check_axes in ((plain_grid, (AXIS_DATA, AXIS_SEQ, AXIS_EXPERT)),
+                                 (expert_grid, (AXIS_DATA, AXIS_SEQ))):
+            for ax in check_axes:
+                if ax in axes and mesh.shape[ax] > 1:
+                    i = axes.index(ax)
+                    mean = jnp.mean(grid, axis=i, keepdims=True)
+                    dev = jnp.maximum(dev, jnp.max(jnp.abs(grid - mean)))
+        scale = jnp.maximum(
+            jnp.maximum(jnp.max(jnp.abs(plain_grid)), jnp.max(jnp.abs(expert_grid))),
+            1e-30)
         return dev / scale
 
     return compute

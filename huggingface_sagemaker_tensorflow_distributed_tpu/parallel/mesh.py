@@ -15,6 +15,10 @@ Axes:
   ``hvd.size()`` at ``scripts/train.py:112``).
 - ``fsdp``: data parallelism with parameter/optimizer sharding (ZeRO-3
   style; absent in the reference, SURVEY.md §2).
+- ``expert``: expert parallelism for MoE layers (``models/moe.py``):
+  the expert dimension of expert weights is sharded over it, and it
+  doubles as a data axis for the non-expert parts of the model (the
+  standard MoE layout — token all-to-alls ride this axis).
 - ``tensor``: Megatron-style tensor parallelism inside attention/FFN.
 - ``seq``: sequence/context parallelism (ring attention) for long
   sequences.
@@ -38,15 +42,21 @@ from jax.sharding import Mesh
 
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
+AXIS_EXPERT = "expert"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
 
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR)
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
 
 
 def data_axis_names() -> tuple[str, ...]:
-    """Axes over which a global batch is sharded (and grads reduced)."""
-    return (AXIS_DATA, AXIS_FSDP)
+    """Axes over which a global batch is sharded (and grads reduced).
+
+    ``expert`` is a data axis for everything outside MoE layers: tokens
+    are sharded over it like any other batch split, and the MoE dispatch
+    einsum reshards them expert-major (an all-to-all XLA derives from
+    the sharding annotations)."""
+    return (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
 
 
 @dataclass(frozen=True)
@@ -55,21 +65,23 @@ class MeshConfig:
 
     dp: int = -1
     fsdp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        fixed = self.fsdp * self.tp * self.sp
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        fixed = self.fsdp * self.ep * self.tp * self.sp
         if n_devices % fixed != 0:
             raise ValueError(
-                f"fsdp*tp*sp={fixed} does not divide device count {n_devices}"
+                f"fsdp*ep*tp*sp={fixed} does not divide device count {n_devices}"
             )
         dp = self.dp if self.dp != -1 else n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"mesh {dp}x{self.fsdp}x{self.sp}x{self.tp} != {n_devices} devices"
+                f"mesh {dp}x{self.fsdp}x{self.ep}x{self.sp}x{self.tp} "
+                f"!= {n_devices} devices"
             )
-        return (dp, self.fsdp, self.sp, self.tp)
+        return (dp, self.fsdp, self.ep, self.sp, self.tp)
 
 
 # Ambient mesh: modules deep inside a model (e.g. the ring-attention
@@ -131,5 +143,6 @@ def world_size(mesh: Mesh) -> int:
 
 
 def data_parallel_size(mesh: Mesh) -> int:
-    """Number of data-parallel replicas (data × fsdp axes)."""
-    return mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+    """Number of data-parallel replicas (data × fsdp × expert axes)."""
+    return (mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+            * mesh.shape.get(AXIS_EXPERT, 1))

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_SEQ,
     AXIS_TENSOR,
@@ -142,7 +143,7 @@ def ring_attention(q, k, v, mask=None, scale=None, *, mesh: Mesh,
         raise ValueError(
             f"seq len {q.shape[2]} not divisible by seq axis {seq_size}")
 
-    batch_axes = (AXIS_DATA, AXIS_FSDP)
+    batch_axes = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
     qkv_spec = P(batch_axes, AXIS_TENSOR, AXIS_SEQ, None)
     in_specs = [qkv_spec, qkv_spec, qkv_spec]
     args = [q, k, v]
@@ -214,7 +215,8 @@ def ring_attention_or_fallback(q, k, v, mask=None, scale=None,
     if mesh is None or mesh.shape.get(AXIS_SEQ, 1) <= 1:
         return xla_path()
     b, h, s, _ = q.shape
-    dp = mesh.shape.get(AXIS_DATA, 1) * mesh.shape.get(AXIS_FSDP, 1)
+    dp = (mesh.shape.get(AXIS_DATA, 1) * mesh.shape.get(AXIS_FSDP, 1)
+          * mesh.shape.get(AXIS_EXPERT, 1))
     tp = mesh.shape.get(AXIS_TENSOR, 1)
     sp = mesh.shape[AXIS_SEQ]
     # general [b,h,q,k] masks have no ring form — only broadcastable

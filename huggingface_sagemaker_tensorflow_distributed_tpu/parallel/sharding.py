@@ -27,10 +27,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_SEQ,
     AXIS_TENSOR,
+    data_axis_names,
 )
+
+# batch dims shard over every data axis (data, fsdp, expert)
+_BATCH_AXES = data_axis_names()
 
 # (path regex, spec builder) — first match wins. Specs use logical roles:
 # "hidden" dims may be sharded over fsdp, "heads"/"ffn" over tensor.
@@ -38,6 +43,11 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
 # ``tensor``), attention-out and FFN-out are row-parallel (input dim on
 # ``tensor``); embeddings are sharded over fsdp on the vocab dim.
 _PARAM_RULES: Sequence[tuple[str, tuple]] = (
+    # MoE expert weights [E, in, out]: expert dim over ``expert``,
+    # hidden dims Megatron-style; router stays replicated (tiny, fp32)
+    (r"moe/wi$", (AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR)),
+    (r"moe/wo$", (AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)),
+    (r"moe/router$", ()),
     # attention projections: kernel shape (in, out)
     (r"(query|key|value|q_proj|k_proj|v_proj|qkv).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
     (r"(attention_out|out_proj|o_proj|attn_out).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),
@@ -103,8 +113,8 @@ def batch_sharding(mesh: Mesh, seq_axis: bool = False) -> NamedSharding:
     the semantics documented at reference ``scripts/train.py:143-144``.
     """
     if seq_axis:
-        return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ))
-    return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP)))
+        return NamedSharding(mesh, P(_BATCH_AXES, AXIS_SEQ))
+    return NamedSharding(mesh, P(_BATCH_AXES))
 
 
 def seq_axis_is_process_local(mesh: Mesh) -> bool:
@@ -132,8 +142,8 @@ def batch_column_sharding(mesh: Mesh, ndim: int, dim1: int | None = None) -> Nam
     seq_size = mesh.shape.get(AXIS_SEQ, 1)
     if (seq_size > 1 and ndim >= 2 and dim1 is not None
             and dim1 % seq_size == 0 and seq_axis_is_process_local(mesh)):
-        return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ))
-    return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP)))
+        return NamedSharding(mesh, P(_BATCH_AXES, AXIS_SEQ))
+    return NamedSharding(mesh, P(_BATCH_AXES))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -173,6 +173,11 @@ class Trainer:
         self.loss_fn = TASK_LOSSES[self.task]
         self.n_chips = world_size(mesh)
         self.dp_size = data_parallel_size(mesh)
+        # MoE models sow per-layer load-balance losses into the "losses"
+        # collection (models/moe.py); the train step applies with that
+        # collection mutable and adds every sowed value to the task loss.
+        self._has_sown_losses = (
+            getattr(getattr(model, "config", None), "num_experts", 0) or 0) > 0
 
         self.tx, self.scaled_lr = build_optimizer(
             config, world_size=self.dp_size, total_steps=total_steps)
@@ -250,7 +255,19 @@ class Trainer:
         rngs = {"dropout": rng}
 
         def loss_of(params):
-            loss, sums = self.loss_fn(self.model.apply, params, batch, rngs, True)
+            if not self._has_sown_losses:
+                loss, sums = self.loss_fn(self.model.apply, params, batch, rngs, True)
+                return loss, sums
+            sown = []
+
+            def apply_fn(variables, *a, **kw):
+                out, mut = self.model.apply(variables, *a, mutable=["losses"], **kw)
+                sown.append(mut.get("losses", {}))
+                return out
+
+            loss, sums = self.loss_fn(apply_fn, params, batch, rngs, True)
+            for leaf in jax.tree.leaves(sown):
+                loss = loss + jnp.asarray(leaf, jnp.float32)
             return loss, sums
 
         (loss, sums), grads = jax.value_and_grad(loss_of, has_aux=True)(state.params)

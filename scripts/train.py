@@ -119,11 +119,16 @@ def main(argv=None) -> dict:
             config.num_chips, n_local)
 
     mesh = build_mesh(MeshConfig(dp=config.dp, fsdp=config.fsdp,
-                                 tp=config.tp, sp=config.sp))
+                                 ep=config.ep, tp=config.tp, sp=config.sp))
     logger.info("mesh: %s", dict(mesh.shape))
 
     # --- model + tokenizer (reference train.py:69,117) ---
     attention_impl = config.resolve_attention_impl(jax.devices()[0].platform)
+    moe_overrides = {}
+    if config.num_experts:
+        moe_overrides = dict(num_experts=config.num_experts,
+                             expert_top_k=config.expert_top_k,
+                             moe_every=config.moe_every)
     model, params, family, model_config = auto_models.from_pretrained(
         config.model_name_or_path,
         task=config.task,
@@ -134,7 +139,12 @@ def main(argv=None) -> dict:
         from_scratch=config.from_scratch,
         attention_impl=attention_impl,
         remat=config.remat,
+        **moe_overrides,
     )
+    if config.num_experts:
+        logger.info("MoE: %d experts (top-%d) every %d layers, ep=%d",
+                    config.num_experts, config.expert_top_k,
+                    config.moe_every, config.ep)
     if attention_impl == "ring":
         if family == "t5":
             logger.info(

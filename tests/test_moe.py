@@ -1,0 +1,243 @@
+"""MoE + expert parallelism tests (models/moe.py, the ``expert`` mesh
+axis). Beyond-parity capability — the reference has no MoE (SURVEY.md §2
+parallelism inventory), so the contract here is internal consistency:
+routing conservation, ep-sharded == unsharded numerics, aux-loss wiring,
+and expert-sharded checkpoint/divergence behavior.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+    ArrayDataset,
+    ShardedBatcher,
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+    synthetic_text_classification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+    BertForSequenceClassification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    EncoderConfig,
+    is_moe_layer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.moe import (
+    MoeFeedForward,
+    expert_capacity,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+    MeshConfig,
+    build_mesh,
+    param_shardings,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+SEQ = 16
+
+
+def _moe_cfg(**kw):
+    base = dict(vocab_size=256, hidden_size=32, num_layers=2, num_heads=4,
+                intermediate_size=64, max_position_embeddings=SEQ,
+                num_experts=4, expert_top_k=2, moe_every=2)
+    base.update(kw)
+    return EncoderConfig(**base)
+
+
+def test_moe_layer_placement():
+    cfg = _moe_cfg(num_layers=4)
+    assert [is_moe_layer(cfg, i) for i in range(4)] == [False, True, False, True]
+    dense = _moe_cfg(num_experts=0)
+    assert not any(is_moe_layer(dense, i) for i in range(4))
+
+
+def test_capacity_static_and_padded():
+    cfg = _moe_cfg()
+    c = expert_capacity(cfg, 512)
+    # ceil(1.25 * 2 * 512 / 4) = 320, already a multiple of 4
+    assert c == 320
+    assert expert_capacity(cfg, 8) >= 4 and expert_capacity(cfg, 8) % 4 == 0
+
+
+def test_moe_forward_and_routing_conservation():
+    """With generous capacity no token is dropped: the combine weights
+    for every token sum to exactly 1 (normalized top-k gates), so the
+    MoE output is a convex combination of expert outputs."""
+    cfg = _moe_cfg(expert_capacity_factor=4.0)
+    layer = MoeFeedForward(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, SEQ, 32), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    y, state = layer.apply({"params": params}, x, mutable=["losses"])
+    assert y.shape == x.shape
+    assert np.all(np.isfinite(jax.device_get(y)))
+    (aux,) = jax.tree.leaves(state["losses"])
+    # Switch aux loss is >= coef (E * sum f_e P_e >= 1 by Cauchy-Schwarz)
+    assert float(aux) >= cfg.router_aux_coef * 0.99
+
+
+def test_moe_tiny_capacity_drops_gracefully():
+    """Capacity 4 with 16 tokens × top-2: most assignments drop; output
+    must stay finite and dropped tokens contribute zero (residual rides
+    through in the encoder layer)."""
+    cfg = _moe_cfg(expert_capacity_factor=0.1)
+    layer = MoeFeedForward(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, SEQ, 32), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    y, _ = layer.apply({"params": params}, x, mutable=["losses"])
+    assert np.all(np.isfinite(jax.device_get(y)))
+
+
+def _train_losses(mesh_cfg, devices, n_steps=4):
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, labels = synthetic_text_classification(32, seed=0)
+    ds = ArrayDataset.from_texts(tok, texts, labels, max_length=SEQ)
+    mesh = build_mesh(mesh_cfg, devices=devices)
+    cfg = TrainConfig(dtype="float32", learning_rate=1e-3,
+                      scale_lr_by_world_size=False, log_every_steps=0,
+                      rng_impl="threefry")
+    model_cfg = _moe_cfg()
+    model = BertForSequenceClassification(model_cfg, num_labels=2)
+    params = init_params(model, model_cfg)
+    trainer = Trainer(cfg, model, params, mesh)
+    batcher = ShardedBatcher(ds, 8, mesh, shuffle=False)
+    losses = []
+    for step, batch in enumerate(batcher.global_arrays(0)):
+        if step >= n_steps:
+            break
+        trainer.state, m = trainer._train_step(trainer.state, batch)
+        losses.append(float(jax.device_get(m["loss"])))
+    return losses
+
+
+def test_ep_sharded_matches_single_device(devices8):
+    """ep4 (experts sharded, tokens all-to-all'd) must train identically
+    to the same model on one device — the MoE analogue of the dp==1-dev
+    parity test."""
+    single = _train_losses(MeshConfig(), devices8[:1])
+    ep = _train_losses(MeshConfig(dp=-1, ep=4), devices8)
+    np.testing.assert_allclose(ep, single, atol=3e-5)
+
+
+def test_ep_with_tp_matches_single_device(devices8):
+    """ep2×tp2×dp2: expert axis composes with tensor parallelism."""
+    single = _train_losses(MeshConfig(), devices8[:1])
+    mixed = _train_losses(MeshConfig(dp=2, ep=2, tp=2), devices8)
+    np.testing.assert_allclose(mixed, single, atol=3e-5)
+
+
+def test_moe_params_sharded_over_expert_axis(devices8):
+    mesh = build_mesh(MeshConfig(dp=-1, ep=4), devices=devices8)
+    model_cfg = _moe_cfg()
+    model = BertForSequenceClassification(model_cfg, num_labels=2)
+    params = init_params(model, model_cfg)
+    sh = param_shardings(params, mesh)
+    moe = sh["backbone"]["encoder"]["layer_1"]["moe"]
+    assert moe["wi"].spec == P("expert")
+    assert moe["wo"].spec == P("expert")
+    assert moe["router"].spec == P()
+    # dense layer_0 untouched
+    assert "ffn" in sh["backbone"]["encoder"]["layer_0"]
+
+
+def test_aux_loss_reaches_training_loss(devices8):
+    """The sowed load-balance loss must flow into the optimized loss:
+    a model trained with a huge router_aux_coef reports a visibly larger
+    loss than the same model with coef 0."""
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, labels = synthetic_text_classification(16, seed=0)
+    ds = ArrayDataset.from_texts(tok, texts, labels, max_length=SEQ)
+    mesh = build_mesh(MeshConfig(), devices=devices8)
+    losses = {}
+    for coef in (0.0, 100.0):
+        cfg = TrainConfig(dtype="float32", log_every_steps=0,
+                          rng_impl="threefry")
+        model_cfg = _moe_cfg(router_aux_coef=coef)
+        model = BertForSequenceClassification(model_cfg, num_labels=2)
+        params = init_params(model, model_cfg)
+        trainer = Trainer(cfg, model, params, mesh)
+        batcher = ShardedBatcher(ds, 8, mesh, shuffle=False)
+        batch = next(batcher.global_arrays(0))
+        _, m = trainer._train_step(trainer.state, batch)
+        losses[coef] = float(jax.device_get(m["loss"]))
+    # aux >= coef * 1.0 (Switch bound), so the gap must exceed ~99
+    assert losses[100.0] > losses[0.0] + 50.0
+
+
+def test_moe_export_reload_roundtrip(tmp_path):
+    """save_pretrained of an MoE model persists the expert/router weights
+    (moe.safetensors sidecar + MoE fields in config.json) and
+    from_pretrained rebuilds the identical model — no silent weight loss."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+
+    model_cfg = _moe_cfg()
+    model = BertForSequenceClassification(model_cfg, num_labels=2)
+    params = init_params(model, model_cfg)
+    out = str(tmp_path / "export")
+    auto_models.save_pretrained(out, params, "bert", model_cfg)
+
+    model2, params2, family, cfg2 = auto_models.from_pretrained(
+        out, task="seq-cls", num_labels=2)
+    assert cfg2.num_experts == 4 and cfg2.expert_top_k == 2
+    moe1 = params["backbone"]["encoder"]["layer_1"]["moe"]
+    moe2 = params2["backbone"]["encoder"]["layer_1"]["moe"]
+    for key in ("router", "wi", "wo"):
+        np.testing.assert_array_equal(np.asarray(moe1[key]), np.asarray(moe2[key]))
+
+
+def test_moe_upcycling_dense_checkpoint(tmp_path):
+    """Loading a DENSE checkpoint with num_experts>0 (upcycling) must not
+    crash: MoE params stay fresh, dense weights load."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+
+    dense_cfg = _moe_cfg(num_experts=0)
+    model = BertForSequenceClassification(dense_cfg, num_labels=2)
+    params = init_params(model, dense_cfg)
+    out = str(tmp_path / "dense")
+    auto_models.save_pretrained(out, params, "bert", dense_cfg)
+
+    _, up_params, _, up_cfg = auto_models.from_pretrained(
+        out, task="seq-cls", num_labels=2, num_experts=4)
+    assert up_cfg.num_experts == 4
+    assert "moe" in up_params["backbone"]["encoder"]["layer_1"]
+    # dense weights actually loaded (not re-initialized)
+    np.testing.assert_array_equal(
+        np.asarray(params["backbone"]["embeddings"]["word_embeddings"]["embedding"]),
+        np.asarray(up_params["backbone"]["embeddings"]["word_embeddings"]["embedding"]))
+
+
+def test_moe_rejected_for_unsupported_families(tmp_path):
+    """T5 (own config class) and ALBERT (one shared layer) cannot host
+    per-layer expert banks — from_pretrained must fail loudly, not
+    silently train a dense model."""
+    import json
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+
+    d = tmp_path / "albert"
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps({
+        "model_type": "albert", "vocab_size": 128, "hidden_size": 32,
+        "embedding_size": 16, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 32}))
+    with pytest.raises(ValueError, match="not supported"):
+        auto_models.from_pretrained(str(d), task="seq-cls", num_experts=4)
+
+
+def test_divergence_check_tolerates_expert_sharding(devices8):
+    """Expert-sharded weights legitimately differ across the expert
+    axis; the replica-divergence check must not flag them — but must
+    still catch a perturbed replica of a replicated param."""
+    mesh = build_mesh(MeshConfig(dp=2, ep=4), devices=devices8)
+    model_cfg = _moe_cfg()
+    model = BertForSequenceClassification(model_cfg, num_labels=2)
+    params = init_params(model, model_cfg)
+    cfg = TrainConfig(dtype="float32", log_every_steps=0)
+    trainer = Trainer(cfg, model, params, mesh)
+    assert trainer.check_replica_divergence() < 1e-6
