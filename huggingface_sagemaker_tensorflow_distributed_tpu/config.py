@@ -103,8 +103,12 @@ class TrainConfig:
     dp: int = -1                   # -1: use all remaining devices on the data axis
     fsdp: int = 1
     ep: int = 1                    # expert parallel (MoE expert sharding)
+    pp: int = 1                    # pipeline parallel (GPipe over stacked layers)
     tp: int = 1
     sp: int = 1                    # sequence/context parallel (ring attention)
+    # microbatches per pipeline round-trip (0 → = pp); more microbatches
+    # shrink the fill/drain bubble: overhead ~ (pp-1)/(M+pp-1)
+    pipeline_microbatches: int = 0
 
     # --- Mixture-of-Experts (models/moe.py; beyond-parity — the
     #     reference has no MoE). 0 = dense FFN everywhere. MoE weights
@@ -193,9 +197,13 @@ class TrainConfig:
             raise ValueError("gradient_accumulation_steps must be >= 1")
         if self.learning_rate <= 0:
             raise ValueError("learning_rate must be positive")
-        for ax in ("fsdp", "ep", "tp", "sp"):
+        for ax in ("fsdp", "ep", "pp", "tp", "sp"):
             if getattr(self, ax) <= 0:
                 raise ValueError(f"mesh axis {ax} must be positive")
+        if self.pipeline_microbatches < 0:
+            raise ValueError("pipeline_microbatches must be >= 0")
+        if self.pp > 1 and self.num_experts:
+            raise ValueError("pp > 1 cannot combine with num_experts (MoE)")
         if self.num_experts < 0 or self.expert_top_k < 1 or self.moe_every < 1:
             raise ValueError("num_experts >= 0, expert_top_k >= 1, moe_every >= 1")
         if self.ep > 1 and self.num_experts == 0:

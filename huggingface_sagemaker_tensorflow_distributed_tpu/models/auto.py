@@ -160,8 +160,10 @@ _HF_CONFIG_EXPORTERS = {
 }
 
 
-# families whose Encoder stack supports per-layer MoE FFNs
+# families whose Encoder stack supports per-layer MoE FFNs / pipelining
+# (T5 has its own blocks; ALBERT shares one layer across the stack)
 _MOE_FAMILIES = ("bert", "roberta", "distilbert", "electra")
+_PIPELINE_FAMILIES = _MOE_FAMILIES
 
 _MOE_CONFIG_KEYS = ("num_experts", "expert_top_k", "moe_every",
                     "expert_capacity_factor", "router_aux_coef")
@@ -221,6 +223,11 @@ def from_pretrained(
         raise ValueError(
             f"MoE (num_experts={wants_moe}) is not supported for "
             f"family {family!r}; supported: {sorted(_MOE_FAMILIES)}")
+    wants_pp = config_overrides.get("pipeline_stages", 0)
+    if wants_pp and family not in _PIPELINE_FAMILIES:
+        raise ValueError(
+            f"pipeline_stages={wants_pp} is not supported for family "
+            f"{family!r}; supported: {sorted(_PIPELINE_FAMILIES)}")
     if family == "t5" and task != "seq2seq":
         # failing loudly here beats a TypeError deep inside jit tracing
         # when the seq-cls loss feeds an encoder-decoder model
@@ -246,6 +253,19 @@ def from_pretrained(
     if not from_scratch and has_weights:
         state = load_hf_state_dict(model_name_or_path)
         loaded = hf_to_params(state, family)
+        if getattr(config, "pipeline_stages", 0):
+            # checkpoints are stored per-layer; the pipelined encoder
+            # wants the layer-stacked tree
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                stack_layer_params,
+            )
+
+            bb = loaded.get("backbone", {})
+            if "encoder" in bb:
+                bb = dict(bb)
+                bb["pipelined_encoder"] = stack_layer_params(
+                    bb.pop("encoder"), config.num_layers)
+                loaded = {**loaded, "backbone": bb}
         params, missing = merge_into(params, loaded)
         logger.info("loaded %s (%s) — %d fresh head params", model_name_or_path,
                     family, len(missing))
@@ -254,44 +274,49 @@ def from_pretrained(
             # sidecar written by save_pretrained for MoE models: expert/
             # router weights under their native param paths
             from safetensors.numpy import load_file
-            params = _overlay_flat(params, load_file(moe_path))
-            logger.info("loaded MoE expert weights from %s", moe_path)
+            params, applied = _overlay_flat(params, load_file(moe_path))
+            model_moe = {k for k in _flatten_params(params) if "/moe/" in k}
+            if applied != model_moe:
+                # a moe_every/num_experts override moved the expert
+                # layers: refusing beats silently training random experts
+                raise ValueError(
+                    f"MoE sidecar {moe_path} does not line up with the "
+                    f"model's expert layout (sidecar-only: "
+                    f"{sorted(set(applied) - model_moe)[:4]}, model-only: "
+                    f"{sorted(model_moe - applied)[:4]}); load with the "
+                    "checkpoint's own num_experts/moe_every settings")
+            logger.info("loaded %d MoE expert weights from %s",
+                        len(applied), moe_path)
     else:
         logger.info("initialized %s (%s) from scratch", model_name_or_path, family)
     return model, params, family, config
 
 
 def _flatten_params(params: Any) -> dict[str, np.ndarray]:
-    flat: dict[str, np.ndarray] = {}
+    from flax.traverse_util import flatten_dict
 
-    def walk(node, path):
-        if isinstance(node, dict):
-            for k, v in node.items():
-                walk(v, path + (k,))
-        else:
-            flat["/".join(path)] = np.asarray(node)
-
-    walk(params, ())
-    return flat
+    return {k: np.asarray(v)
+            for k, v in flatten_dict(params, sep="/").items()}
 
 
-def _overlay_flat(params: Any, flat: dict[str, np.ndarray]) -> Any:
-    """Overlay a {native-path: array} dict onto a param tree."""
+def _overlay_flat(params: Any, flat: dict[str, np.ndarray]) -> tuple[Any, set]:
+    """Overlay a {native-path: array} dict onto a param tree. Returns
+    (params, keys actually applied) so callers can detect sidecar/model
+    layout mismatches instead of silently keeping random init."""
+    from flax.traverse_util import flatten_dict, unflatten_dict
 
-    def walk(node, path):
-        if isinstance(node, dict):
-            return {k: walk(v, path + (k,)) for k, v in node.items()}
-        key = "/".join(path)
-        if key in flat:
-            src = flat[key]
-            if tuple(np.shape(src)) != tuple(np.shape(node)):
-                raise ValueError(
-                    f"shape mismatch at {key}: sidecar {np.shape(src)} "
-                    f"vs model {np.shape(node)}")
-            return jnp.asarray(src, dtype=jnp.asarray(node).dtype)
-        return node
-
-    return walk(params, ())
+    tree = flatten_dict(params, sep="/")
+    applied = set()
+    for key, src in flat.items():
+        if key not in tree:
+            continue
+        if tuple(np.shape(src)) != tuple(np.shape(tree[key])):
+            raise ValueError(
+                f"shape mismatch at {key}: sidecar {np.shape(src)} "
+                f"vs model {np.shape(tree[key])}")
+        tree[key] = jnp.asarray(src, dtype=jnp.asarray(tree[key]).dtype)
+        applied.add(key)
+    return unflatten_dict(tree, sep="/"), applied
 
 
 def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderConfig,
@@ -314,6 +339,18 @@ def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderCo
         return
     os.makedirs(output_dir, exist_ok=True)
     params = jax.device_get(params)
+    if getattr(config, "pipeline_stages", 0):
+        # stacked → per-layer so the HF reverse rules apply
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+            unstack_layer_params,
+        )
+
+        bb = params.get("backbone", {})
+        if "pipelined_encoder" in bb:
+            bb = dict(bb)
+            bb["encoder"] = unstack_layer_params(
+                bb.pop("pipelined_encoder"), config.num_layers)
+            params = {**params, "backbone": bb}
     state = params_to_hf(params, family)
     state = {k: np.ascontiguousarray(v) for k, v in state.items()}
     from safetensors.numpy import save_file

@@ -77,6 +77,11 @@ class EncoderConfig:
     expert_capacity_factor: float = 1.25
     moe_every: int = 2
     router_aux_coef: float = 0.01
+    # Pipeline parallelism (models/pipeline.py): 0 = dense Encoder.
+    # When > 0 the encoder runs a GPipe schedule over layer-stacked
+    # params sharded over the ``pipe`` mesh axis.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0   # 0 → = pipeline_stages
     # Rematerialize the attention core only: the fp32 [B,H,S,S] softmax
     # residuals XLA otherwise saves (and copies) for backward dominate HBM
     # traffic at seq 512 — recomputing them in backward is measurably
@@ -285,6 +290,14 @@ class EncoderBackbone(nn.Module):
             # ELECTRA factorized-embedding projection (HF
             # ``ElectraModel.embeddings_project``)
             x = _dense(cfg, cfg.hidden_size, "embeddings_project")(x)
-        x = Encoder(cfg, name="encoder")(x, additive_mask, deterministic)
+        if cfg.pipeline_stages:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                PipelinedEncoder,
+            )
+
+            x = PipelinedEncoder(cfg, name="pipelined_encoder")(
+                x, additive_mask, deterministic)
+        else:
+            x = Encoder(cfg, name="encoder")(x, additive_mask, deterministic)
         pooled = Pooler(cfg, name="pooler")(x) if cfg.use_pooler else None
         return x, pooled

@@ -43,19 +43,11 @@ def expert_capacity(cfg: EncoderConfig, seq_len: int) -> int:
 
 
 def _constrain(x, *spec):
-    """Pin an intermediate's sharding when an ambient mesh is present
-    (training under the Trainer); no-op in meshless traces (init,
-    single-device tools)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
-        maybe_current_mesh,
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
+        constrain_if_mesh,
     )
 
-    mesh = maybe_current_mesh()
-    if mesh is None:
-        return x
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+    return constrain_if_mesh(x, *spec)
 
 
 class MoeFeedForward(nn.Module):
@@ -96,7 +88,6 @@ class MoeFeedForward(nn.Module):
         remaining = probs
         counts = jnp.zeros((B, E), jnp.float32)    # slots used per expert
         combine = jnp.zeros((B, S, E, C), jnp.float32)
-        gate_kept = jnp.zeros((B, S), jnp.float32)
         gate_total = jnp.zeros((B, S), jnp.float32)
         top1_mask = None
         for _ in range(k):
@@ -116,12 +107,12 @@ class MoeFeedForward(nn.Module):
             disp = (mask[..., None] * slot_oh[:, :, None, :]
                     * kept[:, :, None, None].astype(jnp.float32))  # [B,S,E,C]
             combine = combine + gate[:, :, None, None] * disp
-            gate_kept = gate_kept + gate * kept.astype(jnp.float32)
             gate_total = gate_total + gate
 
-        # normalize kept gates over the selected top-k mass (Mixtral/HF
-        # convention); tokens with every choice dropped contribute 0 and
-        # ride the residual connection
+        # normalize each token's gates over its total selected top-k mass
+        # (Mixtral/HF convention); capacity-dropped choices simply keep
+        # their zero dispatch, and a token with every choice dropped
+        # contributes 0 and rides the residual connection
         denom = jnp.where(gate_total > 0.0, gate_total, 1.0)
         combine = combine / denom[:, :, None, None]
         dispatch = (combine > 0.0).astype(cfg.dtype)               # [B,S,E,C]

@@ -1,0 +1,225 @@
+"""Pipeline parallelism: a GPipe-scheduled encoder over the ``pipe``
+mesh axis.
+
+Beyond-parity capability (the reference has no pipeline parallelism,
+SURVEY.md §2 parallelism inventory), designed as *dense SPMD* rather
+than per-stage programs: the encoder's layers live in ONE layer-stacked
+param tree (leading dim = num_layers, sharded over ``pipe``), and the
+GPipe schedule is expressed as compiler-friendly array code —
+
+    lax.scan over ticks
+      └─ vmap over stages (each applies its layers_per_stage layers)
+      └─ jnp.roll along the stage dim (stage s → stage s+1 handoff)
+
+Under ``jit`` with the stage dim sharded over ``pipe``, XLA lowers the
+roll to a collective-permute along the pipe axis and the vmap body runs
+concurrently on every stage — the classic SPMD pipelining formulation
+(MaxText/praxis lineage), with no hand-written send/recv and no
+per-stage program divergence. Single-device meshes execute the same
+schedule (bit-identical math, just no overlap), so pipelined models run
+everywhere the dense ones do.
+
+Schedule shape: M microbatches over S stages take M + S - 1 ticks; the
+fill/drain bubble computes on zero padding and its outputs are dropped.
+Backward is plain autodiff through the scan/roll — the standard GPipe
+recomputation trade is available via ``EncoderConfig.remat``.
+
+Conversion helpers map between the per-layer tree of the dense
+``Encoder`` (``layer_{i}/attention/query/kernel``) and the stacked tree
+here (``query_kernel`` with leading layer dim), so HF checkpoints load
+into pipelined models and pipelined models export back to HF layout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    EncoderConfig,
+    EncoderLayer,
+)
+
+# stacked-name ↔ per-layer-path map: last two path components joined by
+# "_" (attention/query/kernel → query_kernel, ffn_ln/scale → ffn_ln_scale)
+_LAYER_LEAVES = (
+    ("attention", "query", "kernel"), ("attention", "query", "bias"),
+    ("attention", "key", "kernel"), ("attention", "key", "bias"),
+    ("attention", "value", "kernel"), ("attention", "value", "bias"),
+    ("attention", "attention_out", "kernel"), ("attention", "attention_out", "bias"),
+    ("attention_ln", "scale",), ("attention_ln", "bias",),
+    ("ffn", "intermediate", "kernel"), ("ffn", "intermediate", "bias"),
+    ("ffn", "ffn_out", "kernel"), ("ffn", "ffn_out", "bias"),
+    ("ffn_ln", "scale",), ("ffn_ln", "bias",),
+)
+
+
+def _stacked_name(path: tuple) -> str:
+    return "_".join(path[-2:])
+
+
+def stack_layer_params(encoder_params: dict, num_layers: int) -> dict:
+    """Dense ``Encoder`` params (``layer_{i}/...``) → the stacked flat
+    tree ``PipelinedEncoder`` declares (leading dim = num_layers)."""
+    out: dict[str, Any] = {}
+    for path in _LAYER_LEAVES:
+        leaves = []
+        for i in range(num_layers):
+            node = encoder_params[f"layer_{i}"]
+            for key in path:
+                node = node[key]
+            leaves.append(np.asarray(node))
+        out[_stacked_name(path)] = np.stack(leaves, axis=0)
+    return out
+
+
+def unstack_layer_params(stacked: dict, num_layers: int) -> dict:
+    """Inverse of :func:`stack_layer_params` (for HF-layout export)."""
+    out: dict[str, Any] = {}
+    for i in range(num_layers):
+        layer: dict[str, Any] = {}
+        for path in _LAYER_LEAVES:
+            node = layer
+            for key in path[:-1]:
+                node = node.setdefault(key, {})
+            node[path[-1]] = np.asarray(stacked[_stacked_name(path)])[i]
+        out[f"layer_{i}"] = layer
+    return out
+
+
+def _layer_tree(flat: dict, index) -> dict:
+    """One layer's EncoderLayer-structured params from the stacked tree."""
+    tree: dict[str, Any] = {}
+    for path in _LAYER_LEAVES:
+        node = tree
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        node[path[-1]] = flat[_stacked_name(path)][index]
+    return tree
+
+
+class PipelinedEncoder(nn.Module):
+    """Drop-in replacement for ``Encoder`` when
+    ``config.pipeline_stages > 0``. Same math, layer-stacked params,
+    GPipe schedule (see module docstring)."""
+
+    config: EncoderConfig
+
+    def _declare_stacked(self) -> dict:
+        cfg = self.config
+        L, H, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+        kernel = nn.initializers.normal(cfg.initializer_range)
+        zeros, ones = nn.initializers.zeros, nn.initializers.ones
+        shapes = {
+            "query_kernel": ((L, H, H), kernel), "query_bias": ((L, H), zeros),
+            "key_kernel": ((L, H, H), kernel), "key_bias": ((L, H), zeros),
+            "value_kernel": ((L, H, H), kernel), "value_bias": ((L, H), zeros),
+            "attention_out_kernel": ((L, H, H), kernel),
+            "attention_out_bias": ((L, H), zeros),
+            "attention_ln_scale": ((L, H), ones), "attention_ln_bias": ((L, H), zeros),
+            "intermediate_kernel": ((L, H, F), kernel),
+            "intermediate_bias": ((L, F), zeros),
+            "ffn_out_kernel": ((L, F, H), kernel), "ffn_out_bias": ((L, H), zeros),
+            "ffn_ln_scale": ((L, H), ones), "ffn_ln_bias": ((L, H), zeros),
+        }
+        return {name: self.param(name, init, shape, self.config.param_dtype)
+                for name, (shape, init) in shapes.items()}
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+            AXIS_PIPE,
+            data_axis_names,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
+            constrain_if_mesh,
+        )
+
+        cfg = self.config
+        pp = cfg.pipeline_stages
+        L = cfg.num_layers
+        if pp < 1 or L % pp:
+            raise ValueError(
+                f"pipeline_stages={pp} must be >= 1 and divide num_layers={L}")
+        if cfg.num_experts:
+            raise ValueError("pipeline_stages and num_experts cannot combine "
+                             "(pipelined MoE is not supported)")
+        lps = L // pp
+        B, S, H = hidden.shape
+        # The schedule's outputs are M-invariant (same math, different
+        # overlap), so a batch that doesn't divide the requested
+        # microbatch count degrades to gcd(B, M) instead of failing —
+        # init traces (batch 1) and ragged eval tails stay runnable.
+        M = math.gcd(B, cfg.pipeline_microbatches or pp)
+        mb = B // M
+        batch_axes = data_axis_names()
+
+        flat = self._declare_stacked()
+        # [L, ...] → [pp, lps, ...]: stage-major so the stored dim-0
+        # sharding over ``pipe`` aligns stages with pipe ranks
+        staged = jax.tree.map(
+            lambda a: a.reshape(pp, lps, *a.shape[1:]), flat)
+
+        if attn_mask is None:
+            attn_mask = jnp.zeros((B, 1, 1, S), jnp.float32)
+        attn_mask = jnp.broadcast_to(attn_mask, (B, 1, 1, S))
+
+        layer = EncoderLayer(cfg)
+        base_key = (None if deterministic
+                    else self.make_rng("dropout"))
+
+        def stage_fn(p_stage, x, m, key):
+            for i in range(lps):
+                p_i = _layer_tree(p_stage, i)
+                if deterministic:
+                    x = layer.apply({"params": p_i}, x, m, True)
+                else:
+                    x = layer.apply({"params": p_i}, x, m, False,
+                                    rngs={"dropout": jax.random.fold_in(key, i)})
+            return x
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(stage_fn)
+
+        x_mb = hidden.reshape(M, mb, S, H)
+        m_mb = attn_mask.reshape(M, mb, 1, 1, S)
+        pad_x = jnp.zeros((pp - 1, mb, S, H), hidden.dtype)
+        pad_m = jnp.zeros((pp - 1, mb, 1, 1, S), attn_mask.dtype)
+        xs_feed = jnp.concatenate([x_mb, pad_x], axis=0)    # [T, ...]
+        ms_feed = jnp.concatenate([m_mb, pad_m], axis=0)
+
+        state_x = jnp.zeros((pp, mb, S, H), hidden.dtype)
+        state_m = jnp.zeros((pp, mb, 1, 1, S), attn_mask.dtype)
+
+        def tick(carry, feed):
+            sx, sm, t = carry
+            in_x, in_m = feed
+            # stage 0 ingests the next microbatch; the rolled-in garbage
+            # at slot 0 is overwritten
+            sx = sx.at[0].set(in_x)
+            sm = sm.at[0].set(in_m)
+            sx = constrain_if_mesh(sx, AXIS_PIPE, batch_axes)
+            if deterministic:
+                out = jax.vmap(lambda p, x, m: stage_fn(p, x, m, None))(
+                    staged, sx, sm)
+            else:
+                tick_key = jax.random.fold_in(base_key, t)
+                keys = jax.vmap(lambda s: jax.random.fold_in(tick_key, s))(
+                    jnp.arange(pp))
+                out = jax.vmap(stage_fn)(staged, sx, sm, keys)
+            out = constrain_if_mesh(out, AXIS_PIPE, batch_axes)
+            y = out[-1]                     # last stage's finished microbatch
+            sx = jnp.roll(out, 1, axis=0)   # stage s → stage s+1
+            sm = jnp.roll(sm, 1, axis=0)
+            return (sx, sm, t + 1), y
+
+        (_, _, _), ys = jax.lax.scan(
+            tick, (state_x, state_m, jnp.zeros((), jnp.int32)),
+            (xs_feed, ms_feed))
+        # first pp-1 tick outputs are fill-bubble garbage
+        return ys[pp - 1:].reshape(B, S, H)

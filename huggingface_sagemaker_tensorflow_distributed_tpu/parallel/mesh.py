@@ -19,6 +19,9 @@ Axes:
   the expert dimension of expert weights is sharded over it, and it
   doubles as a data axis for the non-expert parts of the model (the
   standard MoE layout — token all-to-alls ride this axis).
+- ``pipe``: pipeline parallelism (``models/pipeline.py``): the stacked
+  layer dimension of a pipelined encoder is sharded over it; microbatch
+  handoffs between stages are collective-permutes along this axis.
 - ``tensor``: Megatron-style tensor parallelism inside attention/FFN.
 - ``seq``: sequence/context parallelism (ring attention) for long
   sequences.
@@ -43,10 +46,11 @@ from jax.sharding import Mesh
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_EXPERT = "expert"
+AXIS_PIPE = "pipe"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
 
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ, AXIS_TENSOR)
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR)
 
 
 def data_axis_names() -> tuple[str, ...]:
@@ -66,22 +70,24 @@ class MeshConfig:
     dp: int = -1
     fsdp: int = 1
     ep: int = 1
+    pp: int = 1
     tp: int = 1
     sp: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
-        fixed = self.fsdp * self.ep * self.tp * self.sp
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int, int]:
+        fixed = self.fsdp * self.ep * self.pp * self.tp * self.sp
         if n_devices % fixed != 0:
             raise ValueError(
-                f"fsdp*ep*tp*sp={fixed} does not divide device count {n_devices}"
+                f"fsdp*ep*pp*tp*sp={fixed} does not divide device count "
+                f"{n_devices}"
             )
         dp = self.dp if self.dp != -1 else n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"mesh {dp}x{self.fsdp}x{self.ep}x{self.sp}x{self.tp} "
+                f"mesh {dp}x{self.fsdp}x{self.ep}x{self.pp}x{self.sp}x{self.tp} "
                 f"!= {n_devices} devices"
             )
-        return (dp, self.fsdp, self.ep, self.sp, self.tp)
+        return (dp, self.fsdp, self.ep, self.pp, self.sp, self.tp)
 
 
 # Ambient mesh: modules deep inside a model (e.g. the ring-attention

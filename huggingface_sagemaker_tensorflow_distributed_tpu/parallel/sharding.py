@@ -29,9 +29,11 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
+    AXIS_PIPE,
     AXIS_SEQ,
     AXIS_TENSOR,
     data_axis_names,
+    maybe_current_mesh,
 )
 
 # batch dims shard over every data axis (data, fsdp, expert)
@@ -48,6 +50,14 @@ _PARAM_RULES: Sequence[tuple[str, tuple]] = (
     (r"moe/wi$", (AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR)),
     (r"moe/wo$", (AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)),
     (r"moe/router$", ()),
+    # pipelined encoder: layer-stacked params [L, ...] — stage dim over
+    # ``pipe``, then the Megatron layout on the per-layer dims. MUST
+    # precede the dense rules (those would misread dim 0 as the in-dim).
+    (r"pipelined_encoder/(query|key|value|intermediate)_kernel$",
+     (AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR)),
+    (r"pipelined_encoder/(attention_out|ffn_out)_kernel$",
+     (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
+    (r"pipelined_encoder/", (AXIS_PIPE,)),
     # attention projections: kernel shape (in, out)
     (r"(query|key|value|q_proj|k_proj|v_proj|qkv).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
     (r"(attention_out|out_proj|o_proj|attn_out).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),
@@ -148,6 +158,17 @@ def batch_column_sharding(mesh: Mesh, ndim: int, dim1: int | None = None) -> Nam
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def constrain_if_mesh(x, *spec):
+    """``with_sharding_constraint`` against the ambient mesh when one is
+    active (training under the Trainer); no-op in meshless traces
+    (param init, single-device tools). For pinning intermediates inside
+    model code — MoE dispatch, pipeline stage state."""
+    mesh = maybe_current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:

@@ -119,7 +119,8 @@ def main(argv=None) -> dict:
             config.num_chips, n_local)
 
     mesh = build_mesh(MeshConfig(dp=config.dp, fsdp=config.fsdp,
-                                 ep=config.ep, tp=config.tp, sp=config.sp))
+                                 ep=config.ep, pp=config.pp,
+                                 tp=config.tp, sp=config.sp))
     logger.info("mesh: %s", dict(mesh.shape))
 
     # --- model + tokenizer (reference train.py:69,117) ---
@@ -129,6 +130,10 @@ def main(argv=None) -> dict:
         moe_overrides = dict(num_experts=config.num_experts,
                              expert_top_k=config.expert_top_k,
                              moe_every=config.moe_every)
+    if config.pp > 1:
+        moe_overrides.update(
+            pipeline_stages=config.pp,
+            pipeline_microbatches=config.pipeline_microbatches)
     model, params, family, model_config = auto_models.from_pretrained(
         config.model_name_or_path,
         task=config.task,

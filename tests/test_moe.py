@@ -211,6 +211,22 @@ def test_moe_upcycling_dense_checkpoint(tmp_path):
         np.asarray(up_params["backbone"]["embeddings"]["word_embeddings"]["embedding"]))
 
 
+def test_moe_sidecar_layout_mismatch_raises(tmp_path):
+    """Reloading an MoE export with a different moe_every must fail
+    loudly — silently training random experts is the failure mode."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+
+    model_cfg = _moe_cfg(num_layers=4)   # experts at layers 1, 3
+    model = BertForSequenceClassification(model_cfg, num_labels=2)
+    params = init_params(model, model_cfg)
+    out = str(tmp_path / "moe4")
+    auto_models.save_pretrained(out, params, "bert", model_cfg)
+    # either guard may fire first: the strict-backbone check (dense FFN
+    # missing where a layer went MoE→dense) or the sidecar layout check
+    with pytest.raises(ValueError, match="sidecar|missing"):
+        auto_models.from_pretrained(out, task="seq-cls", moe_every=4)
+
+
 def test_moe_rejected_for_unsupported_families(tmp_path):
     """T5 (own config class) and ALBERT (one shared layer) cannot host
     per-layer expert banks — from_pretrained must fail loudly, not

@@ -1,0 +1,265 @@
+"""Pipeline-parallelism tests (models/pipeline.py, the ``pipe`` mesh
+axis). Contract: the GPipe schedule is bit-compatible with the dense
+Encoder (same math, different execution order), composes with dp/tp on
+a real mesh, and round-trips HF checkpoints through the stacked layout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+    ArrayDataset,
+    ShardedBatcher,
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+    synthetic_text_classification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+    BertForSequenceClassification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+    stack_layer_params,
+    unstack_layer_params,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+    MeshConfig,
+    build_mesh,
+    param_shardings,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+SEQ = 16
+L = 4
+
+
+def _cfg(pp=0, **kw):
+    base = dict(vocab_size=256, hidden_size=32, num_layers=L, num_heads=4,
+                intermediate_size=64, max_position_embeddings=SEQ,
+                hidden_dropout=0.0, attention_dropout=0.0,
+                pipeline_stages=pp)
+    base.update(kw)
+    return EncoderConfig(**base)
+
+
+def _inputs(batch=8):
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(5, 250, (batch, SEQ)), jnp.int32)
+    mask = jnp.ones((batch, SEQ), jnp.int32)
+    return ids, mask
+
+
+def test_pipelined_matches_dense_forward():
+    """Same weights (stacked from the dense model) → identical logits.
+    The schedule is a re-ordering of the same math, so tolerance is
+    float-roundoff only."""
+    dense_cfg = _cfg(pp=0)
+    dense = BertForSequenceClassification(dense_cfg, num_labels=2)
+    dense_params = init_params(dense, dense_cfg)
+
+    pp_cfg = _cfg(pp=2)
+    piped = BertForSequenceClassification(pp_cfg, num_labels=2)
+    pp_params = init_params(piped, pp_cfg)
+    pp_params = jax.tree.map(lambda x: x, pp_params)  # mutable copy
+    pp_params["backbone"]["pipelined_encoder"] = jax.tree.map(
+        jnp.asarray,
+        stack_layer_params(dense_params["backbone"]["encoder"], L))
+    for key in ("embeddings", "pooler"):
+        pp_params["backbone"][key] = dense_params["backbone"][key]
+    pp_params["classifier"] = dense_params["classifier"]
+
+    ids, mask = _inputs()
+    out_dense = dense.apply({"params": dense_params}, ids, mask,
+                            deterministic=True)
+    out_pp = piped.apply({"params": pp_params}, ids, mask, deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=1e-5)
+
+
+def test_pipelined_grads_match_dense():
+    """Backward through scan/roll produces the same gradients as the
+    dense stack (mapped back through unstack)."""
+    dense_cfg = _cfg(pp=0)
+    dense = BertForSequenceClassification(dense_cfg, num_labels=2)
+    dense_params = init_params(dense, dense_cfg)
+    pp_cfg = _cfg(pp=2, pipeline_microbatches=4)
+    piped = BertForSequenceClassification(pp_cfg, num_labels=2)
+    pp_params = init_params(piped, pp_cfg)
+    pp_params["backbone"]["pipelined_encoder"] = jax.tree.map(
+        jnp.asarray,
+        stack_layer_params(dense_params["backbone"]["encoder"], L))
+    for key in ("embeddings", "pooler"):
+        pp_params["backbone"][key] = dense_params["backbone"][key]
+    pp_params["classifier"] = dense_params["classifier"]
+
+    ids, mask = _inputs()
+
+    def loss_dense(p):
+        return jnp.sum(dense.apply({"params": p}, ids, mask,
+                                   deterministic=True) ** 2)
+
+    def loss_pp(p):
+        return jnp.sum(piped.apply({"params": p}, ids, mask,
+                                   deterministic=True) ** 2)
+
+    g_dense = jax.grad(loss_dense)(dense_params)
+    g_pp = jax.grad(loss_pp)(pp_params)
+    g_pp_enc = unstack_layer_params(
+        jax.tree.map(np.asarray, g_pp["backbone"]["pipelined_encoder"]), L)
+    for i in range(L):
+        np.testing.assert_allclose(
+            g_pp_enc[f"layer_{i}"]["attention"]["query"]["kernel"],
+            np.asarray(g_dense["backbone"]["encoder"][f"layer_{i}"]
+                       ["attention"]["query"]["kernel"]),
+            atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(g_pp["classifier"]["kernel"]),
+        np.asarray(g_dense["classifier"]["kernel"]), atol=2e-4)
+
+
+def test_stack_unstack_roundtrip():
+    cfg = _cfg()
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    params = init_params(model, cfg)
+    enc = params["backbone"]["encoder"]
+    back = unstack_layer_params(stack_layer_params(enc, L), L)
+    for i in range(L):
+        np.testing.assert_array_equal(
+            back[f"layer_{i}"]["ffn"]["intermediate"]["kernel"],
+            np.asarray(enc[f"layer_{i}"]["ffn"]["intermediate"]["kernel"]))
+
+
+def test_pp_mesh_training_matches_single_device(devices8):
+    """dp2×pp2×tp2 training = single-device pipelined training: the pipe
+    axis shards stages but must not change the update."""
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, labels = synthetic_text_classification(32, seed=0)
+    ds = ArrayDataset.from_texts(tok, texts, labels, max_length=SEQ)
+
+    def run(mesh_cfg, devices):
+        mesh = build_mesh(mesh_cfg, devices=devices)
+        cfg = TrainConfig(dtype="float32", learning_rate=1e-3,
+                          scale_lr_by_world_size=False, log_every_steps=0,
+                          rng_impl="threefry")
+        model_cfg = _cfg(pp=2)
+        model = BertForSequenceClassification(model_cfg, num_labels=2)
+        params = init_params(model, model_cfg)
+        trainer = Trainer(cfg, model, params, mesh)
+        batcher = ShardedBatcher(ds, 8, mesh, shuffle=False)
+        losses = []
+        for step, batch in enumerate(batcher.global_arrays(0)):
+            if step >= 4:
+                break
+            trainer.state, m = trainer._train_step(trainer.state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    single = run(MeshConfig(), devices8[:1])
+    sharded = run(MeshConfig(dp=2, pp=2, tp=2), devices8)
+    np.testing.assert_allclose(sharded, single, atol=3e-5)
+
+
+def test_pipelined_params_sharded_over_pipe(devices8):
+    mesh = build_mesh(MeshConfig(dp=-1, pp=2, tp=2), devices=devices8)
+    model_cfg = _cfg(pp=2)
+    model = BertForSequenceClassification(model_cfg, num_labels=2)
+    params = init_params(model, model_cfg)
+    sh = param_shardings(params, mesh)
+    enc = sh["backbone"]["pipelined_encoder"]
+    assert enc["query_kernel"].spec == P("pipe", None, "tensor")
+    assert enc["ffn_out_kernel"].spec == P("pipe", "tensor")
+    assert enc["attention_ln_scale"].spec == P("pipe")
+
+
+def test_hf_checkpoint_loads_into_pipelined_model(tmp_path):
+    """Export a dense model, reload with pipeline_stages=2: forward must
+    match the dense original (weights stacked on load)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+
+    dense_cfg = _cfg()
+    dense = BertForSequenceClassification(dense_cfg, num_labels=2)
+    dense_params = init_params(dense, dense_cfg)
+    out = str(tmp_path / "dense")
+    auto_models.save_pretrained(out, dense_params, "bert", dense_cfg)
+
+    model, params, _, cfg = auto_models.from_pretrained(
+        out, task="seq-cls", num_labels=2, pipeline_stages=2,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    assert cfg.pipeline_stages == 2
+    ids, mask = _inputs()
+    out_dense = dense.apply({"params": dense_params}, ids, mask,
+                            deterministic=True)
+    out_pp = model.apply({"params": params}, ids, mask, deterministic=True)
+    # classifier head is freshly initialized on load, so compare the
+    # backbone by re-using the dense head on the pipelined trunk: logits
+    # differ, pooled trunk must not — compare via the exported encoder
+    np.testing.assert_allclose(
+        np.asarray(out_pp).shape, np.asarray(out_dense).shape)
+    # strong check: stacked weights equal the dense ones
+    stacked = stack_layer_params(dense_params["backbone"]["encoder"], L)
+    for name, arr in stacked.items():
+        np.testing.assert_allclose(
+            np.asarray(params["backbone"]["pipelined_encoder"][name]), arr,
+            atol=1e-6)
+
+
+def test_pipelined_export_roundtrip(tmp_path):
+    """save_pretrained of a pipelined model writes per-layer HF layout
+    loadable as a dense model with identical weights."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+
+    pp_cfg = _cfg(pp=2)
+    piped = BertForSequenceClassification(pp_cfg, num_labels=2)
+    pp_params = init_params(piped, pp_cfg)
+    out = str(tmp_path / "pp-export")
+    auto_models.save_pretrained(out, pp_params, "bert", pp_cfg)
+
+    _, dense_params, _, dense_cfg = auto_models.from_pretrained(
+        out, task="seq-cls", num_labels=2)
+    assert dense_cfg.pipeline_stages == 0
+    stacked = pp_params["backbone"]["pipelined_encoder"]
+    restacked = stack_layer_params(dense_params["backbone"]["encoder"], L)
+    for name in restacked:
+        np.testing.assert_allclose(restacked[name], np.asarray(stacked[name]),
+                                   atol=1e-6)
+
+
+def test_non_dividing_microbatches_degrade_to_gcd():
+    """batch 8 with pipeline_microbatches=3 → effective M=1; outputs are
+    M-invariant so results still match the dense model."""
+    dense_cfg = _cfg(pp=0)
+    dense = BertForSequenceClassification(dense_cfg, num_labels=2)
+    dense_params = init_params(dense, dense_cfg)
+    cfg = _cfg(pp=2, pipeline_microbatches=3)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    params = init_params(model, cfg)
+    params["backbone"]["pipelined_encoder"] = jax.tree.map(
+        jnp.asarray, stack_layer_params(dense_params["backbone"]["encoder"], L))
+    for key in ("embeddings", "pooler"):
+        params["backbone"][key] = dense_params["backbone"][key]
+    params["classifier"] = dense_params["classifier"]
+    ids, mask = _inputs(batch=8)
+    out_pp = model.apply({"params": params}, ids, mask, deterministic=True)
+    out_dense = dense.apply({"params": dense_params}, ids, mask,
+                            deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=1e-5)
+
+
+def test_dropout_runs_under_pipeline():
+    """Non-deterministic path (per-tick/stage/layer folded keys) runs and
+    produces different outputs across dropout keys."""
+    cfg = _cfg(pp=2, hidden_dropout=0.5)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    params = init_params(model, cfg)
+    ids, mask = _inputs()
+    outs = [model.apply({"params": params}, ids, mask, deterministic=False,
+                        rngs={"dropout": jax.random.PRNGKey(k)})
+            for k in (0, 1)]
+    assert not np.allclose(np.asarray(outs[0]), np.asarray(outs[1]))

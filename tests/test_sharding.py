@@ -32,9 +32,10 @@ SEQ = 16
 
 
 def test_mesh_resolve():
-    assert MeshConfig(dp=-1).resolve(8) == (8, 1, 1, 1, 1)
-    assert MeshConfig(dp=-1, fsdp=2, tp=2).resolve(8) == (2, 2, 1, 1, 2)
-    assert MeshConfig(dp=-1, ep=4).resolve(8) == (2, 1, 4, 1, 1)
+    assert MeshConfig(dp=-1).resolve(8) == (8, 1, 1, 1, 1, 1)
+    assert MeshConfig(dp=-1, fsdp=2, tp=2).resolve(8) == (2, 2, 1, 1, 1, 2)
+    assert MeshConfig(dp=-1, ep=4).resolve(8) == (2, 1, 4, 1, 1, 1)
+    assert MeshConfig(dp=-1, pp=4).resolve(8) == (2, 1, 1, 4, 1, 1)
     with pytest.raises(ValueError):
         MeshConfig(dp=3, fsdp=3).resolve(8)
     with pytest.raises(ValueError):
