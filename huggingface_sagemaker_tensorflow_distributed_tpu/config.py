@@ -183,7 +183,8 @@ class TrainConfig:
     log_all_hosts: bool = False
 
     def __post_init__(self):
-        if self.task not in ("seq-cls", "token-cls", "qa", "seq2seq"):
+        if self.task not in ("seq-cls", "token-cls", "qa", "seq2seq",
+                             "causal-lm"):
             raise ValueError(f"unknown task {self.task!r}")
         if self.dtype not in ("bfloat16", "float32", "float16"):
             raise ValueError(f"unknown dtype {self.dtype!r}")

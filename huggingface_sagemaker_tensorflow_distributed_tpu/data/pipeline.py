@@ -77,6 +77,17 @@ class ArrayDataset:
         return cls(cols)
 
     @classmethod
+    def from_lm_texts(cls, tokenizer, texts, max_length: int = 512) -> "ArrayDataset":
+        """Causal-LM corpus: labels are the input ids themselves (the
+        trainer's causal-lm loss shifts them); pad positions get -100."""
+        enc = tokenizer(texts, truncation=True, padding="max_length",
+                        max_length=max_length)
+        ids = np.asarray(enc["input_ids"], np.int32)
+        mask = np.asarray(enc["attention_mask"], np.int32)
+        labels = np.where(mask > 0, ids, -100).astype(np.int32)
+        return cls({"input_ids": ids, "attention_mask": mask, "labels": labels})
+
+    @classmethod
     def from_token_classification(cls, tokenizer, sentences, word_tags,
                                   max_length: int = 512) -> "ArrayDataset":
         """Word-level NER → token-level labels, -100 on specials/pads and

@@ -184,6 +184,11 @@ class HFTokenizer:
     def __init__(self, hf_tokenizer):
         self._tok = hf_tokenizer
         self.model_max_length = min(hf_tokenizer.model_max_length, 1 << 20)
+        if hf_tokenizer.pad_token_id is None and hf_tokenizer.eos_token_id is not None:
+            # GPT-2 family ships without a pad token; padding to static
+            # shapes is non-negotiable on TPU — HF's standard recipe is
+            # pad = eos (pad positions are masked out everywhere anyway)
+            hf_tokenizer.pad_token = hf_tokenizer.eos_token
         self.pad_token_id = hf_tokenizer.pad_token_id or 0
 
     def __call__(self, texts, truncation: bool = True, padding: str = "max_length",

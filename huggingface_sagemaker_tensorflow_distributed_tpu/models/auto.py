@@ -32,6 +32,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.models import (
     bert,
     distilbert,
     electra,
+    gpt2,
     roberta,
     t5,
 )
@@ -65,6 +66,7 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("albert", "token-cls"): albert.AlbertForTokenClassification,
     ("albert", "qa"): albert.AlbertForQuestionAnswering,
     ("t5", "seq2seq"): t5.T5ForConditionalGeneration,
+    ("gpt2", "causal-lm"): gpt2.Gpt2LMHeadModel,
 }
 
 CONFIG_BUILDERS = {
@@ -74,6 +76,7 @@ CONFIG_BUILDERS = {
     "electra": electra.electra_config_from_hf,
     "albert": albert.albert_config_from_hf,
     "t5": t5.t5_config_from_hf,
+    "gpt2": gpt2.gpt2_config_from_hf,
 }
 
 # Our config → HF config.json for export
@@ -142,6 +145,19 @@ _HF_CONFIG_EXPORTERS = {
         "attention_probs_dropout_prob": c.attention_dropout,
         "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
     },
+    "gpt2": lambda c: {
+        "model_type": "gpt2", "architectures": ["GPT2LMHeadModel"],
+        "vocab_size": c.vocab_size, "n_positions": c.max_position_embeddings,
+        "n_embd": c.hidden_size, "n_layer": c.num_layers,
+        "n_head": c.num_heads, "n_inner": c.intermediate_size,
+        "activation_function": c.hidden_act,
+        "layer_norm_epsilon": c.layer_norm_eps,
+        "resid_pdrop": c.hidden_dropout, "embd_pdrop": c.embd_dropout,
+        "attn_pdrop": c.attention_dropout,
+        "bos_token_id": c.bos_token_id, "eos_token_id": c.eos_token_id,
+        "pad_token_id": c.pad_token_id,
+        "initializer_range": c.initializer_range,
+    },
     "t5": lambda c: {
         "model_type": "t5", "architectures": ["T5ForConditionalGeneration"],
         "vocab_size": c.vocab_size, "d_model": c.d_model, "d_kv": c.d_kv,
@@ -180,7 +196,7 @@ def build_model(family: str, task: str, config: EncoderConfig, num_labels: int =
     cls = MODEL_REGISTRY.get((family, task))
     if cls is None:
         raise ValueError(f"no model for family={family!r} task={task!r}")
-    if task in ("qa", "seq2seq"):
+    if task in ("qa", "seq2seq", "causal-lm"):
         return cls(config)
     return cls(config, num_labels=num_labels)
 
@@ -234,6 +250,10 @@ def from_pretrained(
         raise ValueError(
             f"{model_name_or_path!r} is a T5 (encoder-decoder) checkpoint; "
             f"it only supports task='seq2seq', got task={task!r}")
+    if family == "gpt2" and task != "causal-lm":
+        raise ValueError(
+            f"{model_name_or_path!r} is a GPT-2 (decoder-only) checkpoint; "
+            f"it only supports task='causal-lm', got task={task!r}")
     if family in ("bert", "albert") and task != "seq-cls":
         # HF Bert/Albert QA/token-cls models are built with
         # add_pooling_layer=False; only the seq-cls head uses the pooler.

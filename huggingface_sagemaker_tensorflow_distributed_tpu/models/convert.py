@@ -158,6 +158,22 @@ _ALBERT_RULES = [
     (r"^classifier$", r"classifier"),
 ]
 
+# GPT-2: HF Conv1D stores weights [in, out] (already Flax layout), so
+# this family is exempt from the kernel transpose in both directions.
+_GPT2_RULES = [
+    (r"^(?:transformer\.)?wte$", r"backbone/wte"),
+    (r"^(?:transformer\.)?wpe$", r"backbone/wpe"),
+    (r"^(?:transformer\.)?h\.(\d+)\.ln_1$", r"backbone/h_\1/ln_1"),
+    (r"^(?:transformer\.)?h\.(\d+)\.attn\.c_attn$", r"backbone/h_\1/attention/qkv"),
+    (r"^(?:transformer\.)?h\.(\d+)\.attn\.c_proj$", r"backbone/h_\1/attention/attn_out"),
+    (r"^(?:transformer\.)?h\.(\d+)\.ln_2$", r"backbone/h_\1/ln_2"),
+    (r"^(?:transformer\.)?h\.(\d+)\.mlp\.c_fc$", r"backbone/h_\1/mlp/fc_in"),
+    (r"^(?:transformer\.)?h\.(\d+)\.mlp\.c_proj$", r"backbone/h_\1/mlp/fc_out"),
+    (r"^(?:transformer\.)?ln_f$", r"backbone/ln_f"),
+    # lm_head is tied to wte; a separately-saved one is the same array
+    (r"^lm_head$", r"backbone/wte"),
+]
+
 RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_RULES,
     "roberta": _ROBERTA_RULES,
@@ -165,7 +181,10 @@ RULES_BY_FAMILY: dict[str, list] = {
     "electra": _ELECTRA_RULES,
     "albert": _ALBERT_RULES,
     "t5": _T5_RULES,
+    "gpt2": _GPT2_RULES,
 }
+
+_NO_TRANSPOSE_FAMILIES = ("gpt2",)
 
 
 def load_hf_state_dict(model_dir: str) -> dict[str, np.ndarray]:
@@ -199,8 +218,9 @@ def translate_key(torch_key: str, family: str) -> str | None:
             leaf_name = base.rsplit("/", 1)[-1]
             is_embed = "word_embeddings" in base or "position_embeddings" in base \
                 or "token_type_embeddings" in base or "rel_bias" in base \
-                or base == "shared"
-            is_ln = leaf_name.endswith("_ln") or "layernorm" in leaf_name.lower()
+                or base == "shared" or leaf_name in ("wte", "wpe")
+            is_ln = leaf_name.endswith("_ln") or leaf_name.startswith("ln_") \
+                or "layernorm" in leaf_name.lower()
             if kind == "weight":
                 leaf = "embedding" if is_embed else ("scale" if is_ln else "kernel")
             elif kind == "bias":
@@ -219,7 +239,8 @@ def hf_to_params(state_dict: dict[str, np.ndarray], family: str) -> dict:
         if path is None:
             logger.info("convert: skipping unmapped key %s", torch_key)
             continue
-        if path.endswith("/kernel") and value.ndim == 2:
+        if path.endswith("/kernel") and value.ndim == 2 \
+                and family not in _NO_TRANSPOSE_FAMILIES:
             value = value.T  # torch Linear [out,in] → Flax Dense [in,out]
         parts = path.split("/")
         node = nested
@@ -388,6 +409,18 @@ _ALBERT_REVERSE = [
     (r"^classifier$", "classifier"),
 ]
 
+_GPT2_REVERSE = [
+    (r"^backbone/wte$", "transformer.wte"),
+    (r"^backbone/wpe$", "transformer.wpe"),
+    (r"^backbone/h_(\d+)/ln_1$", "transformer.h.{}.ln_1"),
+    (r"^backbone/h_(\d+)/attention/qkv$", "transformer.h.{}.attn.c_attn"),
+    (r"^backbone/h_(\d+)/attention/attn_out$", "transformer.h.{}.attn.c_proj"),
+    (r"^backbone/h_(\d+)/ln_2$", "transformer.h.{}.ln_2"),
+    (r"^backbone/h_(\d+)/mlp/fc_in$", "transformer.h.{}.mlp.c_fc"),
+    (r"^backbone/h_(\d+)/mlp/fc_out$", "transformer.h.{}.mlp.c_proj"),
+    (r"^backbone/ln_f$", "transformer.ln_f"),
+]
+
 REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_REVERSE,
     "roberta": _ROBERTA_REVERSE,
@@ -395,6 +428,7 @@ REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "electra": _ELECTRA_REVERSE,
     "albert": _ALBERT_REVERSE,
     "t5": _T5_REVERSE,
+    "gpt2": _GPT2_REVERSE,
 }
 
 
@@ -427,7 +461,8 @@ def params_to_hf(params: Any, family: str) -> dict[str, np.ndarray]:
             logger.info("export: skipping unmapped param %s", path)
             continue
         if leaf == "kernel":
-            out[torch_stem + ".weight"] = value.T if value.ndim == 2 else value
+            no_t = family in _NO_TRANSPOSE_FAMILIES or value.ndim != 2
+            out[torch_stem + ".weight"] = value if no_t else value.T
         elif leaf in ("scale", "embedding"):
             out[torch_stem + ".weight"] = value
         elif leaf == "bias":

@@ -83,18 +83,8 @@ def _generate_jit(model, params, input_ids, attention_mask, max_new_tokens,
             {"params": params, "cache": cache}, token, encoder_hidden,
             attention_mask, decode=True, deterministic=True,
             mutable=["cache"], method=model.decode)
-        logits = logits[:, -1, :].astype(jnp.float32)
-        if temperature == 0.0:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            logits = logits / temperature
-            if top_k:
-                logits = _filter_top_k(logits, top_k)
-            if top_p:
-                logits = _filter_top_p(logits, top_p)
-            rng, sub = jax.random.split(rng)
-            nxt = jax.random.categorical(sub, logits, axis=-1)
-            nxt = nxt.astype(jnp.int32)
+        nxt, rng = _sample_next(logits[:, -1, :].astype(jnp.float32),
+                                temperature, top_k, top_p, rng)
         nxt = jnp.where(finished, jnp.int32(cfg.pad_token_id), nxt)
         finished = finished | (nxt == cfg.eos_token_id)
         return (nxt[:, None], mutated["cache"], finished, rng), nxt
@@ -123,6 +113,97 @@ def generate(model, params, input_ids, attention_mask=None,
                          int(max_new_tokens), float(temperature),
                          jax.random.PRNGKey(seed), top_k=int(top_k),
                          top_p=float(top_p))
+
+
+def _sample_next(logits, temperature, top_k, top_p, rng):
+    """One sampling decision from [batch, vocab] fp32 logits; returns
+    (next_token int32 [batch], rng)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
+    logits = logits / temperature
+    if top_k:
+        logits = _filter_top_k(logits, top_k)
+    if top_p:
+        logits = _filter_top_p(logits, top_p)
+    rng, sub = jax.random.split(rng)
+    return jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32), rng
+
+
+@functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
+                                             "temperature", "top_k", "top_p"))
+def _generate_causal_jit(model, params, input_ids, attention_mask,
+                         max_new_tokens, temperature, rng, top_k=0, top_p=0.0):
+    """Decoder-only generation: one prefill pass writes the prompt into
+    the KV cache, then a jitted scan decodes token-by-token. Left-padded
+    prompts are supported: positions come from the padding-mask cumsum
+    and padded cache slots stay masked for the whole decode."""
+    cfg = model.config
+    B, P = input_ids.shape
+    total = P + max_new_tokens
+
+    # allocate full-length cache buffers (no writes on the init pass)
+    _, variables = model.apply(
+        {"params": params}, jnp.ones((B, total), jnp.int32), decode=True,
+        deterministic=True, mutable=["cache"])
+    cache = variables["cache"]
+
+    # kv-buffer validity: prompt mask + not-yet-generated zeros
+    valid = jnp.concatenate(
+        [attention_mask.astype(jnp.int32),
+         jnp.zeros((B, max_new_tokens), jnp.int32)], axis=1)
+    n_real = jnp.sum(attention_mask, axis=1).astype(jnp.int32)   # [B]
+
+    # prefill: logical positions from the mask (left-pad aware)
+    pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0).astype(jnp.int32)
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, input_ids, valid,
+        position_ids=pos, decode=True, deterministic=True, mutable=["cache"])
+    cache = mutated["cache"]
+    # per-row last REAL token (right- and left-padded prompts both work):
+    # left-padded rows end at index P-1, right-padded at n_real-1
+    last_real = jnp.where(attention_mask[:, -1] > 0, P - 1, n_real - 1)
+    last_logits = jnp.take_along_axis(
+        logits, last_real[:, None, None], axis=1)[:, 0].astype(jnp.float32)
+    first, rng = _sample_next(last_logits, temperature, top_k, top_p, rng)
+    finished = first == cfg.eos_token_id
+
+    def step(carry, t):
+        token, cache, valid, finished, rng = carry
+        cur = P + t
+        valid = lax.dynamic_update_slice(
+            valid, jnp.ones((B, 1), jnp.int32), (0, cur))
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, token[:, None], valid,
+            position_ids=(n_real + t)[:, None], decode=True,
+            deterministic=True, mutable=["cache"])
+        nxt, rng = _sample_next(logits[:, -1, :].astype(jnp.float32),
+                                temperature, top_k, top_p, rng)
+        nxt = jnp.where(finished, jnp.int32(cfg.pad_token_id), nxt)
+        finished = finished | (nxt == cfg.eos_token_id)
+        return (nxt, mutated["cache"], valid, finished, rng), nxt
+
+    carry = (first, cache, valid, finished, rng)
+    _, rest = lax.scan(step, carry, jnp.arange(max_new_tokens - 1),
+                       length=max_new_tokens - 1)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def generate_causal(model, params, input_ids, attention_mask=None,
+                    max_new_tokens: int = 64, temperature: float = 0.0,
+                    top_k: int = 0, top_p: float = 0.0, seed: int = 0) -> jax.Array:
+    """Decoder-only ``generate`` (GPT-2 family): greedy at
+    ``temperature=0``, otherwise temperature/top-k/top-p sampling.
+    Prompts may be left-padded (mark pads 0 in ``attention_mask``).
+    Returns [batch, max_new_tokens] continuation ids, ``pad_token_id``
+    after EOS."""
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if attention_mask is None:
+        attention_mask = jnp.ones_like(input_ids)
+    attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    return _generate_causal_jit(model, params, input_ids, attention_mask,
+                                int(max_new_tokens), float(temperature),
+                                jax.random.PRNGKey(seed), top_k=int(top_k),
+                                top_p=float(top_p))
 
 
 _NEG = jnp.float32(-1e9)

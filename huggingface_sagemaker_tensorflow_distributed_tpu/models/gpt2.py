@@ -1,0 +1,252 @@
+"""GPT-2: decoder-only causal language model.
+
+Extends the model zoo beyond the reference's BERT-family surface
+(reference ``scripts/train.py:117`` loads any
+``TFAutoModelForSequenceClassification``; the HF ecosystem the reference
+rides also ships decoder-only LMs — this is the TPU-native equivalent,
+SURVEY.md D7). Architecture: HF ``GPT2LMHeadModel`` parity —
+
+- learned token (wte) + position (wpe) embeddings, embedding dropout;
+- pre-LN blocks: ``x + attn(ln_1(x))`` then ``x + mlp(ln_2(x))``;
+- fused qkv projection (HF ``c_attn``; kept fused so the checkpoint
+  converts 1:1 — HF Conv1D stores [in, out], NO transpose on load);
+- gelu_new MLP, final ``ln_f``, LM head tied to wte.
+
+Causal masking runs through ``ops.attention.dot_product_attention``
+(causal=True), so training uses the Pallas flash kernel's
+diagonal-tile-skipping path on TPU. Decode uses the same incremental KV
+cache pattern as T5 (``"cache"`` collection, ``dynamic_update_slice``),
+driving ``models/generate.py::generate_causal``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import ACT2FN
+from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+    dot_product_attention,
+    make_attention_mask,
+)
+
+NEG_INF = -1e9
+
+
+@dataclass(frozen=True)
+class Gpt2Config:
+    vocab_size: int = 50257
+    max_position_embeddings: int = 1024   # HF n_positions
+    hidden_size: int = 768                # n_embd
+    num_layers: int = 12                  # n_layer
+    num_heads: int = 12                   # n_head
+    intermediate_size: int = 3072         # n_inner (4*n_embd default)
+    hidden_act: str = "gelu_new"
+    layer_norm_eps: float = 1e-5
+    hidden_dropout: float = 0.1           # resid_pdrop
+    embd_dropout: float = 0.1             # embd_pdrop
+    attention_dropout: float = 0.1        # attn_pdrop
+    initializer_range: float = 0.02
+    bos_token_id: int = 50256
+    eos_token_id: int = 50256
+    pad_token_id: int = 50256             # GPT-2 has no pad; HF uses eos
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"
+    remat: bool = False
+
+
+def gpt2_config_from_hf(hf_config: dict, **overrides) -> Gpt2Config:
+    kw = dict(
+        vocab_size=hf_config["vocab_size"],
+        max_position_embeddings=hf_config.get("n_positions", 1024),
+        hidden_size=hf_config["n_embd"],
+        num_layers=hf_config["n_layer"],
+        num_heads=hf_config["n_head"],
+        intermediate_size=hf_config.get("n_inner") or 4 * hf_config["n_embd"],
+        hidden_act=hf_config.get("activation_function", "gelu_new"),
+        layer_norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+        hidden_dropout=hf_config.get("resid_pdrop", 0.1),
+        embd_dropout=hf_config.get("embd_pdrop", 0.1),
+        attention_dropout=hf_config.get("attn_pdrop", 0.1),
+        initializer_range=hf_config.get("initializer_range", 0.02),
+        bos_token_id=hf_config.get("bos_token_id", 50256),
+        eos_token_id=hf_config.get("eos_token_id", 50256),
+        # explicit pad id 0 is valid — only None falls back to EOS
+        pad_token_id=(hf_config["pad_token_id"]
+                      if hf_config.get("pad_token_id") is not None
+                      else hf_config.get("eos_token_id", 50256)),
+    )
+    kw.update(overrides)
+    # MoE/pipeline knobs target EncoderConfig; GPT-2 does not support them
+    kw.pop("use_pooler", None)
+    return Gpt2Config(**kw)
+
+
+def _dense(cfg: Gpt2Config, features: int, name: str,
+           std: Optional[float] = None) -> nn.Dense:
+    return nn.Dense(
+        features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        kernel_init=nn.initializers.normal(std or cfg.initializer_range),
+        name=name)
+
+
+def _layernorm(cfg: Gpt2Config, name: str) -> nn.LayerNorm:
+    return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name=name)
+
+
+class Gpt2Attention(nn.Module):
+    """Fused-qkv causal self-attention with optional incremental cache."""
+
+    config: Gpt2Config
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, deterministic: bool = True,
+                 decode: bool = False):
+        cfg = self.config
+        H = cfg.hidden_size
+        head_dim = H // cfg.num_heads
+
+        qkv = _dense(cfg, 3 * H, "qkv")(hidden)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def split_heads(x):
+            b, s, _ = x.shape
+            return x.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = split_heads(q), split_heads(k), split_heads(v)
+
+        causal = True
+        if decode:
+            is_init = self.has_variable("cache", "cached_key")
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            cache_index = self.variable("cache", "cache_index",
+                                        lambda: jnp.array(0, jnp.int32))
+            if is_init:
+                cur = cache_index.value
+                max_len = cached_k.value.shape[2]
+                q_len = q.shape[2]
+                k = lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
+                v = lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
+                cached_k.value, cached_v.value = k, v
+                cache_index.value = cur + q_len
+                valid = jnp.arange(max_len)[None, :] <= (
+                    cur + jnp.arange(q_len)[:, None])
+                step_mask = jnp.where(valid, 0.0, NEG_INF)[None, None]
+                attn_mask = step_mask if attn_mask is None else attn_mask + step_mask
+                causal = False   # the step mask already encodes causality
+
+        ctx = dot_product_attention(q, k, v, mask=attn_mask,
+                                    impl=cfg.attention_impl, causal=causal)
+        b, h, s, d = ctx.shape
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        # HF init: c_proj scaled by 1/sqrt(2*n_layer) (residual-flow init)
+        out = _dense(cfg, H, "attn_out",
+                     std=cfg.initializer_range / (2 * cfg.num_layers) ** 0.5)(ctx)
+        out = nn.Dropout(cfg.hidden_dropout)(out, deterministic=deterministic)
+        return out
+
+
+class Gpt2Mlp(nn.Module):
+    config: Gpt2Config
+
+    @nn.compact
+    def __call__(self, hidden, deterministic: bool = True):
+        cfg = self.config
+        x = _dense(cfg, cfg.intermediate_size, "fc_in")(hidden)
+        x = ACT2FN[cfg.hidden_act](x)
+        x = _dense(cfg, cfg.hidden_size, "fc_out",
+                   std=cfg.initializer_range / (2 * cfg.num_layers) ** 0.5)(x)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        return x
+
+
+class Gpt2Block(nn.Module):
+    """Pre-LN transformer block (GPT-2 ordering)."""
+
+    config: Gpt2Config
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, deterministic: bool = True,
+                 decode: bool = False):
+        cfg = self.config
+        attn = Gpt2Attention(cfg, name="attention")(
+            _layernorm(cfg, "ln_1")(hidden), attn_mask, deterministic, decode)
+        hidden = hidden + attn
+        mlp = Gpt2Mlp(cfg, name="mlp")(
+            _layernorm(cfg, "ln_2")(hidden), deterministic)
+        return hidden + mlp
+
+
+class Gpt2Model(nn.Module):
+    """Backbone: embeddings + blocks + final LN. Returns (hidden, wte)
+    so the LM head can tie logits to the token embedding."""
+
+    config: Gpt2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        B, S = input_ids.shape
+
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="wte")
+        wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       embedding_init=nn.initializers.normal(cfg.initializer_range),
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="wpe")
+
+        if position_ids is None:
+            offset = 0
+            if decode:
+                # physical write position tracked alongside the KV caches
+                is_init = self.has_variable("cache", "position_index")
+                idx = self.variable("cache", "position_index",
+                                    lambda: jnp.array(0, jnp.int32))
+                if is_init:
+                    offset = idx.value
+                    idx.value = offset + S
+            position_ids = offset + jnp.arange(S)[None, :]
+
+        # training/prefill: [B, S] padding mask; decode: kv-buffer
+        # validity [B, max_len] — both become the additive form
+        additive_mask = (make_attention_mask(attention_mask)
+                         if attention_mask is not None else None)
+
+        x = wte(input_ids) + wpe(position_ids)
+        x = nn.Dropout(cfg.embd_dropout)(x, deterministic=deterministic)
+
+        block_cls = Gpt2Block
+        if cfg.remat:
+            block_cls = nn.remat(Gpt2Block, static_argnums=(3, 4))
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"h_{i}")(x, additive_mask, deterministic,
+                                              decode)
+        x = _layernorm(cfg, "ln_f")(x)
+        return x, wte.embedding
+
+
+class Gpt2LMHeadModel(nn.Module):
+    """GPT-2 with the tied LM head (HF ``GPT2LMHeadModel`` parity)."""
+
+    config: Gpt2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic: bool = True,
+                 decode: bool = False):
+        # token_type_ids accepted for trainer-signature parity; GPT-2 has
+        # no segment embeddings
+        hidden, embedding = Gpt2Model(self.config, name="backbone")(
+            input_ids, attention_mask, position_ids, deterministic, decode)
+        logits = jnp.einsum("bsh,vh->bsv", hidden,
+                            embedding.astype(self.config.dtype))
+        return logits.astype(jnp.float32)

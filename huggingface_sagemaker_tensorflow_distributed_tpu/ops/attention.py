@@ -40,22 +40,29 @@ def xla_attention(q, k, v, mask=None, scale=None):
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
 
 
-def dot_product_attention(q, k, v, mask=None, scale=None, impl: str = "xla"):
+def dot_product_attention(q, k, v, mask=None, scale=None, impl: str = "xla",
+                          causal: bool = False):
     """Dispatch on implementation tier. ``impl='flash'`` requires TPU;
     ``impl='ring'`` requires an ambient mesh with a ``seq`` axis
-    (``parallel.mesh.use_mesh`` / Trainer sets it)."""
+    (``parallel.mesh.use_mesh`` / Trainer sets it). ``causal`` applies
+    autoregressive masking in whichever tier is fastest for it (the
+    flash kernel skips above-diagonal tiles entirely)."""
     if impl == "flash":
         from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_attention import (
             flash_attention,
         )
-        return flash_attention(q, k, v, mask=mask, scale=scale)
+        return flash_attention(q, k, v, mask=mask, scale=scale, causal=causal)
     if impl == "ring":
         from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.ring_attention import (
             ring_attention_or_fallback,
         )
-        return ring_attention_or_fallback(q, k, v, mask=mask, scale=scale)
+        return ring_attention_or_fallback(q, k, v, mask=mask, scale=scale,
+                                          causal=causal)
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r} (xla | flash | ring)")
+    if causal:
+        cm = make_causal_mask(q.shape[2], k.shape[2])
+        mask = cm if mask is None else mask + cm
     return xla_attention(q, k, v, mask=mask, scale=scale)
 
 

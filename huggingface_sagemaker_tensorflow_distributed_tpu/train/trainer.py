@@ -138,11 +138,28 @@ def seq2seq_loss(apply_fn, params, batch, rngs, train: bool):
     return _masked_sums(per_tok, correct, token_valid)
 
 
+def causal_lm_loss(apply_fn, params, batch, rngs, train: bool):
+    """Next-token CE for decoder-only LMs (GPT-2 family): logits at
+    position i predict token i+1; pad targets (and padded eval rows)
+    are masked out. Metric is next-token accuracy."""
+    logits = _apply(apply_fn, params, batch, rngs, train)        # [B,S,V]
+    labels = batch["labels"][:, 1:]
+    logits = logits[:, :-1]
+    token_valid = (batch["attention_mask"][:, 1:] > 0) & (labels != -100)
+    if "valid" in batch:
+        token_valid = token_valid & (batch["valid"][:, None] > 0)
+    safe_labels = jnp.maximum(labels, 0)
+    per_tok = softmax_cross_entropy_with_integer_labels(logits, safe_labels)
+    correct = jnp.argmax(logits, -1) == safe_labels
+    return _masked_sums(per_tok, correct, token_valid)
+
+
 TASK_LOSSES: dict[str, Callable] = {
     "seq-cls": seq_cls_loss,
     "token-cls": token_cls_loss,
     "qa": qa_loss,
     "seq2seq": seq2seq_loss,
+    "causal-lm": causal_lm_loss,
 }
 
 

@@ -79,6 +79,11 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
         texts, labels = load_text_classification(config.dataset, split, **kw)
         _check_num_labels(labels, config.num_labels, config.task)
         return ArrayDataset.from_texts(tokenizer, texts, labels, max_len)
+    if config.task == "causal-lm":
+        # any text source works as an LM corpus; classification labels
+        # are simply ignored
+        texts, _ = load_text_classification(config.dataset, split, **kw)
+        return ArrayDataset.from_lm_texts(tokenizer, texts, max_len)
     if config.task == "token-cls":
         sents, tags = load_token_classification(config.dataset, split, **kw)
         _check_num_labels([t for ts in tags for t in ts], config.num_labels,
