@@ -184,7 +184,7 @@ class TrainConfig:
 
     def __post_init__(self):
         if self.task not in ("seq-cls", "token-cls", "qa", "seq2seq",
-                             "causal-lm"):
+                             "causal-lm", "mlm"):
             raise ValueError(f"unknown task {self.task!r}")
         if self.dtype not in ("bfloat16", "float32", "float16"):
             raise ValueError(f"unknown dtype {self.dtype!r}")

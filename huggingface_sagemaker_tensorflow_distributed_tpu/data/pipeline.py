@@ -77,6 +77,61 @@ class ArrayDataset:
         return cls(cols)
 
     @classmethod
+    def from_mlm_texts(cls, tokenizer, texts, max_length: int = 512,
+                       mlm_probability: float = 0.15, whole_word: bool = True,
+                       seed: int = 0) -> "ArrayDataset":
+        """Masked-LM corpus with (whole-word) masking — the pretraining
+        recipe behind the reference's default checkpoint
+        ``bert-large-uncased-whole-word-masking`` (reference
+        ``launch.py:17``). HF ``DataCollatorForWholeWordMask`` semantics:
+        ``mlm_probability`` of WORDS are chosen (every subword of a
+        chosen word is predicted); chosen tokens become [MASK] 80% /
+        random 10% / unchanged 10%; labels are -100 elsewhere. Masking
+        is drawn once at dataset build (static over epochs; HF redraws
+        per batch — one epoch of its stream)."""
+        import re as _re
+
+        mask_id = getattr(tokenizer, "mask_token_id", None)
+        if mask_id is None:
+            raise ValueError(
+                "tokenizer has no [MASK] token — MLM needs one "
+                "(BERT-family vocabs ship it)")
+        if hasattr(tokenizer, "encode_text_words"):
+            # HF fast tokenizers: native tokenization of the raw text
+            # (byte-BPE spacing preserved) + word_ids from the encoding
+            enc = tokenizer.encode_text_words(texts, max_length=max_length)
+        else:
+            words = [_re.findall(r"\w+|[^\w\s]", t) for t in texts]
+            enc = tokenizer.encode_words(words, max_length=max_length)
+        ids = np.asarray(enc["input_ids"], np.int32).copy()
+        am = np.asarray(enc["attention_mask"], np.int32)
+        wid = np.asarray(enc["word_ids"], np.int32)
+        labels = np.full_like(ids, -100)
+        rng = np.random.RandomState(seed)
+        vocab = int(getattr(tokenizer, "vocab_size"))
+        width = ids.shape[1]
+        for r in range(ids.shape[0]):
+            wmax = int(wid[r].max())
+            if wmax < 0:
+                continue
+            if whole_word:
+                chosen = rng.rand(wmax + 1) < mlm_probability
+                if not chosen.any():
+                    chosen[rng.randint(wmax + 1)] = True
+                sel = (wid[r] >= 0) & chosen[np.maximum(wid[r], 0)]
+            else:
+                sel = (wid[r] >= 0) & (rng.rand(width) < mlm_probability)
+                if not sel.any():
+                    cand = np.flatnonzero(wid[r] >= 0)
+                    sel[cand[rng.randint(len(cand))]] = True
+            labels[r, sel] = ids[r, sel]
+            action = rng.rand(width)
+            ids[r, sel & (action < 0.8)] = mask_id
+            do_rand = sel & (action >= 0.8) & (action < 0.9)
+            ids[r, do_rand] = rng.randint(0, vocab, int(do_rand.sum()))
+        return cls({"input_ids": ids, "attention_mask": am, "labels": labels})
+
+    @classmethod
     def from_lm_texts(cls, tokenizer, texts, max_length: int = 512) -> "ArrayDataset":
         """Causal-LM corpus: labels are the input ids themselves (the
         trainer's causal-lm loss shifts them); pad positions get -100."""

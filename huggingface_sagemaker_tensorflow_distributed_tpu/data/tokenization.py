@@ -48,6 +48,9 @@ class WordHashTokenizer:
         self.pad_token_id = 0
         self.cls_token_id = 1
         self.sep_token_id = 2
+        # the hash fallback has no reserved [MASK]; UNK (3) doubles as
+        # the mask token — fine for the synthetic/offline MLM tier
+        self.mask_token_id = 3
 
     def _word_id(self, word: str) -> int:
         digest = hashlib.md5(word.encode("utf-8")).digest()
@@ -190,6 +193,8 @@ class HFTokenizer:
             # pad = eos (pad positions are masked out everywhere anyway)
             hf_tokenizer.pad_token = hf_tokenizer.eos_token
         self.pad_token_id = hf_tokenizer.pad_token_id or 0
+        self.mask_token_id = hf_tokenizer.mask_token_id   # None for GPT-2
+        self.vocab_size = hf_tokenizer.vocab_size
 
     def __call__(self, texts, truncation: bool = True, padding: str = "max_length",
                  max_length: int | None = None, text_pairs=None,
@@ -213,6 +218,26 @@ class HFTokenizer:
                         padding="max_length", max_length=max_length,
                         return_tensors="np")
         n = len(word_lists)
+        word_ids = np.full((n, max_length), -1, np.int32)
+        for r in range(n):
+            for t, w in enumerate(out.word_ids(r)):
+                if w is not None:
+                    word_ids[r, t] = w
+        return {"input_ids": out["input_ids"].astype(np.int32),
+                "attention_mask": out["attention_mask"].astype(np.int32),
+                "word_ids": word_ids}
+
+    def encode_text_words(self, texts, max_length: int | None = None):
+        """RAW text → subword ids + word alignment. Unlike
+        ``encode_words`` this tokenizes the text natively (byte-BPE
+        spacing preserved — RoBERTa rejects pre-split input without
+        add_prefix_space, and pre-splitting would change its ids) and
+        reads word boundaries from the fast tokenizer, exactly like HF's
+        whole-word-mask collator."""
+        max_length = max_length or self.model_max_length
+        out = self._tok(texts, truncation=True, padding="max_length",
+                        max_length=max_length, return_tensors="np")
+        n = len(texts)
         word_ids = np.full((n, max_length), -1, np.int32)
         for r in range(n):
             for t, w in enumerate(out.word_ids(r)):

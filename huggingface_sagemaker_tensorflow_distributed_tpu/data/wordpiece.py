@@ -207,6 +207,9 @@ class WordPieceTokenizer:
         self.cls_token_id = vocab[cls_token]
         self.sep_token_id = vocab[sep_token]
         self.pad_token_id = vocab[pad_token]
+        # optional: BERT vocabs ship [MASK]; None when absent (MLM
+        # dataset building raises a clear error in that case)
+        self.mask_token_id = vocab.get("[MASK]")
         self.vocab_size = len(vocab)
 
     # -- core: overridden by the C++-backed subclass ------------------------

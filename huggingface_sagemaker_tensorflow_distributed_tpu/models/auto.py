@@ -67,6 +67,9 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("albert", "qa"): albert.AlbertForQuestionAnswering,
     ("t5", "seq2seq"): t5.T5ForConditionalGeneration,
     ("gpt2", "causal-lm"): gpt2.Gpt2LMHeadModel,
+    ("bert", "mlm"): bert.BertForMaskedLM,
+    ("roberta", "mlm"): roberta.RobertaForMaskedLM,
+    ("distilbert", "mlm"): distilbert.DistilBertForMaskedLM,
 }
 
 CONFIG_BUILDERS = {
@@ -196,7 +199,7 @@ def build_model(family: str, task: str, config: EncoderConfig, num_labels: int =
     cls = MODEL_REGISTRY.get((family, task))
     if cls is None:
         raise ValueError(f"no model for family={family!r} task={task!r}")
-    if task in ("qa", "seq2seq", "causal-lm"):
+    if task in ("qa", "seq2seq", "causal-lm", "mlm"):
         return cls(config)
     return cls(config, num_labels=num_labels)
 

@@ -49,6 +49,11 @@ _BERT_RULES = [
     (r"^(?:bert\.)?pooler\.dense$", r"backbone/pooler/pooler"),
     (r"^qa_outputs$", r"qa_outputs"),
     (r"^classifier$", r"classifier"),
+    # MLM head (BertForMaskedLM): decoder is tied to word_embeddings
+    # (decoder.* intentionally unmapped)
+    (r"^cls\.predictions\.transform\.dense$", r"mlm_head/transform"),
+    (r"^cls\.predictions\.transform\.LayerNorm$", r"mlm_head/ln"),
+    (r"^cls\.predictions$", r"mlm_head"),
 ]
 
 _ROBERTA_RULES = [
@@ -69,6 +74,10 @@ _ROBERTA_RULES = [
     (r"^classifier\.out_proj$", r"head/classifier"),
     (r"^qa_outputs$", r"qa_outputs"),
     (r"^classifier$", r"classifier"),  # token-cls head (no sub-keys)
+    # MLM head (RobertaForMaskedLM); lm_head.decoder tied → unmapped
+    (r"^lm_head\.dense$", r"mlm_head/transform"),
+    (r"^lm_head\.layer_norm$", r"mlm_head/ln"),
+    (r"^lm_head$", r"mlm_head"),
 ]
 
 _DISTILBERT_RULES = [
@@ -86,6 +95,12 @@ _DISTILBERT_RULES = [
     (r"^pre_classifier$", r"pre_classifier"),
     (r"^qa_outputs$", r"qa_outputs"),
     (r"^classifier$", r"classifier"),
+    # MLM head (DistilBertForMaskedLM); vocab_projector.weight is the
+    # tied embedding (its kernel lands on a path the template lacks and
+    # is dropped by merge), its bias is the output bias
+    (r"^vocab_transform$", r"mlm_head/transform"),
+    (r"^vocab_layer_norm$", r"mlm_head/ln"),
+    (r"^vocab_projector$", r"mlm_head"),
 ]
 
 # T5 layer indices: encoder layer.0=self-attn layer.1=FF;
@@ -311,6 +326,9 @@ _BERT_REVERSE = [
     (r"^backbone/pooler/pooler$", "bert.pooler.dense"),
     (r"^qa_outputs$", "qa_outputs"),
     (r"^classifier$", "classifier"),
+    (r"^mlm_head/transform$", "cls.predictions.transform.dense"),
+    (r"^mlm_head/ln$", "cls.predictions.transform.LayerNorm"),
+    (r"^mlm_head$", "cls.predictions"),
 ]
 
 _ROBERTA_REVERSE = [
@@ -330,6 +348,9 @@ _ROBERTA_REVERSE = [
     (r"^head/classifier$", "classifier.out_proj"),
     (r"^qa_outputs$", "qa_outputs"),
     (r"^classifier$", "classifier"),
+    (r"^mlm_head/transform$", "lm_head.dense"),
+    (r"^mlm_head/ln$", "lm_head.layer_norm"),
+    (r"^mlm_head$", "lm_head"),
 ]
 
 _DISTILBERT_REVERSE = [
@@ -347,6 +368,9 @@ _DISTILBERT_REVERSE = [
     (r"^pre_classifier$", "pre_classifier"),
     (r"^qa_outputs$", "qa_outputs"),
     (r"^classifier$", "classifier"),
+    (r"^mlm_head/transform$", "vocab_transform"),
+    (r"^mlm_head/ln$", "vocab_layer_norm"),
+    (r"^mlm_head$", "vocab_projector"),
 ]
 
 _T5_REVERSE = [

@@ -272,6 +272,28 @@ class Pooler(nn.Module):
         return jnp.tanh(_dense(cfg, cfg.hidden_size, "pooler")(cls))
 
 
+class MlmHead(nn.Module):
+    """Masked-LM prediction head: transform dense + activation + LN,
+    then a decoder TIED to the word-embedding table (passed in by the
+    family model, which reads it from its own bound variables) plus an
+    output bias — HF ``BertLMPredictionHead`` / ``RobertaLMHead`` /
+    DistilBERT ``vocab_transform``+``vocab_projector`` parity."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, hidden, embedding_table):
+        cfg = self.config
+        x = _dense(cfg, embedding_table.shape[1], "transform")(hidden)
+        x = ACT2FN[cfg.hidden_act](x)
+        x = _layernorm(cfg, "ln")(x)
+        logits = jnp.einsum("bsh,vh->bsv", x,
+                            embedding_table.astype(cfg.dtype))
+        bias = self.param("bias", nn.initializers.zeros,
+                          (embedding_table.shape[0],), cfg.param_dtype)
+        return (logits + bias.astype(cfg.dtype)).astype(jnp.float32)
+
+
 class EncoderBackbone(nn.Module):
     """Embeddings + encoder (+ pooler): the shared trunk for all heads."""
 

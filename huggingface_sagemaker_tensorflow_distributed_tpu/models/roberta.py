@@ -20,6 +20,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
     EncoderBackbone,
     EncoderConfig,
     _dense,
+    MlmHead,
 )
 
 
@@ -97,3 +98,21 @@ class RobertaForQuestionAnswering(nn.Module):
         logits = _dense(self.config, 2, "qa_outputs")(seq)
         start, end = jnp.split(logits, 2, axis=-1)
         return start[..., 0], end[..., 0]
+
+
+class RobertaForMaskedLM(nn.Module):
+    """Masked-LM head tied to the word embeddings (HF
+    ``RobertaForMaskedLM`` parity; covers whole-word-masking pretraining —
+    the reference's default checkpoint is
+    ``bert-large-uncased-whole-word-masking``, reference ``launch.py:17``)."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        table = self.variables["params"]["backbone"]["embeddings"][
+            "word_embeddings"]["embedding"]
+        return MlmHead(self.config, name="mlm_head")(seq, table)

@@ -160,6 +160,9 @@ TASK_LOSSES: dict[str, Callable] = {
     "qa": qa_loss,
     "seq2seq": seq2seq_loss,
     "causal-lm": causal_lm_loss,
+    # masked-LM: CE over the vocab at the masked positions only —
+    # exactly the token-cls shape (labels -100 everywhere else)
+    "mlm": token_cls_loss,
 }
 
 

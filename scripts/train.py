@@ -84,6 +84,10 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
         # are simply ignored
         texts, _ = load_text_classification(config.dataset, split, **kw)
         return ArrayDataset.from_lm_texts(tokenizer, texts, max_len)
+    if config.task == "mlm":
+        texts, _ = load_text_classification(config.dataset, split, **kw)
+        return ArrayDataset.from_mlm_texts(tokenizer, texts, max_len,
+                                           seed=config.seed)
     if config.task == "token-cls":
         sents, tags = load_token_classification(config.dataset, split, **kw)
         _check_num_labels([t for ts in tags for t in ts], config.num_labels,

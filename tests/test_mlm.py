@@ -1,0 +1,204 @@
+"""Masked-LM task tests: HF torch parity for the tied-decoder heads
+(BERT/RoBERTa/DistilBERT), whole-word masking statistics, and the mlm
+training path end to end (the pretraining recipe behind the reference's
+default checkpoint bert-large-uncased-whole-word-masking)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig  # noqa: E402
+from huggingface_sagemaker_tensorflow_distributed_tpu.data import (  # noqa: E402
+    ArrayDataset,
+    ShardedBatcher,
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (  # noqa: E402
+    synthetic_text_classification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models  # noqa: E402
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (  # noqa: E402
+    MeshConfig,
+    build_mesh,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer  # noqa: E402
+
+TOL = 2e-4
+
+
+def _inputs(vocab, batch=3, seq=12, seed=0):
+    r = np.random.RandomState(seed)
+    ids = r.randint(4, vocab, (batch, seq))
+    mask = np.ones((batch, seq), np.int64)
+    return ids, mask
+
+
+@pytest.mark.parametrize("family", ["bert", "roberta", "distilbert"])
+def test_mlm_head_parity(family, tmp_path):
+    torch.manual_seed(0)
+    if family == "bert":
+        cfg = transformers.BertConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)
+        m = transformers.BertForMaskedLM(cfg).eval()
+    elif family == "roberta":
+        cfg = transformers.RobertaConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=66, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0, pad_token_id=1)
+        m = transformers.RobertaForMaskedLM(cfg).eval()
+    else:
+        cfg = transformers.DistilBertConfig(
+            vocab_size=128, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+            max_position_embeddings=64, dropout=0.0, attention_dropout=0.0)
+        m = transformers.DistilBertForMaskedLM(cfg).eval()
+    d = str(tmp_path / family)
+    m.save_pretrained(d)
+
+    model, params, fam, _ = auto_models.from_pretrained(d, task="mlm")
+    assert fam == family
+    ids, mask = _inputs(128)
+    with torch.no_grad():
+        t_out = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+                        deterministic=True)
+    np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                               atol=TOL, rtol=1e-3)
+
+
+def test_whole_word_masking_statistics():
+    tok = WordHashTokenizer(vocab_size=512)
+    texts = ["the quick brown fox jumps over the lazy dog " * 4] * 50
+    ds = ArrayDataset.from_mlm_texts(tok, texts, max_length=48, seed=0)
+    ids = ds.columns["input_ids"]
+    labels = ds.columns["labels"]
+    am = ds.columns["attention_mask"]
+    masked = labels != -100
+    # ~15% of real tokens predicted (CLS/SEP excluded)
+    frac = masked.sum() / (am.sum() - 2 * len(texts))
+    assert 0.08 < frac < 0.25
+    # of the predicted positions, ~80% are the mask id
+    mask_frac = (ids[masked] == tok.mask_token_id).mean()
+    assert 0.6 < mask_frac < 0.95
+    # unmasked positions keep their ids and are ignored by the loss
+    assert np.all(labels[~masked] == -100)
+    # whole-word: every repetition of a chosen word is independent, but
+    # within one row a chosen word's token IS its whole word here (the
+    # hash tokenizer is one-token-per-word), so just verify every masked
+    # label was a real token
+    assert np.all(labels[masked] >= 0)
+
+
+def test_whole_word_masks_all_subwords(tmp_path):
+    """With a real subword tokenizer, every subword of a chosen word is
+    predicted together (the WWM property)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.wordpiece import (
+        WordPieceTokenizer,
+    )
+
+    vocab = {w: i for i, w in enumerate(
+        ["[PAD]", "[CLS]", "[SEP]", "[UNK]", "[MASK]",
+         "play", "##ing", "##ground", "the", "on"])}
+    tok = WordPieceTokenizer(vocab)
+    texts = ["playing on the playground"] * 30
+    ds = ArrayDataset.from_mlm_texts(tok, texts, max_length=12, seed=1)
+    labels = ds.columns["labels"]
+    enc = tok.encode_words([["playing", "on", "the", "playground"]] * 30,
+                           max_length=12)
+    wid = enc["word_ids"]
+    for r in range(len(texts)):
+        # for every word, its subword positions are either all predicted
+        # or none
+        for w in range(wid[r].max() + 1):
+            pos = wid[r] == w
+            flags = labels[r][pos] != -100
+            assert flags.all() or not flags.any()
+
+
+def test_mlm_with_hf_byte_bpe_tokenizer(tmp_path):
+    """RoBERTa-style byte-BPE fast tokenizer through from_mlm_texts:
+    must tokenize RAW text (pre-split input would be rejected without
+    add_prefix_space and would change the ids) and mask whole words."""
+    from tokenizers import ByteLevelBPETokenizer
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
+        HFTokenizer,
+    )
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the quick brown fox jumps over the lazy dog\n" * 50)
+    bpe = ByteLevelBPETokenizer()
+    bpe.train([str(corpus)], vocab_size=300, min_frequency=1,
+              special_tokens=["<s>", "<pad>", "</s>", "<unk>", "<mask>"])
+    bpe.save_model(str(tmp_path))
+    hf = transformers.RobertaTokenizerFast(
+        vocab_file=str(tmp_path / "vocab.json"),
+        merges_file=str(tmp_path / "merges.txt"),
+        model_max_length=32)
+    tok = HFTokenizer(hf)
+    assert tok.mask_token_id is not None
+
+    texts = ["the quick brown fox jumps over the lazy dog"] * 20
+    ds = ArrayDataset.from_mlm_texts(tok, texts, max_length=32, seed=0)
+    labels = ds.columns["labels"]
+    masked = labels != -100
+    assert masked.any()
+    # masked labels are real token ids from the natural tokenization
+    nat = hf(texts[0], return_tensors="np")["input_ids"][0]
+    assert set(labels[masked].tolist()) <= set(nat.tolist())
+
+
+def test_mlm_training_learns(devices8):
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(64, seed=0)
+    ds = ArrayDataset.from_mlm_texts(tok, texts, max_length=16, seed=0)
+    mesh = build_mesh(MeshConfig(), devices=devices8)
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+        BertForMaskedLM,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+
+    model_cfg = EncoderConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                              num_heads=4, intermediate_size=64,
+                              max_position_embeddings=16, hidden_dropout=0.0,
+                              attention_dropout=0.0, use_pooler=False)
+    model = BertForMaskedLM(model_cfg)
+    params = init_params(model, model_cfg)
+    cfg = TrainConfig(task="mlm", dtype="float32", learning_rate=5e-3,
+                      scale_lr_by_world_size=False, log_every_steps=0,
+                      rng_impl="threefry", epochs=3)
+    trainer = Trainer(cfg, model, params, mesh)
+    batcher = ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0)
+    history = trainer.fit(batcher)
+    assert history["loss"][-1] < history["loss"][0] * 0.9
+
+
+def test_mlm_export_reloads_in_hf(tmp_path):
+    """Our MLM export loads back into HF torch with identical logits
+    (tied decoder reconstructed by HF's tie_weights)."""
+    torch.manual_seed(0)
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = transformers.BertForMaskedLM(cfg).eval()
+    d = str(tmp_path / "src")
+    m.save_pretrained(d)
+    model, params, fam, our_cfg = auto_models.from_pretrained(d, task="mlm")
+    out = str(tmp_path / "export")
+    auto_models.save_pretrained(out, params, fam, our_cfg)
+    m2 = transformers.BertForMaskedLM.from_pretrained(out).eval()
+    ids, mask = _inputs(128)
+    with torch.no_grad():
+        a = m(input_ids=torch.tensor(ids)).logits.numpy()
+        b = m2(input_ids=torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(b, a, atol=1e-5)
