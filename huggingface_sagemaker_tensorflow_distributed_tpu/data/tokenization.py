@@ -210,14 +210,9 @@ class HFTokenizer:
         return res
 
 
-    def encode_words(self, word_lists, max_length: int | None = None):
-        """Pre-split words → subword ids + word alignment (fast-tokenizer
-        ``word_ids()``; -1 for specials/pads)."""
-        max_length = max_length or self.model_max_length
-        out = self._tok(word_lists, is_split_into_words=True, truncation=True,
-                        padding="max_length", max_length=max_length,
-                        return_tensors="np")
-        n = len(word_lists)
+    def _with_word_ids(self, out, n: int, max_length: int):
+        """Pack a fast-tokenizer BatchEncoding into our ids/mask/word_ids
+        contract (-1 for specials/pads)."""
         word_ids = np.full((n, max_length), -1, np.int32)
         for r in range(n):
             for t, w in enumerate(out.word_ids(r)):
@@ -226,6 +221,15 @@ class HFTokenizer:
         return {"input_ids": out["input_ids"].astype(np.int32),
                 "attention_mask": out["attention_mask"].astype(np.int32),
                 "word_ids": word_ids}
+
+    def encode_words(self, word_lists, max_length: int | None = None):
+        """Pre-split words → subword ids + word alignment (fast-tokenizer
+        ``word_ids()``; -1 for specials/pads)."""
+        max_length = max_length or self.model_max_length
+        out = self._tok(word_lists, is_split_into_words=True, truncation=True,
+                        padding="max_length", max_length=max_length,
+                        return_tensors="np")
+        return self._with_word_ids(out, len(word_lists), max_length)
 
     def encode_text_words(self, texts, max_length: int | None = None):
         """RAW text → subword ids + word alignment. Unlike
@@ -237,15 +241,7 @@ class HFTokenizer:
         max_length = max_length or self.model_max_length
         out = self._tok(texts, truncation=True, padding="max_length",
                         max_length=max_length, return_tensors="np")
-        n = len(texts)
-        word_ids = np.full((n, max_length), -1, np.int32)
-        for r in range(n):
-            for t, w in enumerate(out.word_ids(r)):
-                if w is not None:
-                    word_ids[r, t] = w
-        return {"input_ids": out["input_ids"].astype(np.int32),
-                "attention_mask": out["attention_mask"].astype(np.int32),
-                "word_ids": word_ids}
+        return self._with_word_ids(out, len(texts), max_length)
 
     def encode_qa(self, questions, contexts, start_chars, answer_texts,
                   max_length: int | None = None):

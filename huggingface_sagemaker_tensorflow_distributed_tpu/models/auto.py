@@ -30,6 +30,7 @@ import numpy as np
 from huggingface_sagemaker_tensorflow_distributed_tpu.models import (
     albert,
     bert,
+    deberta,
     distilbert,
     electra,
     gpt2,
@@ -70,6 +71,9 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("bert", "mlm"): bert.BertForMaskedLM,
     ("roberta", "mlm"): roberta.RobertaForMaskedLM,
     ("distilbert", "mlm"): distilbert.DistilBertForMaskedLM,
+    ("deberta-v2", "seq-cls"): deberta.DebertaV2ForSequenceClassification,
+    ("deberta-v2", "token-cls"): deberta.DebertaV2ForTokenClassification,
+    ("deberta-v2", "qa"): deberta.DebertaV2ForQuestionAnswering,
 }
 
 CONFIG_BUILDERS = {
@@ -80,6 +84,7 @@ CONFIG_BUILDERS = {
     "albert": albert.albert_config_from_hf,
     "t5": t5.t5_config_from_hf,
     "gpt2": gpt2.gpt2_config_from_hf,
+    "deberta-v2": deberta.deberta_config_from_hf,
 }
 
 # Our config → HF config.json for export
@@ -147,6 +152,34 @@ _HF_CONFIG_EXPORTERS = {
         "hidden_dropout_prob": c.hidden_dropout,
         "attention_probs_dropout_prob": c.attention_dropout,
         "pad_token_id": c.pad_token_id, "initializer_range": c.initializer_range,
+    },
+    "deberta-v2": lambda c: {
+        "model_type": "deberta-v2",
+        "architectures": ["DebertaV2ForSequenceClassification"],
+        "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_layers, "num_attention_heads": c.num_heads,
+        "intermediate_size": c.intermediate_size,
+        "max_position_embeddings": c.max_position_embeddings,
+        "type_vocab_size": c.type_vocab_size, "hidden_act": c.hidden_act,
+        "layer_norm_eps": c.layer_norm_eps,
+        "hidden_dropout_prob": c.hidden_dropout,
+        "attention_probs_dropout_prob": c.attention_dropout,
+        "pooler_dropout": c.pooler_dropout,
+        "pooler_hidden_act": c.pooler_hidden_act,
+        "pooler_hidden_size": c.hidden_size,
+        "pad_token_id": c.pad_token_id,
+        "initializer_range": c.initializer_range,
+        "embedding_size": c.embedding_size or c.hidden_size,
+        "position_biased_input": c.position_biased_input,
+        "relative_attention": c.relative_attention,
+        "position_buckets": c.position_buckets,
+        "max_relative_positions": c.max_relative_positions,
+        "share_att_key": c.share_att_key,
+        "pos_att_type": list(c.pos_att_type),
+        "norm_rel_ebd": c.norm_rel_ebd,
+        **({"conv_kernel_size": c.conv_kernel_size,
+            "conv_act": c.conv_act, "conv_groups": c.conv_groups}
+           if c.conv_kernel_size else {}),
     },
     "gpt2": lambda c: {
         "model_type": "gpt2", "architectures": ["GPT2LMHeadModel"],

@@ -173,6 +173,32 @@ _ALBERT_RULES = [
     (r"^classifier$", r"classifier"),
 ]
 
+
+_DEBERTA_V2_RULES = [
+    (r"^(?:deberta\.)?embeddings\.word_embeddings$", r"backbone/word_embeddings"),
+    (r"^(?:deberta\.)?embeddings\.position_embeddings$", r"backbone/position_embeddings"),
+    (r"^(?:deberta\.)?embeddings\.token_type_embeddings$", r"backbone/token_type_embeddings"),
+    (r"^(?:deberta\.)?embeddings\.embed_proj$", r"backbone/embed_proj"),
+    (r"^(?:deberta\.)?embeddings\.LayerNorm$", r"backbone/embeddings_ln"),
+    (r"^(?:deberta\.)?encoder\.rel_embeddings$", r"backbone/rel_embeddings"),
+    (r"^(?:deberta\.)?encoder\.LayerNorm$", r"backbone/rel_ln"),
+    (r"^(?:deberta\.)?encoder\.conv\.conv$", r"backbone/conv/conv"),
+    (r"^(?:deberta\.)?encoder\.conv\.LayerNorm$", r"backbone/conv/conv_ln"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.attention\.self\.query_proj$", r"backbone/layer_\1/attention/query"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.attention\.self\.key_proj$", r"backbone/layer_\1/attention/key"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.attention\.self\.value_proj$", r"backbone/layer_\1/attention/value"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.attention\.self\.pos_key_proj$", r"backbone/layer_\1/attention/pos_key"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.attention\.self\.pos_query_proj$", r"backbone/layer_\1/attention/pos_query"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.attention\.output\.dense$", r"backbone/layer_\1/attention_out"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.attention\.output\.LayerNorm$", r"backbone/layer_\1/attention_ln"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.intermediate\.dense$", r"backbone/layer_\1/intermediate"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.output\.dense$", r"backbone/layer_\1/ffn_out"),
+    (r"^(?:deberta\.)?encoder\.layer\.(\d+)\.output\.LayerNorm$", r"backbone/layer_\1/ffn_ln"),
+    (r"^pooler\.dense$", r"pooler"),
+    (r"^qa_outputs$", r"qa_outputs"),
+    (r"^classifier$", r"classifier"),
+]
+
 # GPT-2: HF Conv1D stores weights [in, out] (already Flax layout), so
 # this family is exempt from the kernel transpose in both directions.
 _GPT2_RULES = [
@@ -197,6 +223,7 @@ RULES_BY_FAMILY: dict[str, list] = {
     "albert": _ALBERT_RULES,
     "t5": _T5_RULES,
     "gpt2": _GPT2_RULES,
+    "deberta-v2": _DEBERTA_V2_RULES,
 }
 
 _NO_TRANSPOSE_FAMILIES = ("gpt2",)
@@ -233,9 +260,10 @@ def translate_key(torch_key: str, family: str) -> str | None:
             leaf_name = base.rsplit("/", 1)[-1]
             is_embed = "word_embeddings" in base or "position_embeddings" in base \
                 or "token_type_embeddings" in base or "rel_bias" in base \
-                or base == "shared" or leaf_name in ("wte", "wpe")
+                or "rel_embeddings" in base or base == "shared" \
+                or leaf_name in ("wte", "wpe")
             is_ln = leaf_name.endswith("_ln") or leaf_name.startswith("ln_") \
-                or "layernorm" in leaf_name.lower()
+                or leaf_name == "ln" or "layernorm" in leaf_name.lower()
             if kind == "weight":
                 leaf = "embedding" if is_embed else ("scale" if is_ln else "kernel")
             elif kind == "bias":
@@ -257,6 +285,8 @@ def hf_to_params(state_dict: dict[str, np.ndarray], family: str) -> dict:
         if path.endswith("/kernel") and value.ndim == 2 \
                 and family not in _NO_TRANSPOSE_FAMILIES:
             value = value.T  # torch Linear [out,in] → Flax Dense [in,out]
+        elif path.endswith("/kernel") and value.ndim == 3:
+            value = value.transpose(2, 1, 0)  # Conv1d [out,in,k] → [k,in,out]
         parts = path.split("/")
         node = nested
         for p in parts[:-1]:
@@ -445,6 +475,32 @@ _GPT2_REVERSE = [
     (r"^backbone/ln_f$", "transformer.ln_f"),
 ]
 
+
+_DEBERTA_V2_REVERSE = [
+    (r"^backbone/word_embeddings$", "deberta.embeddings.word_embeddings"),
+    (r"^backbone/position_embeddings$", "deberta.embeddings.position_embeddings"),
+    (r"^backbone/token_type_embeddings$", "deberta.embeddings.token_type_embeddings"),
+    (r"^backbone/embed_proj$", "deberta.embeddings.embed_proj"),
+    (r"^backbone/embeddings_ln$", "deberta.embeddings.LayerNorm"),
+    (r"^backbone/rel_embeddings$", "deberta.encoder.rel_embeddings"),
+    (r"^backbone/rel_ln$", "deberta.encoder.LayerNorm"),
+    (r"^backbone/conv/conv$", "deberta.encoder.conv.conv"),
+    (r"^backbone/conv/conv_ln$", "deberta.encoder.conv.LayerNorm"),
+    (r"^backbone/layer_(\d+)/attention/query$", "deberta.encoder.layer.{}.attention.self.query_proj"),
+    (r"^backbone/layer_(\d+)/attention/key$", "deberta.encoder.layer.{}.attention.self.key_proj"),
+    (r"^backbone/layer_(\d+)/attention/value$", "deberta.encoder.layer.{}.attention.self.value_proj"),
+    (r"^backbone/layer_(\d+)/attention/pos_key$", "deberta.encoder.layer.{}.attention.self.pos_key_proj"),
+    (r"^backbone/layer_(\d+)/attention/pos_query$", "deberta.encoder.layer.{}.attention.self.pos_query_proj"),
+    (r"^backbone/layer_(\d+)/attention_out$", "deberta.encoder.layer.{}.attention.output.dense"),
+    (r"^backbone/layer_(\d+)/attention_ln$", "deberta.encoder.layer.{}.attention.output.LayerNorm"),
+    (r"^backbone/layer_(\d+)/intermediate$", "deberta.encoder.layer.{}.intermediate.dense"),
+    (r"^backbone/layer_(\d+)/ffn_out$", "deberta.encoder.layer.{}.output.dense"),
+    (r"^backbone/layer_(\d+)/ffn_ln$", "deberta.encoder.layer.{}.output.LayerNorm"),
+    (r"^pooler$", "pooler.dense"),
+    (r"^qa_outputs$", "qa_outputs"),
+    (r"^classifier$", "classifier"),
+]
+
 REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_REVERSE,
     "roberta": _ROBERTA_REVERSE,
@@ -453,6 +509,7 @@ REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "albert": _ALBERT_REVERSE,
     "t5": _T5_REVERSE,
     "gpt2": _GPT2_REVERSE,
+    "deberta-v2": _DEBERTA_V2_REVERSE,
 }
 
 
@@ -485,8 +542,11 @@ def params_to_hf(params: Any, family: str) -> dict[str, np.ndarray]:
             logger.info("export: skipping unmapped param %s", path)
             continue
         if leaf == "kernel":
-            no_t = family in _NO_TRANSPOSE_FAMILIES or value.ndim != 2
-            out[torch_stem + ".weight"] = value if no_t else value.T
+            if value.ndim == 3:
+                out[torch_stem + ".weight"] = value.transpose(2, 1, 0)
+            else:
+                no_t = family in _NO_TRANSPOSE_FAMILIES or value.ndim != 2
+                out[torch_stem + ".weight"] = value if no_t else value.T
         elif leaf in ("scale", "embedding"):
             out[torch_stem + ".weight"] = value
         elif leaf == "bias":

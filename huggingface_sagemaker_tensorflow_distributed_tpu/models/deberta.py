@@ -1,0 +1,393 @@
+"""DeBERTa-v2/v3: disentangled-attention encoders + task heads.
+
+Extends the model zoo beyond the reference's BERT surface (reference
+``scripts/train.py:117`` accepts any HF seq-cls checkpoint; DeBERTa-v3 is
+the strongest open encoder family on GLUE — SURVEY.md D7). HF
+``DebertaV2Model`` parity:
+
+- **Disentangled attention**: content-to-content scores plus
+  content→position (c2p) and position→content (p2c) terms computed from
+  a shared relative-position embedding table with log-bucketed distances
+  (``make_log_bucket_position``), each scaled by
+  ``sqrt(head_dim * (1 + |pos_att_type|))``. v3 shares the content
+  query/key projections for the position terms (``share_att_key``).
+- Embeddings: word (+ optional absolute positions when
+  ``position_biased_input``) + LN, pad positions zeroed, optional
+  ``embed_proj`` when ``embedding_size != hidden_size``.
+- Encoder-level rel-embedding table with optional LayerNorm
+  (``norm_rel_ebd``), optional depthwise-ish ConvLayer merged after the
+  first encoder layer (deberta-v2-xlarge).
+
+The score grid is [B, H, Q, K] with two gathers per layer — inherently
+materializing, so this family runs the XLA attention formulation (a
+flash-style kernel would need the gathers fused; not attempted).
+Numerics verified against HF torch in ``tests/test_deberta.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import ACT2FN
+
+NEG_INF = -1e9
+
+
+@dataclass(frozen=True)
+class DebertaV2Config:
+    vocab_size: int = 128100
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 0
+    hidden_act: str = "gelu"
+    layer_norm_eps: float = 1e-7
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    pooler_dropout: float = 0.0
+    pooler_hidden_act: str = "gelu"
+    classifier_dropout: Optional[float] = None   # HF cls_dropout/drop_out
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+    embedding_size: Optional[int] = None
+    position_biased_input: bool = True
+    relative_attention: bool = True
+    position_buckets: int = 256
+    max_relative_positions: int = -1             # -1: max_position_embeddings
+    share_att_key: bool = True
+    pos_att_type: tuple = ("c2p", "p2c")
+    norm_rel_ebd: str = "layer_norm"
+    conv_kernel_size: int = 0                    # 0 = no ConvLayer
+    conv_act: str = "tanh"
+    conv_groups: int = 1
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"                  # disentangled → xla only
+    remat: bool = False
+
+    @property
+    def pos_ebd_size(self) -> int:
+        maxp = (self.max_relative_positions if self.max_relative_positions > 0
+                else self.max_position_embeddings)
+        return self.position_buckets if self.position_buckets > 0 else maxp
+
+
+def deberta_config_from_hf(hf_config: dict, **overrides) -> DebertaV2Config:
+    kw = dict(
+        vocab_size=hf_config["vocab_size"],
+        hidden_size=hf_config["hidden_size"],
+        num_layers=hf_config["num_hidden_layers"],
+        num_heads=hf_config["num_attention_heads"],
+        intermediate_size=hf_config["intermediate_size"],
+        max_position_embeddings=hf_config["max_position_embeddings"],
+        type_vocab_size=hf_config.get("type_vocab_size", 0),
+        hidden_act=hf_config.get("hidden_act", "gelu"),
+        layer_norm_eps=hf_config.get("layer_norm_eps", 1e-7),
+        hidden_dropout=hf_config.get("hidden_dropout_prob", 0.1),
+        attention_dropout=hf_config.get("attention_probs_dropout_prob", 0.1),
+        pooler_dropout=hf_config.get("pooler_dropout", 0.0),
+        pooler_hidden_act=hf_config.get("pooler_hidden_act", "gelu"),
+        classifier_dropout=hf_config.get("cls_dropout"),
+        initializer_range=hf_config.get("initializer_range", 0.02),
+        pad_token_id=hf_config.get("pad_token_id", 0),
+        embedding_size=hf_config.get("embedding_size"),
+        position_biased_input=hf_config.get("position_biased_input", True),
+        relative_attention=hf_config.get("relative_attention", False),
+        position_buckets=hf_config.get("position_buckets", -1),
+        max_relative_positions=hf_config.get("max_relative_positions", -1),
+        share_att_key=hf_config.get("share_att_key", False),
+        # hub configs store pos_att_type as "c2p|p2c" (HF splits the
+        # string for backwards compatibility — so must we)
+        pos_att_type=tuple(
+            x.strip() for x in pat.split("|")) if isinstance(
+            (pat := hf_config.get("pos_att_type") or ()), str)
+        else tuple(pat),
+        norm_rel_ebd=hf_config.get("norm_rel_ebd", "none"),
+        conv_kernel_size=hf_config.get("conv_kernel_size", 0) or 0,
+        conv_act=hf_config.get("conv_act", "tanh"),
+        conv_groups=hf_config.get("conv_groups", 1),
+    )
+    kw.update(overrides)
+    kw.pop("use_pooler", None)
+    return DebertaV2Config(**kw)
+
+
+def make_log_bucket_position(rel, bucket_size: int, max_position: int):
+    """HF ``make_log_bucket_position``: linear within ±bucket/2,
+    log-spaced beyond, clamped sign-symmetric."""
+    sign = jnp.sign(rel)
+    mid = bucket_size // 2
+    abs_pos = jnp.where((rel < mid) & (rel > -mid), mid - 1,
+                        jnp.abs(rel)).astype(jnp.float32)
+    log_pos = jnp.ceil(
+        jnp.log(abs_pos / mid) / math.log((max_position - 1) / mid)
+        * (mid - 1)) + mid
+    return jnp.where(abs_pos <= mid, rel.astype(jnp.float32),
+                     log_pos * sign).astype(jnp.int32)
+
+
+def build_relative_position(q_len: int, k_len: int, bucket_size: int,
+                            max_position: int):
+    """[q_len, k_len] int32 relative positions (bucketed when enabled)."""
+    rel = jnp.arange(q_len)[:, None] - jnp.arange(k_len)[None, :]
+    if bucket_size > 0 and max_position > 0:
+        rel = make_log_bucket_position(rel, bucket_size, max_position)
+    return rel.astype(jnp.int32)
+
+
+def _dense(cfg, features: int, name: str, use_bias: bool = True) -> nn.Dense:
+    return nn.Dense(features, use_bias=use_bias, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    kernel_init=nn.initializers.normal(cfg.initializer_range),
+                    name=name)
+
+
+def _layernorm(cfg, name: str) -> nn.LayerNorm:
+    return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name=name)
+
+
+class DisentangledSelfAttention(nn.Module):
+    """HF ``DisentangledSelfAttention`` parity (self-attention form)."""
+
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, hidden, qk_mask, rel_embeddings,
+                 deterministic: bool = True):
+        cfg = self.config
+        H, heads = cfg.hidden_size, cfg.num_heads
+        head_dim = H // heads
+        B, S, _ = hidden.shape
+
+        def split(x, length):
+            return x.reshape(B, length, heads, head_dim).transpose(0, 2, 1, 3)
+
+        query_proj = _dense(cfg, H, "query")
+        key_proj = _dense(cfg, H, "key")
+        q = split(query_proj(hidden), S)
+        k = split(key_proj(hidden), S)
+        v = split(_dense(cfg, H, "value")(hidden), S)
+
+        scale_factor = 1 + len(cfg.pos_att_type)
+        scale = math.sqrt(head_dim * scale_factor)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / scale
+
+        if cfg.relative_attention and cfg.pos_att_type:
+            span = cfg.pos_ebd_size
+            maxp = (cfg.max_relative_positions if cfg.max_relative_positions > 0
+                    else cfg.max_position_embeddings)
+            rel_pos = build_relative_position(S, S, cfg.position_buckets, maxp)
+            rel = nn.Dropout(cfg.hidden_dropout)(rel_embeddings,
+                                                 deterministic=deterministic)
+            rel = rel[: span * 2][None]                     # [1, 2*span, H]
+
+            if cfg.share_att_key:
+                # v3: the position terms reuse the CONTENT projections
+                # (same module instances → same params)
+                pos_key = key_proj(rel)
+                pos_query = query_proj(rel)
+            else:
+                pos_key = (_dense(cfg, H, "pos_key")(rel)
+                           if "c2p" in cfg.pos_att_type else None)
+                pos_query = (_dense(cfg, H, "pos_query")(rel)
+                             if "p2c" in cfg.pos_att_type else None)
+
+            def split_pos(x):
+                return x.reshape(1, 2 * span, heads, head_dim).transpose(0, 2, 1, 3)
+
+            if "c2p" in cfg.pos_att_type:
+                pk = split_pos(pos_key)                     # [1,h,2s,d]
+                c2p = jnp.einsum("bhqd,xhkd->bhqk", q, pk).astype(jnp.float32)
+                idx = jnp.clip(rel_pos + span, 0, span * 2 - 1)  # [S,S]
+                c2p = jnp.take_along_axis(
+                    c2p, jnp.broadcast_to(idx[None, None], (B, heads, S, S)),
+                    axis=-1)
+                scores = scores + c2p / scale
+            if "p2c" in cfg.pos_att_type:
+                pq = split_pos(pos_query)
+                p2c = jnp.einsum("bhkd,xhqd->bhkq", k, pq).astype(jnp.float32)
+                idx = jnp.clip(-rel_pos + span, 0, span * 2 - 1)
+                p2c = jnp.take_along_axis(
+                    p2c, jnp.broadcast_to(idx[None, None], (B, heads, S, S)),
+                    axis=-1)
+                scores = scores + p2c.transpose(0, 1, 3, 2) / scale
+
+        scores = jnp.where(qk_mask, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        probs = nn.Dropout(cfg.attention_dropout)(probs,
+                                                  deterministic=deterministic)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, heads * head_dim)
+        return ctx
+
+
+class DebertaLayer(nn.Module):
+    """Post-LN layer: disentangled attention + FFN (HF DebertaV2Layer)."""
+
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, hidden, qk_mask, rel_embeddings,
+                 deterministic: bool = True):
+        cfg = self.config
+        attn = DisentangledSelfAttention(cfg, name="attention")(
+            hidden, qk_mask, rel_embeddings, deterministic)
+        attn = _dense(cfg, cfg.hidden_size, "attention_out")(attn)
+        attn = nn.Dropout(cfg.hidden_dropout)(attn, deterministic=deterministic)
+        hidden = _layernorm(cfg, "attention_ln")(hidden + attn)
+        x = _dense(cfg, cfg.intermediate_size, "intermediate")(hidden)
+        x = ACT2FN[cfg.hidden_act](x)
+        x = _dense(cfg, cfg.hidden_size, "ffn_out")(x)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        return _layernorm(cfg, "ffn_ln")(hidden + x)
+
+
+class DebertaConv(nn.Module):
+    """HF ``ConvLayer``: conv over tokens merged into the first layer's
+    output through a LayerNorm residual."""
+
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, initial_hidden, layer0_out, input_mask,
+                 deterministic: bool = True):
+        cfg = self.config
+        conv = nn.Conv(cfg.hidden_size, (cfg.conv_kernel_size,),
+                       padding="SAME", feature_group_count=cfg.conv_groups,
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="conv")(initial_hidden)
+        conv = conv * input_mask[..., None].astype(conv.dtype)
+        conv = ACT2FN[cfg.conv_act](
+            nn.Dropout(cfg.hidden_dropout)(conv, deterministic=deterministic))
+        out = _layernorm(cfg, "conv_ln")(layer0_out + conv)
+        return out * input_mask[..., None].astype(out.dtype)
+
+
+class DebertaBackbone(nn.Module):
+    """Embeddings + disentangled encoder; returns final hidden states."""
+
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        emb_size = cfg.embedding_size or cfg.hidden_size
+
+        x = nn.Embed(cfg.vocab_size, emb_size,
+                     embedding_init=nn.initializers.normal(cfg.initializer_range),
+                     dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="word_embeddings")(input_ids)
+        if cfg.position_biased_input:
+            pos = nn.Embed(cfg.max_position_embeddings, emb_size,
+                           embedding_init=nn.initializers.normal(cfg.initializer_range),
+                           dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                           name="position_embeddings")(jnp.arange(S)[None, :])
+            x = x + pos
+        if cfg.type_vocab_size > 0:
+            if token_type_ids is None:
+                token_type_ids = jnp.zeros_like(input_ids)
+            x = x + nn.Embed(cfg.type_vocab_size, emb_size,
+                             embedding_init=nn.initializers.normal(cfg.initializer_range),
+                             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                             name="token_type_embeddings")(token_type_ids)
+        if emb_size != cfg.hidden_size:
+            x = _dense(cfg, cfg.hidden_size, "embed_proj", use_bias=False)(x)
+        x = _layernorm(cfg, "embeddings_ln")(x)
+        x = x * attention_mask[..., None].astype(x.dtype)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+
+        # rel-embedding table (encoder-level, shared by all layers);
+        # declared as an Embed so the param path ends in /embedding like
+        # every other table (conversion + sharding rules line up)
+        rel_embeddings = None
+        if cfg.relative_attention:
+            rel_embeddings = nn.Embed(
+                cfg.pos_ebd_size * 2, cfg.hidden_size,
+                embedding_init=nn.initializers.normal(cfg.initializer_range),
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="rel_embeddings").embedding.astype(cfg.dtype)
+            if "layer_norm" in cfg.norm_rel_ebd:
+                rel_embeddings = _layernorm(cfg, "rel_ln")(rel_embeddings)
+
+        # DeBERTa masks both query and key validity
+        m = attention_mask.astype(bool)
+        qk_mask = m[:, None, None, :] & m[:, None, :, None]
+
+        initial = x
+        layer_cls = DebertaLayer
+        if cfg.remat:
+            layer_cls = nn.remat(DebertaLayer, static_argnums=(4,))
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, qk_mask, rel_embeddings,
+                                                  deterministic)
+            if i == 0 and cfg.conv_kernel_size > 0:
+                x = DebertaConv(cfg, name="conv")(initial, x, attention_mask,
+                                                  deterministic)
+        return x
+
+
+def _head_dropout(cfg) -> float:
+    return (cfg.classifier_dropout if cfg.classifier_dropout is not None
+            else cfg.hidden_dropout)
+
+
+class DebertaV2ForSequenceClassification(nn.Module):
+    """ContextPooler (CLS → dropout → dense → act) + classifier."""
+
+    config: DebertaV2Config
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        seq = DebertaBackbone(cfg, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        x = seq[:, 0]
+        x = nn.Dropout(cfg.pooler_dropout)(x, deterministic=deterministic)
+        x = ACT2FN[cfg.pooler_hidden_act](
+            _dense(cfg, cfg.hidden_size, "pooler")(x))
+        x = nn.Dropout(_head_dropout(cfg))(x, deterministic=deterministic)
+        return _dense(cfg, self.num_labels, "classifier")(x)
+
+
+class DebertaV2ForTokenClassification(nn.Module):
+    config: DebertaV2Config
+    num_labels: int = 9
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        seq = DebertaBackbone(cfg, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        seq = nn.Dropout(cfg.hidden_dropout)(seq, deterministic=deterministic)
+        return _dense(cfg, self.num_labels, "classifier")(seq)
+
+
+class DebertaV2ForQuestionAnswering(nn.Module):
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        seq = DebertaBackbone(cfg, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        logits = _dense(cfg, 2, "qa_outputs")(seq)
+        start, end = jnp.split(logits, 2, axis=-1)
+        return start[..., 0], end[..., 0]

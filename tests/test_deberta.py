@@ -1,0 +1,199 @@
+"""DeBERTa-v2/v3 family tests: HF torch numerics parity for the
+disentangled-attention stack across its configuration space (v3-style
+shared-key log buckets, v2-style separate position projections + conv,
+c2p-only), head coverage, export round-trip, and trainer integration."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig  # noqa: E402
+from huggingface_sagemaker_tensorflow_distributed_tpu.data import (  # noqa: E402
+    ArrayDataset,
+    ShardedBatcher,
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (  # noqa: E402
+    synthetic_text_classification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models  # noqa: E402
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (  # noqa: E402
+    MeshConfig,
+    build_mesh,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer  # noqa: E402
+
+TOL = 3e-4
+
+
+def _hf_cfg(**kw):
+    base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=64,
+                max_position_embeddings=64, type_vocab_size=0,
+                hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                pooler_dropout=0.0, relative_attention=True,
+                position_buckets=16, norm_rel_ebd="layer_norm",
+                share_att_key=True, pos_att_type=["c2p", "p2c"],
+                pad_token_id=0)
+    base.update(kw)
+    return transformers.DebertaV2Config(**base)
+
+
+def _inputs(batch=3, seq=12, vocab=128, seed=0):
+    r = np.random.RandomState(seed)
+    ids = r.randint(4, vocab, (batch, seq))
+    mask = np.ones((batch, seq), np.int64)
+    mask[1, 8:] = 0
+    ids[1, 8:] = 0
+    return ids, mask
+
+
+def _parity(hf_model, d, task, extra_tol=1.0):
+    model, params, family, cfg = auto_models.from_pretrained(
+        d, task=task, num_labels=2)
+    assert family == "deberta-v2"
+    ids, mask = _inputs()
+    with torch.no_grad():
+        t_out = hf_model(input_ids=torch.tensor(ids),
+                         attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+                        deterministic=True)
+    if task == "qa":
+        for t, j in [(t_out.start_logits, j_out[0]), (t_out.end_logits, j_out[1])]:
+            # padded positions diverge (HF leaves them unmasked garbage);
+            # compare the real ones
+            np.testing.assert_allclose(np.asarray(j)[mask > 0],
+                                       t.numpy()[mask > 0],
+                                       atol=TOL * extra_tol, rtol=1e-3)
+    else:
+        np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                                   atol=TOL * extra_tol, rtol=1e-3)
+    return model, params, cfg
+
+
+def test_deberta_v3_style_seq_cls_parity(tmp_path):
+    """v3 recipe: shared att key, log buckets, rel-embedding LayerNorm."""
+    torch.manual_seed(0)
+    m = transformers.DebertaV2ForSequenceClassification(_hf_cfg()).eval()
+    d = str(tmp_path / "v3")
+    m.save_pretrained(d)
+    _parity(m, d, "seq-cls")
+
+
+def test_deberta_v2_style_separate_pos_proj_parity(tmp_path):
+    """v2 recipe: separate pos_key/pos_query projections, no buckets
+    (linear relative positions up to max_relative_positions)."""
+    torch.manual_seed(1)
+    m = transformers.DebertaV2ForSequenceClassification(
+        _hf_cfg(share_att_key=False, position_buckets=-1,
+                max_relative_positions=16, norm_rel_ebd="none")).eval()
+    d = str(tmp_path / "v2")
+    m.save_pretrained(d)
+    _parity(m, d, "seq-cls")
+
+
+def test_deberta_conv_layer_parity(tmp_path):
+    """deberta-v2-xlarge recipe: ConvLayer merged after layer 0."""
+    torch.manual_seed(2)
+    m = transformers.DebertaV2ForSequenceClassification(
+        _hf_cfg(conv_kernel_size=3, conv_act="tanh")).eval()
+    d = str(tmp_path / "conv")
+    m.save_pretrained(d)
+    _parity(m, d, "seq-cls")
+
+
+def test_deberta_c2p_only_parity(tmp_path):
+    torch.manual_seed(3)
+    m = transformers.DebertaV2ForSequenceClassification(
+        _hf_cfg(pos_att_type=["c2p"])).eval()
+    d = str(tmp_path / "c2p")
+    m.save_pretrained(d)
+    _parity(m, d, "seq-cls")
+
+
+def test_deberta_embedding_size_and_token_types_parity(tmp_path):
+    """Factorized embedding (embed_proj) + token-type embeddings."""
+    torch.manual_seed(4)
+    m = transformers.DebertaV2ForSequenceClassification(
+        _hf_cfg(embedding_size=16, type_vocab_size=2)).eval()
+    d = str(tmp_path / "emb")
+    m.save_pretrained(d)
+    _parity(m, d, "seq-cls")
+
+
+def test_deberta_token_cls_and_qa_parity(tmp_path):
+    torch.manual_seed(5)
+    cfg = _hf_cfg(num_labels=2)
+    mt = transformers.DebertaV2ForTokenClassification(cfg).eval()
+    d1 = str(tmp_path / "tok")
+    mt.save_pretrained(d1)
+    _parity(mt, d1, "token-cls")
+    mq = transformers.DebertaV2ForQuestionAnswering(cfg).eval()
+    d2 = str(tmp_path / "qa")
+    mq.save_pretrained(d2)
+    _parity(mq, d2, "qa")
+
+
+def test_deberta_hub_style_string_pos_att_type(tmp_path):
+    """Raw hub config.json stores pos_att_type as the string "c2p|p2c";
+    it must parse into the tuple, not char-split (which would silently
+    disable disentangled attention)."""
+    import json
+
+    torch.manual_seed(7)
+    m = transformers.DebertaV2ForSequenceClassification(_hf_cfg()).eval()
+    d = str(tmp_path / "hub")
+    m.save_pretrained(d)
+    cfg = json.load(open(f"{d}/config.json"))
+    cfg["pos_att_type"] = "c2p|p2c"
+    json.dump(cfg, open(f"{d}/config.json", "w"))
+    model, params, _ = _parity(m, d, "seq-cls")
+    assert model.config.pos_att_type == ("c2p", "p2c")
+
+
+def test_deberta_export_roundtrip(tmp_path):
+    """Our export reloads in HF torch with identical logits."""
+    torch.manual_seed(6)
+    m = transformers.DebertaV2ForSequenceClassification(_hf_cfg()).eval()
+    d = str(tmp_path / "src")
+    m.save_pretrained(d)
+    model, params, fam, cfg = auto_models.from_pretrained(
+        d, task="seq-cls", num_labels=2)
+    out = str(tmp_path / "export")
+    auto_models.save_pretrained(out, params, fam, cfg)
+    m2 = transformers.DebertaV2ForSequenceClassification.from_pretrained(out).eval()
+    ids, mask = _inputs()
+    with torch.no_grad():
+        a = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)).logits
+        b = m2(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)).logits
+    np.testing.assert_allclose(b.numpy(), a.numpy(), atol=1e-5)
+
+
+def test_deberta_training_learns(devices8):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.deberta import (
+        DebertaV2Config,
+        DebertaV2ForSequenceClassification,
+    )
+
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, labels = synthetic_text_classification(64, seed=0)
+    ds = ArrayDataset.from_texts(tok, texts, labels, max_length=16)
+    mesh = build_mesh(MeshConfig(), devices=devices8)
+    model_cfg = DebertaV2Config(
+        vocab_size=256, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=16,
+        position_buckets=8, hidden_dropout=0.0, attention_dropout=0.0)
+    model = DebertaV2ForSequenceClassification(model_cfg, num_labels=2)
+    params = init_params(model, model_cfg)
+    cfg = TrainConfig(dtype="float32", learning_rate=1e-2,
+                      scale_lr_by_world_size=False, log_every_steps=0,
+                      rng_impl="threefry", epochs=6)
+    trainer = Trainer(cfg, model, params, mesh)
+    batcher = ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0)
+    history = trainer.fit(batcher)
+    assert history["loss"][-1] < history["loss"][0] * 0.8

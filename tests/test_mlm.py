@@ -59,6 +59,12 @@ def test_mlm_head_parity(family, tmp_path):
             vocab_size=128, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
             max_position_embeddings=64, dropout=0.0, attention_dropout=0.0)
         m = transformers.DistilBertForMaskedLM(cfg).eval()
+    # perturb EVERY param away from init (LN gammas included) so a
+    # conversion rule that silently drops a weight cannot hide behind
+    # fresh-init defaults (ones/zeros)
+    with torch.no_grad():
+        for p in m.parameters():
+            p.add_(torch.randn_like(p) * 0.02)
     d = str(tmp_path / family)
     m.save_pretrained(d)
 
