@@ -74,6 +74,7 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("deberta-v2", "seq-cls"): deberta.DebertaV2ForSequenceClassification,
     ("deberta-v2", "token-cls"): deberta.DebertaV2ForTokenClassification,
     ("deberta-v2", "qa"): deberta.DebertaV2ForQuestionAnswering,
+    ("deberta-v2", "mlm"): deberta.DebertaV2ForMaskedLM,
 }
 
 CONFIG_BUILDERS = {

@@ -197,6 +197,11 @@ _DEBERTA_V2_RULES = [
     (r"^pooler\.dense$", r"pooler"),
     (r"^qa_outputs$", r"qa_outputs"),
     (r"^classifier$", r"classifier"),
+    # MLM head (legacy DebertaV2ForMaskedLM: BERT's cls.predictions
+    # layout; decoder tied to word_embeddings → unmapped)
+    (r"^cls\.predictions\.transform\.dense$", r"mlm_head/transform"),
+    (r"^cls\.predictions\.transform\.LayerNorm$", r"mlm_head/ln"),
+    (r"^cls\.predictions$", r"mlm_head"),
 ]
 
 # GPT-2: HF Conv1D stores weights [in, out] (already Flax layout), so
@@ -499,6 +504,9 @@ _DEBERTA_V2_REVERSE = [
     (r"^pooler$", "pooler.dense"),
     (r"^qa_outputs$", "qa_outputs"),
     (r"^classifier$", "classifier"),
+    (r"^mlm_head/transform$", "cls.predictions.transform.dense"),
+    (r"^mlm_head/ln$", "cls.predictions.transform.LayerNorm"),
+    (r"^mlm_head$", "cls.predictions"),
 ]
 
 REVERSE_RULES_BY_FAMILY: dict[str, list] = {

@@ -35,7 +35,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import ACT2FN
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    ACT2FN,
+    MlmHead,
+)
 
 NEG_INF = -1e9
 
@@ -377,6 +380,22 @@ class DebertaV2ForTokenClassification(nn.Module):
             input_ids, attention_mask, token_type_ids, deterministic)
         seq = nn.Dropout(cfg.hidden_dropout)(seq, deterministic=deterministic)
         return _dense(cfg, self.num_labels, "classifier")(seq)
+
+
+class DebertaV2ForMaskedLM(nn.Module):
+    """Masked-LM head tied to the word embeddings (HF legacy
+    ``DebertaV2ForMaskedLM``/``DebertaV2OnlyMLMHead`` — same
+    ``cls.predictions`` layout as BERT, so ``MlmHead`` is shared)."""
+
+    config: DebertaV2Config
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq = DebertaBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        table = self.variables["params"]["backbone"]["word_embeddings"]["embedding"]
+        return MlmHead(self.config, name="mlm_head")(seq, table)
 
 
 class DebertaV2ForQuestionAnswering(nn.Module):

@@ -197,3 +197,24 @@ def test_deberta_training_learns(devices8):
     batcher = ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0)
     history = trainer.fit(batcher)
     assert history["loss"][-1] < history["loss"][0] * 0.8
+
+
+def test_deberta_mlm_parity(tmp_path):
+    """Legacy DebertaV2ForMaskedLM (cls.predictions head, tied decoder);
+    weights perturbed so dropped params can't hide behind fresh init."""
+    torch.manual_seed(8)
+    m = transformers.DebertaV2ForMaskedLM(_hf_cfg()).eval()
+    with torch.no_grad():
+        for p in m.parameters():
+            p.add_(torch.randn_like(p) * 0.02)
+    d = str(tmp_path / "mlm")
+    m.save_pretrained(d)
+    model, params, family, cfg = auto_models.from_pretrained(d, task="mlm")
+    ids, mask = _inputs()
+    with torch.no_grad():
+        t_out = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+                        deterministic=True)
+    np.testing.assert_allclose(np.asarray(j_out)[mask > 0],
+                               t_out.logits.numpy()[mask > 0],
+                               atol=TOL, rtol=1e-3)
