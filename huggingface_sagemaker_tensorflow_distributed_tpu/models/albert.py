@@ -29,6 +29,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
     Pooler,
     _dense,
     head_dropout_rate,
+    MlmHead,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
     make_attention_mask,
@@ -130,3 +131,20 @@ class AlbertForQuestionAnswering(nn.Module):
         logits = _dense(self.config, 2, "qa_outputs")(seq)
         start, end = jnp.split(logits, 2, axis=-1)
         return start[..., 0], end[..., 0]
+
+
+class AlbertForMaskedLM(nn.Module):
+    """Masked-LM head tied to the factorized word embeddings (HF
+    ``AlbertMLMHead`` parity: dense hidden→embedding_size, activation,
+    LN, tied decoder + bias)."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = AlbertBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        table = self.variables["params"]["backbone"]["embeddings"][
+            "word_embeddings"]["embedding"]
+        return MlmHead(self.config, name="mlm_head")(seq, table)

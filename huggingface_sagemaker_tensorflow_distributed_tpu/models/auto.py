@@ -71,6 +71,7 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("bert", "mlm"): bert.BertForMaskedLM,
     ("roberta", "mlm"): roberta.RobertaForMaskedLM,
     ("distilbert", "mlm"): distilbert.DistilBertForMaskedLM,
+    ("albert", "mlm"): albert.AlbertForMaskedLM,
     ("deberta-v2", "seq-cls"): deberta.DebertaV2ForSequenceClassification,
     ("deberta-v2", "token-cls"): deberta.DebertaV2ForTokenClassification,
     ("deberta-v2", "qa"): deberta.DebertaV2ForQuestionAnswering,
@@ -287,6 +288,14 @@ def from_pretrained(
         raise ValueError(
             f"{model_name_or_path!r} is a T5 (encoder-decoder) checkpoint; "
             f"it only supports task='seq2seq', got task={task!r}")
+    if (family == "deberta-v2" and task == "mlm"
+            and hf_config.get("legacy") is False):
+        raise ValueError(
+            f"{model_name_or_path!r} uses the non-legacy DeBERTa MLM head "
+            "(lm_predictions.lm_head); only the legacy cls.predictions "
+            "layout is supported — silently loading would leave a random "
+            "head (HF's own non-legacy forward is broken in transformers "
+            "4.57: tie_weights clobbers lm_head.dense)")
     if family == "gpt2" and task != "causal-lm":
         raise ValueError(
             f"{model_name_or_path!r} is a GPT-2 (decoder-only) checkpoint; "

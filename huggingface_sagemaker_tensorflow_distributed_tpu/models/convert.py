@@ -171,6 +171,10 @@ _ALBERT_RULES = [
     (r"^(?:albert\.)?pooler$", r"backbone/pooler/pooler"),
     (r"^qa_outputs$", r"qa_outputs"),
     (r"^classifier$", r"classifier"),
+    # MLM head (AlbertForMaskedLM); decoder tied → unmapped
+    (r"^predictions\.dense$", r"mlm_head/transform"),
+    (r"^predictions\.LayerNorm$", r"mlm_head/ln"),
+    (r"^predictions$", r"mlm_head"),
 ]
 
 
@@ -198,7 +202,10 @@ _DEBERTA_V2_RULES = [
     (r"^qa_outputs$", r"qa_outputs"),
     (r"^classifier$", r"classifier"),
     # MLM head (legacy DebertaV2ForMaskedLM: BERT's cls.predictions
-    # layout; decoder tied to word_embeddings → unmapped)
+    # layout; decoder tied to word_embeddings → unmapped). The HF
+    # legacy=false layout is NOT mapped: auto.from_pretrained rejects it
+    # loudly (HF's own tie_weights clobbers lm_head.dense with the
+    # embedding matrix and its forward crashes — transformers 4.57).
     (r"^cls\.predictions\.transform\.dense$", r"mlm_head/transform"),
     (r"^cls\.predictions\.transform\.LayerNorm$", r"mlm_head/ln"),
     (r"^cls\.predictions$", r"mlm_head"),
@@ -466,6 +473,9 @@ _ALBERT_REVERSE = [
     (r"^backbone/pooler/pooler$", "albert.pooler"),
     (r"^qa_outputs$", "qa_outputs"),
     (r"^classifier$", "classifier"),
+    (r"^mlm_head/transform$", "predictions.dense"),
+    (r"^mlm_head/ln$", "predictions.LayerNorm"),
+    (r"^mlm_head$", "predictions"),
 ]
 
 _GPT2_REVERSE = [

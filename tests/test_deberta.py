@@ -218,3 +218,16 @@ def test_deberta_mlm_parity(tmp_path):
     np.testing.assert_allclose(np.asarray(j_out)[mask > 0],
                                t_out.logits.numpy()[mask > 0],
                                atol=TOL, rtol=1e-3)
+
+
+def test_deberta_mlm_non_legacy_rejected(tmp_path):
+    """HF legacy=false MLM checkpoints are rejected loudly: HF's own
+    tie_weights clobbers lm_head.dense with the embedding matrix (its
+    forward crashes in transformers 4.57), so a silent partial load
+    would leave a random head."""
+    torch.manual_seed(10)
+    m = transformers.DebertaV2ForMaskedLM(_hf_cfg(legacy=False)).eval()
+    d = str(tmp_path / "mlm-nl")
+    m.save_pretrained(d)
+    with pytest.raises(ValueError, match="non-legacy"):
+        auto_models.from_pretrained(d, task="mlm")

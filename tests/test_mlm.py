@@ -208,3 +208,29 @@ def test_mlm_export_reloads_in_hf(tmp_path):
         a = m(input_ids=torch.tensor(ids)).logits.numpy()
         b = m2(input_ids=torch.tensor(ids)).logits.numpy()
     np.testing.assert_allclose(b, a, atol=1e-5)
+
+
+def test_albert_mlm_parity(tmp_path):
+    """ALBERT factorized-embedding MLM head (dense hidden→embedding_size,
+    tied decoder); weights perturbed so dropped params can't hide."""
+    torch.manual_seed(9)
+    cfg = transformers.AlbertConfig(
+        vocab_size=128, hidden_size=32, embedding_size=16,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, classifier_dropout_prob=0.0)
+    m = transformers.AlbertForMaskedLM(cfg).eval()
+    with torch.no_grad():
+        for p in m.parameters():
+            p.add_(torch.randn_like(p) * 0.02)
+    d = str(tmp_path / "albert")
+    m.save_pretrained(d)
+    model, params, fam, _ = auto_models.from_pretrained(d, task="mlm")
+    assert fam == "albert"
+    ids, mask = _inputs(128)
+    with torch.no_grad():
+        t_out = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+                        deterministic=True)
+    np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                               atol=TOL, rtol=1e-3)
