@@ -52,6 +52,19 @@ class WordHashTokenizer:
         # the mask token — fine for the synthetic/offline MLM tier
         self.mask_token_id = 3
 
+    def convert_ids_to_tokens(self, ids) -> list[str]:
+        """Hash buckets are one-way; specials resolve, buckets become
+        placeholders (this tier exists for synthetic/offline runs)."""
+        names = {self.pad_token_id: "[PAD]", self.cls_token_id: "[CLS]",
+                 self.sep_token_id: "[SEP]", self.mask_token_id: "[UNK]"}
+        return [names.get(int(i), f"<{int(i)}>") for i in ids]
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        specials = {self.pad_token_id, self.cls_token_id, self.sep_token_id}
+        toks = [t for i, t in zip(ids, self.convert_ids_to_tokens(ids))
+                if not (skip_special_tokens and int(i) in specials)]
+        return " ".join(toks)
+
     def _word_id(self, word: str) -> int:
         digest = hashlib.md5(word.encode("utf-8")).digest()
         bucket = int.from_bytes(digest[:4], "little") % (self.vocab_size - 4)
@@ -195,6 +208,13 @@ class HFTokenizer:
         self.pad_token_id = hf_tokenizer.pad_token_id or 0
         self.mask_token_id = hf_tokenizer.mask_token_id   # None for GPT-2
         self.vocab_size = hf_tokenizer.vocab_size
+
+    def convert_ids_to_tokens(self, ids) -> list[str]:
+        return self._tok.convert_ids_to_tokens([int(i) for i in ids])
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        return self._tok.decode([int(i) for i in ids],
+                                skip_special_tokens=skip_special_tokens)
 
     def __call__(self, texts, truncation: bool = True, padding: str = "max_length",
                  max_length: int | None = None, text_pairs=None,

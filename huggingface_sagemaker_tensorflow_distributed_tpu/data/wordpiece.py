@@ -211,6 +211,24 @@ class WordPieceTokenizer:
         # dataset building raises a clear error in that case)
         self.mask_token_id = vocab.get("[MASK]")
         self.vocab_size = len(vocab)
+        self._inv_vocab = {i: t for t, i in vocab.items()}
+
+    def convert_ids_to_tokens(self, ids) -> list[str]:
+        return [self._inv_vocab.get(int(i), self.unk_token) for i in ids]
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        """Ids → text with WordPiece continuation (##) re-joining."""
+        specials = {self.pad_token, self.cls_token, self.sep_token,
+                    self.unk_token, "[MASK]"}
+        words: list[str] = []
+        for tok in self.convert_ids_to_tokens(ids):
+            if skip_special_tokens and tok in specials:
+                continue
+            if tok.startswith("##") and words:
+                words[-1] += tok[2:]
+            else:
+                words.append(tok)
+        return " ".join(words)
 
     # -- core: overridden by the C++-backed subclass ------------------------
 

@@ -1,0 +1,224 @@
+"""Inference entry point: load an exported checkpoint and predict.
+
+Completes the model-surface parity with the reference's HF ecosystem
+(the reference's model objects carry ``pipeline``-style inference via
+``transformers``; the repo itself only fine-tunes — reference
+``scripts/train.py:145,170``). One jitted forward (or the cached
+generation loop) per invocation:
+
+  python scripts/predict.py --model_dir /path/to/export --task seq-cls \
+      --text "a great movie"
+  python scripts/predict.py --model_dir ... --task qa \
+      --text "who wrote it?" --context "it was written by Ada."
+  python scripts/predict.py --model_dir ... --task seq2seq \
+      --text "summarize: ..." --max_new_tokens 48 --num_beams 4
+  python scripts/predict.py --model_dir ... --task causal-lm \
+      --text "once upon a time" --temperature 0.8 --top_p 0.9
+  python scripts/predict.py --model_dir ... --task mlm \
+      --text "the capital of france is [MASK]"
+
+Each input line (from ``--text``/``--context`` or ``--input_file``
+jsonl with {"text": ..., "context"?: ...}) produces ONE JSON line on
+stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.data import load_tokenizer
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+
+
+def _encode_mlm_with_mask(tokenizer, texts, max_length, mask_id):
+    """Encode texts containing literal "[MASK]" markers for tokenizers
+    that don't recognize the token inline: tokenize the segments around
+    each marker and splice the mask id between them."""
+    cls_id = getattr(tokenizer, "cls_token_id", None)
+    sep_id = getattr(tokenizer, "sep_token_id", None)
+    pad_id = getattr(tokenizer, "pad_token_id", 0)
+    rows = []
+    for text in texts:
+        row = [cls_id] if cls_id is not None else []
+        parts = text.split("[MASK]")
+        for i, part in enumerate(parts):
+            if part.strip():
+                seg = tokenizer([part], add_special_tokens=False,
+                                max_length=max_length)
+                am = np.asarray(seg["attention_mask"][0])
+                row += [int(x) for x in np.asarray(seg["input_ids"][0])[am > 0]]
+            if i < len(parts) - 1:
+                row.append(int(mask_id))
+        if sep_id is not None:
+            row.append(sep_id)
+        if int(mask_id) not in row[:max_length] and int(mask_id) in row:
+            print(f"warning: [MASK] in {text[:40]!r} fell past "
+                  f"--max_seq_length {max_length} and was truncated away",
+                  file=sys.stderr)
+        rows.append(row[:max_length])
+    width = max(len(r) for r in rows)
+    ids = np.full((len(rows), width), pad_id, np.int32)
+    am = np.zeros((len(rows), width), np.int32)
+    for r, row in enumerate(rows):
+        ids[r, : len(row)] = row
+        am[r, : len(row)] = 1
+    return {"input_ids": ids, "attention_mask": am}
+
+
+def _encode(tokenizer, texts, contexts, max_length):
+    # 'longest' keeps the jitted width at the actual batch length
+    if contexts is not None:
+        return tokenizer(texts, text_pairs=contexts, max_length=max_length,
+                         padding="longest")
+    return tokenizer(texts, max_length=max_length, padding="longest")
+
+
+def predict(args) -> list[dict]:
+    model, params, family, config = auto_models.from_pretrained(
+        args.model_dir, task=args.task, num_labels=args.num_labels)
+    tokenizer = load_tokenizer(args.model_dir, vocab_size=config.vocab_size)
+
+    if args.input_file:
+        rows = [json.loads(l) for l in open(args.input_file) if l.strip()]
+        texts = [r["text"] for r in rows]
+        # context is per-row optional; rows without one get an empty pair
+        contexts = ([r.get("context", "") for r in rows]
+                    if any("context" in r for r in rows) else None)
+    else:
+        texts = [args.text]
+        contexts = [args.context] if args.context else None
+
+    max_len = min(args.max_seq_length,
+                  getattr(config, "max_position_embeddings", args.max_seq_length))
+    enc = _encode(tokenizer, texts, contexts, max_len)
+    ids = jnp.asarray(enc["input_ids"])
+    mask = jnp.asarray(enc["attention_mask"])
+    token_types = (jnp.asarray(enc["token_type_ids"])
+                   if "token_type_ids" in enc else None)
+
+    results: list[dict] = []
+    if args.task in ("seq2seq", "causal-lm"):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+            beam_search_generate,
+            generate,
+            generate_causal,
+        )
+
+        if args.task == "seq2seq":
+            if args.num_beams > 1:
+                out = beam_search_generate(model, params, ids, mask,
+                                           num_beams=args.num_beams,
+                                           max_new_tokens=args.max_new_tokens,
+                                           length_penalty=args.length_penalty)
+            else:
+                out = generate(model, params, ids, mask,
+                               max_new_tokens=args.max_new_tokens,
+                               temperature=args.temperature,
+                               top_k=args.top_k, top_p=args.top_p,
+                               seed=args.seed)
+        else:
+            out = generate_causal(model, params, ids, mask,
+                                  max_new_tokens=args.max_new_tokens,
+                                  temperature=args.temperature,
+                                  top_k=args.top_k, top_p=args.top_p,
+                                  seed=args.seed)
+        for text, row in zip(texts, np.asarray(out)):
+            results.append({"text": text,
+                            "generated": tokenizer.decode(row),
+                            "generated_ids": row.tolist()})
+        return results
+
+    # token_type_ids matter for pair inputs (QA): the trainer forwards
+    # them (train/trainer.py::_apply), so inference must too
+    apply = jax.jit(lambda p, i, m, t: model.apply(
+        {"params": p}, i, m, token_type_ids=t, deterministic=True))
+    out = apply(params, ids, mask, token_types)
+
+    if args.task == "seq-cls":
+        probs = np.asarray(jax.nn.softmax(out.astype(jnp.float32), -1))
+        for text, p in zip(texts, probs):
+            results.append({"text": text, "label": int(p.argmax()),
+                            "probs": [round(float(x), 4) for x in p]})
+    elif args.task == "token-cls":
+        pred = np.asarray(jnp.argmax(out, -1))
+        am = np.asarray(mask)
+        for r, text in enumerate(texts):
+            toks = tokenizer.convert_ids_to_tokens(np.asarray(ids[r])[am[r] > 0])
+            results.append({"text": text,
+                            "tokens": toks,
+                            "labels": pred[r][am[r] > 0].tolist()})
+    elif args.task == "qa":
+        start, end = out
+        s = np.asarray(jnp.argmax(start, -1))
+        e = np.asarray(jnp.argmax(end, -1))
+        for r, text in enumerate(texts):
+            lo, hi = int(s[r]), int(e[r])
+            span_ids = np.asarray(ids[r])[lo: hi + 1] if hi >= lo else []
+            results.append({"text": text, "start": lo, "end": hi,
+                            "answer": tokenizer.decode(span_ids)})
+    elif args.task == "mlm":
+        mask_id = getattr(tokenizer, "mask_token_id", None)
+        if mask_id is not None and not np.any(np.asarray(ids) == mask_id):
+            # in-repo tokenizers split a literal "[MASK]" into
+            # punctuation; re-encode segment-wise around the marker
+            enc = _encode_mlm_with_mask(tokenizer, texts, max_len, mask_id)
+            ids = jnp.asarray(enc["input_ids"])
+            mask = jnp.asarray(enc["attention_mask"])
+            out = apply(params, ids, mask, None)
+        logits = np.asarray(out)
+        for r, text in enumerate(texts):
+            row_ids = np.asarray(ids[r])
+            fills = []
+            for pos in np.flatnonzero(row_ids == mask_id):
+                top = np.argsort(-logits[r, pos])[: args.top_k or 5]
+                fills.append({"position": int(pos),
+                              "top_tokens": tokenizer.convert_ids_to_tokens(top),
+                              "top_ids": top.tolist()})
+            results.append({"text": text, "fills": fills})
+    else:
+        raise ValueError(f"unknown task {args.task!r}")
+    return results
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model_dir", required=True)
+    ap.add_argument("--task", default="seq-cls",
+                    choices=["seq-cls", "token-cls", "qa", "seq2seq",
+                             "causal-lm", "mlm"])
+    ap.add_argument("--text", default=None)
+    ap.add_argument("--context", default=None)
+    ap.add_argument("--input_file", default=None,
+                    help="jsonl with {'text': ..., 'context'?: ...}")
+    ap.add_argument("--num_labels", type=int, default=2)
+    ap.add_argument("--max_seq_length", type=int, default=512)
+    ap.add_argument("--max_new_tokens", type=int, default=64)
+    ap.add_argument("--num_beams", type=int, default=1)
+    ap.add_argument("--length_penalty", type=float, default=1.0)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top_k", type=int, default=0)
+    ap.add_argument("--top_p", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    return ap
+
+
+def main(argv=None):
+    ap = build_parser()
+    args = ap.parse_args(argv)
+    if not args.text and not args.input_file:
+        ap.error("provide --text or --input_file")
+    for row in predict(args):
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

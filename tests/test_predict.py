@@ -1,0 +1,100 @@
+"""scripts/predict.py surface tests: every task path produces the
+documented JSON contract from an exported checkpoint."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+    BertForSequenceClassification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+    Gpt2Config,
+    Gpt2LMHeadModel,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+
+import predict as predict_mod
+
+
+def _bert_export(tmp_path):
+    cfg = EncoderConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                        num_heads=4, intermediate_size=64,
+                        max_position_embeddings=32)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    params = init_params(model, cfg)
+    out = str(tmp_path / "bert")
+    auto_models.save_pretrained(out, params, "bert", cfg)
+    return out
+
+
+def _run(argv):
+    # the REAL CLI parser — tests cannot drift from the tool
+    args = predict_mod.build_parser().parse_args(
+        argv + ["--max_seq_length", "32", "--max_new_tokens", "4"])
+    return predict_mod.predict(args)
+
+
+def test_predict_seq_cls(tmp_path):
+    d = _bert_export(tmp_path)
+    rows = _run(["--model_dir", d, "--task", "seq-cls",
+                 "--text", "a fine film"])
+    assert len(rows) == 1
+    assert rows[0]["label"] in (0, 1)
+    assert abs(sum(rows[0]["probs"]) - 1.0) < 1e-3
+
+
+def test_predict_qa_and_batch_file(tmp_path):
+    d = _bert_export(tmp_path)
+    f = tmp_path / "in.jsonl"
+    # second row has NO context — per-row optional
+    f.write_text(json.dumps({"text": "who is it?", "context": "it is ada."}) + "\n"
+                 + json.dumps({"text": "what now?"}) + "\n")
+    rows = _run(["--model_dir", d, "--task", "qa",
+                 "--input_file", str(f)])
+    assert len(rows) == 2
+    for r in rows:
+        assert "answer" in r and r["end"] >= 0
+
+
+def test_predict_causal_lm(tmp_path):
+    cfg = Gpt2Config(vocab_size=256, hidden_size=32, num_layers=2,
+                     num_heads=4, intermediate_size=64,
+                     max_position_embeddings=64)
+    model = Gpt2LMHeadModel(cfg)
+    params = init_params(model, cfg)
+    out = str(tmp_path / "gpt2")
+    auto_models.save_pretrained(out, params, "gpt2", cfg)
+    rows = _run(["--model_dir", out, "--task", "causal-lm",
+                 "--text", "hello world"])
+    assert len(rows[0]["generated_ids"]) == 4
+    assert isinstance(rows[0]["generated"], str)
+
+
+def test_predict_mlm_fills(tmp_path):
+    """With a real WordPiece vocab the [MASK] token round-trips and the
+    fill positions are reported with top tokens."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+        BertForMaskedLM,
+    )
+
+    vocab_words = ["[PAD]", "[CLS]", "[SEP]", "[UNK]", "[MASK]",
+                   "the", "movie", "was", "good", "bad"]
+    cfg = EncoderConfig(vocab_size=len(vocab_words), hidden_size=32,
+                        num_layers=2, num_heads=4, intermediate_size=64,
+                        max_position_embeddings=32, use_pooler=False)
+    model = BertForMaskedLM(cfg)
+    params = init_params(model, cfg)
+    out = str(tmp_path / "mlm")
+    auto_models.save_pretrained(out, params, "bert", cfg)
+    (tmp_path / "mlm" / "vocab.txt").write_text("\n".join(vocab_words))
+    rows = _run(["--model_dir", out, "--task", "mlm",
+                 "--text", "the movie was [MASK]", "--top_k", "3"])
+    assert rows[0]["fills"], "the [MASK] position must be found"
+    assert len(rows[0]["fills"][0]["top_tokens"]) == 3
