@@ -63,7 +63,8 @@ class TrainConfig:
 
     # --- model / task ---
     model_name_or_path: str = "bert-base-uncased"
-    task: str = "seq-cls"          # seq-cls | token-cls | qa | seq2seq
+    task: str = "seq-cls"          # seq-cls | token-cls | qa | seq2seq |
+                                   # causal-lm | mlm | rtd
     num_labels: int = 2
     max_seq_length: int = 512      # reference pads to tokenizer.model_max_length=512 (train.py:81)
     max_target_length: int = 64    # seq2seq decoder length (summaries are short)
@@ -184,7 +185,7 @@ class TrainConfig:
 
     def __post_init__(self):
         if self.task not in ("seq-cls", "token-cls", "qa", "seq2seq",
-                             "causal-lm", "mlm"):
+                             "causal-lm", "mlm", "rtd"):
             raise ValueError(f"unknown task {self.task!r}")
         if self.dtype not in ("bfloat16", "float32", "float16"):
             raise ValueError(f"unknown dtype {self.dtype!r}")

@@ -132,6 +132,34 @@ class ArrayDataset:
         return cls({"input_ids": ids, "attention_mask": am, "labels": labels})
 
     @classmethod
+    def from_rtd_texts(cls, tokenizer, texts, max_length: int = 512,
+                       replace_probability: float = 0.15,
+                       seed: int = 0) -> "ArrayDataset":
+        """Replaced-token-detection corpus (ELECTRA pretraining shape):
+        ~``replace_probability`` of real tokens are swapped for random
+        vocab ids; labels are 1 where the id actually changed, 0 on
+        untouched tokens, -100 on specials/pads. (Real ELECTRA samples
+        replacements from a trained generator; random replacement is the
+        standard offline/ablation tier.)"""
+        enc = tokenizer(texts, truncation=True, padding="max_length",
+                        max_length=max_length)
+        ids = np.asarray(enc["input_ids"], np.int32).copy()
+        am = np.asarray(enc["attention_mask"], np.int32)
+        specials = {getattr(tokenizer, name, None)
+                    for name in ("pad_token_id", "cls_token_id",
+                                 "sep_token_id", "mask_token_id")}
+        real = (am > 0) & ~np.isin(ids, [s for s in specials if s is not None])
+        rng = np.random.RandomState(seed)
+        vocab = int(getattr(tokenizer, "vocab_size"))
+        pick = real & (rng.rand(*ids.shape) < replace_probability)
+        draws = rng.randint(0, vocab, ids.shape).astype(np.int32)
+        changed = pick & (draws != ids)
+        labels = np.where(real, 0, -100).astype(np.int32)
+        labels[changed] = 1
+        ids = np.where(changed, draws, ids)
+        return cls({"input_ids": ids, "attention_mask": am, "labels": labels})
+
+    @classmethod
     def from_lm_texts(cls, tokenizer, texts, max_length: int = 512) -> "ArrayDataset":
         """Causal-LM corpus: labels are the input ids themselves (the
         trainer's causal-lm loss shifts them); pad positions get -100."""

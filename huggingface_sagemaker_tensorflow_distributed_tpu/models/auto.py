@@ -76,6 +76,7 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("deberta-v2", "token-cls"): deberta.DebertaV2ForTokenClassification,
     ("deberta-v2", "qa"): deberta.DebertaV2ForQuestionAnswering,
     ("deberta-v2", "mlm"): deberta.DebertaV2ForMaskedLM,
+    ("electra", "rtd"): electra.ElectraForPreTraining,
 }
 
 CONFIG_BUILDERS = {
@@ -234,7 +235,7 @@ def build_model(family: str, task: str, config: EncoderConfig, num_labels: int =
     cls = MODEL_REGISTRY.get((family, task))
     if cls is None:
         raise ValueError(f"no model for family={family!r} task={task!r}")
-    if task in ("qa", "seq2seq", "causal-lm", "mlm"):
+    if task in ("qa", "seq2seq", "causal-lm", "mlm", "rtd"):
         return cls(config)
     return cls(config, num_labels=num_labels)
 

@@ -147,6 +147,9 @@ _ELECTRA_RULES = [
     (r"^(?:electra\.)?encoder\.layer\.(\d+)\.intermediate\.dense$", r"backbone/encoder/layer_\1/ffn/intermediate"),
     (r"^(?:electra\.)?encoder\.layer\.(\d+)\.output\.dense$", r"backbone/encoder/layer_\1/ffn/ffn_out"),
     (r"^(?:electra\.)?encoder\.layer\.(\d+)\.output\.LayerNorm$", r"backbone/encoder/layer_\1/ffn_ln"),
+    # RTD discriminator head (ElectraForPreTraining)
+    (r"^discriminator_predictions\.dense$", r"disc_dense"),
+    (r"^discriminator_predictions\.dense_prediction$", r"disc_prediction"),
     # ElectraClassificationHead
     (r"^classifier\.dense$", r"head/head_dense"),
     (r"^classifier\.out_proj$", r"head/classifier"),
@@ -437,6 +440,8 @@ _T5_REVERSE = [
 ]
 
 _ELECTRA_REVERSE = [
+    (r"^disc_dense$", "discriminator_predictions.dense"),
+    (r"^disc_prediction$", "discriminator_predictions.dense_prediction"),
     (r"^backbone/embeddings/word_embeddings$", "electra.embeddings.word_embeddings"),
     (r"^backbone/embeddings/position_embeddings$", "electra.embeddings.position_embeddings"),
     (r"^backbone/embeddings/token_type_embeddings$", "electra.embeddings.token_type_embeddings"),

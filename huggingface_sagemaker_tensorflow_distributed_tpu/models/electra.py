@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    ACT2FN,
     EncoderBackbone,
     EncoderConfig,
     _dense,
@@ -102,3 +103,21 @@ class ElectraForQuestionAnswering(nn.Module):
         logits = _dense(self.config, 2, "qa_outputs")(seq)
         start, end = jnp.split(logits, 2, axis=-1)
         return start[..., 0], end[..., 0]
+
+
+class ElectraForPreTraining(nn.Module):
+    """Replaced-token-detection discriminator (HF
+    ``ElectraForPreTraining`` parity): per-token binary logit saying
+    whether the token was replaced — ELECTRA's pretraining objective."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        seq, _ = EncoderBackbone(cfg, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        x = _dense(cfg, cfg.hidden_size, "disc_dense")(seq)
+        x = ACT2FN[cfg.hidden_act](x)
+        return _dense(cfg, 1, "disc_prediction")(x)[..., 0].astype(jnp.float32)

@@ -154,6 +154,22 @@ def causal_lm_loss(apply_fn, params, batch, rngs, train: bool):
     return _masked_sums(per_tok, correct, token_valid)
 
 
+def rtd_loss(apply_fn, params, batch, rngs, train: bool):
+    """Replaced-token detection (ELECTRA pretraining): per-token binary
+    CE on whether the token was substituted; -100/pad positions are
+    ignored. Metric is detection accuracy."""
+    logits = _apply(apply_fn, params, batch, rngs, train)        # [B,S]
+    labels = batch["labels"]
+    token_valid = (labels != -100) & (batch["attention_mask"] > 0)
+    if "valid" in batch:
+        token_valid = token_valid & (batch["valid"][:, None] > 0)
+    target = jnp.maximum(labels, 0).astype(jnp.float32)
+    per_tok = optax.sigmoid_binary_cross_entropy(
+        logits.astype(jnp.float32), target)
+    correct = (logits > 0) == (target > 0.5)
+    return _masked_sums(per_tok, correct, token_valid)
+
+
 TASK_LOSSES: dict[str, Callable] = {
     "seq-cls": seq_cls_loss,
     "token-cls": token_cls_loss,
@@ -163,6 +179,7 @@ TASK_LOSSES: dict[str, Callable] = {
     # masked-LM: CE over the vocab at the masked positions only —
     # exactly the token-cls shape (labels -100 everywhere else)
     "mlm": token_cls_loss,
+    "rtd": rtd_loss,
 }
 
 

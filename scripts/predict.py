@@ -165,6 +165,16 @@ def predict(args) -> list[dict]:
             span_ids = np.asarray(ids[r])[lo: hi + 1] if hi >= lo else []
             results.append({"text": text, "start": lo, "end": hi,
                             "answer": tokenizer.decode(span_ids)})
+    elif args.task == "rtd":
+        # per-token probability that the token was replaced (ELECTRA
+        # discriminator; sigmoid of the binary logit)
+        probs = np.asarray(jax.nn.sigmoid(out.astype(jnp.float32)))
+        am = np.asarray(mask)
+        for r, text in enumerate(texts):
+            toks = tokenizer.convert_ids_to_tokens(np.asarray(ids[r])[am[r] > 0])
+            results.append({"text": text, "tokens": toks,
+                            "replaced_prob": [round(float(x), 4)
+                                              for x in probs[r][am[r] > 0]]})
     elif args.task == "mlm":
         mask_id = getattr(tokenizer, "mask_token_id", None)
         if mask_id is not None and not np.any(np.asarray(ids) == mask_id):
@@ -194,7 +204,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--model_dir", required=True)
     ap.add_argument("--task", default="seq-cls",
                     choices=["seq-cls", "token-cls", "qa", "seq2seq",
-                             "causal-lm", "mlm"])
+                             "causal-lm", "mlm", "rtd"])
     ap.add_argument("--text", default=None)
     ap.add_argument("--context", default=None)
     ap.add_argument("--input_file", default=None,

@@ -88,6 +88,10 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
         texts, _ = load_text_classification(config.dataset, split, **kw)
         return ArrayDataset.from_mlm_texts(tokenizer, texts, max_len,
                                            seed=config.seed)
+    if config.task == "rtd":
+        texts, _ = load_text_classification(config.dataset, split, **kw)
+        return ArrayDataset.from_rtd_texts(tokenizer, texts, max_len,
+                                           seed=config.seed)
     if config.task == "token-cls":
         sents, tags = load_token_classification(config.dataset, split, **kw)
         _check_num_labels([t for ts in tags for t in ts], config.num_labels,

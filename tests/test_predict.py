@@ -98,3 +98,21 @@ def test_predict_mlm_fills(tmp_path):
                  "--text", "the movie was [MASK]", "--top_k", "3"])
     assert rows[0]["fills"], "the [MASK] position must be found"
     assert len(rows[0]["fills"][0]["top_tokens"]) == 3
+
+
+def test_predict_rtd(tmp_path):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.electra import (
+        ElectraForPreTraining,
+    )
+
+    cfg = EncoderConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                        num_heads=4, intermediate_size=64,
+                        max_position_embeddings=32, use_pooler=False)
+    model = ElectraForPreTraining(cfg)
+    params = init_params(model, cfg)
+    out = str(tmp_path / "rtd")
+    auto_models.save_pretrained(out, params, "electra", cfg)
+    rows = _run(["--model_dir", out, "--task", "rtd",
+                 "--text", "a plain sentence"])
+    assert len(rows[0]["tokens"]) == len(rows[0]["replaced_prob"])
+    assert all(0.0 <= p <= 1.0 for p in rows[0]["replaced_prob"])

@@ -1,0 +1,98 @@
+"""Replaced-token-detection task (ELECTRA pretraining): HF parity for
+the discriminator head, corpus corruption statistics, e2e training."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig  # noqa: E402
+from huggingface_sagemaker_tensorflow_distributed_tpu.data import (  # noqa: E402
+    ArrayDataset,
+    ShardedBatcher,
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (  # noqa: E402
+    synthetic_text_classification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models  # noqa: E402
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (  # noqa: E402
+    MeshConfig,
+    build_mesh,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer  # noqa: E402
+
+
+def test_rtd_head_parity(tmp_path):
+    torch.manual_seed(0)
+    cfg = transformers.ElectraConfig(
+        vocab_size=128, hidden_size=32, embedding_size=16,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = transformers.ElectraForPreTraining(cfg).eval()
+    with torch.no_grad():
+        for p in m.parameters():
+            p.add_(torch.randn_like(p) * 0.02)
+    d = str(tmp_path / "electra")
+    m.save_pretrained(d)
+    model, params, fam, _ = auto_models.from_pretrained(d, task="rtd")
+    assert fam == "electra"
+    r = np.random.RandomState(0)
+    ids = r.randint(4, 128, (3, 12))
+    mask = np.ones((3, 12), np.int64)
+    with torch.no_grad():
+        t_out = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+                        deterministic=True)
+    np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_rtd_corpus_statistics():
+    tok = WordHashTokenizer(vocab_size=1024)
+    texts = ["the quick brown fox jumps over the lazy dog " * 4] * 50
+    ds = ArrayDataset.from_rtd_texts(tok, texts, max_length=48, seed=0)
+    ids = ds.columns["input_ids"]
+    labels = ds.columns["labels"]
+    am = ds.columns["attention_mask"]
+    # specials/pads ignored; real tokens labeled 0/1
+    assert set(np.unique(labels)) <= {-100, 0, 1}
+    real = labels != -100
+    frac = (labels == 1).sum() / real.sum()
+    assert 0.08 < frac < 0.22
+    # replaced positions actually differ from the clean encoding
+    clean = tok(texts, max_length=48)["input_ids"]
+    changed = (ids != clean) & real
+    np.testing.assert_array_equal(changed, labels == 1)
+    # pads/specials are -100
+    assert np.all(labels[am == 0] == -100)
+
+
+def test_rtd_training_learns(devices8):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.electra import (
+        ElectraForPreTraining,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(64, seed=0)
+    ds = ArrayDataset.from_rtd_texts(tok, texts, max_length=16, seed=0)
+    mesh = build_mesh(MeshConfig(), devices=devices8)
+    model_cfg = EncoderConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                              num_heads=4, intermediate_size=64,
+                              max_position_embeddings=16, hidden_dropout=0.0,
+                              attention_dropout=0.0, use_pooler=False)
+    model = ElectraForPreTraining(model_cfg)
+    params = init_params(model, model_cfg)
+    cfg = TrainConfig(task="rtd", dtype="float32", learning_rate=5e-3,
+                      scale_lr_by_world_size=False, log_every_steps=0,
+                      rng_impl="threefry", epochs=3)
+    trainer = Trainer(cfg, model, params, mesh)
+    batcher = ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0)
+    history = trainer.fit(batcher)
+    assert history["loss"][-1] < history["loss"][0] * 0.95
