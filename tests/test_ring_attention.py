@@ -144,3 +144,37 @@ def test_bert_train_step_with_ring_attention(devices8):
 
     assert np.isfinite(losses["ring"])
     np.testing.assert_allclose(losses["ring"], losses["xla"], atol=1e-5)
+
+
+def test_ring_long_context_seq2048_sp8(devices8):
+    """Long-context evidence (SURVEY.md §5.7 beyond-parity): EXACT
+    attention at seq 2048 with the sequence axis fully sharded over all
+    8 devices (256 tokens per shard) — each device only ever holds
+    O(seq/sp) keys/values at a time, the memory shape that makes
+    sequences longer than one chip's HBM feasible."""
+    mesh = build_mesh(MeshConfig(dp=1, sp=8), devices=devices8)
+    q, k, v = _qkv(b=1, h=2, s=2048, d=8, seed=7)
+    ref = xla_attention(q, k, v)
+    out = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh=mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ring_long_context_causal_masked(devices8):
+    """Same 2048/sp8 shape with causal + padding masks riding the ring."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        make_causal_mask,
+    )
+
+    mesh = build_mesh(MeshConfig(dp=1, sp=8), devices=devices8)
+    q, k, v = _qkv(b=1, h=2, s=2048, d=8, seed=8)
+    rng = np.random.RandomState(9)
+    am = (rng.rand(1, 2048) > 0.2).astype(np.int32)
+    am[:, :64] = 1
+    pad = make_attention_mask(jnp.asarray(am))
+    ref = xla_attention(q, k, v, mask=pad + make_causal_mask(2048, 2048))
+    out = jax.jit(lambda q, k, v, m: ring_attention(
+        q, k, v, mask=m, causal=True, mesh=mesh))(q, k, v, pad)
+    # compare only valid query rows (fully-masked rows are don't-care)
+    valid = am[0] > 0
+    np.testing.assert_allclose(np.asarray(out)[0, :, valid],
+                               np.asarray(ref)[0, :, valid], atol=1e-4)
