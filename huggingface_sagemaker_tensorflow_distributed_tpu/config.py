@@ -68,6 +68,9 @@ class TrainConfig:
     num_labels: int = 2
     max_seq_length: int = 512      # reference pads to tokenizer.model_max_length=512 (train.py:81)
     max_target_length: int = 64    # seq2seq decoder length (summaries are short)
+    # T5 pretraining: corrupt spans of the input text instead of a
+    # source/target dataset (task stays seq2seq; any text source works)
+    span_corruption: bool = False
     from_scratch: bool = False     # random init instead of pretrained weights
 
     # --- data ---

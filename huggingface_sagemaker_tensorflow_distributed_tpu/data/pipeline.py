@@ -132,6 +132,94 @@ class ArrayDataset:
         return cls({"input_ids": ids, "attention_mask": am, "labels": labels})
 
     @classmethod
+    def from_span_corruption_texts(cls, tokenizer, texts,
+                                   max_source_length: int = 512,
+                                   max_target_length: int = 114,
+                                   corruption_rate: float = 0.15,
+                                   mean_span_length: float = 3.0,
+                                   n_sentinels: int = 100,
+                                   decoder_start_token_id: int = 0,
+                                   pad_token_id: int = 0,
+                                   eos_token_id: int = 1,
+                                   seed: int = 0) -> "ArrayDataset":
+        """T5 span-corruption pretraining (the objective behind every T5
+        checkpoint): ~``corruption_rate`` of tokens are dropped in spans
+        of mean length ``mean_span_length``; each span is replaced by a
+        sentinel (<extra_id_i> = vocab_size-1-i, descending) in the
+        source, and the target interleaves sentinels with the dropped
+        spans plus a final sentinel — the paper's layout::
+
+            source: Thank you <X> me to your party <Y> week .
+            target: <X> for inviting <Y> last <Z>
+        """
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+            shift_right,
+        )
+
+        enc = tokenizer(texts, truncation=True, padding="max_length",
+                        max_length=max_source_length,
+                        add_special_tokens=False)
+        ids = np.asarray(enc["input_ids"], np.int32)
+        am = np.asarray(enc["attention_mask"], np.int32)
+        vocab = int(getattr(tokenizer, "vocab_size"))
+        rng = np.random.RandomState(seed)
+
+        def partition(total: int, parts: int) -> list[int]:
+            """total split into ``parts`` random segments, each >= 1."""
+            cuts = np.sort(rng.choice(total - 1, parts - 1, replace=False)) + 1 \
+                if parts > 1 else np.array([], np.int64)
+            bounds = np.concatenate([[0], cuts, [total]])
+            return list(np.diff(bounds))
+
+        n_rows = ids.shape[0]
+        src = np.full((n_rows, max_source_length), pad_token_id, np.int32)
+        src_mask = np.zeros((n_rows, max_source_length), np.int32)
+        tgt_ids = np.full((n_rows, max_target_length), pad_token_id, np.int32)
+        tgt_mask = np.zeros((n_rows, max_target_length), np.int32)
+        for r in range(n_rows):
+            toks = ids[r][am[r] > 0]
+            n = len(toks)
+            if n < 4:
+                src[r, :n] = toks
+                src[r, min(n, max_source_length - 1)] = eos_token_id
+                src_mask[r, : min(n + 1, max_source_length)] = 1
+                tgt_ids[r, 0] = eos_token_id
+                tgt_mask[r, 0] = 1
+                continue
+            num_noise = int(np.clip(round(n * corruption_rate), 1, n - 2))
+            # num_spans+1 keep-segments of >= 1 token must fit in the
+            # n - num_noise kept tokens
+            num_spans = int(np.clip(round(num_noise / mean_span_length),
+                                    1, min(num_noise, n - num_noise - 1,
+                                           n_sentinels - 1)))
+            noise_lens = partition(num_noise, num_spans)
+            keep_lens = partition(n - num_noise, num_spans + 1)
+            s_row: list[int] = []
+            t_row: list[int] = []
+            pos = 0
+            for i in range(num_spans):
+                sentinel = vocab - 1 - i
+                s_row += toks[pos: pos + keep_lens[i]].tolist() + [sentinel]
+                pos += keep_lens[i]
+                t_row += [sentinel] + toks[pos: pos + noise_lens[i]].tolist()
+                pos += noise_lens[i]
+            s_row += toks[pos:].tolist() + [eos_token_id]  # T5 inputs end </s>
+            t_row += [vocab - 1 - num_spans]          # final sentinel
+            s_row = s_row[:max_source_length]
+            t_row = t_row[: max_target_length - 1] + [eos_token_id]
+            src[r, : len(s_row)] = s_row
+            src_mask[r, : len(s_row)] = 1
+            tgt_ids[r, : len(t_row)] = t_row
+            tgt_mask[r, : len(t_row)] = 1
+        labels = np.where(tgt_mask > 0, tgt_ids, -100).astype(np.int32)
+        dec_in = np.asarray(shift_right(labels, decoder_start_token_id,
+                                        pad_token_id), np.int32)
+        return cls({"input_ids": src, "attention_mask": src_mask,
+                    "decoder_input_ids": dec_in,
+                    "decoder_attention_mask": tgt_mask,
+                    "labels": labels})
+
+    @classmethod
     def from_rtd_texts(cls, tokenizer, texts, max_length: int = 512,
                        replace_probability: float = 0.15,
                        seed: int = 0) -> "ArrayDataset":

@@ -101,6 +101,30 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
         questions, contexts, starts, answers = load_qa(config.dataset, split, **kw)
         return ArrayDataset.from_qa(tokenizer, questions, contexts, starts,
                                     answers, max_len)
+    if config.task == "seq2seq" and config.span_corruption:
+        try:
+            texts, _ = load_text_classification(config.dataset, split, **kw)
+        except ValueError:
+            # seq2seq-registry datasets (cnn_dailymail, ...) work as a
+            # plain text corpus: corrupt the source documents
+            texts, _ = load_seq2seq(config.dataset, split, **kw)
+        # a corrupted 512-token source needs ~0.2*len target tokens
+        # (spans + sentinels + final sentinel); the task default of 64
+        # would truncate spans away silently
+        needed = int(max_len * 0.2) + 4
+        tgt_len = max(config.max_target_length, needed)
+        if tgt_len != config.max_target_length:
+            get_logger("train").info(
+                "span_corruption: raising max_target_length %d → %d to fit "
+                "the corrupted spans", config.max_target_length, tgt_len)
+        return ArrayDataset.from_span_corruption_texts(
+            tokenizer, texts, max_source_length=max_len,
+            max_target_length=tgt_len,
+            decoder_start_token_id=getattr(model_config,
+                                           "decoder_start_token_id", 0),
+            pad_token_id=getattr(model_config, "pad_token_id", 0),
+            eos_token_id=getattr(model_config, "eos_token_id", 1),
+            seed=config.seed)
     if config.task == "seq2seq":
         sources, targets = load_seq2seq(config.dataset, split, **kw)
         return ArrayDataset.from_seq2seq(
