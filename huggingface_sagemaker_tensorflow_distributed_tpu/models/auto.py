@@ -77,6 +77,7 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("deberta-v2", "qa"): deberta.DebertaV2ForQuestionAnswering,
     ("deberta-v2", "mlm"): deberta.DebertaV2ForMaskedLM,
     ("electra", "rtd"): electra.ElectraForPreTraining,
+    ("electra", "mlm"): electra.ElectraForMaskedLM,
 }
 
 CONFIG_BUILDERS = {

@@ -150,6 +150,11 @@ _ELECTRA_RULES = [
     # RTD discriminator head (ElectraForPreTraining)
     (r"^discriminator_predictions\.dense$", r"disc_dense"),
     (r"^discriminator_predictions\.dense_prediction$", r"disc_prediction"),
+    # generator MLM head; generator_lm_head.weight is the tied embedding
+    # (kernel lands on a path the template lacks and is dropped by merge)
+    (r"^generator_predictions\.dense$", r"mlm_head/transform"),
+    (r"^generator_predictions\.LayerNorm$", r"mlm_head/ln"),
+    (r"^generator_lm_head$", r"mlm_head"),
     # ElectraClassificationHead
     (r"^classifier\.dense$", r"head/head_dense"),
     (r"^classifier\.out_proj$", r"head/classifier"),
@@ -442,6 +447,9 @@ _T5_REVERSE = [
 _ELECTRA_REVERSE = [
     (r"^disc_dense$", "discriminator_predictions.dense"),
     (r"^disc_prediction$", "discriminator_predictions.dense_prediction"),
+    (r"^mlm_head/transform$", "generator_predictions.dense"),
+    (r"^mlm_head/ln$", "generator_predictions.LayerNorm"),
+    (r"^mlm_head$", "generator_lm_head"),
     (r"^backbone/embeddings/word_embeddings$", "electra.embeddings.word_embeddings"),
     (r"^backbone/embeddings/position_embeddings$", "electra.embeddings.position_embeddings"),
     (r"^backbone/embeddings/token_type_embeddings$", "electra.embeddings.token_type_embeddings"),

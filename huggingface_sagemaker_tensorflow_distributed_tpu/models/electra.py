@@ -23,6 +23,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
     EncoderBackbone,
     EncoderConfig,
     _dense,
+    MlmHead,
 )
 
 
@@ -121,3 +122,23 @@ class ElectraForPreTraining(nn.Module):
         x = _dense(cfg, cfg.hidden_size, "disc_dense")(seq)
         x = ACT2FN[cfg.hidden_act](x)
         return _dense(cfg, 1, "disc_prediction")(x)[..., 0].astype(jnp.float32)
+
+
+class ElectraForMaskedLM(nn.Module):
+    """Generator MLM head (HF ``ElectraForMaskedLM``:
+    ``generator_predictions`` dense→gelu→LN + ``generator_lm_head`` tied
+    to the factorized word embeddings) — the generator half of ELECTRA
+    pretraining; the discriminator half is ``ElectraForPreTraining``."""
+
+    config: EncoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        seq, _ = EncoderBackbone(self.config, name="backbone")(
+            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+        table = self.variables["params"]["backbone"]["embeddings"][
+            "word_embeddings"]["embedding"]
+        # HF ElectraGeneratorPredictions hardcodes gelu regardless of
+        # config.hidden_act
+        return MlmHead(self.config, act="gelu", name="mlm_head")(seq, table)

@@ -277,15 +277,18 @@ class MlmHead(nn.Module):
     then a decoder TIED to the word-embedding table (passed in by the
     family model, which reads it from its own bound variables) plus an
     output bias — HF ``BertLMPredictionHead`` / ``RobertaLMHead`` /
-    DistilBERT ``vocab_transform``+``vocab_projector`` parity."""
+    DistilBERT ``vocab_transform``+``vocab_projector`` parity.
+    ``act`` overrides the config activation for heads HF hardcodes
+    (ELECTRA's generator always uses gelu)."""
 
     config: EncoderConfig
+    act: Optional[str] = None
 
     @nn.compact
     def __call__(self, hidden, embedding_table):
         cfg = self.config
         x = _dense(cfg, embedding_table.shape[1], "transform")(hidden)
-        x = ACT2FN[cfg.hidden_act](x)
+        x = ACT2FN[self.act or cfg.hidden_act](x)
         x = _layernorm(cfg, "ln")(x)
         logits = jnp.einsum("bsh,vh->bsv", x,
                             embedding_table.astype(cfg.dtype))

@@ -96,3 +96,31 @@ def test_rtd_training_learns(devices8):
     batcher = ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0)
     history = trainer.fit(batcher)
     assert history["loss"][-1] < history["loss"][0] * 0.95
+
+
+def test_electra_generator_mlm_parity(tmp_path):
+    """ELECTRA's generator MLM head (the other half of its pretraining);
+    weights perturbed so dropped params can't hide behind fresh init."""
+    torch.manual_seed(1)
+    cfg = transformers.ElectraConfig(
+        vocab_size=128, hidden_size=32, embedding_size=16,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = transformers.ElectraForMaskedLM(cfg).eval()
+    with torch.no_grad():
+        for p in m.parameters():
+            p.add_(torch.randn_like(p) * 0.02)
+    d = str(tmp_path / "gen")
+    m.save_pretrained(d)
+    model, params, fam, _ = auto_models.from_pretrained(d, task="mlm")
+    assert fam == "electra"
+    r = np.random.RandomState(0)
+    ids = r.randint(4, 128, (3, 12))
+    mask = np.ones((3, 12), np.int64)
+    with torch.no_grad():
+        t_out = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+                        deterministic=True)
+    np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                               atol=2e-4, rtol=1e-3)
