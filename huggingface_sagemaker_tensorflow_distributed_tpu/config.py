@@ -85,6 +85,11 @@ class TrainConfig:
     eval_batch_size: int = 4
     learning_rate: float = 5e-5
     scale_lr_by_world_size: bool = True   # reference semantics: lr × hvd.size() (train.py:112)
+    # adamw default (adam = exact reference parity, coupled, no decay);
+    # adafactor = T5's sublinear-memory pretraining optimizer (no
+    # weight_decay); lamb = large-batch (pod-scale) BERT
+    optimizer: str = "adamw"       # adamw | adam | adafactor | lamb
+    lr_schedule: str = "linear"    # linear | cosine (with warmup_ratio > 0)
     warmup_ratio: float = 0.0
     weight_decay: float = 0.0
     max_grad_norm: float = 0.0     # 0 disables clipping (reference has none)
@@ -202,6 +207,24 @@ class TrainConfig:
             raise ValueError("gradient_accumulation_steps must be >= 1")
         if self.learning_rate <= 0:
             raise ValueError("learning_rate must be positive")
+        if self.optimizer not in ("adamw", "adam", "adafactor", "lamb"):
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
+        if self.optimizer == "adafactor" and self.weight_decay > 0:
+            raise ValueError(
+                "weight_decay with adafactor is not supported: optax "
+                "applies it per-update after lr scaling (~1/lr stronger "
+                "than AdamW's decoupled decay); use adamw or lamb")
+        if self.optimizer == "adam" and self.weight_decay > 0:
+            raise ValueError(
+                "optimizer='adam' is plain coupled Adam (reference "
+                "parity) and ignores weight_decay; use adamw")
+        if self.lr_schedule not in ("linear", "cosine"):
+            raise ValueError(f"unknown lr_schedule {self.lr_schedule!r}")
+        if self.lr_schedule == "cosine" and self.warmup_ratio <= 0:
+            raise ValueError(
+                "lr_schedule='cosine' needs warmup_ratio > 0 (schedules "
+                "only engage with a warmup+decay window; without it the "
+                "lr is constant and the flag would be silently ignored)")
         for ax in ("fsdp", "ep", "pp", "tp", "sp"):
             if getattr(self, ax) <= 0:
                 raise ValueError(f"mesh axis {ax} must be positive")

@@ -7,9 +7,11 @@ allreduce wrapper does not exist — gradients are averaged across the
 data axes by XLA because the loss is a global mean over a sharded batch;
 optax only ever sees already-reduced gradients.
 
-Beyond reference parity: optional warmup + linear decay schedule,
-decoupled weight decay (AdamW) and global-norm clipping — standard
-fine-tuning practice the reference omits.
+Beyond reference parity: optimizer choice (AdamW; Adafactor — T5's own
+pretraining optimizer, sublinear memory; LAMB — the large-batch BERT
+optimizer for pod-scale global batches), warmup + linear/cosine decay
+schedules, decoupled weight decay and global-norm clipping — standard
+practice the reference omits.
 """
 
 from __future__ import annotations
@@ -35,13 +37,32 @@ def build_optimizer(
         # are total_steps // accum (micro-steps in between don't count)
         updates = max(1, total_steps // accum)
         warmup = max(1, int(updates * config.warmup_ratio))
-        schedule = optax.schedules.warmup_linear_decay_schedule(
-            init_value=0.0, peak_value=lr, warmup_steps=warmup,
-            decay_steps=updates, end_value=0.0)
+        if config.lr_schedule == "cosine":
+            schedule = optax.schedules.warmup_cosine_decay_schedule(
+                init_value=0.0, peak_value=lr, warmup_steps=warmup,
+                decay_steps=updates, end_value=0.0)
+        else:
+            schedule = optax.schedules.warmup_linear_decay_schedule(
+                init_value=0.0, peak_value=lr, warmup_steps=warmup,
+                decay_steps=updates, end_value=0.0)
     else:
         schedule = lr  # constant — reference behavior (train.py:113)
 
-    if config.weight_decay > 0:
+    if config.optimizer == "adafactor":
+        # T5's pretraining optimizer: factored second moments, sublinear
+        # optimizer memory — the natural choice for the biggest models.
+        # weight_decay is rejected at config validation: optax applies
+        # adafactor's weight_decay_rate per-update AFTER lr scaling
+        # (~1/lr stronger than AdamW's decoupled decay — silent model
+        # destruction territory).
+        core = optax.adafactor(schedule)
+    elif config.optimizer == "lamb":
+        core = optax.lamb(schedule, weight_decay=config.weight_decay)
+    elif config.optimizer == "adam":
+        # plain coupled Adam — exact reference parity (train.py:113);
+        # weight_decay>0 with it is rejected at config validation
+        core = optax.adam(schedule)
+    elif config.weight_decay > 0:
         core = optax.adamw(schedule, weight_decay=config.weight_decay)
     else:
         core = optax.adam(schedule)

@@ -96,3 +96,18 @@ def test_num_chips_env_parity(monkeypatch):
     monkeypatch.setenv("TPU_NUM_CHIPS", "not-a-number")
     monkeypatch.delenv("SM_NUM_GPUS", raising=False)
     assert TrainConfig().num_chips is None
+
+
+def test_optimizer_validation():
+    import pytest as _pytest
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+
+    with _pytest.raises(ValueError, match="adafactor"):
+        TrainConfig(optimizer="adafactor", weight_decay=0.01)
+    with _pytest.raises(ValueError, match="adamw"):
+        TrainConfig(optimizer="adam", weight_decay=0.01)
+    with _pytest.raises(ValueError, match="cosine"):
+        TrainConfig(lr_schedule="cosine")          # no warmup
+    TrainConfig(optimizer="adam")                  # plain Adam ok
+    TrainConfig(lr_schedule="cosine", warmup_ratio=0.1)

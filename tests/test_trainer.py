@@ -221,3 +221,34 @@ def test_gradient_accumulation_matches_big_batch():
         # fp32 mean-of-means vs one mean: reduction-order noise only
         np.testing.assert_allclose(np.asarray(y), np.asarray(x),
                                    atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("optimizer,lr,wd", [("adafactor", 8e-2, 0.0),
+                                             ("lamb", 2e-2, 0.01)])
+def test_alternative_optimizers_learn(devices8, optimizer, lr, wd):
+    """Adafactor (T5's pretraining optimizer) and LAMB (large-batch
+    BERT) both drive the loss down through the same trainer."""
+    ds = _data(n=128)
+    mesh = build_mesh(MeshConfig(), devices=devices8)
+    model, params = _tiny_model()
+    # both optimizers rescale the raw lr (Adafactor by parameter scale —
+    # tiny init norms mean tiny steps — LAMB by trust ratio), so the
+    # tiny model needs a hotter lr / more updates than adam
+    cfg = TrainConfig(dtype="float32", learning_rate=lr,
+                      optimizer=optimizer, weight_decay=wd,
+                      scale_lr_by_world_size=False, log_every_steps=0,
+                      rng_impl="threefry", epochs=8)
+    trainer = Trainer(cfg, model, params, mesh)
+    batcher = ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0)
+    history = trainer.fit(batcher)
+    assert history["loss"][-1] < history["loss"][0] * 0.9
+
+
+def test_cosine_schedule_builds(devices8):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.optim import (
+        build_optimizer,
+    )
+
+    cfg = TrainConfig(dtype="float32", warmup_ratio=0.1, lr_schedule="cosine")
+    tx, lr = build_optimizer(cfg, world_size=1, total_steps=100)
+    assert lr == cfg.learning_rate
