@@ -29,6 +29,7 @@ import numpy as np
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.models import (
     albert,
+    bart,
     bert,
     deberta,
     distilbert,
@@ -78,6 +79,7 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("deberta-v2", "mlm"): deberta.DebertaV2ForMaskedLM,
     ("electra", "rtd"): electra.ElectraForPreTraining,
     ("electra", "mlm"): electra.ElectraForMaskedLM,
+    ("bart", "seq2seq"): bart.BartForConditionalGeneration,
 }
 
 CONFIG_BUILDERS = {
@@ -89,6 +91,7 @@ CONFIG_BUILDERS = {
     "t5": t5.t5_config_from_hf,
     "gpt2": gpt2.gpt2_config_from_hf,
     "deberta-v2": deberta.deberta_config_from_hf,
+    "bart": bart.bart_config_from_hf,
 }
 
 # Our config → HF config.json for export
@@ -198,6 +201,23 @@ _HF_CONFIG_EXPORTERS = {
         "pad_token_id": c.pad_token_id,
         "initializer_range": c.initializer_range,
     },
+    "bart": lambda c: {
+        "model_type": "bart", "architectures": ["BartForConditionalGeneration"],
+        "vocab_size": c.vocab_size, "d_model": c.d_model,
+        "encoder_layers": c.encoder_layers, "decoder_layers": c.decoder_layers,
+        "encoder_attention_heads": c.encoder_attention_heads,
+        "decoder_attention_heads": c.decoder_attention_heads,
+        "encoder_ffn_dim": c.encoder_ffn_dim,
+        "decoder_ffn_dim": c.decoder_ffn_dim,
+        "activation_function": c.activation_function,
+        "dropout": c.dropout, "attention_dropout": c.attention_dropout,
+        "activation_dropout": c.activation_dropout,
+        "max_position_embeddings": c.max_position_embeddings,
+        "init_std": c.init_std, "scale_embedding": c.scale_embedding,
+        "pad_token_id": c.pad_token_id, "bos_token_id": c.bos_token_id,
+        "eos_token_id": c.eos_token_id,
+        "decoder_start_token_id": c.decoder_start_token_id,
+    },
     "t5": lambda c: {
         "model_type": "t5", "architectures": ["T5ForConditionalGeneration"],
         "vocab_size": c.vocab_size, "d_model": c.d_model, "d_kv": c.d_kv,
@@ -284,12 +304,12 @@ def from_pretrained(
         raise ValueError(
             f"pipeline_stages={wants_pp} is not supported for family "
             f"{family!r}; supported: {sorted(_PIPELINE_FAMILIES)}")
-    if family == "t5" and task != "seq2seq":
+    if family in ("t5", "bart") and task != "seq2seq":
         # failing loudly here beats a TypeError deep inside jit tracing
         # when the seq-cls loss feeds an encoder-decoder model
         raise ValueError(
-            f"{model_name_or_path!r} is a T5 (encoder-decoder) checkpoint; "
-            f"it only supports task='seq2seq', got task={task!r}")
+            f"{model_name_or_path!r} is a {family} (encoder-decoder) "
+            f"checkpoint; it only supports task='seq2seq', got task={task!r}")
     if (family == "deberta-v2" and task == "mlm"
             and hf_config.get("legacy") is False):
         raise ValueError(

@@ -1,0 +1,317 @@
+"""BART: post-LN encoder-decoder LM (summarization's workhorse).
+
+Extends the seq2seq surface beyond T5 (SURVEY.md D7 — the reference's
+HF ecosystem carries BART via the same Auto* machinery as BERT). HF
+``BartForConditionalGeneration`` parity:
+
+- shared token embedding (optionally scaled by sqrt(d_model)) + LEARNED
+  positions with BART's legacy offset of 2 (``embed_positions`` has
+  ``max_position_embeddings + 2`` rows), per-stack ``layernorm_embedding``;
+- post-LN blocks: residual → dropout → add → LayerNorm, with separate
+  self-attn / cross-attn / FFN norms; activation dropout inside the FFN;
+- attention with biased q/k/v/out projections, q pre-scaled by
+  ``head_dim**-0.5``;
+- LM head tied to the shared embedding. HF's ``final_logits_bias``
+  buffer is NOT modeled: it is zeros in every published checkpoint (HF
+  only resizes it when growing the vocab), and both load and export
+  skip it.
+
+``encode``/``decode`` expose the same apply-method interface as T5, so
+``models/generate.py`` (greedy / sampling / beam search, incremental KV
+cache) drives BART unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import ACT2FN
+from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import xla_attention
+
+NEG_INF = -1e9
+_POS_OFFSET = 2   # BartLearnedPositionalEmbedding's legacy offset
+
+
+@dataclass(frozen=True)
+class BartConfig:
+    vocab_size: int = 50265
+    d_model: int = 768
+    encoder_layers: int = 6
+    decoder_layers: int = 6
+    encoder_attention_heads: int = 12
+    decoder_attention_heads: int = 12
+    encoder_ffn_dim: int = 3072
+    decoder_ffn_dim: int = 3072
+    activation_function: str = "gelu"
+    dropout: float = 0.1
+    attention_dropout: float = 0.0
+    activation_dropout: float = 0.0
+    max_position_embeddings: int = 1024
+    init_std: float = 0.02
+    scale_embedding: bool = False
+    pad_token_id: int = 1
+    bos_token_id: int = 0
+    eos_token_id: int = 2
+    decoder_start_token_id: int = 2
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"
+    remat: bool = False
+
+
+def bart_config_from_hf(hf_config: dict, **overrides) -> BartConfig:
+    kw = dict(
+        vocab_size=hf_config["vocab_size"],
+        d_model=hf_config["d_model"],
+        encoder_layers=hf_config["encoder_layers"],
+        decoder_layers=hf_config["decoder_layers"],
+        encoder_attention_heads=hf_config["encoder_attention_heads"],
+        decoder_attention_heads=hf_config["decoder_attention_heads"],
+        encoder_ffn_dim=hf_config["encoder_ffn_dim"],
+        decoder_ffn_dim=hf_config["decoder_ffn_dim"],
+        activation_function=hf_config.get("activation_function", "gelu"),
+        dropout=hf_config.get("dropout", 0.1),
+        attention_dropout=hf_config.get("attention_dropout", 0.0),
+        activation_dropout=hf_config.get("activation_dropout", 0.0),
+        max_position_embeddings=hf_config.get("max_position_embeddings", 1024),
+        init_std=hf_config.get("init_std", 0.02),
+        scale_embedding=hf_config.get("scale_embedding", False),
+        pad_token_id=hf_config.get("pad_token_id", 1),
+        bos_token_id=hf_config.get("bos_token_id", 0),
+        eos_token_id=hf_config.get("eos_token_id", 2),
+        decoder_start_token_id=(
+            hf_config["decoder_start_token_id"]
+            if hf_config.get("decoder_start_token_id") is not None
+            else hf_config.get("eos_token_id", 2)),
+    )
+    kw.update(overrides)
+    kw.pop("use_pooler", None)
+    return BartConfig(**kw)
+
+
+def _dense(cfg, features: int, name: str) -> nn.Dense:
+    return nn.Dense(features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    kernel_init=nn.initializers.normal(cfg.init_std),
+                    name=name)
+
+
+def _ln(cfg, name: str) -> nn.LayerNorm:
+    return nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name=name)
+
+
+def _padding_mask(attention_mask, dtype=jnp.float32):
+    m = attention_mask[:, None, None, :].astype(dtype)
+    return (1.0 - m) * NEG_INF
+
+
+class BartAttention(nn.Module):
+    """Biased-projection attention, q pre-scaled; optional causal cache
+    (same incremental pattern as T5Attention)."""
+
+    config: BartConfig
+    num_heads: int
+
+    @nn.compact
+    def __call__(self, hidden, kv_hidden=None, mask=None,
+                 deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        d = cfg.d_model
+        head_dim = d // self.num_heads
+        source = hidden if kv_hidden is None else kv_hidden
+
+        def split(x):
+            b, s, _ = x.shape
+            return x.reshape(b, s, self.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        q = split(_dense(cfg, d, "query")(hidden)) * head_dim ** -0.5
+        k = split(_dense(cfg, d, "key")(source))
+        v = split(_dense(cfg, d, "value")(source))
+
+        if decode and kv_hidden is None:
+            is_init = self.has_variable("cache", "cached_key")
+            cached_k = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            cache_index = self.variable("cache", "cache_index",
+                                        lambda: jnp.array(0, jnp.int32))
+            if is_init:
+                cur = cache_index.value
+                max_len = cached_k.value.shape[2]
+                q_len = q.shape[2]
+                k = lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
+                v = lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
+                cached_k.value, cached_v.value = k, v
+                cache_index.value = cur + q_len
+                valid = jnp.arange(max_len)[None, :] <= (
+                    cur + jnp.arange(q_len)[:, None])
+                step_mask = jnp.where(valid, 0.0, NEG_INF)[None, None]
+                mask = step_mask if mask is None else mask + step_mask
+
+        if cfg.attention_dropout > 0 and not deterministic:
+            # HF applies dropout to the attention probabilities during
+            # training; the fused xla_attention path has no hook for it
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+            if mask is not None:
+                logits = logits + mask.astype(jnp.float32)
+            probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+            probs = nn.Dropout(cfg.attention_dropout)(probs, deterministic=False)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        else:
+            ctx = xla_attention(q, k, v, mask=mask, scale=1.0)
+        b, h, s, hd = ctx.shape
+        out = _dense(cfg, d, "attention_out")(
+            ctx.transpose(0, 2, 1, 3).reshape(b, s, h * hd))
+        return out
+
+
+class BartEncoderLayer(nn.Module):
+    config: BartConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
+        cfg = self.config
+        drop = nn.Dropout(cfg.dropout)
+        attn = BartAttention(cfg, cfg.encoder_attention_heads,
+                             name="self_attn")(hidden, mask=attn_mask,
+                                               deterministic=deterministic)
+        hidden = _ln(cfg, "self_attn_ln")(hidden + drop(attn, deterministic=deterministic))
+        x = ACT2FN[cfg.activation_function](
+            _dense(cfg, cfg.encoder_ffn_dim, "fc1")(hidden))
+        x = nn.Dropout(cfg.activation_dropout)(x, deterministic=deterministic)
+        x = _dense(cfg, cfg.d_model, "fc2")(x)
+        return _ln(cfg, "ffn_ln")(hidden + drop(x, deterministic=deterministic))
+
+
+class BartDecoderLayer(nn.Module):
+    config: BartConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, enc_hidden=None, enc_mask=None,
+                 deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        drop = nn.Dropout(cfg.dropout)
+        attn = BartAttention(cfg, cfg.decoder_attention_heads,
+                             name="self_attn")(hidden, mask=attn_mask,
+                                               deterministic=deterministic,
+                                               decode=decode)
+        hidden = _ln(cfg, "self_attn_ln")(hidden + drop(attn, deterministic=deterministic))
+        cross = BartAttention(cfg, cfg.decoder_attention_heads,
+                              name="cross_attn")(hidden, kv_hidden=enc_hidden,
+                                                 mask=enc_mask,
+                                                 deterministic=deterministic)
+        hidden = _ln(cfg, "cross_ln")(hidden + drop(cross, deterministic=deterministic))
+        x = ACT2FN[cfg.activation_function](
+            _dense(cfg, cfg.decoder_ffn_dim, "fc1")(hidden))
+        x = nn.Dropout(cfg.activation_dropout)(x, deterministic=deterministic)
+        x = _dense(cfg, cfg.d_model, "fc2")(x)
+        return _ln(cfg, "ffn_ln")(hidden + drop(x, deterministic=deterministic))
+
+
+class BartStack(nn.Module):
+    """Encoder or decoder stack: offset-2 learned positions +
+    layernorm_embedding over the (shared) token embeds, then the
+    post-LN layers."""
+
+    config: BartConfig
+    is_decoder: bool = False
+
+    @nn.compact
+    def __call__(self, embeds, attn_mask=None, enc_hidden=None,
+                 enc_mask=None, deterministic: bool = True,
+                 decode: bool = False):
+        cfg = self.config
+        positions = nn.Embed(
+            cfg.max_position_embeddings + _POS_OFFSET, cfg.d_model,
+            embedding_init=nn.initializers.normal(cfg.init_std),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="embed_positions")
+        pos_offset = 0
+        if self.is_decoder and decode:
+            # physical decode position tracked alongside the KV caches
+            is_init = self.has_variable("cache", "position_index")
+            idx = self.variable("cache", "position_index",
+                                lambda: jnp.array(0, jnp.int32))
+            if is_init:
+                pos_offset = idx.value
+                idx.value = pos_offset + embeds.shape[1]
+        pos_ids = pos_offset + jnp.arange(embeds.shape[1])[None, :] + _POS_OFFSET
+        x = _ln(cfg, "embed_ln")(embeds + positions(pos_ids))
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        n_layers = cfg.decoder_layers if self.is_decoder else cfg.encoder_layers
+        for i in range(n_layers):
+            if self.is_decoder:
+                layer_cls = BartDecoderLayer
+                if cfg.remat:
+                    layer_cls = nn.remat(BartDecoderLayer, static_argnums=(5, 6))
+                x = layer_cls(cfg, name=f"layer_{i}")(
+                    x, attn_mask, enc_hidden, enc_mask, deterministic, decode)
+            else:
+                layer_cls = BartEncoderLayer
+                if cfg.remat:
+                    layer_cls = nn.remat(BartEncoderLayer, static_argnums=(3,))
+                x = layer_cls(cfg, name=f"layer_{i}")(
+                    x, attn_mask, deterministic)
+        return x
+
+
+class BartForConditionalGeneration(nn.Module):
+    """Encoder-decoder LM head tied to the shared embedding; same
+    ``encode``/``decode`` generation interface as T5."""
+
+    config: BartConfig
+
+    is_encoder_decoder = True
+
+    def setup(self):
+        cfg = self.config
+        self.shared = nn.Embed(
+            cfg.vocab_size, cfg.d_model,
+            embedding_init=nn.initializers.normal(cfg.init_std),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        self.encoder = BartStack(cfg, is_decoder=False)
+        self.decoder = BartStack(cfg, is_decoder=True)
+
+    def _embed_tokens(self, ids):
+        cfg = self.config
+        scale = cfg.d_model ** 0.5 if cfg.scale_embedding else 1.0
+        return self.shared(ids) * scale
+
+    def encode(self, input_ids, attention_mask=None, deterministic: bool = True):
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        return self.encoder(self._embed_tokens(input_ids),
+                            attn_mask=_padding_mask(attention_mask),
+                            deterministic=deterministic)
+
+    def decode(self, decoder_input_ids, encoder_hidden,
+               encoder_attention_mask=None, decoder_attention_mask=None,
+               deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        dec_len = decoder_input_ids.shape[1]
+        if decode:
+            self_mask = None   # cache supplies causal masking
+        else:
+            i = jnp.arange(dec_len)[:, None]
+            j = jnp.arange(dec_len)[None, :]
+            self_mask = jnp.where(j <= i, 0.0, NEG_INF)[None, None]
+            if decoder_attention_mask is not None:
+                self_mask = self_mask + _padding_mask(decoder_attention_mask)
+        enc_mask = (None if encoder_attention_mask is None
+                    else _padding_mask(encoder_attention_mask))
+        x = self.decoder(self._embed_tokens(decoder_input_ids),
+                         attn_mask=self_mask,
+                         enc_hidden=encoder_hidden, enc_mask=enc_mask,
+                         deterministic=deterministic, decode=decode)
+        return self.shared.attend(x.astype(cfg.dtype)).astype(jnp.float32)
+
+    def __call__(self, input_ids, attention_mask=None, decoder_input_ids=None,
+                 decoder_attention_mask=None, deterministic: bool = True):
+        enc = self.encode(input_ids, attention_mask, deterministic)
+        return self.decode(decoder_input_ids, enc, attention_mask,
+                           decoder_attention_mask, deterministic)

@@ -219,6 +219,31 @@ _DEBERTA_V2_RULES = [
     (r"^cls\.predictions$", r"mlm_head"),
 ]
 
+
+_BART_RULES = [
+    (r"^(?:model\.)?shared$", r"shared"),
+    (r"^(?:model\.)?(?:encoder|decoder)\.embed_tokens$", r"shared"),  # alias
+    (r"^(?:model\.)?encoder\.embed_positions$", r"encoder/embed_positions"),
+    (r"^(?:model\.)?decoder\.embed_positions$", r"decoder/embed_positions"),
+    (r"^(?:model\.)?encoder\.layernorm_embedding$", r"encoder/embed_ln"),
+    (r"^(?:model\.)?decoder\.layernorm_embedding$", r"decoder/embed_ln"),
+    (r"^(?:model\.)?(encoder|decoder)\.layers\.(\d+)\.self_attn\.q_proj$", r"\1/layer_\2/self_attn/query"),
+    (r"^(?:model\.)?(encoder|decoder)\.layers\.(\d+)\.self_attn\.k_proj$", r"\1/layer_\2/self_attn/key"),
+    (r"^(?:model\.)?(encoder|decoder)\.layers\.(\d+)\.self_attn\.v_proj$", r"\1/layer_\2/self_attn/value"),
+    (r"^(?:model\.)?(encoder|decoder)\.layers\.(\d+)\.self_attn\.out_proj$", r"\1/layer_\2/self_attn/attention_out"),
+    (r"^(?:model\.)?(encoder|decoder)\.layers\.(\d+)\.self_attn_layer_norm$", r"\1/layer_\2/self_attn_ln"),
+    (r"^(?:model\.)?decoder\.layers\.(\d+)\.encoder_attn\.q_proj$", r"decoder/layer_\1/cross_attn/query"),
+    (r"^(?:model\.)?decoder\.layers\.(\d+)\.encoder_attn\.k_proj$", r"decoder/layer_\1/cross_attn/key"),
+    (r"^(?:model\.)?decoder\.layers\.(\d+)\.encoder_attn\.v_proj$", r"decoder/layer_\1/cross_attn/value"),
+    (r"^(?:model\.)?decoder\.layers\.(\d+)\.encoder_attn\.out_proj$", r"decoder/layer_\1/cross_attn/attention_out"),
+    (r"^(?:model\.)?decoder\.layers\.(\d+)\.encoder_attn_layer_norm$", r"decoder/layer_\1/cross_ln"),
+    (r"^(?:model\.)?(encoder|decoder)\.layers\.(\d+)\.fc1$", r"\1/layer_\2/fc1"),
+    (r"^(?:model\.)?(encoder|decoder)\.layers\.(\d+)\.fc2$", r"\1/layer_\2/fc2"),
+    (r"^(?:model\.)?(encoder|decoder)\.layers\.(\d+)\.final_layer_norm$", r"\1/layer_\2/ffn_ln"),
+    # final_logits_bias: zeros in every published checkpoint — skipped
+    # lm_head.weight: tied to shared — skipped
+]
+
 # GPT-2: HF Conv1D stores weights [in, out] (already Flax layout), so
 # this family is exempt from the kernel transpose in both directions.
 _GPT2_RULES = [
@@ -244,6 +269,7 @@ RULES_BY_FAMILY: dict[str, list] = {
     "t5": _T5_RULES,
     "gpt2": _GPT2_RULES,
     "deberta-v2": _DEBERTA_V2_RULES,
+    "bart": _BART_RULES,
 }
 
 _NO_TRANSPOSE_FAMILIES = ("gpt2",)
@@ -281,7 +307,7 @@ def translate_key(torch_key: str, family: str) -> str | None:
             is_embed = "word_embeddings" in base or "position_embeddings" in base \
                 or "token_type_embeddings" in base or "rel_bias" in base \
                 or "rel_embeddings" in base or base == "shared" \
-                or leaf_name in ("wte", "wpe")
+                or leaf_name in ("wte", "wpe", "embed_positions")
             is_ln = leaf_name.endswith("_ln") or leaf_name.startswith("ln_") \
                 or leaf_name == "ln" or "layernorm" in leaf_name.lower()
             if kind == "weight":
@@ -532,6 +558,28 @@ _DEBERTA_V2_REVERSE = [
     (r"^mlm_head$", "cls.predictions"),
 ]
 
+
+_BART_REVERSE = [
+    (r"^shared$", "model.shared"),
+    (r"^encoder/embed_positions$", "model.encoder.embed_positions"),
+    (r"^decoder/embed_positions$", "model.decoder.embed_positions"),
+    (r"^encoder/embed_ln$", "model.encoder.layernorm_embedding"),
+    (r"^decoder/embed_ln$", "model.decoder.layernorm_embedding"),
+    (r"^(encoder|decoder)/layer_(\d+)/self_attn/query$", "model.{}.layers.{}.self_attn.q_proj"),
+    (r"^(encoder|decoder)/layer_(\d+)/self_attn/key$", "model.{}.layers.{}.self_attn.k_proj"),
+    (r"^(encoder|decoder)/layer_(\d+)/self_attn/value$", "model.{}.layers.{}.self_attn.v_proj"),
+    (r"^(encoder|decoder)/layer_(\d+)/self_attn/attention_out$", "model.{}.layers.{}.self_attn.out_proj"),
+    (r"^(encoder|decoder)/layer_(\d+)/self_attn_ln$", "model.{}.layers.{}.self_attn_layer_norm"),
+    (r"^decoder/layer_(\d+)/cross_attn/query$", "model.decoder.layers.{}.encoder_attn.q_proj"),
+    (r"^decoder/layer_(\d+)/cross_attn/key$", "model.decoder.layers.{}.encoder_attn.k_proj"),
+    (r"^decoder/layer_(\d+)/cross_attn/value$", "model.decoder.layers.{}.encoder_attn.v_proj"),
+    (r"^decoder/layer_(\d+)/cross_attn/attention_out$", "model.decoder.layers.{}.encoder_attn.out_proj"),
+    (r"^decoder/layer_(\d+)/cross_ln$", "model.decoder.layers.{}.encoder_attn_layer_norm"),
+    (r"^(encoder|decoder)/layer_(\d+)/fc1$", "model.{}.layers.{}.fc1"),
+    (r"^(encoder|decoder)/layer_(\d+)/fc2$", "model.{}.layers.{}.fc2"),
+    (r"^(encoder|decoder)/layer_(\d+)/ffn_ln$", "model.{}.layers.{}.final_layer_norm"),
+]
+
 REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_REVERSE,
     "roberta": _ROBERTA_REVERSE,
@@ -541,6 +589,7 @@ REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "t5": _T5_REVERSE,
     "gpt2": _GPT2_REVERSE,
     "deberta-v2": _DEBERTA_V2_REVERSE,
+    "bart": _BART_REVERSE,
 }
 
 
