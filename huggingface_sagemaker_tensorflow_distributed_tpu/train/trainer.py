@@ -94,9 +94,13 @@ def seq_cls_loss(apply_fn, params, batch, rngs, train: bool):
     return _masked_sums(per_ex, correct, valid)
 
 
-def token_cls_loss(apply_fn, params, batch, rngs, train: bool):
+def token_cls_loss(apply_fn, params, batch, rngs, train: bool,
+                   with_f1: bool = True):
     """Token-level CE with label masking (labels == -100 ignored, the HF
-    convention); covers the CoNLL NER breadth config."""
+    convention); covers the CoNLL NER breadth config. Eval sums include
+    micro-F1 components over the non-O classes (class 0 = outside), the
+    standard NER summary metric — disabled for tasks that merely share
+    the loss shape (MLM, where class 0 is a vocab token, not a tag)."""
     logits = _apply(apply_fn, params, batch, rngs, train)
     labels = batch["labels"]
     token_valid = (labels != -100) & (batch["attention_mask"] > 0)
@@ -104,8 +108,15 @@ def token_cls_loss(apply_fn, params, batch, rngs, train: bool):
         token_valid = token_valid & (batch["valid"][:, None] > 0)
     safe_labels = jnp.maximum(labels, 0)
     per_tok = softmax_cross_entropy_with_integer_labels(logits, safe_labels)
-    correct = jnp.argmax(logits, -1) == safe_labels
-    return _masked_sums(per_tok, correct, token_valid)
+    pred = jnp.argmax(logits, -1)
+    correct = pred == safe_labels
+    loss, sums = _masked_sums(per_tok, correct, token_valid)
+    if with_f1:
+        v = token_valid.astype(jnp.float32)
+        sums["f1_tp"] = jnp.sum(((pred != 0) & correct).astype(jnp.float32) * v)
+        sums["f1_fp"] = jnp.sum(((pred != 0) & ~correct).astype(jnp.float32) * v)
+        sums["f1_fn"] = jnp.sum(((safe_labels != 0) & ~correct).astype(jnp.float32) * v)
+    return loss, sums
 
 
 def qa_loss(apply_fn, params, batch, rngs, train: bool):
@@ -177,8 +188,9 @@ TASK_LOSSES: dict[str, Callable] = {
     "seq2seq": seq2seq_loss,
     "causal-lm": causal_lm_loss,
     # masked-LM: CE over the vocab at the masked positions only —
-    # exactly the token-cls shape (labels -100 everywhere else)
-    "mlm": token_cls_loss,
+    # exactly the token-cls shape (labels -100 everywhere else), but
+    # without the NER F1 (vocab id 0 is a token, not the O tag)
+    "mlm": functools.partial(token_cls_loss, with_f1=False),
     "rtd": rtd_loss,
 }
 
@@ -444,14 +456,12 @@ class Trainer:
         pin) stays bounded on arbitrarily large eval sets. The ``finally``
         stops the prefetch producer on any mid-eval failure."""
         chunk = 64
-        loss_sum = correct = count = 0.0
+        totals: dict[str, float] = {}
 
         def drain(device_sums):
-            nonlocal loss_sum, correct, count
             for sums in jax.device_get(device_sums):
-                loss_sum += float(sums["loss_sum"])
-                correct += float(sums["correct"])
-                count += float(sums["count"])
+                for key, val in sums.items():
+                    totals[key] = totals.get(key, 0.0) + float(val)
 
         device_sums: list = []
         batch_iter = eval_batcher.global_arrays(epoch=0)
@@ -465,8 +475,15 @@ class Trainer:
             if hasattr(batch_iter, "close"):
                 batch_iter.close()
         drain(device_sums)
-        count = max(count, 1.0)
-        return {"eval_loss": loss_sum / count, "eval_accuracy": correct / count}
+        count = max(totals.get("count", 0.0), 1.0)
+        results = {"eval_loss": totals.get("loss_sum", 0.0) / count,
+                   "eval_accuracy": totals.get("correct", 0.0) / count}
+        if "f1_tp" in totals:
+            # micro-F1 over the non-O classes, aggregated exactly across
+            # hosts/batches from the jitted sums
+            tp, fp, fn = (totals["f1_tp"], totals["f1_fp"], totals["f1_fn"])
+            results["eval_f1"] = 2 * tp / max(2 * tp + fp + fn, 1.0)
+        return results
 
     # -- results emission (reference train.py:154-179) ----------------------
 

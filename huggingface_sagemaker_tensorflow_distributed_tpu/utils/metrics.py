@@ -1,0 +1,45 @@
+"""Host-side text metrics (beyond the reference's accuracy-only
+surface, reference ``scripts/train.py:119``): ROUGE-L for generation
+quality. Token-level micro-F1 is aggregated exactly inside the jitted
+eval step instead (``train/trainer.py::token_cls_loss``)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def _lcs_len(a: Sequence, b: Sequence) -> int:
+    """Classic O(len(a)·len(b)) longest-common-subsequence length with a
+    rolling row (summaries are short; no need for anything fancier)."""
+    if not a or not b:
+        return 0
+    prev = [0] * (len(b) + 1)
+    for x in a:
+        cur = [0]
+        for j, y in enumerate(b, 1):
+            cur.append(prev[j - 1] + 1 if x == y else max(prev[j], cur[-1]))
+        prev = cur
+    return prev[-1]
+
+
+def rouge_l(predictions: Sequence[str], references: Sequence[str]) -> dict:
+    """Corpus ROUGE-L (sentence-level LCS, whitespace tokens, averaged
+    F-measure — the ``rouge_score`` default used by HF summarization
+    examples). Returns precision/recall/f1 means."""
+    if len(predictions) != len(references):
+        raise ValueError("predictions and references must align")
+    ps, rs, fs = [], [], []
+    for pred, ref in zip(predictions, references):
+        p_toks = pred.split()
+        r_toks = ref.split()
+        lcs = _lcs_len(p_toks, r_toks)
+        p = lcs / len(p_toks) if p_toks else 0.0
+        r = lcs / len(r_toks) if r_toks else 0.0
+        f = 2 * p * r / (p + r) if p + r else 0.0
+        ps.append(p)
+        rs.append(r)
+        fs.append(f)
+    n = max(len(fs), 1)
+    return {"rougeL_precision": sum(ps) / n,
+            "rougeL_recall": sum(rs) / n,
+            "rougeL_f1": sum(fs) / n}

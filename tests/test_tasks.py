@@ -104,3 +104,50 @@ def test_qa_learns():
     # span accuracy: argmax start/end both right counts 1.0
     assert hist["sparse_categorical_accuracy"][-1] > 0.6
     assert hist["loss"][-1] < hist["loss"][0] * 0.7
+
+
+def test_token_cls_eval_reports_micro_f1(devices8):
+    """token-cls eval aggregates micro-F1 components inside the jitted
+    step; a perfect predictor must score f1=1 and a constant-O predictor
+    f1=0 (accuracy can still be high — exactly why F1 is reported)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        token_cls_loss,
+    )
+
+    B, S, C = 2, 8, 3
+    labels = np.zeros((B, S), np.int32)
+    labels[:, :2] = 1            # a few entity tokens, rest O
+    batch = {"labels": jnp.asarray(labels),
+             "attention_mask": jnp.ones((B, S), jnp.int32),
+             "input_ids": jnp.ones((B, S), jnp.int32)}
+
+    def fake_apply(logits):
+        def apply_fn(variables, *a, **kw):
+            return logits
+        return apply_fn
+
+    perfect = jax.nn.one_hot(labels, C) * 10.0
+    _, sums = token_cls_loss(fake_apply(jnp.asarray(perfect)), None, batch, {}, False)
+    tp, fp, fn = float(sums["f1_tp"]), float(sums["f1_fp"]), float(sums["f1_fn"])
+    assert 2 * tp / (2 * tp + fp + fn) == 1.0
+
+    all_o = jax.nn.one_hot(np.zeros((B, S), np.int32), C) * 10.0
+    _, sums = token_cls_loss(fake_apply(jnp.asarray(all_o)), None, batch, {}, False)
+    assert float(sums["f1_tp"]) == 0.0 and float(sums["f1_fn"]) == 4.0
+
+
+def test_rouge_l():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import rouge_l
+
+    out = rouge_l(["the cat sat on the mat"], ["the cat sat on the mat"])
+    assert out["rougeL_f1"] == 1.0
+    out = rouge_l(["a b c d"], ["x y z w"])
+    assert out["rougeL_f1"] == 0.0
+    out = rouge_l(["the quick fox"], ["the slow fox"])
+    assert 0.0 < out["rougeL_f1"] < 1.0
+    with pytest.raises(ValueError):
+        rouge_l(["a"], ["a", "b"])
