@@ -71,6 +71,10 @@ class TrainConfig:
     # T5 pretraining: corrupt spans of the input text instead of a
     # source/target dataset (task stays seq2seq; any text source works)
     span_corruption: bool = False
+    # seq2seq eval extra: greedy-generate this many eval examples and
+    # report ROUGE-L alongside loss/accuracy (0 = off; generation is a
+    # separate pass, so this scales eval cost with the sample count)
+    eval_rouge_samples: int = 0
     from_scratch: bool = False     # random init instead of pretrained weights
 
     # --- data ---

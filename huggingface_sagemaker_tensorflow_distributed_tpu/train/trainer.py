@@ -98,9 +98,11 @@ def token_cls_loss(apply_fn, params, batch, rngs, train: bool,
                    with_f1: bool = True):
     """Token-level CE with label masking (labels == -100 ignored, the HF
     convention); covers the CoNLL NER breadth config. Eval sums include
-    micro-F1 components over the non-O classes (class 0 = outside), the
-    standard NER summary metric — disabled for tasks that merely share
-    the loss shape (MLM, where class 0 is a vocab token, not a tag)."""
+    TOKEN-level micro-F1 components over the non-O classes (class 0 =
+    outside). NB: published CoNLL baselines report ENTITY-level
+    (seqeval) F1, which is stricter — don't compare the two directly.
+    Disabled for tasks that merely share the loss shape (MLM, where
+    class 0 is a vocab token, not a tag)."""
     logits = _apply(apply_fn, params, batch, rngs, train)
     labels = batch["labels"]
     token_valid = (labels != -100) & (batch["attention_mask"] > 0)

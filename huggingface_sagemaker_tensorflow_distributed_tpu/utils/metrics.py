@@ -5,6 +5,7 @@ eval step instead (``train/trainer.py::token_cls_loss``)."""
 
 from __future__ import annotations
 
+import re
 from typing import Sequence
 
 
@@ -22,16 +23,24 @@ def _lcs_len(a: Sequence, b: Sequence) -> int:
     return prev[-1]
 
 
+def _rouge_tokens(text: str) -> list[str]:
+    """rouge_score's default tokenization: lowercase, alphanumeric runs
+    only (punctuation stripped) — without it, cased/punctuated model
+    output scores systematically below the HF baselines it is compared
+    against."""
+    return re.findall(r"[a-z0-9]+", text.lower())
+
+
 def rouge_l(predictions: Sequence[str], references: Sequence[str]) -> dict:
-    """Corpus ROUGE-L (sentence-level LCS, whitespace tokens, averaged
-    F-measure — the ``rouge_score`` default used by HF summarization
-    examples). Returns precision/recall/f1 means."""
+    """Corpus ROUGE-L (sentence-level LCS, rouge_score-style
+    tokenization, averaged F-measure — the default HF summarization
+    examples report). Returns precision/recall/f1 means."""
     if len(predictions) != len(references):
         raise ValueError("predictions and references must align")
     ps, rs, fs = [], [], []
     for pred, ref in zip(predictions, references):
-        p_toks = pred.split()
-        r_toks = ref.split()
+        p_toks = _rouge_tokens(pred)
+        r_toks = _rouge_tokens(ref)
         lcs = _lcs_len(p_toks, r_toks)
         p = lcs / len(p_toks) if p_toks else 0.0
         r = lcs / len(r_toks) if r_toks else 0.0

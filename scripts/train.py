@@ -257,6 +257,25 @@ def main(argv=None) -> dict:
         if config.do_eval:
             logger.info("*** Evaluate ***")
             eval_results = trainer.evaluate(eval_batcher)
+            if config.task == "seq2seq" and config.eval_rouge_samples:
+                import numpy as np
+
+                from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+                    generate,
+                )
+                from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import (
+                    rouge_l,
+                )
+
+                n = min(config.eval_rouge_samples, len(eval_ds))
+                cols = eval_ds[np.arange(n)]
+                out = generate(model, trainer.state.params,
+                               cols["input_ids"], cols["attention_mask"],
+                               max_new_tokens=config.max_target_length)
+                preds = [tokenizer.decode(r) for r in np.asarray(out)]
+                refs = [tokenizer.decode(r[r != -100])
+                        for r in cols["labels"]]
+                eval_results.update(rouge_l(preds, refs))
             trainer.write_eval_results(eval_results)
             results["eval"] = eval_results
 
