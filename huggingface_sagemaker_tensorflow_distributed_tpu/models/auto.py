@@ -245,11 +245,21 @@ _MOE_CONFIG_KEYS = ("num_experts", "expert_top_k", "moe_every",
                     "expert_capacity_factor", "router_aux_coef")
 
 
+# architecturally identical families that ship under their own
+# model_type: same modules, same state-dict key layout
+_FAMILY_ALIASES = {
+    "xlm-roberta": "roberta",   # XLM-R == RoBERTa with a bigger vocab
+    "camembert": "roberta",
+}
+
+
 def detect_family(hf_config: dict) -> str:
     mt = hf_config.get("model_type", "")
+    mt = _FAMILY_ALIASES.get(mt, mt)
     if mt in CONFIG_BUILDERS:
         return mt
-    raise ValueError(f"unsupported model_type {mt!r} (supported: {sorted(CONFIG_BUILDERS)})")
+    raise ValueError(f"unsupported model_type {mt!r} (supported: "
+                     f"{sorted(CONFIG_BUILDERS) + sorted(_FAMILY_ALIASES)})")
 
 
 def build_model(family: str, task: str, config: EncoderConfig, num_labels: int = 2):

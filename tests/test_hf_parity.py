@@ -262,3 +262,30 @@ def test_albert_head_dropout_follows_classifier_dropout_prob():
         "classifier_dropout_prob": 0.1})
     assert cfg.hidden_dropout == 0.0
     assert head_dropout_rate(cfg) == 0.1
+
+
+def test_xlm_roberta_alias_parity(tmp_path):
+    """XLM-RoBERTa (model_type xlm-roberta) is architecturally RoBERTa —
+    the family alias loads it with full numerics parity."""
+    torch.manual_seed(11)
+    cfg = transformers.XLMRobertaConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=66, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, pad_token_id=1)
+    m = transformers.XLMRobertaForSequenceClassification(cfg).eval()
+    with torch.no_grad():
+        for p in m.parameters():
+            p.add_(torch.randn_like(p) * 0.02)
+    d = str(tmp_path / "xlmr")
+    m.save_pretrained(d)
+    model, params, family, _ = auto_models.from_pretrained(
+        d, task="seq-cls", num_labels=2)
+    assert family == "roberta"
+    ids, mask = _inputs(128, pad_id=1)
+    with torch.no_grad():
+        t_out = m(input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+                        deterministic=True)
+    np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                               atol=TOL, rtol=1e-3)
