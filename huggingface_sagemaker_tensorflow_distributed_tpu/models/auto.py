@@ -80,6 +80,7 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("electra", "rtd"): electra.ElectraForPreTraining,
     ("electra", "mlm"): electra.ElectraForMaskedLM,
     ("bart", "seq2seq"): bart.BartForConditionalGeneration,
+    ("mbart", "seq2seq"): bart.BartForConditionalGeneration,
 }
 
 CONFIG_BUILDERS = {
@@ -92,9 +93,34 @@ CONFIG_BUILDERS = {
     "gpt2": gpt2.gpt2_config_from_hf,
     "deberta-v2": deberta.deberta_config_from_hf,
     "bart": bart.bart_config_from_hf,
+    # mBART hardcodes pre-LN + per-stack final LN in its modeling class
+    # (not in config.json), so the builder pins the variant flags
+    "mbart": lambda hf, **ov: bart.bart_config_from_hf(
+        hf, **{"normalize_before": True, "stack_final_ln": True, **ov}),
 }
 
 # Our config → HF config.json for export
+def _bart_hf_config(c) -> dict:
+    return {
+        "model_type": "bart", "architectures": ["BartForConditionalGeneration"],
+        "vocab_size": c.vocab_size, "d_model": c.d_model,
+        "encoder_layers": c.encoder_layers, "decoder_layers": c.decoder_layers,
+        "encoder_attention_heads": c.encoder_attention_heads,
+        "decoder_attention_heads": c.decoder_attention_heads,
+        "encoder_ffn_dim": c.encoder_ffn_dim,
+        "decoder_ffn_dim": c.decoder_ffn_dim,
+        "activation_function": c.activation_function,
+        "dropout": c.dropout, "attention_dropout": c.attention_dropout,
+        "activation_dropout": c.activation_dropout,
+        "max_position_embeddings": c.max_position_embeddings,
+        "init_std": c.init_std, "scale_embedding": c.scale_embedding,
+        "pad_token_id": c.pad_token_id, "bos_token_id": c.bos_token_id,
+        "eos_token_id": c.eos_token_id,
+        "decoder_start_token_id": c.decoder_start_token_id,
+        "forced_bos_token_id": c.forced_bos_token_id,
+    }
+
+
 _HF_CONFIG_EXPORTERS = {
     "bert": lambda c: {
         "model_type": "bert", "architectures": ["BertForSequenceClassification"],
@@ -201,23 +227,9 @@ _HF_CONFIG_EXPORTERS = {
         "pad_token_id": c.pad_token_id,
         "initializer_range": c.initializer_range,
     },
-    "bart": lambda c: {
-        "model_type": "bart", "architectures": ["BartForConditionalGeneration"],
-        "vocab_size": c.vocab_size, "d_model": c.d_model,
-        "encoder_layers": c.encoder_layers, "decoder_layers": c.decoder_layers,
-        "encoder_attention_heads": c.encoder_attention_heads,
-        "decoder_attention_heads": c.decoder_attention_heads,
-        "encoder_ffn_dim": c.encoder_ffn_dim,
-        "decoder_ffn_dim": c.decoder_ffn_dim,
-        "activation_function": c.activation_function,
-        "dropout": c.dropout, "attention_dropout": c.attention_dropout,
-        "activation_dropout": c.activation_dropout,
-        "max_position_embeddings": c.max_position_embeddings,
-        "init_std": c.init_std, "scale_embedding": c.scale_embedding,
-        "pad_token_id": c.pad_token_id, "bos_token_id": c.bos_token_id,
-        "eos_token_id": c.eos_token_id,
-        "decoder_start_token_id": c.decoder_start_token_id,
-    },
+    "bart": _bart_hf_config,
+    "mbart": lambda c: {**_bart_hf_config(c), "model_type": "mbart",
+                        "architectures": ["MBartForConditionalGeneration"]},
     "t5": lambda c: {
         "model_type": "t5", "architectures": ["T5ForConditionalGeneration"],
         "vocab_size": c.vocab_size, "d_model": c.d_model, "d_kv": c.d_kv,
@@ -314,7 +326,7 @@ def from_pretrained(
         raise ValueError(
             f"pipeline_stages={wants_pp} is not supported for family "
             f"{family!r}; supported: {sorted(_PIPELINE_FAMILIES)}")
-    if family in ("t5", "bart") and task != "seq2seq":
+    if family in ("t5", "bart", "mbart") and task != "seq2seq":
         # failing loudly here beats a TypeError deep inside jit tracing
         # when the seq-cls loss feeds an encoder-decoder model
         raise ValueError(

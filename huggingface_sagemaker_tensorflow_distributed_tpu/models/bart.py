@@ -59,10 +59,16 @@ class BartConfig:
     bos_token_id: int = 0
     eos_token_id: int = 2
     decoder_start_token_id: int = 2
+    # mBART: force this token (the target-language id) as the first
+    # generated token; generation honours it in greedy/sampling/beam
+    forced_bos_token_id: Optional[int] = None
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"
     remat: bool = False
+    # mBART variant: pre-LN blocks + a final LayerNorm per stack
+    normalize_before: bool = False
+    stack_final_ln: bool = False
 
 
 def bart_config_from_hf(hf_config: dict, **overrides) -> BartConfig:
@@ -89,6 +95,7 @@ def bart_config_from_hf(hf_config: dict, **overrides) -> BartConfig:
             hf_config["decoder_start_token_id"]
             if hf_config.get("decoder_start_token_id") is not None
             else hf_config.get("eos_token_id", 2)),
+        forced_bos_token_id=hf_config.get("forced_bos_token_id"),
     )
     kw.update(overrides)
     kw.pop("use_pooler", None)
@@ -177,15 +184,24 @@ class BartEncoderLayer(nn.Module):
     def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
         cfg = self.config
         drop = nn.Dropout(cfg.dropout)
+        attn_ln = _ln(cfg, "self_attn_ln")
+        ffn_ln = _ln(cfg, "ffn_ln")
+        pre = cfg.normalize_before          # mBART: LN before each sublayer
+
+        x = attn_ln(hidden) if pre else hidden
         attn = BartAttention(cfg, cfg.encoder_attention_heads,
-                             name="self_attn")(hidden, mask=attn_mask,
+                             name="self_attn")(x, mask=attn_mask,
                                                deterministic=deterministic)
-        hidden = _ln(cfg, "self_attn_ln")(hidden + drop(attn, deterministic=deterministic))
+        hidden = hidden + drop(attn, deterministic=deterministic)
+        if not pre:
+            hidden = attn_ln(hidden)
+        x = ffn_ln(hidden) if pre else hidden
         x = ACT2FN[cfg.activation_function](
-            _dense(cfg, cfg.encoder_ffn_dim, "fc1")(hidden))
+            _dense(cfg, cfg.encoder_ffn_dim, "fc1")(x))
         x = nn.Dropout(cfg.activation_dropout)(x, deterministic=deterministic)
         x = _dense(cfg, cfg.d_model, "fc2")(x)
-        return _ln(cfg, "ffn_ln")(hidden + drop(x, deterministic=deterministic))
+        hidden = hidden + drop(x, deterministic=deterministic)
+        return hidden if pre else ffn_ln(hidden)
 
 
 class BartDecoderLayer(nn.Module):
@@ -196,21 +212,34 @@ class BartDecoderLayer(nn.Module):
                  deterministic: bool = True, decode: bool = False):
         cfg = self.config
         drop = nn.Dropout(cfg.dropout)
+        attn_ln = _ln(cfg, "self_attn_ln")
+        cross_ln = _ln(cfg, "cross_ln")
+        ffn_ln = _ln(cfg, "ffn_ln")
+        pre = cfg.normalize_before
+
+        x = attn_ln(hidden) if pre else hidden
         attn = BartAttention(cfg, cfg.decoder_attention_heads,
-                             name="self_attn")(hidden, mask=attn_mask,
+                             name="self_attn")(x, mask=attn_mask,
                                                deterministic=deterministic,
                                                decode=decode)
-        hidden = _ln(cfg, "self_attn_ln")(hidden + drop(attn, deterministic=deterministic))
+        hidden = hidden + drop(attn, deterministic=deterministic)
+        if not pre:
+            hidden = attn_ln(hidden)
+        x = cross_ln(hidden) if pre else hidden
         cross = BartAttention(cfg, cfg.decoder_attention_heads,
-                              name="cross_attn")(hidden, kv_hidden=enc_hidden,
+                              name="cross_attn")(x, kv_hidden=enc_hidden,
                                                  mask=enc_mask,
                                                  deterministic=deterministic)
-        hidden = _ln(cfg, "cross_ln")(hidden + drop(cross, deterministic=deterministic))
+        hidden = hidden + drop(cross, deterministic=deterministic)
+        if not pre:
+            hidden = cross_ln(hidden)
+        x = ffn_ln(hidden) if pre else hidden
         x = ACT2FN[cfg.activation_function](
-            _dense(cfg, cfg.decoder_ffn_dim, "fc1")(hidden))
+            _dense(cfg, cfg.decoder_ffn_dim, "fc1")(x))
         x = nn.Dropout(cfg.activation_dropout)(x, deterministic=deterministic)
         x = _dense(cfg, cfg.d_model, "fc2")(x)
-        return _ln(cfg, "ffn_ln")(hidden + drop(x, deterministic=deterministic))
+        hidden = hidden + drop(x, deterministic=deterministic)
+        return hidden if pre else ffn_ln(hidden)
 
 
 class BartStack(nn.Module):
@@ -257,6 +286,8 @@ class BartStack(nn.Module):
                     layer_cls = nn.remat(BartEncoderLayer, static_argnums=(3,))
                 x = layer_cls(cfg, name=f"layer_{i}")(
                     x, attn_mask, deterministic)
+        if cfg.stack_final_ln:
+            x = _ln(cfg, "final_ln")(x)
         return x
 
 

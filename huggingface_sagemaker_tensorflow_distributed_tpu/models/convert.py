@@ -244,6 +244,11 @@ _BART_RULES = [
     # lm_head.weight: tied to shared — skipped
 ]
 
+# mBART: same key layout + a final LayerNorm per stack
+_MBART_RULES = _BART_RULES + [
+    (r"^(?:model\.)?(encoder|decoder)\.layer_norm$", r"\1/final_ln"),
+]
+
 # GPT-2: HF Conv1D stores weights [in, out] (already Flax layout), so
 # this family is exempt from the kernel transpose in both directions.
 _GPT2_RULES = [
@@ -270,6 +275,7 @@ RULES_BY_FAMILY: dict[str, list] = {
     "gpt2": _GPT2_RULES,
     "deberta-v2": _DEBERTA_V2_RULES,
     "bart": _BART_RULES,
+    "mbart": _MBART_RULES,
 }
 
 _NO_TRANSPOSE_FAMILIES = ("gpt2",)
@@ -580,6 +586,10 @@ _BART_REVERSE = [
     (r"^(encoder|decoder)/layer_(\d+)/ffn_ln$", "model.{}.layers.{}.final_layer_norm"),
 ]
 
+_MBART_REVERSE = _BART_REVERSE + [
+    (r"^(encoder|decoder)/final_ln$", "model.{}.layer_norm"),
+]
+
 REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_REVERSE,
     "roberta": _ROBERTA_REVERSE,
@@ -590,6 +600,7 @@ REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "gpt2": _GPT2_REVERSE,
     "deberta-v2": _DEBERTA_V2_REVERSE,
     "bart": _BART_REVERSE,
+    "mbart": _MBART_REVERSE,
 }
 
 
@@ -636,6 +647,22 @@ def params_to_hf(params: Any, family: str) -> dict[str, np.ndarray]:
     return out
 
 
+_GENERATION_KEYS = ("forced_bos_token_id", "forced_eos_token_id",
+                    "decoder_start_token_id", "bos_token_id",
+                    "eos_token_id", "pad_token_id")
+
+
 def load_hf_config(model_dir: str) -> dict:
+    """config.json, with generation fields backfilled from
+    generation_config.json — modern transformers writes
+    forced_bos_token_id etc. there and nulls them in config.json."""
     with open(os.path.join(model_dir, "config.json")) as f:
-        return json.load(f)
+        cfg = json.load(f)
+    gen_path = os.path.join(model_dir, "generation_config.json")
+    if os.path.exists(gen_path):
+        with open(gen_path) as f:
+            gen = json.load(f)
+        for key in _GENERATION_KEYS:
+            if cfg.get(key) is None and gen.get(key) is not None:
+                cfg[key] = gen[key]
+    return cfg

@@ -77,20 +77,24 @@ def _generate_jit(model, params, input_ids, attention_mask, max_new_tokens,
     batch = input_ids.shape[0]
     start = jnp.full((batch, 1), cfg.decoder_start_token_id, jnp.int32)
 
-    def step(carry, _):
+    forced_bos = getattr(cfg, "forced_bos_token_id", None)
+
+    def step(carry, t):
         token, cache, finished, rng = carry
         logits, mutated = model.apply(
             {"params": params, "cache": cache}, token, encoder_hidden,
             attention_mask, decode=True, deterministic=True,
             mutable=["cache"], method=model.decode)
-        nxt, rng = _sample_next(logits[:, -1, :].astype(jnp.float32),
-                                temperature, top_k, top_p, rng)
+        logits = logits[:, -1, :].astype(jnp.float32)
+        if forced_bos is not None:
+            logits = jnp.where(t == 0, _force_token(logits, forced_bos), logits)
+        nxt, rng = _sample_next(logits, temperature, top_k, top_p, rng)
         nxt = jnp.where(finished, jnp.int32(cfg.pad_token_id), nxt)
         finished = finished | (nxt == cfg.eos_token_id)
         return (nxt[:, None], mutated["cache"], finished, rng), nxt
 
     carry = (start, cache, jnp.zeros((batch,), bool), rng)
-    _, tokens = lax.scan(step, carry, None, length=max_new_tokens)
+    _, tokens = lax.scan(step, carry, jnp.arange(max_new_tokens))
     return tokens.T  # [batch, max_new_tokens]
 
 
@@ -113,6 +117,14 @@ def generate(model, params, input_ids, attention_mask=None,
                          int(max_new_tokens), float(temperature),
                          jax.random.PRNGKey(seed), top_k=int(top_k),
                          top_p=float(top_p))
+
+
+def _force_token(logits, token_id):
+    """Replace a step's distribution with a point mass on ``token_id``
+    (HF ``forced_bos_token_id`` semantics — mBART forces the target
+    language id as the first generated token)."""
+    forced = jnp.full_like(logits, -jnp.inf)
+    return forced.at[..., token_id].set(0.0)
 
 
 def _sample_next(logits, temperature, top_k, top_p, rng):
@@ -271,6 +283,11 @@ def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
             method=model.decode)
         logp = jax.nn.log_softmax(
             logits[:, -1, :].astype(jnp.float32)).reshape(B, K, V)
+        forced_bos = getattr(cfg, "forced_bos_token_id", None)
+        if forced_bos is not None:
+            # mBART semantics: the first generated token is the forced
+            # language id on every beam
+            logp = jnp.where(t == 0, _force_token(logp, forced_bos), logp)
         cand = live_scores[:, :, None] + logp                  # [B, K, V]
         top2k, flat = lax.top_k(cand.reshape(B, K * V), 2 * K)
         parent = flat // V                                     # [B, 2K]
