@@ -1,0 +1,128 @@
+"""Decode throughput (bench.py --generate): tokens/s/chip per mode.
+
+The reference's model objects carry ``generate`` via HF ``transformers``
+(SURVEY.md D7; the reference itself only fine-tunes,
+reference ``scripts/train.py:145``) — round 2 proved our decode paths
+token-exact against HF; this mode measures them. Three lines:
+
+- ``gpt2_greedy``      GPT-2 (124M shape) prefill + jitted-scan greedy
+                       continuation — the decoder-only path.
+- ``bart_greedy``      BART-base encoder once + cached greedy decode —
+                       the encoder-decoder path.
+- ``bart_beam4``       same, beam search at 4 beams (beams flattened
+                       into the batch dim, so the chip sees batch×beams).
+
+tokens/s/chip counts GENERATED tokens only (batch × max_new_tokens ÷
+wall; prefill/encoder cost is inside the wall clock, amortized over the
+continuation — the standard way decode throughput is quoted). Each mode
+runs once to compile, then the timed repeat; completion is forced by
+``jax.device_get`` of the output ids (a host fetch of the real buffer —
+``block_until_ready`` can return early over the axon tunnel).
+
+``vs_baseline`` is 0.0: the reference publishes no decode numbers
+(BASELINE.md) and there is no literature anchor at these exact shapes.
+
+Off-TPU the models shrink to smoke-test size (the mode must stay
+runnable in the CPU gate); TPU runs use the real 124M/139M shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _bench_one(run, n_new_tokens: int, batch: int) -> float:
+    """tokens/s for one decode config: compile pass, then timed pass."""
+    import jax
+
+    jax.device_get(run())          # compile + warm
+    t0 = time.perf_counter()
+    jax.device_get(run())          # real buffers fetched → fully done
+    wall = time.perf_counter() - t0
+    return batch * n_new_tokens / wall
+
+
+def bench_generate() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _on_tpu
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bart import (
+        BartConfig,
+        BartForConditionalGeneration,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        beam_search_generate,
+        generate,
+        generate_causal,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+
+    on_tpu = _on_tpu()
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.RandomState(0)
+
+    if on_tpu:
+        batch, prompt_len, new_tokens = 16, 128, 128
+        gpt2_cfg = Gpt2Config(dtype=dtype)                  # 124M
+        bart_cfg = BartConfig(dtype=dtype)                  # base, 139M
+    else:
+        batch, prompt_len, new_tokens = 4, 16, 16
+        gpt2_cfg = Gpt2Config(vocab_size=512, hidden_size=64, num_layers=2,
+                              num_heads=4, intermediate_size=128,
+                              max_position_embeddings=256, dtype=dtype)
+        bart_cfg = BartConfig(vocab_size=512, d_model=64, encoder_layers=2,
+                              decoder_layers=2, encoder_attention_heads=4,
+                              decoder_attention_heads=4, encoder_ffn_dim=128,
+                              decoder_ffn_dim=128, max_position_embeddings=256,
+                              dtype=dtype)
+
+    results = {}
+
+    gpt2 = Gpt2LMHeadModel(gpt2_cfg)
+    gpt2_params = init_params(gpt2, gpt2_cfg, seed=0)
+    prompt = jnp.asarray(
+        rng.randint(0, gpt2_cfg.vocab_size, (batch, prompt_len)), jnp.int32)
+    results["gpt2_greedy"] = _bench_one(
+        lambda: generate_causal(gpt2, gpt2_params, prompt,
+                                max_new_tokens=new_tokens),
+        new_tokens, batch)
+
+    bart = BartForConditionalGeneration(bart_cfg)
+    bart_params = init_params(bart, bart_cfg, seed=0)
+    src = jnp.asarray(
+        rng.randint(3, bart_cfg.vocab_size, (batch, prompt_len)), jnp.int32)
+    results["bart_greedy"] = _bench_one(
+        lambda: generate(bart, bart_params, src, max_new_tokens=new_tokens),
+        new_tokens, batch)
+    results["bart_beam4"] = _bench_one(
+        lambda: beam_search_generate(bart, bart_params, src, num_beams=4,
+                                     max_new_tokens=new_tokens),
+        new_tokens, batch)
+
+    n_chips = len(jax.devices())
+    for mode, tok_s in results.items():
+        print(json.dumps({
+            "metric": f"generate_{mode}_tokens_per_sec_per_chip",
+            "value": round(tok_s / n_chips, 1),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0,  # no reference decode number (BASELINE.md)
+            "detail": {"batch": batch, "prompt_len": prompt_len,
+                       "new_tokens": new_tokens,
+                       "model_scale": "real" if on_tpu else "smoke"},
+        }))
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # repo root, for `from bench import ...`
+    bench_generate()
