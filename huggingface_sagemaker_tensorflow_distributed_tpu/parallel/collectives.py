@@ -19,28 +19,13 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def pmean_over(tree, axis_names):
-    """Mean a pytree over mesh axes — the gradient allreduce of
-    ``hvd.DistributedOptimizer`` (reference ``scripts/train.py:114``),
-    for use inside ``shard_map`` regions."""
-    return jax.tree.map(lambda x: lax.pmean(x, axis_names), tree)
-
-
-def psum_over(tree, axis_names):
-    return jax.tree.map(lambda x: lax.psum(x, axis_names), tree)
-
-
 def ppermute_shift(x, axis_name: str, shift: int = 1):
-    """Ring shift along a mesh axis (building block for ring attention
-    and hand-rolled reduce-scatter). ``shift=1`` sends to the next
-    device on the ring."""
+    """Ring shift along a mesh axis — the KV-rotation step of ring
+    attention (``parallel/ring_attention.py``). ``shift=1`` sends to the
+    next device on the ring; ``shift=-1`` to the previous."""
     n = lax.axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
-
-
-def axis_index(axis_name: str):
-    return lax.axis_index(axis_name)
 
 
 def param_fingerprint(params) -> jnp.ndarray:

@@ -34,6 +34,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.collectives import (
+    ppermute_shift,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,
@@ -53,8 +56,6 @@ def _ring_body(q32, scale, axis_name, n, causal, sq, my_idx, rel=None,
     [num_buckets, local_heads] enables T5-style relative-position bias:
     the [sq, sk] bias tile for the current ring step is recomputed from
     global positions, so the full [S, S] bias never materializes."""
-
-    perm = [(i, (i - 1) % n) for i in range(n)]
 
     def body(i, carry):
         m, l, o, k, v, mask = carry
@@ -91,10 +92,12 @@ def _ring_body(q32, scale, axis_name, n, causal, sq, my_idx, rel=None,
         o = o * corr + jnp.einsum(
             "bhqk,bhkd->bhqd", e, v.astype(jnp.float32),
             preferred_element_type=jnp.float32)
-        k = jax.lax.ppermute(k, axis_name, perm)
-        v = jax.lax.ppermute(v, axis_name, perm)
+        # each device hands its KV block to the previous neighbour, so at
+        # ring step i we hold the block that started at shard my_idx + i
+        k = ppermute_shift(k, axis_name, shift=-1)
+        v = ppermute_shift(v, axis_name, shift=-1)
         if mask is not None:
-            mask = jax.lax.ppermute(mask, axis_name, perm)
+            mask = ppermute_shift(mask, axis_name, shift=-1)
         return new_m, l, o, k, v, mask
 
     return body

@@ -177,7 +177,14 @@ def predict(args) -> list[dict]:
                                               for x in probs[r][am[r] > 0]]})
     elif args.task == "mlm":
         mask_id = getattr(tokenizer, "mask_token_id", None)
-        if mask_id is not None and not np.any(np.asarray(ids) == mask_id):
+        if mask_id is None:
+            # without this, the elementwise ids == None comparison below
+            # is all-False and every row silently gets empty 'fills'
+            raise ValueError(
+                "mlm prediction needs a tokenizer with a mask token "
+                "(tokenizer.mask_token_id is None); same loud-failure "
+                "convention as ArrayDataset.from_mlm_texts")
+        if not np.any(np.asarray(ids) == mask_id):
             # in-repo tokenizers split a literal "[MASK]" into
             # punctuation; re-encode segment-wise around the marker
             enc = _encode_mlm_with_mask(tokenizer, texts, max_len, mask_id)
