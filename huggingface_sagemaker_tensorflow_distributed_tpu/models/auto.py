@@ -251,7 +251,7 @@ _HF_CONFIG_EXPORTERS = {
 # families whose Encoder stack supports per-layer MoE FFNs / pipelining
 # (T5 has its own blocks; ALBERT shares one layer across the stack)
 _MOE_FAMILIES = ("bert", "roberta", "distilbert", "electra")
-_PIPELINE_FAMILIES = _MOE_FAMILIES
+_PIPELINE_FAMILIES = _MOE_FAMILIES + ("gpt2",)
 
 _MOE_CONFIG_KEYS = ("num_experts", "expert_top_k", "moe_every",
                     "expert_capacity_factor", "router_aux_coef")
@@ -364,9 +364,10 @@ def from_pretrained(
         state = load_hf_state_dict(model_name_or_path)
         loaded = hf_to_params(state, family)
         if getattr(config, "pipeline_stages", 0):
-            # checkpoints are stored per-layer; the pipelined encoder
-            # wants the layer-stacked tree
+            # checkpoints are stored per-layer; the pipelined modules
+            # want the layer-stacked tree
             from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                GPT2_LAYER_LEAVES,
                 stack_layer_params,
             )
 
@@ -375,6 +376,13 @@ def from_pretrained(
                 bb = dict(bb)
                 bb["pipelined_encoder"] = stack_layer_params(
                     bb.pop("encoder"), config.num_layers)
+                loaded = {**loaded, "backbone": bb}
+            elif family == "gpt2":
+                bb = dict(bb)
+                layers = {k: bb.pop(k) for k in list(bb)
+                          if k.startswith("h_")}
+                bb["pipelined_h"] = stack_layer_params(
+                    layers, config.num_layers, GPT2_LAYER_LEAVES, "h_{}")
                 loaded = {**loaded, "backbone": bb}
         params, missing = merge_into(params, loaded)
         logger.info("loaded %s (%s) — %d fresh head params", model_name_or_path,
@@ -452,6 +460,7 @@ def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderCo
     if getattr(config, "pipeline_stages", 0):
         # stacked → per-layer so the HF reverse rules apply
         from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+            GPT2_LAYER_LEAVES,
             unstack_layer_params,
         )
 
@@ -460,6 +469,12 @@ def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderCo
             bb = dict(bb)
             bb["encoder"] = unstack_layer_params(
                 bb.pop("pipelined_encoder"), config.num_layers)
+            params = {**params, "backbone": bb}
+        elif "pipelined_h" in bb:
+            bb = dict(bb)
+            bb.update(unstack_layer_params(
+                bb.pop("pipelined_h"), config.num_layers,
+                GPT2_LAYER_LEAVES, "h_{}"))
             params = {**params, "backbone": bb}
     state = params_to_hf(params, family)
     state = {k: np.ascontiguousarray(v) for k, v in state.items()}

@@ -59,6 +59,10 @@ class Gpt2Config:
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"
     remat: bool = False
+    # GPipe pipeline parallelism over the block stack (models/pipeline.py;
+    # training/scoring path only — decode keeps the dense stack)
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
 
 
 def gpt2_config_from_hf(hf_config: dict, **overrides) -> Gpt2Config:
@@ -83,8 +87,11 @@ def gpt2_config_from_hf(hf_config: dict, **overrides) -> Gpt2Config:
                       else hf_config.get("eos_token_id", 50256)),
     )
     kw.update(overrides)
-    # MoE/pipeline knobs target EncoderConfig; GPT-2 does not support them
-    kw.pop("use_pooler", None)
+    # MoE/pooler knobs target EncoderConfig; GPT-2 does not support them
+    # (pipeline_stages it does — PipelinedGpt2Stack)
+    for key in ("use_pooler", "num_experts", "expert_top_k", "moe_every",
+                "expert_capacity_factor", "router_aux_coef"):
+        kw.pop(key, None)
     return Gpt2Config(**kw)
 
 
@@ -143,8 +150,34 @@ class Gpt2Attention(nn.Module):
                 attn_mask = step_mask if attn_mask is None else attn_mask + step_mask
                 causal = False   # the step mask already encodes causality
 
-        ctx = dot_product_attention(q, k, v, mask=attn_mask,
-                                    impl=cfg.attention_impl, causal=causal)
+        if cfg.attention_dropout > 0 and not deterministic:
+            if cfg.attention_impl == "ring":
+                # the unfused fallback below attends over the LOCAL
+                # sequence shard only — under sp>1 that is shard-local
+                # garbage (config.py sp notes), and ring attention has
+                # no probability-dropout hook
+                raise ValueError(
+                    "attention_dropout > 0 cannot combine with "
+                    "attention_impl='ring' (sequence parallelism): set "
+                    "attention_dropout=0.0 for sp training")
+            # HF applies dropout to the attention probabilities during
+            # training (attn_pdrop); the fused attention paths have no
+            # hook for it, so mirror BartAttention's unfused softmax
+            logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+                jnp.float32) * head_dim ** -0.5
+            if attn_mask is not None:
+                logits = logits + attn_mask.astype(jnp.float32)
+            if causal:
+                sq, sk = logits.shape[-2], logits.shape[-1]
+                keep = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+                logits = jnp.where(keep, logits, NEG_INF)
+            probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+            probs = nn.Dropout(cfg.attention_dropout)(probs,
+                                                      deterministic=False)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        else:
+            ctx = dot_product_attention(q, k, v, mask=attn_mask,
+                                        impl=cfg.attention_impl, causal=causal)
         b, h, s, d = ctx.shape
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         # HF init: c_proj scaled by 1/sqrt(2*n_layer) (residual-flow init)
@@ -224,12 +257,25 @@ class Gpt2Model(nn.Module):
         x = wte(input_ids) + wpe(position_ids)
         x = nn.Dropout(cfg.embd_dropout)(x, deterministic=deterministic)
 
-        block_cls = Gpt2Block
-        if cfg.remat:
-            block_cls = nn.remat(Gpt2Block, static_argnums=(3, 4))
-        for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"h_{i}")(x, additive_mask, deterministic,
-                                              decode)
+        if cfg.pipeline_stages:
+            if decode:
+                raise ValueError(
+                    "pipeline_stages and incremental decode cannot combine: "
+                    "the KV cache is stage-local state the dense stack owns; "
+                    "export the pipelined checkpoint and reload it dense "
+                    "(pipeline_stages=0) for generation")
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                PipelinedGpt2Stack,
+            )
+            x = PipelinedGpt2Stack(cfg, name="pipelined_h")(
+                x, additive_mask, deterministic)
+        else:
+            block_cls = Gpt2Block
+            if cfg.remat:
+                block_cls = nn.remat(Gpt2Block, static_argnums=(3, 4))
+            for i in range(cfg.num_layers):
+                x = block_cls(cfg, name=f"h_{i}")(x, additive_mask,
+                                                  deterministic, decode)
         x = _layernorm(cfg, "ln_f")(x)
         return x, wte.embedding
 

@@ -58,49 +58,136 @@ _LAYER_LEAVES = (
     ("ffn_ln", "scale",), ("ffn_ln", "bias",),
 )
 
+# same contract for the GPT-2 block (``Gpt2Block``: ln_1 → fused-qkv
+# attention → ln_2 → mlp), used by ``PipelinedGpt2Stack``
+GPT2_LAYER_LEAVES = (
+    ("ln_1", "scale"), ("ln_1", "bias"),
+    ("attention", "qkv", "kernel"), ("attention", "qkv", "bias"),
+    ("attention", "attn_out", "kernel"), ("attention", "attn_out", "bias"),
+    ("ln_2", "scale"), ("ln_2", "bias"),
+    ("mlp", "fc_in", "kernel"), ("mlp", "fc_in", "bias"),
+    ("mlp", "fc_out", "kernel"), ("mlp", "fc_out", "bias"),
+)
+
 
 def _stacked_name(path: tuple) -> str:
     return "_".join(path[-2:])
 
 
-def stack_layer_params(encoder_params: dict, num_layers: int) -> dict:
-    """Dense ``Encoder`` params (``layer_{i}/...``) → the stacked flat
-    tree ``PipelinedEncoder`` declares (leading dim = num_layers)."""
+def stack_layer_params(layer_params: dict, num_layers: int,
+                       leaves: tuple = _LAYER_LEAVES,
+                       layer_fmt: str = "layer_{}") -> dict:
+    """Per-layer dense params (``layer_{i}/...``) → the stacked flat
+    tree the pipelined modules declare (leading dim = num_layers)."""
     out: dict[str, Any] = {}
-    for path in _LAYER_LEAVES:
-        leaves = []
+    for path in leaves:
+        stacked = []
         for i in range(num_layers):
-            node = encoder_params[f"layer_{i}"]
+            node = layer_params[layer_fmt.format(i)]
             for key in path:
                 node = node[key]
-            leaves.append(np.asarray(node))
-        out[_stacked_name(path)] = np.stack(leaves, axis=0)
+            stacked.append(np.asarray(node))
+        out[_stacked_name(path)] = np.stack(stacked, axis=0)
     return out
 
 
-def unstack_layer_params(stacked: dict, num_layers: int) -> dict:
+def unstack_layer_params(stacked: dict, num_layers: int,
+                         leaves: tuple = _LAYER_LEAVES,
+                         layer_fmt: str = "layer_{}") -> dict:
     """Inverse of :func:`stack_layer_params` (for HF-layout export)."""
     out: dict[str, Any] = {}
     for i in range(num_layers):
         layer: dict[str, Any] = {}
-        for path in _LAYER_LEAVES:
+        for path in leaves:
             node = layer
             for key in path[:-1]:
                 node = node.setdefault(key, {})
             node[path[-1]] = np.asarray(stacked[_stacked_name(path)])[i]
-        out[f"layer_{i}"] = layer
+        out[layer_fmt.format(i)] = layer
     return out
 
 
-def _layer_tree(flat: dict, index) -> dict:
-    """One layer's EncoderLayer-structured params from the stacked tree."""
+def _layer_tree(flat: dict, index, leaves: tuple = _LAYER_LEAVES) -> dict:
+    """One layer's block-structured params from the stacked tree."""
     tree: dict[str, Any] = {}
-    for path in _LAYER_LEAVES:
+    for path in leaves:
         node = tree
         for key in path[:-1]:
             node = node.setdefault(key, {})
         node[path[-1]] = flat[_stacked_name(path)][index]
     return tree
+
+
+def gpipe_schedule(stage_fn, staged, hidden, attn_mask, *, pp: int,
+                   microbatches: int, deterministic: bool, base_key):
+    """The scan/vmap/roll GPipe schedule (module docstring), shared by
+    every pipelined family. ``stage_fn(p_stage, x, m, key) -> x`` applies
+    one stage's layers; ``staged`` is the [pp, lps, ...] param tree;
+    ``attn_mask`` is the additive [B, 1, 1, S] mask (never None here)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+        AXIS_PIPE,
+        data_axis_names,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
+        constrain_if_mesh,
+    )
+
+    B, S, H = hidden.shape
+    # The schedule's outputs are M-invariant (same math, different
+    # overlap), so a batch that doesn't divide the requested
+    # microbatch count degrades to gcd(B, M) instead of failing —
+    # init traces (batch 1) and ragged eval tails stay runnable.
+    M = math.gcd(B, microbatches or pp)
+    mb = B // M
+    batch_axes = data_axis_names()
+
+    x_mb = hidden.reshape(M, mb, S, H)
+    m_mb = attn_mask.reshape(M, mb, 1, 1, attn_mask.shape[-1])
+    pad_x = jnp.zeros((pp - 1, mb, S, H), hidden.dtype)
+    pad_m = jnp.zeros((pp - 1, mb, 1, 1, attn_mask.shape[-1]),
+                      attn_mask.dtype)
+    xs_feed = jnp.concatenate([x_mb, pad_x], axis=0)    # [T, ...]
+    ms_feed = jnp.concatenate([m_mb, pad_m], axis=0)
+
+    state_x = jnp.zeros((pp, mb, S, H), hidden.dtype)
+    state_m = jnp.zeros((pp, mb, 1, 1, attn_mask.shape[-1]),
+                        attn_mask.dtype)
+
+    def tick(carry, feed):
+        sx, sm, t = carry
+        in_x, in_m = feed
+        # stage 0 ingests the next microbatch; the rolled-in garbage
+        # at slot 0 is overwritten
+        sx = sx.at[0].set(in_x)
+        sm = sm.at[0].set(in_m)
+        sx = constrain_if_mesh(sx, AXIS_PIPE, batch_axes)
+        if deterministic:
+            out = jax.vmap(lambda p, x, m: stage_fn(p, x, m, None))(
+                staged, sx, sm)
+        else:
+            tick_key = jax.random.fold_in(base_key, t)
+            keys = jax.vmap(lambda s: jax.random.fold_in(tick_key, s))(
+                jnp.arange(pp))
+            out = jax.vmap(stage_fn)(staged, sx, sm, keys)
+        out = constrain_if_mesh(out, AXIS_PIPE, batch_axes)
+        y = out[-1]                     # last stage's finished microbatch
+        sx = jnp.roll(out, 1, axis=0)   # stage s → stage s+1
+        sm = jnp.roll(sm, 1, axis=0)
+        return (sx, sm, t + 1), y
+
+    (_, _, _), ys = jax.lax.scan(
+        tick, (state_x, state_m, jnp.zeros((), jnp.int32)),
+        (xs_feed, ms_feed))
+    # first pp-1 tick outputs are fill-bubble garbage
+    return ys[pp - 1:].reshape(B, S, H)
+
+
+def _check_pipeline_shape(pp: int, num_layers: int) -> int:
+    if pp < 1 or num_layers % pp:
+        raise ValueError(
+            f"pipeline_stages={pp} must be >= 1 and divide "
+            f"num_layers={num_layers}")
+    return num_layers // pp
 
 
 class PipelinedEncoder(nn.Module):
@@ -132,32 +219,13 @@ class PipelinedEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
-        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
-            AXIS_PIPE,
-            data_axis_names,
-        )
-        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
-            constrain_if_mesh,
-        )
-
         cfg = self.config
         pp = cfg.pipeline_stages
-        L = cfg.num_layers
-        if pp < 1 or L % pp:
-            raise ValueError(
-                f"pipeline_stages={pp} must be >= 1 and divide num_layers={L}")
+        lps = _check_pipeline_shape(pp, cfg.num_layers)
         if cfg.num_experts:
             raise ValueError("pipeline_stages and num_experts cannot combine "
                              "(pipelined MoE is not supported)")
-        lps = L // pp
-        B, S, H = hidden.shape
-        # The schedule's outputs are M-invariant (same math, different
-        # overlap), so a batch that doesn't divide the requested
-        # microbatch count degrades to gcd(B, M) instead of failing —
-        # init traces (batch 1) and ragged eval tails stay runnable.
-        M = math.gcd(B, cfg.pipeline_microbatches or pp)
-        mb = B // M
-        batch_axes = data_axis_names()
+        B, S, _ = hidden.shape
 
         flat = self._declare_stacked()
         # [L, ...] → [pp, lps, ...]: stage-major so the stored dim-0
@@ -186,40 +254,78 @@ class PipelinedEncoder(nn.Module):
         if cfg.remat:
             stage_fn = jax.checkpoint(stage_fn)
 
-        x_mb = hidden.reshape(M, mb, S, H)
-        m_mb = attn_mask.reshape(M, mb, 1, 1, S)
-        pad_x = jnp.zeros((pp - 1, mb, S, H), hidden.dtype)
-        pad_m = jnp.zeros((pp - 1, mb, 1, 1, S), attn_mask.dtype)
-        xs_feed = jnp.concatenate([x_mb, pad_x], axis=0)    # [T, ...]
-        ms_feed = jnp.concatenate([m_mb, pad_m], axis=0)
+        return gpipe_schedule(
+            stage_fn, staged, hidden, attn_mask, pp=pp,
+            microbatches=cfg.pipeline_microbatches,
+            deterministic=deterministic, base_key=base_key)
 
-        state_x = jnp.zeros((pp, mb, S, H), hidden.dtype)
-        state_m = jnp.zeros((pp, mb, 1, 1, S), attn_mask.dtype)
 
-        def tick(carry, feed):
-            sx, sm, t = carry
-            in_x, in_m = feed
-            # stage 0 ingests the next microbatch; the rolled-in garbage
-            # at slot 0 is overwritten
-            sx = sx.at[0].set(in_x)
-            sm = sm.at[0].set(in_m)
-            sx = constrain_if_mesh(sx, AXIS_PIPE, batch_axes)
-            if deterministic:
-                out = jax.vmap(lambda p, x, m: stage_fn(p, x, m, None))(
-                    staged, sx, sm)
-            else:
-                tick_key = jax.random.fold_in(base_key, t)
-                keys = jax.vmap(lambda s: jax.random.fold_in(tick_key, s))(
-                    jnp.arange(pp))
-                out = jax.vmap(stage_fn)(staged, sx, sm, keys)
-            out = constrain_if_mesh(out, AXIS_PIPE, batch_axes)
-            y = out[-1]                     # last stage's finished microbatch
-            sx = jnp.roll(out, 1, axis=0)   # stage s → stage s+1
-            sm = jnp.roll(sm, 1, axis=0)
-            return (sx, sm, t + 1), y
+class PipelinedGpt2Stack(nn.Module):
+    """The GPT-2 block stack under the same GPipe schedule — pipeline
+    parallelism for the decoder-only family (training/scoring path; the
+    incremental-decode KV cache is stage-local state the dense stack
+    owns, so generation runs the dense path — ``Gpt2Model`` enforces
+    this). Same math as the ``h_{i}`` loop in ``Gpt2Model``: causal
+    masking is applied inside each block via ``dot_product_attention
+    (causal=True)``, so only the padding mask rides the schedule."""
 
-        (_, _, _), ys = jax.lax.scan(
-            tick, (state_x, state_m, jnp.zeros((), jnp.int32)),
-            (xs_feed, ms_feed))
-        # first pp-1 tick outputs are fill-bubble garbage
-        return ys[pp - 1:].reshape(B, S, H)
+    config: Any  # Gpt2Config (annotated loosely to avoid a cycle)
+
+    def _declare_stacked(self) -> dict:
+        cfg = self.config
+        L, H, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+        kernel = nn.initializers.normal(cfg.initializer_range)
+        # HF residual-flow init for the two output projections
+        resid = nn.initializers.normal(
+            cfg.initializer_range / (2 * cfg.num_layers) ** 0.5)
+        zeros, ones = nn.initializers.zeros, nn.initializers.ones
+        shapes = {
+            "ln_1_scale": ((L, H), ones), "ln_1_bias": ((L, H), zeros),
+            "qkv_kernel": ((L, H, 3 * H), kernel), "qkv_bias": ((L, 3 * H), zeros),
+            "attn_out_kernel": ((L, H, H), resid),
+            "attn_out_bias": ((L, H), zeros),
+            "ln_2_scale": ((L, H), ones), "ln_2_bias": ((L, H), zeros),
+            "fc_in_kernel": ((L, H, F), kernel), "fc_in_bias": ((L, F), zeros),
+            "fc_out_kernel": ((L, F, H), resid), "fc_out_bias": ((L, H), zeros),
+        }
+        return {name: self.param(name, init, shape, self.config.param_dtype)
+                for name, (shape, init) in shapes.items()}
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import Gpt2Block
+
+        cfg = self.config
+        pp = cfg.pipeline_stages
+        lps = _check_pipeline_shape(pp, cfg.num_layers)
+        B, S, _ = hidden.shape
+
+        flat = self._declare_stacked()
+        staged = jax.tree.map(
+            lambda a: a.reshape(pp, lps, *a.shape[1:]), flat)
+
+        if attn_mask is None:
+            attn_mask = jnp.zeros((B, 1, 1, S), jnp.float32)
+        attn_mask = jnp.broadcast_to(attn_mask, (B, 1, 1, S))
+
+        block = Gpt2Block(cfg)
+        base_key = (None if deterministic
+                    else self.make_rng("dropout"))
+
+        def stage_fn(p_stage, x, m, key):
+            for i in range(lps):
+                p_i = _layer_tree(p_stage, i, GPT2_LAYER_LEAVES)
+                if deterministic:
+                    x = block.apply({"params": p_i}, x, m, True)
+                else:
+                    x = block.apply({"params": p_i}, x, m, False,
+                                    rngs={"dropout": jax.random.fold_in(key, i)})
+            return x
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(stage_fn)
+
+        return gpipe_schedule(
+            stage_fn, staged, hidden, attn_mask, pp=pp,
+            microbatches=cfg.pipeline_microbatches,
+            deterministic=deterministic, base_key=base_key)

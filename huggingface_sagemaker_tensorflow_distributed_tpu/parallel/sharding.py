@@ -58,6 +58,10 @@ _PARAM_RULES: Sequence[tuple[str, tuple]] = (
     (r"pipelined_encoder/(attention_out|ffn_out)_kernel$",
      (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
     (r"pipelined_encoder/", (AXIS_PIPE,)),
+    # pipelined GPT-2 stack: same contract, fused-qkv naming
+    (r"pipelined_h/(qkv|fc_in)_kernel$", (AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR)),
+    (r"pipelined_h/(attn_out|fc_out)_kernel$", (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
+    (r"pipelined_h/", (AXIS_PIPE,)),
     # attention projections: kernel shape (in, out)
     (r"(query|key|value|q_proj|k_proj|v_proj|qkv).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
     (r"(attention_out|out_proj|o_proj|attn_out).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),

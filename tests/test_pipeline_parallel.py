@@ -263,3 +263,162 @@ def test_dropout_runs_under_pipeline():
                         rngs={"dropout": jax.random.PRNGKey(k)})
             for k in (0, 1)]
     assert not np.allclose(np.asarray(outs[0]), np.asarray(outs[1]))
+
+
+# --- GPT-2 (decoder-only family) under the same schedule ---------------------
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (  # noqa: E402
+    Gpt2Config,
+    Gpt2LMHeadModel,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (  # noqa: E402
+    GPT2_LAYER_LEAVES,
+)
+
+
+def _gpt2_cfg(pp=0, **kw):
+    base = dict(vocab_size=256, hidden_size=32, num_layers=L, num_heads=4,
+                intermediate_size=64, max_position_embeddings=SEQ,
+                hidden_dropout=0.0, embd_dropout=0.0, attention_dropout=0.0,
+                pipeline_stages=pp)
+    base.update(kw)
+    return Gpt2Config(**base)
+
+
+def _gpt2_pair():
+    """(dense model+params, pipelined model+params with the SAME weights)."""
+    dense_cfg = _gpt2_cfg(pp=0)
+    dense = Gpt2LMHeadModel(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+
+    pp_cfg = _gpt2_cfg(pp=2, pipeline_microbatches=4)
+    piped = Gpt2LMHeadModel(pp_cfg)
+    pp_params = init_params(piped, pp_cfg)
+    bb = dense_params["backbone"]
+    pp_params["backbone"]["pipelined_h"] = jax.tree.map(
+        jnp.asarray,
+        stack_layer_params({k: bb[k] for k in bb if k.startswith("h_")}, L,
+                           GPT2_LAYER_LEAVES, "h_{}"))
+    for key in ("wte", "wpe", "ln_f"):
+        pp_params["backbone"][key] = bb[key]
+    return dense, dense_params, piped, pp_params
+
+
+def test_gpt2_pipelined_matches_dense_forward():
+    dense, dense_params, piped, pp_params = _gpt2_pair()
+    ids, mask = _inputs()
+    out_dense = dense.apply({"params": dense_params}, ids, mask,
+                            deterministic=True)
+    out_pp = piped.apply({"params": pp_params}, ids, mask, deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=1e-5)
+
+
+def test_gpt2_pipelined_grads_match_dense():
+    dense, dense_params, piped, pp_params = _gpt2_pair()
+    ids, mask = _inputs()
+
+    def loss_dense(p):
+        return jnp.mean(dense.apply({"params": p}, ids, mask,
+                                    deterministic=True) ** 2)
+
+    def loss_pp(p):
+        return jnp.mean(piped.apply({"params": p}, ids, mask,
+                                    deterministic=True) ** 2)
+
+    g_dense = jax.grad(loss_dense)(dense_params)
+    g_pp = jax.grad(loss_pp)(pp_params)
+    g_layers = unstack_layer_params(
+        jax.tree.map(np.asarray, g_pp["backbone"]["pipelined_h"]), L,
+        GPT2_LAYER_LEAVES, "h_{}")
+    for i in range(L):
+        np.testing.assert_allclose(
+            g_layers[f"h_{i}"]["attention"]["qkv"]["kernel"],
+            np.asarray(g_dense["backbone"][f"h_{i}"]["attention"]["qkv"]["kernel"]),
+            atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(g_pp["backbone"]["wte"]["embedding"]),
+        np.asarray(g_dense["backbone"]["wte"]["embedding"]), atol=2e-4)
+
+
+def test_gpt2_pp_mesh_training_matches_single_device(devices8):
+    """dp2×pp2×tp2 causal-lm training = single-device pipelined training."""
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(32, seed=3)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=SEQ)
+
+    def run(mesh_cfg, devices):
+        mesh = build_mesh(mesh_cfg, devices=devices)
+        cfg = TrainConfig(task="causal-lm", dtype="float32",
+                          learning_rate=1e-3, scale_lr_by_world_size=False,
+                          log_every_steps=0, rng_impl="threefry")
+        model_cfg = _gpt2_cfg(pp=2)
+        model = Gpt2LMHeadModel(model_cfg)
+        params = init_params(model, model_cfg)
+        trainer = Trainer(cfg, model, params, mesh)
+        batcher = ShardedBatcher(ds, 8, mesh, shuffle=False)
+        losses = []
+        for step, batch in enumerate(batcher.global_arrays(0)):
+            if step >= 4:
+                break
+            trainer.state, m = trainer._train_step(trainer.state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    single = run(MeshConfig(), devices8[:1])
+    sharded = run(MeshConfig(dp=2, pp=2, tp=2), devices8)
+    np.testing.assert_allclose(sharded, single, atol=3e-5)
+
+
+def test_gpt2_pipelined_params_sharded_over_pipe(devices8):
+    mesh = build_mesh(MeshConfig(dp=-1, pp=2, tp=2), devices=devices8)
+    model_cfg = _gpt2_cfg(pp=2)
+    model = Gpt2LMHeadModel(model_cfg)
+    params = init_params(model, model_cfg)
+    sh = param_shardings(params, mesh)
+    stacked = sh["backbone"]["pipelined_h"]
+    assert stacked["qkv_kernel"].spec == P("pipe", None, "tensor")
+    assert stacked["fc_out_kernel"].spec == P("pipe", "tensor")
+    assert stacked["ln_1_scale"].spec == P("pipe")
+
+
+def test_gpt2_hf_checkpoint_roundtrips_through_pipelined(tmp_path):
+    """dense export → pipelined load (stacked weights match) → pipelined
+    export → dense load (weights survive the full cycle)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+
+    dense_cfg = _gpt2_cfg()
+    dense = Gpt2LMHeadModel(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+    out = str(tmp_path / "gpt2-dense")
+    auto_models.save_pretrained(out, dense_params, "gpt2", dense_cfg)
+
+    model, params, fam, cfg = auto_models.from_pretrained(
+        out, task="causal-lm", pipeline_stages=2,
+        hidden_dropout=0.0, embd_dropout=0.0, attention_dropout=0.0)
+    assert fam == "gpt2" and cfg.pipeline_stages == 2
+    bb = dense_params["backbone"]
+    stacked = stack_layer_params({k: bb[k] for k in bb if k.startswith("h_")},
+                                 L, GPT2_LAYER_LEAVES, "h_{}")
+    for name, arr in stacked.items():
+        np.testing.assert_allclose(
+            np.asarray(params["backbone"]["pipelined_h"][name]), arr,
+            atol=1e-6)
+
+    out2 = str(tmp_path / "gpt2-pp-export")
+    auto_models.save_pretrained(out2, params, "gpt2", cfg)
+    _, dense2, _, cfg2 = auto_models.from_pretrained(out2, task="causal-lm")
+    assert cfg2.pipeline_stages == 0
+    np.testing.assert_allclose(
+        np.asarray(dense2["backbone"]["h_0"]["attention"]["qkv"]["kernel"]),
+        np.asarray(bb["h_0"]["attention"]["qkv"]["kernel"]), atol=1e-6)
+
+
+def test_gpt2_pipelined_decode_raises():
+    cfg = _gpt2_cfg(pp=2)
+    model = Gpt2LMHeadModel(cfg)
+    params = init_params(model, cfg)
+    ids, mask = _inputs(batch=2)
+    with pytest.raises(ValueError, match="decode"):
+        model.apply({"params": params}, ids, mask, deterministic=True,
+                    decode=True, mutable=["cache"])
