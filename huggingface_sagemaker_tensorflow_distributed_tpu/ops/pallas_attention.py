@@ -176,16 +176,22 @@ def _flash_fwd_call(q, k, v, mask, scale, block_q, block_k, causal, interpret,
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-               dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
+               dq_ref, dq_acc, delta_ref, *, scale, causal, block_q, block_k):
     """Grid (B, H, num_q, num_kv); accumulates dQ for one q block across
-    kv blocks.  dS = P ∘ (dO·Vᵀ − Δ), dQ = scale · dS·K."""
+    kv blocks.  dS = P ∘ (dO·Vᵀ − Δ), dQ = scale · dS·K.
+    Δ_i = Σ_d dO_id·O_id is computed HERE (once per q block, into VMEM
+    scratch) rather than by a separate XLA pass — the [B,H,S,128]
+    lane-broadcast Δ array never exists in HBM."""
     ik = pl.program_id(3)
     num_kv = pl.num_programs(3)
 
     @pl.when(ik == 0)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
+        d = jnp.sum(do_ref[0, 0].astype(jnp.float32)
+                    * o_ref[0, 0].astype(jnp.float32), axis=-1, keepdims=True)
+        delta_ref[...] = jnp.broadcast_to(d, delta_ref.shape)
 
     iq = pl.program_id(2)
     run = _tile_runs(causal, iq, ik, block_q, block_k)
@@ -209,7 +215,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BQ, BK]
-        delta = delta_ref[0, 0][:, :1]                    # [BQ, 1]
+        delta = delta_ref[:, :1]                          # [BQ, 1]
         ds = p * (dp - delta)                             # [BQ, BK] fp32
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -220,12 +226,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
                 dk_ref, dv_ref, dmask_ref, dk_acc, dv_acc, dm_acc,
                 *, scale, causal, block_q, block_k):
     """Grid (B, H, num_kv, num_q); accumulates dK/dV (and the padding-mask
     cotangent) for one kv block across q blocks.
-    dV = Pᵀ·dO, dK = scale · dSᵀ·Q, dmask = Σ_q dS."""
+    dV = Pᵀ·dO, dK = scale · dSᵀ·Q, dmask = Σ_q dS. Δ is recomputed
+    per (kv, q) tile from the dO/O blocks already in VMEM — one
+    elementwise [BQ, D] pass on the VPU instead of an HBM tile read."""
     iq = pl.program_id(3)
     num_q = pl.num_programs(3)
 
@@ -263,7 +271,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BQ, BK]
-        delta = delta_ref[0, 0][:, :1]
+        delta = jnp.sum(do.astype(jnp.float32)
+                        * o_ref[0, 0].astype(jnp.float32),
+                        axis=-1, keepdims=True)           # [BQ, 1]
         ds = p * (dp - delta)                             # [BQ, BK]
         dk_acc[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -289,18 +299,15 @@ def _flash_bwd_call(q, k, v, mask, o, lse, do, scale, block_q, block_k,
     num_q = q_len // block_q
     num_kv = kv_len // block_k
 
-    # Δ_i = Σ_d dO_id · O_id — tiny elementwise pass, XLA fuses it;
-    # broadcast across 128 lanes to match the TPU row-vector layout
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
-
+    # Δ = Σ_d dO·O is folded into the kernels (dQ: once per q block into
+    # scratch; dKV: recomputed per tile) — no HBM Δ array
     q_spec = pl.BlockSpec((1, 1, block_q, head_dim),
                           lambda b, h, j, i: (b, h, j, 0))
     kv_spec = pl.BlockSpec((1, 1, block_k, head_dim),
                            lambda b, h, j, i: (b, h, i, 0))
     row_spec = pl.BlockSpec((1, 1, block_q, 128), lambda b, h, j, i: (b, h, j, 0))
-    base_args = [q, k, v, do, lse, delta]
-    base_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
+    base_args = [q, k, v, do, lse, o]
+    base_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, q_spec]
     has_mask = mask is not None
     if has_mask:
         base_args.append(mask.reshape(batch, 1, kv_len))
@@ -311,11 +318,11 @@ def _flash_bwd_call(q, k, v, mask, o, lse, do, scale, block_q, block_k,
 
     def dq_kernel(*refs):
         if has_mask:
-            (q_, k_, v_, do_, lse_, dl_, m_, dq_, acc_) = refs
+            (q_, k_, v_, do_, lse_, o_, m_, dq_, acc_, dlt_) = refs
         else:
-            (q_, k_, v_, do_, lse_, dl_, dq_, acc_) = refs
+            (q_, k_, v_, do_, lse_, o_, dq_, acc_, dlt_) = refs
             m_ = None
-        _dq_kernel(q_, k_, v_, do_, lse_, dl_, m_, dq_, acc_, **kw)
+        _dq_kernel(q_, k_, v_, do_, lse_, o_, m_, dq_, acc_, dlt_, **kw)
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -323,7 +330,8 @@ def _flash_bwd_call(q, k, v, mask, o, lse, do, scale, block_q, block_k,
         in_specs=base_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32),
+                        pltpu.VMEM((block_q, 128), jnp.float32)],  # Δ
         interpret=interpret,
     )(*base_args)
 
@@ -334,7 +342,7 @@ def _flash_bwd_call(q, k, v, mask, o, lse, do, scale, block_q, block_k,
                              lambda b, h, i, j: (b, h, i, 0))
     row_spec_t = pl.BlockSpec((1, 1, block_q, 128),
                               lambda b, h, i, j: (b, h, j, 0))
-    specs_t = [q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, row_spec_t]
+    specs_t = [q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t, q_spec_t]
     if has_mask:
         specs_t.append(
             pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, i)))
@@ -353,12 +361,12 @@ def _flash_bwd_call(q, k, v, mask, o, lse, do, scale, block_q, block_k,
 
     def dkv_kernel(*refs):
         if has_mask:
-            (q_, k_, v_, do_, lse_, dl_, m_, dk_, dv_, dm_,
+            (q_, k_, v_, do_, lse_, o_, m_, dk_, dv_, dm_,
              dka_, dva_, dma_) = refs
         else:
-            (q_, k_, v_, do_, lse_, dl_, dk_, dv_, dka_, dva_) = refs
+            (q_, k_, v_, do_, lse_, o_, dk_, dv_, dka_, dva_) = refs
             m_ = dm_ = dma_ = None
-        _dkv_kernel(q_, k_, v_, do_, lse_, dl_, m_, dk_, dv_, dm_,
+        _dkv_kernel(q_, k_, v_, do_, lse_, o_, m_, dk_, dv_, dm_,
                     dka_, dva_, dma_, **kw)
 
     outs = pl.pallas_call(
