@@ -31,3 +31,77 @@ def devices8():
 @pytest.fixture()
 def rng():
     return np.random.RandomState(0)
+
+
+# --- fast/full tiering (VERDICT r2 next-steps #7) ---------------------------
+# The full suite needs ~11-14 min on a 1-core box; time-budgeted gates
+# run `pytest -m "not slow"` (<2 min). Every test measured >=4s on the
+# 1-core reference run is listed here (plus new integration tests as
+# they're added); the full suite stays the default for the builder loop.
+_SLOW_TESTS = {
+    "test_launcher.py",          # whole module: multi-process e2e jobs
+    "test_mesh32.py",            # 32-virtual-device subprocess parity
+    "test_bf16_quality.py",      # full bf16-vs-fp32 training runs
+    "test_t5.py::test_cached_decode_matches_teacher_forcing",
+    "test_trainer.py::test_bf16_training_quality_matches_fp32",
+    "test_pipeline_parallel.py::test_pp_mesh_training_matches_single_device",
+    "test_pipeline_parallel.py::test_gpt2_pp_mesh_training_matches_single_device",
+    "test_pipeline_parallel.py::test_pipelined_grads_match_dense",
+    "test_pipeline_parallel.py::test_gpt2_pipelined_grads_match_dense",
+    "test_pipeline_parallel.py::test_pipelined_matches_dense_forward",
+    "test_pipeline_parallel.py::test_gpt2_pipelined_matches_dense_forward",
+    "test_pipeline_parallel.py::test_dropout_runs_under_pipeline",
+    "test_pipeline_parallel.py::test_non_dividing_microbatches_degrade_to_gcd",
+    "test_pipeline_parallel.py::test_hf_checkpoint_loads_into_pipelined_model",
+    "test_moe.py::test_ep_with_tp_matches_single_device",
+    "test_moe.py::test_ep_sharded_matches_single_device",
+    "test_moe.py::test_aux_loss_reaches_training_loss",
+    "test_moe.py::test_moe_forward_and_routing_conservation",
+    "test_trainer.py::test_alternative_optimizers_learn",
+    "test_sharding.py::test_sharded_train_step_matches_single_device",
+    "test_sharding.py::test_param_partition_rules",
+    "test_bart.py::test_bart_trains_on_seq2seq",
+    "test_bart.py::test_bart_teacher_forced_parity",
+    "test_bart.py::test_mbart_cached_greedy_with_forced_bos_matches_hf",
+    "test_bart.py::test_bart_beam_search_runs",
+    "test_tasks.py::test_token_cls_learns",
+    "test_tasks.py::test_qa_learns",
+    "test_trainer.py::test_dp8_matches_dp1_loss_curve",
+    "test_ring_attention.py::test_bert_train_step_with_ring_attention",
+    "test_ring_attention.py::test_ring_gradients_match",
+    "test_t5_ring.py::test_t5_ring_encoder_matches_xla",
+    "test_t5_ring.py::test_t5_ring_generate_matches_xla",
+    "test_span_corruption.py::test_t5_trains_on_span_corruption",
+    "test_trainer.py::test_gradient_accumulation_matches_big_batch",
+    "test_gpt2.py::test_gpt2_incremental_decode_matches_full",
+    "test_gpt2.py::test_gpt2_generate_left_padded",
+    "test_gpt2.py::test_gpt2_causal_lm_training_learns",
+    "test_trainer.py::test_eval_with_padded_tail_is_exact",
+    "test_trainer.py::test_training_learns",
+    "test_trainer.py::test_bf16_compute_runs",
+    "test_trainer.py::test_results_files_contract",
+    "test_checkpoint.py::test_resume_continues_training",
+    "test_checkpoint.py::test_save_restore_roundtrip",
+    "test_checkpoint.py::test_async_save_overlaps_and_restores_identically",
+    "test_checkpoint.py::test_mid_epoch_resume_skips_consumed_batches",
+    "test_checkpoint.py::test_divergence_check_passes_on_consistent_replicas",
+    "test_checkpoint.py::test_divergence_check_catches_perturbed_replica",
+    "test_t5.py::test_seq2seq_training_learns",
+    "test_t5.py::test_forward_shapes_finite",
+    "test_deberta.py::test_deberta_training_learns",
+    "test_deberta.py::test_deberta_v3_style_seq_cls_parity",
+    "test_mesh_bench.py::test_profile_breakdown_finds_collectives",
+    "test_pallas_attention.py::test_flash_causal_matches_xla_fwd_and_bwd",
+    "test_pallas_attention.py::test_flash_qkv_grads_match_xla",
+    "test_rtd.py::test_rtd_training_learns",
+    "test_mlm.py::test_mlm_training_learns",
+    "test_predict.py::test_predict_mlm_fills",
+}
+
+
+def pytest_collection_modifyitems(items):
+    for item in items:
+        fname = item.fspath.basename
+        base_id = f"{fname}::{item.originalname or item.name}"
+        if fname in _SLOW_TESTS or base_id in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
