@@ -137,6 +137,10 @@ class TrainConfig:
     # per-chip batch >= 16 and never loses, so it is the TPU default.
     attention_impl: str = "auto"   # auto | xla | flash (pallas) | ring
     remat: bool = False            # rematerialize encoder layers (FLOPs for HBM)
+    # Fused LM-head + CE (ops/pallas_vocab_ce.py): the [B,S,V] logits
+    # never materialize in HBM. causal-lm only; opt-in (numerics match
+    # the unfused path to fp32 roundoff, tests/test_vocab_ce.py).
+    fused_vocab_ce: bool = False
 
     # --- length bucketing (tf.data bucket_by_sequence_length capability;
     #     the reference pads everything to 512, train.py:80-83). 0 = off;

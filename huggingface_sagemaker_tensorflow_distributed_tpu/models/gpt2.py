@@ -281,18 +281,31 @@ class Gpt2Model(nn.Module):
 
 
 class Gpt2LMHeadModel(nn.Module):
-    """GPT-2 with the tied LM head (HF ``GPT2LMHeadModel`` parity)."""
+    """GPT-2 with the tied LM head (HF ``GPT2LMHeadModel`` parity).
+
+    ``hidden_and_embedding`` exposes the pre-head activations so the
+    fused vocab-CE loss (``ops/pallas_vocab_ce.py``) can skip the
+    [B, S, V] logits materialisation entirely."""
 
     config: Gpt2Config
 
-    @nn.compact
+    def setup(self):
+        self.backbone = Gpt2Model(self.config)
+
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  position_ids=None, deterministic: bool = True,
                  decode: bool = False):
         # token_type_ids accepted for trainer-signature parity; GPT-2 has
         # no segment embeddings
-        hidden, embedding = Gpt2Model(self.config, name="backbone")(
+        hidden, embedding = self.backbone(
             input_ids, attention_mask, position_ids, deterministic, decode)
         logits = jnp.einsum("bsh,vh->bsv", hidden,
                             embedding.astype(self.config.dtype))
         return logits.astype(jnp.float32)
+
+    def hidden_and_embedding(self, input_ids, attention_mask=None,
+                             token_type_ids=None, position_ids=None,
+                             deterministic: bool = True):
+        """(hidden [B, S, H], tied embedding [V, H]) — the fused-CE path."""
+        return self.backbone(input_ids, attention_mask, position_ids,
+                             deterministic, False)

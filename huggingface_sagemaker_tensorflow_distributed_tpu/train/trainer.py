@@ -183,6 +183,69 @@ def rtd_loss(apply_fn, params, batch, rngs, train: bool):
     return _masked_sums(per_tok, correct, token_valid)
 
 
+def make_fused_causal_lm_loss(model, block_n: int = 256, block_v: int = 512,
+                              interpret: bool | None = None):
+    """``causal_lm_loss`` without the [B, S, V] logits: the model exposes
+    ``hidden_and_embedding`` and the blocked-vocab Pallas kernel
+    (``ops/pallas_vocab_ce.py``) reduces head-matmul + CE + argmax on
+    chip. The kernel is shard_mapped over the data axes, so each dp
+    shard computes its own tokens and the weight cotangent is psummed by
+    the shard_map transpose (the same all-reduce the unfused head matmul
+    would produce). Instead of slicing off the last position (which
+    would break the token-block tiling: S-1 is odd), labels are shifted
+    left with a -100 pad so every position is computed and the last is
+    masked — identical masked sums to ``causal_lm_loss``."""
+    from jax.sharding import PartitionSpec as P
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_vocab_ce import (
+        fused_vocab_cross_entropy,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+        data_axis_names,
+        maybe_current_mesh,
+    )
+
+    def loss(apply_fn, params, batch, rngs, train: bool):
+        hidden, embedding = model.apply(
+            {"params": params}, batch["input_ids"], batch["attention_mask"],
+            deterministic=not train, rngs=rngs,
+            method=model.hidden_and_embedding)               # [B,S,H], [V,H]
+        B = hidden.shape[0]
+        labels = batch["labels"]
+        shifted = jnp.concatenate(
+            [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1)
+        token_valid = jnp.concatenate(
+            [(batch["attention_mask"][:, 1:] > 0) & (labels[:, 1:] != -100),
+             jnp.zeros((B, 1), bool)], axis=1)
+        if "valid" in batch:
+            token_valid = token_valid & (batch["valid"][:, None] > 0)
+        safe_labels = jnp.maximum(shifted, 0)
+
+        def ce(h, w, lab):
+            n = h.shape[0] * h.shape[1]
+            per_tok, pred = fused_vocab_cross_entropy(
+                h.reshape(n, h.shape[2]), w, lab.reshape(n),
+                block_n=block_n, block_v=block_v, interpret=interpret)
+            return per_tok.reshape(lab.shape), pred.reshape(lab.shape)
+
+        mesh = maybe_current_mesh()
+        batch_axes = data_axis_names()
+        if mesh is not None and any(
+                mesh.shape.get(a, 1) > 1 for a in batch_axes):
+            from jax import shard_map
+            # check_vma=False: pallas_call does not annotate varying-mesh
+            # axes on its outputs, which the default vma check rejects
+            ce = shard_map(ce, mesh=mesh,
+                           in_specs=(P(batch_axes), P(), P(batch_axes)),
+                           out_specs=(P(batch_axes), P(batch_axes)),
+                           check_vma=False)
+        per_tok, pred = ce(hidden, embedding, safe_labels)
+        correct = pred == safe_labels
+        return _masked_sums(per_tok, correct, token_valid)
+
+    return loss
+
+
 TASK_LOSSES: dict[str, Callable] = {
     "seq-cls": seq_cls_loss,
     "token-cls": token_cls_loss,
@@ -222,6 +285,13 @@ class Trainer:
         if self.task not in TASK_LOSSES:
             raise ValueError(f"no loss for task {self.task!r}")
         self.loss_fn = TASK_LOSSES[self.task]
+        if getattr(config, "fused_vocab_ce", False):
+            if self.task != "causal-lm" or not hasattr(model,
+                                                       "hidden_and_embedding"):
+                raise ValueError(
+                    "fused_vocab_ce requires task='causal-lm' and a model "
+                    "exposing hidden_and_embedding (GPT-2 family)")
+            self.loss_fn = make_fused_causal_lm_loss(model)
         self.n_chips = world_size(mesh)
         self.dp_size = data_parallel_size(mesh)
         # MoE models sow per-layer load-balance losses into the "losses"

@@ -96,6 +96,7 @@ _SLOW_TESTS = {
     "test_rtd.py::test_rtd_training_learns",
     "test_mlm.py::test_mlm_training_learns",
     "test_predict.py::test_predict_mlm_fills",
+    "test_vocab_ce.py::test_fused_causal_lm_training_matches_unfused",
 }
 
 

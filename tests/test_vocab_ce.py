@@ -1,0 +1,157 @@
+"""Fused LM-head + cross-entropy parity (ops/pallas_vocab_ce.py).
+
+Contract: loss, prediction, and BOTH gradients (dHidden, dWeight) match
+the unfused full-logits path to fp32 roundoff — in interpret mode on
+CPU, including a non-128-multiple vocab (padding masked in-kernel) and
+multi-block token/vocab grids.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_vocab_ce import (
+    fused_vocab_cross_entropy,
+)
+
+
+def _unfused(hidden, weight, labels):
+    logits = hidden.astype(jnp.float32) @ weight.astype(jnp.float32).T
+    return (optax.softmax_cross_entropy_with_integer_labels(logits, labels),
+            jnp.argmax(logits, -1))
+
+
+def _rand(n_tok, h_dim, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    hidden = jnp.asarray(rng.randn(n_tok, h_dim).astype(np.float32))
+    weight = jnp.asarray((rng.randn(vocab, h_dim) * 0.05).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, vocab, n_tok), jnp.int32)
+    return hidden, weight, labels
+
+
+@pytest.mark.parametrize("n_tok,vocab,block_n,block_v", [
+    (256, 512, 128, 256),     # multi-block both axes
+    (128, 1000, 128, 256),    # vocab NOT a multiple of block_v (padding)
+    (384, 131, 128, 256),     # vocab < block_v, needs masked tail
+])
+def test_fused_matches_unfused_loss_and_pred(n_tok, vocab, block_n, block_v):
+    hidden, weight, labels = _rand(n_tok, 128, vocab)
+    want_loss, want_pred = _unfused(hidden, weight, labels)
+    got_loss, got_pred = fused_vocab_cross_entropy(
+        hidden, weight, labels, block_n=block_n, block_v=block_v,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(got_loss), np.asarray(want_loss),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got_pred), np.asarray(want_pred))
+
+
+def test_fused_gradients_match_unfused():
+    hidden, weight, labels = _rand(256, 128, 777, seed=1)
+    valid = jnp.asarray((np.arange(256) % 5 != 0).astype(np.float32))
+
+    def loss_fused(h, w):
+        per_tok, _ = fused_vocab_cross_entropy(h, w, labels, block_n=128,
+                                               block_v=256, interpret=True)
+        return jnp.sum(per_tok * valid) / jnp.sum(valid)
+
+    def loss_unfused(h, w):
+        per_tok, _ = _unfused(h, w, labels)
+        return jnp.sum(per_tok * valid) / jnp.sum(valid)
+
+    (gh_f, gw_f) = jax.grad(loss_fused, argnums=(0, 1))(hidden, weight)
+    (gh_u, gw_u) = jax.grad(loss_unfused, argnums=(0, 1))(hidden, weight)
+    np.testing.assert_allclose(np.asarray(gh_f), np.asarray(gh_u),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_u),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_argmax_tie_and_first_max_semantics():
+    """Identical rows of W produce logit ties across vocab blocks; the
+    fused argmax must pick the FIRST maximal id like jnp.argmax."""
+    rng = np.random.RandomState(2)
+    hidden = jnp.asarray(rng.randn(128, 128).astype(np.float32))
+    row = (rng.randn(1, 128) * 0.05).astype(np.float32)
+    weight = jnp.asarray(np.repeat(row, 512, axis=0))     # ALL rows equal
+    labels = jnp.zeros(128, jnp.int32)
+    _, pred = fused_vocab_cross_entropy(hidden, weight, labels, block_n=128,
+                                        block_v=256, interpret=True)
+    np.testing.assert_array_equal(np.asarray(pred), np.zeros(128))
+
+
+def test_fallback_path_on_untileable_shapes():
+    """N not a block multiple → XLA fallback, same results."""
+    hidden, weight, labels = _rand(100, 64, 300, seed=3)
+    want_loss, want_pred = _unfused(hidden, weight, labels)
+    got_loss, got_pred = fused_vocab_cross_entropy(hidden, weight, labels,
+                                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(got_loss), np.asarray(want_loss),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got_pred), np.asarray(want_pred))
+
+
+def test_fused_causal_lm_training_matches_unfused(devices8):
+    """Trainer with fused_vocab_ce=True reproduces the unfused loss
+    sequence on a dp8 mesh (shard_mapped kernel, psummed dW through the
+    whole optimizer update). Tiny hidden (not 128-multiple) exercises
+    the in-shard-map fallback; hidden=128 exercises the real kernel."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+    seq = 16
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(32, seed=7)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=seq)
+
+    def run(fused, hidden_size):
+        mesh = build_mesh(MeshConfig(dp=-1), devices=jax.devices())
+        model_cfg = Gpt2Config(
+            vocab_size=256, hidden_size=hidden_size, num_layers=2,
+            num_heads=4, intermediate_size=2 * hidden_size,
+            max_position_embeddings=seq, hidden_dropout=0.0,
+            embd_dropout=0.0, attention_dropout=0.0)
+        model = Gpt2LMHeadModel(model_cfg)
+        params = init_params(model, model_cfg, seed=0)
+        cfg = TrainConfig(task="causal-lm", dtype="float32",
+                          learning_rate=1e-3, scale_lr_by_world_size=False,
+                          log_every_steps=0, fused_vocab_ce=fused,
+                          rng_impl="threefry")
+        trainer = Trainer(cfg, model, params, mesh)
+        if fused:
+            # force the real Pallas kernel (interpret mode) on this CPU
+            # mesh — the default would take the unfused off-TPU fallback
+            from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+                make_fused_causal_lm_loss,
+            )
+            trainer.loss_fn = make_fused_causal_lm_loss(model, interpret=True)
+        batcher = ShardedBatcher(ds, 16, mesh, shuffle=False)
+        losses = []
+        for step, batch in enumerate(batcher.global_arrays(0)):
+            if step >= 3:
+                break
+            trainer.state, m = trainer._train_step(trainer.state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    for hs in (32, 128):
+        np.testing.assert_allclose(run(True, hs), run(False, hs), rtol=2e-5,
+                                   err_msg=f"hidden_size={hs}")
