@@ -45,6 +45,8 @@ Extra modes (each also prints one JSON line per run):
                        vs compute time from a profiler trace.
   --generate           decode throughput: tokens/s/chip for GPT-2
                        prefill+scan and BART cached greedy + beam.
+  --causal-lm          GPT-2 124M training throughput, fused
+                       vocab-CE loss vs full-logits baseline.
 
 Results across rounds are recorded in BENCH_EXTRA.md.
 """
@@ -303,6 +305,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
     if args.generate:
         return [f"generate_{m}_tokens_per_sec_per_chip"
                 for m in ("gpt2_greedy", "bart_greedy", "bart_beam4")]
+    if args.causal_lm:
+        return ["gpt2_finetune_fused_ce_samples_per_sec_per_chip"]
     if args.model == "bert-large":
         return ["bert_large_wwm_finetune_samples_per_sec_per_chip"]
     return ["bert_base_finetune_samples_per_sec_per_chip"]
@@ -353,6 +357,9 @@ def _run_child(args: argparse.Namespace) -> None:
     elif args.generate:
         from benchmarks.generate_bench import bench_generate
         bench_generate()
+    elif args.causal_lm:
+        from benchmarks.causal_lm_bench import bench_causal_lm
+        bench_causal_lm()
     elif args.model == "bert-large":
         bench_bert_large()
     else:
@@ -366,13 +373,15 @@ def main() -> None:
     parser.add_argument("--buckets", action="store_true")
     parser.add_argument("--mesh", action="store_true")
     parser.add_argument("--generate", action="store_true")
+    parser.add_argument("--causal-lm", action="store_true", dest="causal_lm")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)  # internal: run measured body
     args = parser.parse_args()
     picked = [n for n, on in [("--model", args.model is not None),
                               ("--buckets", args.buckets),
                               ("--mesh", args.mesh),
-                              ("--generate", args.generate)] if on]
+                              ("--generate", args.generate),
+                              ("--causal-lm", args.causal_lm)] if on]
     if len(picked) > 1:
         parser.error(f"pick one mode, got {' and '.join(picked)}")
 
