@@ -379,9 +379,15 @@ def merge_into(template: Any, loaded: dict, strict_backbone: bool = True) -> tup
         # checkpoint (HF BERT-family checkpoints have no experts); the
         # sidecar loader in auto.from_pretrained overlays them when a
         # moe.safetensors exists.
+        # The pooler lives under backbone/ but is head-like: HF builds
+        # MLM/QA/token-cls models with add_pooling_layer=False, so a
+        # checkpoint exported from one legitimately lacks it — loading
+        # such a checkpoint for seq-cls freshly initializes pooler +
+        # classifier (exactly HF from_pretrained's behavior).
         backbone_missing = [m for m in missing
                             if m.startswith(_backbone_prefixes)
-                            and "/moe/" not in m]
+                            and "/moe/" not in m
+                            and "/pooler/" not in m]
         if backbone_missing and strict_backbone:
             raise ValueError(f"backbone params missing from checkpoint: {backbone_missing[:8]}")
         logger.info("convert: freshly initialized head params: %s", missing)

@@ -234,3 +234,36 @@ def test_albert_mlm_parity(tmp_path):
                         deterministic=True)
     np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
                                atol=TOL, rtol=1e-3)
+
+
+def test_mlm_export_reloads_for_seq_cls(tmp_path):
+    """The reference's main path: a pretrained (here: MLM-exported)
+    checkpoint loads for sequence classification with pooler +
+    classifier freshly initialized (HF from_pretrained semantics) and
+    the backbone weights carried over."""
+    import numpy as np
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+        BertForMaskedLM,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import EncoderConfig
+
+    cfg = EncoderConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                        num_heads=4, intermediate_size=64,
+                        max_position_embeddings=16, use_pooler=False)
+    mlm = BertForMaskedLM(cfg)
+    params = init_params(mlm, cfg)
+    out = str(tmp_path / "mlm-export")
+    auto_models.save_pretrained(out, params, "bert", cfg)
+
+    model, loaded, fam, lcfg = auto_models.from_pretrained(
+        out, task="seq-cls", num_labels=2)
+    assert fam == "bert" and lcfg.use_pooler
+    np.testing.assert_allclose(
+        np.asarray(loaded["backbone"]["encoder"]["layer_0"]["attention"]
+                   ["query"]["kernel"]),
+        np.asarray(params["backbone"]["encoder"]["layer_0"]["attention"]
+                   ["query"]["kernel"]), atol=1e-6)
+    assert "pooler" in loaded["backbone"] and "classifier" in loaded
