@@ -97,6 +97,32 @@ _SLOW_TESTS = {
     "test_mlm.py::test_mlm_training_learns",
     "test_predict.py::test_predict_mlm_fills",
     "test_vocab_ce.py::test_fused_causal_lm_training_matches_unfused",
+    # ≥2s band (uncontended measurement, r3) — trimmed so the fast gate
+    # lands under 2 minutes on one core
+    "test_bart.py::test_bart_cached_greedy_matches_hf_generate",
+    "test_t5.py::test_t5_parity_vs_hf",
+    "test_sharding.py::test_rules_skip_non_divisible_dims",
+    "test_bart.py::test_mbart_parity_and_roundtrip",
+    "test_moe.py::test_moe_tiny_capacity_drops_gracefully",
+    "test_gpt2.py::test_gpt2_generate_right_padded",
+    "test_vocab_ce.py::test_fused_gradients_match_unfused",
+    "test_vocab_ce.py::test_fused_matches_unfused_loss_and_pred",
+    "test_t5.py::test_sampled_generation_respects_top_k",
+    "test_deberta.py::test_deberta_v2_style_separate_pos_proj_parity",
+    "test_pallas_attention.py::test_flash_mask_gradient_nonzero",
+    "test_gpt2.py::test_gpt2_lm_parity",
+    "test_t5.py::test_t5_beam_search_matches_hf",
+    "test_t5.py::test_beam_search_pads_after_eos",
+    "test_t5.py::test_beam1_score_dominates_greedy",
+    "test_t5.py::test_t5_greedy_generate_matches_hf",
+    "test_deberta.py::test_deberta_conv_layer_parity",
+    "test_checkpoint.py::test_no_checkpoint_returns_none",
+    "test_sharding.py::test_optimizer_state_sharded_like_params",
+    "test_pipeline_parallel.py::test_pipelined_params_sharded_over_pipe",
+    "test_pipeline_parallel.py::test_gpt2_pipelined_decode_raises",
+    "test_moe.py::test_moe_params_sharded_over_expert_axis",
+    "test_predict.py::test_predict_causal_lm",
+    "test_predict.py::test_predict_rtd",
 }
 
 
