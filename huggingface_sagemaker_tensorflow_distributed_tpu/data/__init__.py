@@ -10,5 +10,6 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (  # n
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (  # noqa: F401
     ArrayDataset,
+    MlmDataset,
     ShardedBatcher,
 )

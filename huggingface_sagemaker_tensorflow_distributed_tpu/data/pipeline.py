@@ -79,16 +79,19 @@ class ArrayDataset:
     @classmethod
     def from_mlm_texts(cls, tokenizer, texts, max_length: int = 512,
                        mlm_probability: float = 0.15, whole_word: bool = True,
-                       seed: int = 0) -> "ArrayDataset":
+                       seed: int = 0) -> "MlmDataset":
         """Masked-LM corpus with (whole-word) masking — the pretraining
         recipe behind the reference's default checkpoint
         ``bert-large-uncased-whole-word-masking`` (reference
         ``launch.py:17``). HF ``DataCollatorForWholeWordMask`` semantics:
         ``mlm_probability`` of WORDS are chosen (every subword of a
         chosen word is predicted); chosen tokens become [MASK] 80% /
-        random 10% / unchanged 10%; labels are -100 elsewhere. Masking
-        is drawn once at dataset build (static over epochs; HF redraws
-        per batch — one epoch of its stream)."""
+        random 10% / unchanged 10%; labels are -100 elsewhere.
+
+        Returns an :class:`MlmDataset`: masks are RE-DRAWN each epoch
+        (``ShardedBatcher`` calls ``begin_epoch``), matching HF's
+        per-batch collator diversity; eval paths iterate with
+        ``epoch=0`` so held-out masks stay fixed."""
         import re as _re
 
         mask_id = getattr(tokenizer, "mask_token_id", None)
@@ -103,33 +106,14 @@ class ArrayDataset:
         else:
             words = [_re.findall(r"\w+|[^\w\s]", t) for t in texts]
             enc = tokenizer.encode_words(words, max_length=max_length)
-        ids = np.asarray(enc["input_ids"], np.int32).copy()
-        am = np.asarray(enc["attention_mask"], np.int32)
-        wid = np.asarray(enc["word_ids"], np.int32)
-        labels = np.full_like(ids, -100)
-        rng = np.random.RandomState(seed)
-        vocab = int(getattr(tokenizer, "vocab_size"))
-        width = ids.shape[1]
-        for r in range(ids.shape[0]):
-            wmax = int(wid[r].max())
-            if wmax < 0:
-                continue
-            if whole_word:
-                chosen = rng.rand(wmax + 1) < mlm_probability
-                if not chosen.any():
-                    chosen[rng.randint(wmax + 1)] = True
-                sel = (wid[r] >= 0) & chosen[np.maximum(wid[r], 0)]
-            else:
-                sel = (wid[r] >= 0) & (rng.rand(width) < mlm_probability)
-                if not sel.any():
-                    cand = np.flatnonzero(wid[r] >= 0)
-                    sel[cand[rng.randint(len(cand))]] = True
-            labels[r, sel] = ids[r, sel]
-            action = rng.rand(width)
-            ids[r, sel & (action < 0.8)] = mask_id
-            do_rand = sel & (action >= 0.8) & (action < 0.9)
-            ids[r, do_rand] = rng.randint(0, vocab, int(do_rand.sum()))
-        return cls({"input_ids": ids, "attention_mask": am, "labels": labels})
+        return MlmDataset(
+            clean_ids=np.asarray(enc["input_ids"], np.int32),
+            attention_mask=np.asarray(enc["attention_mask"], np.int32),
+            word_ids=np.asarray(enc["word_ids"], np.int32),
+            mask_token_id=int(mask_id),
+            vocab_size=int(getattr(tokenizer, "vocab_size")),
+            mlm_probability=mlm_probability, whole_word=whole_word,
+            seed=seed)
 
     @classmethod
     def from_span_corruption_texts(cls, tokenizer, texts,
@@ -333,6 +317,76 @@ class ArrayDataset:
                     "labels": labels})
 
 
+class MlmDataset(ArrayDataset):
+    """ArrayDataset whose MLM masking is re-drawn per epoch.
+
+    Holds the CLEAN token ids + word ids; ``begin_epoch(e)`` materializes
+    ``input_ids``/``labels`` from ``RandomState(seed + e)`` — fully
+    vectorized, so a redraw costs one pass over the corpus, and every
+    host derives identical masks with no communication (same seed
+    discipline as ``ShardedBatcher``'s epoch permutation). Fixes the
+    static-masking quirk where every epoch saw identical masks (HF's
+    ``DataCollatorForWholeWordMask`` redraws per batch; per-epoch is the
+    same diversity at epoch granularity)."""
+
+    def __init__(self, clean_ids: np.ndarray, attention_mask: np.ndarray,
+                 word_ids: np.ndarray, mask_token_id: int, vocab_size: int,
+                 mlm_probability: float = 0.15, whole_word: bool = True,
+                 seed: int = 0):
+        self._clean_ids = clean_ids
+        self._word_ids = word_ids
+        self._mask_token_id = mask_token_id
+        self._vocab_size = vocab_size
+        self._mlm_probability = mlm_probability
+        self._whole_word = whole_word
+        self._seed = seed
+        # words per row (word ids are 0..wmax, -100/-1 on specials/pads)
+        self._n_words = np.maximum(word_ids.max(axis=1) + 1, 0)
+        self._epoch: Optional[int] = None
+        super().__init__({"attention_mask": attention_mask})
+        self.begin_epoch(0)
+
+    def begin_epoch(self, epoch: int) -> None:
+        """Re-draw masks for ``epoch`` (idempotent per epoch)."""
+        if epoch == self._epoch:
+            return
+        rng = np.random.RandomState(self._seed + epoch)
+        ids = self._clean_ids.copy()
+        labels = np.full_like(ids, -100)
+        wid = self._word_ids
+        n, width = ids.shape
+        has_words = self._n_words > 0
+        if self._whole_word:
+            max_w = max(int(self._n_words.max()), 1)
+            chosen = rng.rand(n, max_w) < self._mlm_probability
+            # positions past a row's word count never matter (wid never
+            # points there), but "at least one word chosen" must only
+            # consider real words
+            real_w = np.arange(max_w)[None, :] < self._n_words[:, None]
+            none = has_words & ~(chosen & real_w).any(axis=1)
+            idx = np.flatnonzero(none)
+            if len(idx):
+                pick = (rng.rand(len(idx)) * self._n_words[idx]).astype(np.int64)
+                chosen[idx, pick] = True
+            sel = (wid >= 0) & np.take_along_axis(
+                chosen, np.maximum(wid, 0), axis=1)
+        else:
+            sel = (wid >= 0) & (rng.rand(n, width) < self._mlm_probability)
+            none = has_words & ~sel.any(axis=1)
+            for r in np.flatnonzero(none):
+                cand = np.flatnonzero(wid[r] >= 0)
+                sel[r, cand[rng.randint(len(cand))]] = True
+        labels[sel] = self._clean_ids[sel]
+        action = rng.rand(n, width)
+        ids[sel & (action < 0.8)] = self._mask_token_id
+        do_rand = sel & (action >= 0.8) & (action < 0.9)
+        ids[do_rand] = rng.randint(0, self._vocab_size,
+                                   int(do_rand.sum())).astype(ids.dtype)
+        self.columns["input_ids"] = ids
+        self.columns["labels"] = labels
+        self._epoch = epoch
+
+
 _PREFETCH_END = object()
 
 
@@ -482,6 +536,12 @@ class ShardedBatcher:
         ``start_step`` skips already-consumed batches of this epoch's
         permutation — the data-position part of mid-epoch resume.
         """
+        begin_epoch = getattr(self.dataset, "begin_epoch", None)
+        if begin_epoch is not None:
+            # per-epoch transforms (MLM re-masking): deterministic from
+            # seed+epoch, so every host agrees and mid-epoch resume
+            # (start_step) replays the identical columns
+            begin_epoch(epoch)
         n = len(self.dataset)
         order = np.arange(n)
         if self.shuffle:
@@ -496,6 +556,13 @@ class ShardedBatcher:
             order = self._length_sorted_windows(order)
         steps = self.steps_per_epoch()
         for s in range(start_step, steps):
+            if begin_epoch is not None:
+                # re-assert before every gather: another batcher over the
+                # SAME dataset object may have re-masked to its own epoch
+                # between our yields (idempotent no-op in the sequential
+                # train→eval pattern; NOT safe to interleave from two
+                # threads concurrently)
+                begin_epoch(epoch)
             lo = s * self.global_batch_size
             global_idx = order[lo: lo + self.global_batch_size]
             valid_n = len(global_idx)

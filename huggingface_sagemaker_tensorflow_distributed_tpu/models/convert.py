@@ -390,6 +390,13 @@ def merge_into(template: Any, loaded: dict, strict_backbone: bool = True) -> tup
                             and "/pooler/" not in m]
         if backbone_missing and strict_backbone:
             raise ValueError(f"backbone params missing from checkpoint: {backbone_missing[:8]}")
+        pooler_missing = [m for m in missing if "/pooler/" in m]
+        if pooler_missing:
+            # legitimate for add_pooling_layer=False checkpoints, but also
+            # what a truncated/corrupt seq-cls checkpoint looks like — keep
+            # it loud enough to notice
+            logger.warning("convert: pooler params absent from checkpoint, "
+                           "freshly initialized: %s", pooler_missing)
         logger.info("convert: freshly initialized head params: %s", missing)
     return merged, missing
 

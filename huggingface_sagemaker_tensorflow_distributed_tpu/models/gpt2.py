@@ -37,6 +37,23 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
 
 NEG_INF = -1e9
 
+_flash_dropout_warned = False
+
+
+def _warn_flash_dropout_fallback():
+    """One-time trace-time warning: attention_impl='flash' with
+    attn_pdrop > 0 in training falls back to the unfused O(S²) softmax
+    (the flash kernel has no probability-dropout hook)."""
+    global _flash_dropout_warned
+    if not _flash_dropout_warned:
+        _flash_dropout_warned = True
+        import logging
+        logging.getLogger(__name__).warning(
+            "gpt2: attention_impl='flash' requested but attention_dropout "
+            "> 0 in training has no flash hook — using the unfused O(S^2) "
+            "softmax for this step. Set attention_dropout=0.0 to keep the "
+            "flash kernel (HF fine-tunes commonly do).")
+
 
 @dataclass(frozen=True)
 class Gpt2Config:
@@ -160,6 +177,8 @@ class Gpt2Attention(nn.Module):
                     "attention_dropout > 0 cannot combine with "
                     "attention_impl='ring' (sequence parallelism): set "
                     "attention_dropout=0.0 for sp training")
+            if cfg.attention_impl == "flash":
+                _warn_flash_dropout_fallback()
             # HF applies dropout to the attention probabilities during
             # training (attn_pdrop); the fused attention paths have no
             # hook for it, so mirror BartAttention's unfused softmax

@@ -270,9 +270,11 @@ def fused_vocab_cross_entropy(hidden, weight, labels, block_n: int = 256,
     caller (multiply the returned loss by the validity mask), matching the
     unfused loss-function contract in ``train/trainer.py``.
 
-    Falls back to the unfused XLA path off-TPU (unless ``interpret`` is
-    forced — tests) and for shapes that don't tile (N not a multiple of
-    an 8-aligned block_n, or H not lane-aligned). The vocab axis always
+    Falls back to the unfused XLA path off-TPU (``interpret=True`` forces
+    the interpret-mode kernel there — tests; ``interpret=False`` off-TPU
+    also falls back, since compiled Mosaic cannot build without a TPU)
+    and for shapes that don't tile (N not a multiple of an 8-aligned
+    block_n, or H not lane-aligned). The vocab axis always
     tiles: W is zero-padded up to a block_v multiple and padded rows are
     masked to -inf in-kernel."""
     from huggingface_sagemaker_tensorflow_distributed_tpu.ops.losses import (
@@ -281,10 +283,15 @@ def fused_vocab_cross_entropy(hidden, weight, labels, block_n: int = 256,
 
     n_tok, h_dim = hidden.shape
     vocab_size = weight.shape[0]
+    on_tpu = jax.devices()[0].platform == "tpu"
     if interpret is None:
         # off-TPU the kernel would run in interpret emulation — orders of
         # magnitude slower than the plain matmul; use the unfused path
-        interpret = False if jax.devices()[0].platform == "tpu" else None
+        interpret = False if on_tpu else None
+    elif interpret is False and not on_tpu:
+        # compiled Mosaic (pltpu.VMEM scratch) cannot build off-TPU; treat a
+        # forced interpret=False like the default off-TPU case: unfused path
+        interpret = None
     # fp32 TPU tiles are (8, 128): block_n must stay 8-aligned
     block_n = min(block_n, n_tok) & ~7
     if (interpret is None or block_n == 0 or n_tok % block_n
