@@ -75,6 +75,10 @@ class TrainConfig:
     # report ROUGE-L alongside loss/accuracy (0 = off; generation is a
     # separate pass, so this scales eval cost with the sample count)
     eval_rouge_samples: int = 0
+    # qa eval extra: decode predicted answer TEXTS for this many eval
+    # examples and report SQuAD exact-match/F1 alongside span accuracy
+    # (0 = off; one extra forward pass over the sampled examples)
+    eval_qa_samples: int = 0
     from_scratch: bool = False     # random init instead of pretrained weights
 
     # --- data ---

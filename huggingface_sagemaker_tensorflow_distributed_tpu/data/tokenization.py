@@ -135,12 +135,17 @@ class WordHashTokenizer:
                 "word_ids": word_ids}
 
     def encode_qa(self, questions, contexts, start_chars, answer_texts,
-                  max_length: int | None = None):
+                  max_length: int | None = None,
+                  return_offsets: bool = False):
         """Question+context pairs → ids with answer span token positions.
 
         Char-offset → token-index mapping via the same regex the word
         hashing uses; spans truncated away land on position 0 (CLS), the
         HF convention for unanswerable-after-truncation.
+        ``return_offsets`` adds ``offset_starts``/``offset_ends`` — char
+        offsets into the context per CONTEXT token, -1 elsewhere (the
+        answer-text decoding input, eval-side only so the extra columns
+        never reach the model).
         """
         max_length = max_length or self.model_max_length
         n = len(questions)
@@ -149,6 +154,8 @@ class WordHashTokenizer:
         token_type_ids = np.zeros((n, max_length), np.int32)
         start_positions = np.zeros(n, np.int32)
         end_positions = np.zeros(n, np.int32)
+        offset_starts = np.full((n, max_length), -1, np.int32)
+        offset_ends = np.full((n, max_length), -1, np.int32)
         for r in range(n):
             q = questions[r].lower() if self.lowercase else questions[r]
             c = contexts[r].lower() if self.lowercase else contexts[r]
@@ -174,9 +181,19 @@ class WordHashTokenizer:
             if tok_start is not None and tok_end < max_length:
                 start_positions[r] = tok_start
                 end_positions[r] = tok_end
-        return {"input_ids": input_ids, "attention_mask": attention_mask,
-                "token_type_ids": token_type_ids,
-                "start_positions": start_positions, "end_positions": end_positions}
+            for t, (_, s, e) in enumerate(ctx_spans):
+                pos = ctx_offset + t
+                if pos >= max_length:
+                    break
+                offset_starts[r, pos] = s
+                offset_ends[r, pos] = e
+        res = {"input_ids": input_ids, "attention_mask": attention_mask,
+               "token_type_ids": token_type_ids,
+               "start_positions": start_positions, "end_positions": end_positions}
+        if return_offsets:
+            res["offset_starts"] = offset_starts
+            res["offset_ends"] = offset_ends
+        return res
 
     def save_pretrained(self, output_dir: str) -> None:
         os.makedirs(output_dir, exist_ok=True)
@@ -264,8 +281,12 @@ class HFTokenizer:
         return self._with_word_ids(out, len(texts), max_length)
 
     def encode_qa(self, questions, contexts, start_chars, answer_texts,
-                  max_length: int | None = None):
-        """Question+context → ids + answer token span via offset mapping."""
+                  max_length: int | None = None,
+                  return_offsets: bool = False):
+        """Question+context → ids + answer token span via offset mapping.
+        ``return_offsets`` adds ``offset_starts``/``offset_ends`` (char
+        offsets into the context per CONTEXT token, -1 elsewhere) for
+        answer-text decoding at eval."""
         max_length = max_length or self.model_max_length
         out = self._tok(questions, contexts, truncation="only_second",
                         padding="max_length", max_length=max_length,
@@ -273,6 +294,8 @@ class HFTokenizer:
         n = len(questions)
         start_positions = np.zeros(n, np.int32)
         end_positions = np.zeros(n, np.int32)
+        offset_starts = np.full((n, max_length), -1, np.int32)
+        offset_ends = np.full((n, max_length), -1, np.int32)
         offsets = out["offset_mapping"]
         for r in range(n):
             a_start = start_chars[r]
@@ -282,6 +305,8 @@ class HFTokenizer:
             for t, (s, e) in enumerate(offsets[r]):
                 if seq_ids[t] != 1 or e == s:
                     continue
+                offset_starts[r, t] = s
+                offset_ends[r, t] = e
                 if s < a_end and e > a_start:
                     if tok_start is None:
                         tok_start = t
@@ -297,6 +322,9 @@ class HFTokenizer:
                "start_positions": start_positions, "end_positions": end_positions}
         if "token_type_ids" in out:
             res["token_type_ids"] = out["token_type_ids"].astype(np.int32)
+        if return_offsets:
+            res["offset_starts"] = offset_starts
+            res["offset_ends"] = offset_ends
         return res
 
     def save_pretrained(self, output_dir: str) -> None:

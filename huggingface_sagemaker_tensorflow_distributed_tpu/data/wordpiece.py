@@ -335,10 +335,13 @@ class WordPieceTokenizer:
                 "word_ids": word_ids}
 
     def encode_qa(self, questions, contexts, start_chars, answer_texts,
-                  max_length: int | None = None):
+                  max_length: int | None = None,
+                  return_offsets: bool = False):
         """Question+context pairs → ids + answer token spans via the
         code-point offsets the core emits (HF offset_mapping semantics,
-        truncation="only_second")."""
+        truncation="only_second"). ``return_offsets`` adds
+        ``offset_starts``/``offset_ends`` (char offsets into the context
+        per CONTEXT token, -1 elsewhere) for answer-text decoding."""
         max_length = max_length or self.model_max_length
         n = len(questions)
         q_ids, _, _, _, q_cnt = self._tokenize_batch(list(questions), max_length)
@@ -350,6 +353,8 @@ class WordPieceTokenizer:
         token_type_ids = np.zeros((n, max_length), np.int32)
         start_positions = np.zeros(n, np.int32)
         end_positions = np.zeros(n, np.int32)
+        offset_starts = np.full((n, max_length), -1, np.int32)
+        offset_ends = np.full((n, max_length), -1, np.int32)
         for r in range(n):
             # only_second truncation: question keeps its tokens (capped so
             # CLS/q/SEP/SEP still fit), context gets the remaining room
@@ -371,6 +376,8 @@ class WordPieceTokenizer:
                 s, e = int(c_starts[r, t]), int(c_ends[r, t])
                 if e == s:
                     continue
+                offset_starts[r, ctx_offset + t] = s
+                offset_ends[r, ctx_offset + t] = e
                 if s < a_end and e > a_start:
                     if tok_start is None:
                         tok_start = ctx_offset + t
@@ -381,10 +388,14 @@ class WordPieceTokenizer:
             if tok_start is not None and last_end >= a_end:
                 start_positions[r] = tok_start
                 end_positions[r] = tok_end
-        return {"input_ids": input_ids, "attention_mask": attention_mask,
-                "token_type_ids": token_type_ids,
-                "start_positions": start_positions,
-                "end_positions": end_positions}
+        res = {"input_ids": input_ids, "attention_mask": attention_mask,
+               "token_type_ids": token_type_ids,
+               "start_positions": start_positions,
+               "end_positions": end_positions}
+        if return_offsets:
+            res["offset_starts"] = offset_starts
+            res["offset_ends"] = offset_ends
+        return res
 
     # -- persistence (HF vocab.txt layout: save_pretrained parity,
     #    reference scripts/train.py:183) -----------------------------------

@@ -6,6 +6,8 @@ eval step instead (``train/trainer.py::token_cls_loss``)."""
 from __future__ import annotations
 
 import re
+import string
+from collections import Counter
 from typing import Sequence
 
 
@@ -52,3 +54,82 @@ def rouge_l(predictions: Sequence[str], references: Sequence[str]) -> dict:
     return {"rougeL_precision": sum(ps) / n,
             "rougeL_recall": sum(rs) / n,
             "rougeL_f1": sum(fs) / n}
+
+
+# -- SQuAD answer-text metrics (the numbers every extractive-QA result is
+#    quoted in; reference analogue: the accuracy metric at train.py:119
+#    applied to its task) -------------------------------------------------
+
+_ARTICLES = re.compile(r"\b(a|an|the)\b")
+
+
+def squad_normalize(text: str) -> str:
+    """The official SQuAD answer normalization, in its exact order:
+    lowercase → REMOVE punctuation (not replace — 'U.S.' must equal
+    'US') → drop English articles → collapse whitespace."""
+    text = text.lower()
+    text = "".join(ch for ch in text if ch not in string.punctuation)
+    text = _ARTICLES.sub(" ", text)
+    return " ".join(text.split())
+
+
+def squad_em_f1(predictions: Sequence[str], references: Sequence[str]) -> dict:
+    """Corpus exact-match + token-level F1 over normalized answer texts
+    (official SQuAD v1 scoring, single reference per example). Returns
+    percentages, the convention SQuAD numbers are quoted in."""
+    if len(predictions) != len(references):
+        raise ValueError("predictions and references must align")
+    em_total = f1_total = 0.0
+    for pred, ref in zip(predictions, references):
+        p = squad_normalize(pred)
+        r = squad_normalize(ref)
+        em_total += float(p == r)
+        p_toks, r_toks = p.split(), r.split()
+        if not p_toks or not r_toks:
+            f1_total += float(p_toks == r_toks)
+            continue
+        # multiset intersection (the official script's Counter overlap)
+        common = sum((Counter(p_toks) & Counter(r_toks)).values())
+        if common == 0:
+            continue
+        prec = common / len(p_toks)
+        rec = common / len(r_toks)
+        f1_total += 2 * prec * rec / (prec + rec)
+    n = max(len(predictions), 1)
+    return {"exact_match": 100.0 * em_total / n, "f1": 100.0 * f1_total / n}
+
+
+def extract_answer_spans(start_logits, end_logits, offset_starts,
+                         offset_ends, contexts: Sequence[str],
+                         max_answer_len: int = 30) -> list[str]:
+    """Decode predicted answer texts from span logits (HF run_qa's n-best
+    search collapsed to the argmax pair): best (s, e) with s ≤ e ≤
+    s + max_answer_len over CONTEXT tokens only (offsets ≥ 0); a winning
+    CLS/invalid pair decodes to "" (no-answer convention).
+
+    ``offset_starts``/``offset_ends`` are char offsets into each context,
+    -1 outside context tokens — the ``return_offsets=True`` output of the
+    tokenizers' ``encode_qa``."""
+    import numpy as np
+
+    out = []
+    s_l = np.asarray(start_logits)
+    e_l = np.asarray(end_logits)
+    for r in range(len(contexts)):
+        idx = np.flatnonzero(np.asarray(offset_starts[r]) >= 0)
+        if len(idx) == 0:
+            out.append("")
+            continue
+        # pair-score matrix over context tokens, upper-triangular within
+        # the answer-length window (seq ≤ 512 ⇒ tiny)
+        pair = s_l[r][idx][:, None] + e_l[r][idx][None, :]
+        d = idx[None, :] - idx[:, None]
+        pair = np.where((d >= 0) & (d <= max_answer_len), pair, -np.inf)
+        s_i, e_i = np.unravel_index(np.argmax(pair), pair.shape)
+        if not np.isfinite(pair[s_i, e_i]):
+            out.append("")
+            continue
+        s_tok, e_tok = int(idx[s_i]), int(idx[e_i])
+        out.append(contexts[r][offset_starts[r][s_tok]:
+                               offset_ends[r][e_tok]])
+    return out

@@ -276,6 +276,41 @@ def main(argv=None) -> dict:
                 refs = [tokenizer.decode(r[r != -100])
                         for r in cols["labels"]]
                 eval_results.update(rouge_l(preds, refs))
+            if config.task == "qa" and config.eval_qa_samples:
+                # answer-TEXT exact-match/F1 (the metric SQuAD results are
+                # quoted in), decoded from span logits via char offsets —
+                # span-position accuracy alone under-reports whenever a
+                # different token span yields the same normalized text
+                import numpy as np
+
+                from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import (
+                    extract_answer_spans,
+                    squad_em_f1,
+                )
+
+                questions, contexts, starts, answers = load_qa(
+                    config.dataset, "test", dataset_path=config.dataset_path,
+                    max_samples=config.eval_qa_samples, seed=config.seed)
+                enc = tokenizer.encode_qa(questions, contexts, starts,
+                                          answers, max_length=max_len,
+                                          return_offsets=True)
+                preds: list = []
+                bs = global_eval_batch
+                for lo in range(0, len(questions), bs):
+                    sl = slice(lo, min(lo + bs, len(questions)))
+                    s_log, e_log = model.apply(
+                        {"params": trainer.state.params},
+                        jnp.asarray(enc["input_ids"][sl]),
+                        jnp.asarray(enc["attention_mask"][sl]),
+                        token_type_ids=jnp.asarray(enc["token_type_ids"][sl])
+                        if "token_type_ids" in enc else None,
+                        deterministic=True)
+                    preds.extend(extract_answer_spans(
+                        s_log, e_log, enc["offset_starts"][sl],
+                        enc["offset_ends"][sl], contexts[sl]))
+                em_f1 = squad_em_f1(preds, list(answers))
+                eval_results["eval_exact_match"] = em_f1["exact_match"]
+                eval_results["eval_f1"] = em_f1["f1"]
             trainer.write_eval_results(eval_results)
             results["eval"] = eval_results
 

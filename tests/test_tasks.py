@@ -140,6 +140,131 @@ def test_token_cls_eval_reports_micro_f1(devices8):
     assert float(sums["f1_tp"]) == 0.0 and float(sums["f1_fn"]) == 4.0
 
 
+def test_squad_em_f1():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import (
+        squad_em_f1,
+        squad_normalize,
+    )
+
+    # official normalization: case, punctuation, articles, whitespace
+    assert squad_normalize("The  Eiffel Tower!") == "eiffel tower"
+    assert squad_normalize("a dog.") == "dog"
+    # punctuation is REMOVED, not replaced: 'U.S.' ≡ 'US' officially
+    assert squad_normalize("U.S.") == squad_normalize("US")
+    out = squad_em_f1(["U.S."], ["US"])
+    assert out["exact_match"] == 100.0 and out["f1"] == 100.0
+    out = squad_em_f1(["The Eiffel Tower"], ["eiffel tower"])
+    assert out["exact_match"] == 100.0 and out["f1"] == 100.0
+    # partial token overlap: F1 rewards it, EM doesn't
+    out = squad_em_f1(["eiffel tower of paris"], ["eiffel tower"])
+    assert out["exact_match"] == 0.0
+    assert 0.0 < out["f1"] < 100.0
+    # empty prediction vs non-empty gold
+    out = squad_em_f1([""], ["paris"])
+    assert out["exact_match"] == 0.0 and out["f1"] == 0.0
+    with pytest.raises(ValueError):
+        squad_em_f1(["a"], ["a", "b"])
+
+
+def test_extract_answer_spans_decodes_gold():
+    """Feeding one-hot logits at the GOLD span positions through the
+    offsets returned by encode_qa must reproduce the answer text — the
+    whole decode path (offsets → char span → context slice) round-trips."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import (
+        extract_answer_spans,
+        squad_em_f1,
+    )
+
+    tok = WordHashTokenizer(vocab_size=1024)
+    q, c, s, a = synthetic_qa(32, seed=2, ctx_len=(10, 30))
+    enc = tok.encode_qa(q, c, s, a, max_length=SEQ, return_offsets=True)
+    n, L = enc["input_ids"].shape
+    s_log = np.full((n, L), -10.0, np.float32)
+    e_log = np.full((n, L), -10.0, np.float32)
+    s_log[np.arange(n), enc["start_positions"]] = 10.0
+    e_log[np.arange(n), enc["end_positions"]] = 10.0
+    preds = extract_answer_spans(s_log, e_log, enc["offset_starts"],
+                                 enc["offset_ends"], c)
+    out = squad_em_f1(preds, list(a))
+    assert out["exact_match"] == 100.0
+
+
+def test_encode_qa_offsets_slice_to_answer_wordpiece():
+    """WordPiece tier: gold span positions + offsets slice the context to
+    exactly the labeled answer text."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.wordpiece import (
+        WordPieceTokenizer,
+    )
+
+    vocab = {w: i for i, w in enumerate(
+        ["[PAD]", "[CLS]", "[SEP]", "[UNK]", "[MASK]",
+         "we", "went", "to", "par", "##is", "yesterday", "which", "place",
+         "?"])}
+    tok = WordPieceTokenizer(vocab)
+    ctx = "we went to paris yesterday"
+    enc = tok.encode_qa(["which place ?"], [ctx], [ctx.index("paris")],
+                        ["paris"], max_length=16, return_offsets=True)
+    s = int(enc["start_positions"][0])
+    e = int(enc["end_positions"][0])
+    assert s > 0  # span found
+    text = ctx[enc["offset_starts"][0][s]:enc["offset_ends"][0][e]]
+    assert text == "paris"
+    # offsets are -1 outside context tokens (question/CLS/SEP/pad)
+    assert enc["offset_starts"][0][0] == -1
+    assert enc["offset_starts"][0][1] == -1
+
+
+def test_encode_qa_offsets_cover_truncation_boundary():
+    """A context token that lands on the LAST position after truncation
+    can still be the labeled gold span — its offset must be recorded, or
+    a model predicting the gold span exactly would decode to ''."""
+    tok = WordHashTokenizer(vocab_size=512)
+    ctx = " ".join(f"w{i}" for i in range(20))
+    # 2-token question → ctx_offset=4; answer placed so its token sits at
+    # position max_length-1
+    L = 12
+    answer_idx = L - 1 - 4  # context token index landing on position L-1
+    words = ctx.split()
+    a_start = ctx.index(words[answer_idx])
+    enc = tok.encode_qa(["which one"], [ctx], [a_start], [words[answer_idx]],
+                        max_length=L, return_offsets=True)
+    s, e = int(enc["start_positions"][0]), int(enc["end_positions"][0])
+    assert s == e == L - 1
+    assert enc["offset_starts"][0][s] >= 0, "offset missing at boundary"
+    assert ctx[enc["offset_starts"][0][s]:enc["offset_ends"][0][e]] == words[answer_idx]
+
+
+def test_qa_eval_reports_em_f1(tmp_path, devices8):
+    """scripts/train.py --task qa --eval_qa_samples N lands
+    eval_exact_match / eval_f1 in eval_results.txt (reference analogue:
+    the metric emission at train.py:170)."""
+    import transformers
+
+    from scripts.train import main as train_main
+
+    mdir = str(tmp_path / "cfg")
+    transformers.BertConfig(
+        vocab_size=4096, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=SEQ).save_pretrained(mdir)
+    out = str(tmp_path / "out")
+    train_main([
+        "--task", "qa", "--dataset", "synthetic", "--from_scratch", "true",
+        "--model_name_or_path", mdir, "--epochs", "2",
+        "--train_batch_size", "2", "--dtype", "float32",
+        "--max_seq_length", str(SEQ), "--max_train_samples", "256",
+        "--max_eval_samples", "64", "--eval_qa_samples", "32",
+        "--learning_rate", "1e-3", "--scale_lr_by_world_size", "false",
+        "--output_data_dir", out, "--model_dir", str(tmp_path / "model"),
+    ])
+    text = (tmp_path / "out" / "eval_results.txt").read_text()
+    kv = dict(line.split(" = ") for line in text.strip().splitlines())
+    assert "eval_exact_match" in kv and "eval_f1" in kv
+    assert 0.0 <= float(kv["eval_exact_match"]) <= 100.0
+    # F1 upper-bounds EM by construction
+    assert float(kv["eval_f1"]) >= float(kv["eval_exact_match"])
+
+
 def test_rouge_l():
     from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import rouge_l
 
