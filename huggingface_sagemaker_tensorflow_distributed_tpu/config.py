@@ -84,6 +84,12 @@ class TrainConfig:
     # --- data ---
     dataset: str = "imdb"          # imdb | sst2 | conll2003 | squad | cnn_dailymail | synthetic
     dataset_path: Optional[str] = None   # local dataset dir (offline mode)
+    # stream the corpus from disk instead of materializing it densely in
+    # host RAM (mlm / causal-lm / seq-cls; fixes the reference's
+    # materialize-everything quirk at scripts/train.py:80-83). Train-side
+    # only; eval sets stay materialized (they're small and need ROUGE/EM
+    # decoding access)
+    streaming: bool = False
     max_train_samples: Optional[int] = None
     max_eval_samples: Optional[int] = None
 

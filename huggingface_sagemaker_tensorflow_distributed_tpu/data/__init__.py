@@ -13,3 +13,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (  # 
     MlmDataset,
     ShardedBatcher,
 )
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.streaming import (  # noqa: F401
+    LineCorpus,
+    StreamingTextDataset,
+)

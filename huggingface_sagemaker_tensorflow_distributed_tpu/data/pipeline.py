@@ -38,6 +38,28 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
 )
 
 
+def encode_mlm_clean(tokenizer, texts, max_length: int):
+    """Tokenize an MLM corpus WITHOUT masking: (clean_ids, attention_mask,
+    word_ids), the inputs every masking draw starts from. Shared by the
+    materialized (``MlmDataset``) and streaming tiers."""
+    import re as _re
+
+    if getattr(tokenizer, "mask_token_id", None) is None:
+        raise ValueError(
+            "tokenizer has no [MASK] token — MLM needs one "
+            "(BERT-family vocabs ship it)")
+    if hasattr(tokenizer, "encode_text_words"):
+        # HF fast tokenizers: native tokenization of the raw text
+        # (byte-BPE spacing preserved) + word_ids from the encoding
+        enc = tokenizer.encode_text_words(texts, max_length=max_length)
+    else:
+        words = [_re.findall(r"\w+|[^\w\s]", t) for t in texts]
+        enc = tokenizer.encode_words(words, max_length=max_length)
+    return (np.asarray(enc["input_ids"], np.int32),
+            np.asarray(enc["attention_mask"], np.int32),
+            np.asarray(enc["word_ids"], np.int32))
+
+
 @dataclass
 class ArrayDataset:
     """Column dict of host-resident numpy arrays with equal leading dim."""
@@ -92,25 +114,10 @@ class ArrayDataset:
         (``ShardedBatcher`` calls ``begin_epoch``), matching HF's
         per-batch collator diversity; eval paths iterate with
         ``epoch=0`` so held-out masks stay fixed."""
-        import re as _re
-
-        mask_id = getattr(tokenizer, "mask_token_id", None)
-        if mask_id is None:
-            raise ValueError(
-                "tokenizer has no [MASK] token — MLM needs one "
-                "(BERT-family vocabs ship it)")
-        if hasattr(tokenizer, "encode_text_words"):
-            # HF fast tokenizers: native tokenization of the raw text
-            # (byte-BPE spacing preserved) + word_ids from the encoding
-            enc = tokenizer.encode_text_words(texts, max_length=max_length)
-        else:
-            words = [_re.findall(r"\w+|[^\w\s]", t) for t in texts]
-            enc = tokenizer.encode_words(words, max_length=max_length)
+        ids, am, wid = encode_mlm_clean(tokenizer, texts, max_length)
         return MlmDataset(
-            clean_ids=np.asarray(enc["input_ids"], np.int32),
-            attention_mask=np.asarray(enc["attention_mask"], np.int32),
-            word_ids=np.asarray(enc["word_ids"], np.int32),
-            mask_token_id=int(mask_id),
+            clean_ids=ids, attention_mask=am, word_ids=wid,
+            mask_token_id=int(tokenizer.mask_token_id),
             vocab_size=int(getattr(tokenizer, "vocab_size")),
             mlm_probability=mlm_probability, whole_word=whole_word,
             seed=seed)
@@ -317,6 +324,50 @@ class ArrayDataset:
                     "labels": labels})
 
 
+def apply_mlm_masking(clean_ids: np.ndarray, word_ids: np.ndarray,
+                      rng: "np.random.RandomState", mask_token_id: int,
+                      vocab_size: int, mlm_probability: float = 0.15,
+                      whole_word: bool = True) -> tuple[np.ndarray, np.ndarray]:
+    """One vectorized masking draw over ``[n, L]`` clean token rows →
+    ``(input_ids, labels)``. HF collator semantics: ``mlm_probability``
+    of words chosen (≥1 per row with words), chosen tokens become [MASK]
+    80% / random 10% / unchanged 10%, labels -100 elsewhere. Draw count
+    depends only on the shapes, so a fixed-seed ``rng`` is reproducible."""
+    ids = clean_ids.copy()
+    labels = np.full_like(ids, -100)
+    wid = word_ids
+    n, width = ids.shape
+    n_words = np.maximum(wid.max(axis=1) + 1, 0)
+    has_words = n_words > 0
+    if whole_word:
+        max_w = max(int(n_words.max()), 1)
+        chosen = rng.rand(n, max_w) < mlm_probability
+        # positions past a row's word count never matter (wid never
+        # points there), but "at least one word chosen" must only
+        # consider real words
+        real_w = np.arange(max_w)[None, :] < n_words[:, None]
+        none = has_words & ~(chosen & real_w).any(axis=1)
+        idx = np.flatnonzero(none)
+        if len(idx):
+            pick = (rng.rand(len(idx)) * n_words[idx]).astype(np.int64)
+            chosen[idx, pick] = True
+        sel = (wid >= 0) & np.take_along_axis(
+            chosen, np.maximum(wid, 0), axis=1)
+    else:
+        sel = (wid >= 0) & (rng.rand(n, width) < mlm_probability)
+        none = has_words & ~sel.any(axis=1)
+        for r in np.flatnonzero(none):
+            cand = np.flatnonzero(wid[r] >= 0)
+            sel[r, cand[rng.randint(len(cand))]] = True
+    labels[sel] = clean_ids[sel]
+    action = rng.rand(n, width)
+    ids[sel & (action < 0.8)] = mask_token_id
+    do_rand = sel & (action >= 0.8) & (action < 0.9)
+    ids[do_rand] = rng.randint(0, vocab_size,
+                               int(do_rand.sum())).astype(ids.dtype)
+    return ids, labels
+
+
 class MlmDataset(ArrayDataset):
     """ArrayDataset whose MLM masking is re-drawn per epoch.
 
@@ -340,8 +391,6 @@ class MlmDataset(ArrayDataset):
         self._mlm_probability = mlm_probability
         self._whole_word = whole_word
         self._seed = seed
-        # words per row (word ids are 0..wmax, -100/-1 on specials/pads)
-        self._n_words = np.maximum(word_ids.max(axis=1) + 1, 0)
         self._epoch: Optional[int] = None
         super().__init__({"attention_mask": attention_mask})
         self.begin_epoch(0)
@@ -350,38 +399,11 @@ class MlmDataset(ArrayDataset):
         """Re-draw masks for ``epoch`` (idempotent per epoch)."""
         if epoch == self._epoch:
             return
-        rng = np.random.RandomState(self._seed + epoch)
-        ids = self._clean_ids.copy()
-        labels = np.full_like(ids, -100)
-        wid = self._word_ids
-        n, width = ids.shape
-        has_words = self._n_words > 0
-        if self._whole_word:
-            max_w = max(int(self._n_words.max()), 1)
-            chosen = rng.rand(n, max_w) < self._mlm_probability
-            # positions past a row's word count never matter (wid never
-            # points there), but "at least one word chosen" must only
-            # consider real words
-            real_w = np.arange(max_w)[None, :] < self._n_words[:, None]
-            none = has_words & ~(chosen & real_w).any(axis=1)
-            idx = np.flatnonzero(none)
-            if len(idx):
-                pick = (rng.rand(len(idx)) * self._n_words[idx]).astype(np.int64)
-                chosen[idx, pick] = True
-            sel = (wid >= 0) & np.take_along_axis(
-                chosen, np.maximum(wid, 0), axis=1)
-        else:
-            sel = (wid >= 0) & (rng.rand(n, width) < self._mlm_probability)
-            none = has_words & ~sel.any(axis=1)
-            for r in np.flatnonzero(none):
-                cand = np.flatnonzero(wid[r] >= 0)
-                sel[r, cand[rng.randint(len(cand))]] = True
-        labels[sel] = self._clean_ids[sel]
-        action = rng.rand(n, width)
-        ids[sel & (action < 0.8)] = self._mask_token_id
-        do_rand = sel & (action >= 0.8) & (action < 0.9)
-        ids[do_rand] = rng.randint(0, self._vocab_size,
-                                   int(do_rand.sum())).astype(ids.dtype)
+        ids, labels = apply_mlm_masking(
+            self._clean_ids, self._word_ids,
+            np.random.RandomState(self._seed + epoch),
+            self._mask_token_id, self._vocab_size,
+            self._mlm_probability, self._whole_word)
         self.columns["input_ids"] = ids
         self.columns["labels"] = labels
         self._epoch = epoch
@@ -504,6 +526,11 @@ class ShardedBatcher:
                     f"bucket_sizes {bad} not divisible by the mesh seq axis "
                     f"(size {sp}); pad bucket widths to multiples of {sp}")
         self._lengths: dict[str, np.ndarray] = {}
+        if self.bucket_sizes and not hasattr(dataset, "columns"):
+            raise ValueError(
+                "length bucketing needs corpus-wide token lengths, which "
+                "streaming datasets deliberately don't precompute — drop "
+                "bucket_sizes or materialize the dataset")
         if self.bucket_sizes:
             # token count per row, per mask column (native/dataloader.cc):
             # encoder and decoder widths bucket independently

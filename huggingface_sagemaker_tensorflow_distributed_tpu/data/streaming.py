@@ -1,0 +1,187 @@
+"""Streaming/chunked data tier: corpora larger than host RAM.
+
+The reference materializes its WHOLE dataset densely in host memory
+(``set_format`` → ``[N, 512]`` tensors, reference ``scripts/train.py:
+80-83`` — the quirk SURVEY.md §2 says not to copy), and so did our
+``ArrayDataset``. This tier keeps only a line-offset index resident
+(8 bytes/row vs ≈2 KB/row materialized at seq 512) and
+tokenizes/pads/masks per batch window on demand, feeding the SAME
+``ShardedBatcher`` — epoch permutations, per-host sharding, prefetch,
+and device feed are unchanged.
+
+Determinism contract: a row's content depends only on
+``(seed, epoch, row_index)`` — NOT on which batch gathers it — so every
+host materializes identical global batches from the shared permutation
+with no communication, and mid-epoch resume replays identical data.
+MLM masking uses a per-row ``RandomState`` seeded by that triple
+(init_by_array mixing), giving HF-collator mask diversity across epochs
+without ever holding masked copies of the corpus.
+
+Random access into the file is one ``seek+read`` per row per epoch; the
+OS page cache absorbs the locality the epoch permutation has (and the
+``ShardedBatcher`` prefetch thread overlaps it with device compute).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (
+    apply_mlm_masking,
+    encode_mlm_clean,
+)
+
+
+class LineCorpus:
+    """Offset-indexed view of a ``.txt`` (one text per line) or
+    ``.jsonl`` (``{"text": ..., "label": ...}``) file.
+
+    Resident state is one int64 offset per line; texts are read back on
+    demand. The index builds in one buffered pass (no line length
+    limits, no full-file load)."""
+
+    def __init__(self, path: str, text_key: str = "text",
+                 label_key: str = "label", max_rows: Optional[int] = None):
+        self.path = path
+        self.text_key = text_key
+        self.label_key = label_key
+        self._jsonl = path.endswith((".jsonl", ".json"))
+        offsets = [0]
+        with open(path, "rb") as f:
+            for line in f:
+                offsets.append(offsets[-1] + len(line))
+        # drop a trailing empty line's phantom record
+        n = len(offsets) - 1
+        if n and offsets[-1] - offsets[-2] <= 1:
+            with open(path, "rb") as f:
+                f.seek(offsets[-2])
+                if not f.readline().strip():
+                    n -= 1
+        if max_rows is not None:
+            n = min(n, max_rows)
+        self._offsets = np.asarray(offsets[: n + 1], np.int64)
+
+    def __len__(self) -> int:
+        return len(self._offsets) - 1
+
+    def read_rows(self, idx: np.ndarray) -> tuple[list[str], Optional[list[int]]]:
+        """Texts (and labels for jsonl rows that carry them) for ``idx``,
+        in ``idx`` order. Reads happen in file order for seek locality."""
+        order = np.argsort(idx, kind="stable")
+        texts: list[Optional[str]] = [None] * len(idx)
+        labels: list[Optional[int]] = [None] * len(idx)
+        any_label = False
+        with open(self.path, "rb") as f:
+            for j in order:
+                r = int(idx[j])
+                f.seek(self._offsets[r])
+                raw = f.read(int(self._offsets[r + 1] - self._offsets[r]))
+                line = raw.decode("utf-8").rstrip("\r\n")
+                if self._jsonl:
+                    rec = json.loads(line)
+                    texts[j] = rec[self.text_key]
+                    if self.label_key in rec:
+                        labels[j] = int(rec[self.label_key])
+                        any_label = True
+                else:
+                    texts[j] = line
+        return texts, (labels if any_label else None)
+
+
+class StreamingTextDataset:
+    """``ArrayDataset``-compatible streaming source for ``mlm`` /
+    ``causal-lm`` / ``seq-cls`` over a :class:`LineCorpus`.
+
+    Duck-types the batcher contract (``__len__``, ``__getitem__`` with an
+    index array, ``begin_epoch``); only the gathered batch is ever
+    tokenized or resident. Length bucketing needs corpus-wide token
+    lengths, which streaming deliberately does not precompute — the
+    batcher raises a clear error on that combination.
+    """
+
+    def __init__(self, corpus: LineCorpus, tokenizer, task: str = "mlm",
+                 max_length: int = 512, mlm_probability: float = 0.15,
+                 whole_word: bool = True, seed: int = 0,
+                 num_labels: Optional[int] = None):
+        if task not in ("mlm", "causal-lm", "seq-cls"):
+            raise ValueError(
+                f"streaming tier supports mlm/causal-lm/seq-cls, got {task!r}")
+        if task == "mlm" and getattr(tokenizer, "mask_token_id", None) is None:
+            raise ValueError("tokenizer has no [MASK] token — MLM needs one")
+        self.corpus = corpus
+        self.tokenizer = tokenizer
+        self.task = task
+        self.max_length = max_length
+        self.mlm_probability = mlm_probability
+        self.whole_word = whole_word
+        self.seed = seed
+        self.num_labels = num_labels
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        return len(self.corpus)
+
+    def begin_epoch(self, epoch: int) -> None:
+        # stored for mask seeding only; no materialization happens here
+        self._epoch = epoch
+
+    def resident_bytes(self) -> int:
+        """Host memory pinned by the dataset itself (the offset index) —
+        the number the materialized-vs-streaming comparison is about."""
+        return self.corpus._offsets.nbytes
+
+    def __getitem__(self, idx) -> dict[str, np.ndarray]:
+        if not isinstance(idx, np.ndarray):
+            idx = np.atleast_1d(np.asarray(idx, np.int64))
+        texts, labels = self.corpus.read_rows(idx)
+        if self.task == "seq-cls":
+            if labels is None:
+                raise ValueError("seq-cls streaming needs jsonl labels")
+            missing = [int(idx[j]) for j, l in enumerate(labels) if l is None]
+            if missing:
+                raise ValueError(
+                    f"seq-cls streaming: rows {missing[:8]} carry no "
+                    f"'{self.corpus.label_key}' field — every jsonl row "
+                    "needs a label")
+            if self.num_labels is not None:
+                top = max(labels)
+                if top >= self.num_labels:
+                    raise ValueError(
+                        f"seq-cls: corpus row carries label {top} but "
+                        f"num_labels is {self.num_labels}; pass "
+                        f"--num_labels {top + 1}")
+            enc = self.tokenizer(texts, truncation=True,
+                                 padding="max_length",
+                                 max_length=self.max_length)
+            return {"input_ids": np.asarray(enc["input_ids"], np.int32),
+                    "attention_mask": np.asarray(enc["attention_mask"],
+                                                 np.int32),
+                    "labels": np.asarray(labels, np.int32)}
+        if self.task == "causal-lm":
+            enc = self.tokenizer(texts, truncation=True,
+                                 padding="max_length",
+                                 max_length=self.max_length)
+            ids = np.asarray(enc["input_ids"], np.int32)
+            am = np.asarray(enc["attention_mask"], np.int32)
+            return {"input_ids": ids, "attention_mask": am,
+                    "labels": np.where(am > 0, ids, -100).astype(np.int32)}
+        # mlm: clean-tokenize the window, then mask each row from its own
+        # (seed, epoch, row) stream — batch-composition independent
+        clean, am, wid = encode_mlm_clean(self.tokenizer, texts,
+                                          self.max_length)
+        ids = np.empty_like(clean)
+        labels = np.empty_like(clean)
+        vocab = int(getattr(self.tokenizer, "vocab_size"))
+        mask_id = int(self.tokenizer.mask_token_id)
+        for j, r in enumerate(idx):
+            rng = np.random.RandomState(
+                [self.seed & 0x7FFFFFFF, self._epoch, int(r)])
+            row_ids, row_labels = apply_mlm_masking(
+                clean[j: j + 1], wid[j: j + 1], rng, mask_id, vocab,
+                self.mlm_probability, self.whole_word)
+            ids[j] = row_ids[0]
+            labels[j] = row_labels[0]
+        return {"input_ids": ids, "attention_mask": am, "labels": labels}

@@ -68,6 +68,53 @@ def _check_num_labels(labels, num_labels: int, task: str) -> None:
             f"{num_labels}; pass --num_labels {top + 1} (conll2003 needs 9)")
 
 
+def build_streaming_dataset(config: TrainConfig, tokenizer, split: str,
+                            max_len: int, max_samples):
+    """--streaming true: corpus stays on disk, tokenized per batch
+    (fixes the reference's materialize-everything quirk, reference
+    ``scripts/train.py:80-83``). Sources: ``dataset_path/{split}.jsonl``
+    or ``.txt``; the synthetic tier writes its corpus to a cached file
+    once so the path is identical to a real on-disk corpus."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.streaming import (
+        LineCorpus,
+        StreamingTextDataset,
+    )
+
+    if config.dataset_path:
+        base = os.path.join(config.dataset_path, split)
+        path = next((base + ext for ext in (".jsonl", ".txt")
+                     if os.path.exists(base + ext)), None)
+        if path is None:
+            raise ValueError(f"--streaming: no {base}.jsonl or .txt")
+    elif config.dataset == "synthetic":
+        import json as _json
+        import tempfile
+
+        n = max_samples or 2000
+        path = os.path.join(tempfile.gettempdir(),
+                            f"stream_synth_{split}_{n}_{config.seed}.jsonl")
+        if not os.path.exists(path):
+            texts, labels = load_text_classification(
+                "synthetic", split, max_samples=n, seed=config.seed)
+            # per-process unique tmp + atomic replace: multiple local
+            # hosts may race to build the same (deterministic) cache file
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                       suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                for t, l in zip(texts, labels):
+                    f.write(_json.dumps({"text": t, "label": l}) + "\n")
+            os.replace(tmp, path)
+    else:
+        raise ValueError(
+            "--streaming needs --dataset_path (train.jsonl/.txt) or "
+            "--dataset synthetic")
+    corpus = LineCorpus(path, max_rows=max_samples)
+    return StreamingTextDataset(corpus, tokenizer, task=config.task,
+                                max_length=max_len, seed=config.seed,
+                                num_labels=config.num_labels
+                                if config.task == "seq-cls" else None)
+
+
 def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
                   max_samples, model_config=None) -> ArrayDataset:
     """Task-specific load+tokenize: seq-cls (reference parity), token-cls
@@ -75,6 +122,9 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
     synthetic offline tier."""
     kw = dict(dataset_path=config.dataset_path, max_samples=max_samples,
               seed=config.seed)
+    if config.streaming and split == "train":
+        return build_streaming_dataset(config, tokenizer, split, max_len,
+                                       max_samples)
     if config.task == "seq-cls":
         texts, labels = load_text_classification(config.dataset, split, **kw)
         _check_num_labels(labels, config.num_labels, config.task)

@@ -152,6 +152,92 @@ def test_local_two_host_job_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_kill_relaunch_resume_drill(tmp_path):
+    """The SURVEY §5.3 preemption story, composed end to end: a 2-host
+    job loses rank 1 to SIGKILL mid-epoch (after an async mid-epoch
+    checkpoint committed), the launcher kills the hung survivor and
+    raises; relaunching the SAME job dirs resumes from the committed
+    mid-epoch position (step-in-epoch > 0) and runs to completion,
+    writing the terminal results files exactly once."""
+    import re
+    import transformers
+
+    cfg_dir = str(tmp_path / "cfg")
+    transformers.BertConfig(
+        vocab_size=256, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64).save_pretrained(cfg_dir)
+    ckpt_dir = str(tmp_path / "ckpt")
+    out_dir = str(tmp_path / "out")
+    model_dir = str(tmp_path / "model")
+
+    # entry wrapper: rank 1 self-SIGKILLs the moment the first COMMITTED
+    # mid-epoch checkpoint appears (orbax renames the tmp dir to a bare
+    # step name only at commit, so a digit-named dir == durable)
+    entry = tmp_path / "drill_entry.py"
+    entry.write_text(textwrap.dedent("""
+        import os, signal, sys, threading, time
+
+        if os.environ.get("DRILL_KILL") == "1" \\
+                and os.environ["TPU_PROCESS_ID"] == "1":
+            ckpt = os.environ["DRILL_CKPT_DIR"]
+
+            def watchdog():
+                while True:
+                    try:
+                        if any(d.isdigit() and int(d) > 0
+                               for d in os.listdir(ckpt)):
+                            os.kill(os.getpid(), signal.SIGKILL)
+                    except FileNotFoundError:
+                        pass
+                    time.sleep(0.1)
+
+            threading.Thread(target=watchdog, daemon=True).start()
+        from scripts.train import main
+        main(sys.argv[1:])
+    """))
+
+    hyper = {
+        "model_name_or_path": cfg_dir, "from_scratch": True,
+        "dataset": "synthetic", "epochs": 2,
+        "train_batch_size": 2, "dtype": "float32",
+        "max_seq_length": 32, "max_train_samples": 128,
+        "max_eval_samples": 16, "learning_rate": 1e-3,
+        "scale_lr_by_world_size": False,
+        "checkpoint_dir": ckpt_dir, "checkpoint_every_steps": 4,
+        "output_data_dir": out_dir, "model_dir": model_dir,
+    }
+    common = dict(entry_point=str(entry), source_dir=os.getcwd(),
+                  slice_spec="cpu-2", num_hosts=2,
+                  hyperparameters=hyper, job_root=str(tmp_path / "jobs"))
+
+    job1 = TPUJob(coordinator_port=8495,
+                  env={"PYTHONPATH": os.getcwd(), "DRILL_KILL": "1",
+                       "DRILL_CKPT_DIR": ckpt_dir}, **common)
+    handle1 = job1.fit(wait=False)
+    with pytest.raises(RuntimeError, match="failed with codes"):
+        handle1.wait(grace_period=5.0)
+    # the crash left a committed checkpoint and NO terminal results
+    committed = [d for d in os.listdir(ckpt_dir) if d.isdigit()]
+    assert committed, "no committed checkpoint survived the kill"
+    assert not os.path.exists(os.path.join(out_dir, "train_results.txt"))
+
+    job2 = TPUJob(coordinator_port=8494,
+                  env={"PYTHONPATH": os.getcwd()}, **common)
+    handle2 = job2.fit(wait=True)
+    assert handle2.returncodes == [0, 0]
+    log0 = open(os.path.join(handle2.job_dir, "host_0.log")).read()
+    m = re.search(r"resuming from epoch (\d+) \(step-in-epoch (\d+)\)", log0)
+    assert m, "relaunch did not restore the checkpoint"
+    assert int(m.group(2)) > 0, "resume was not mid-epoch"
+    # terminal contract written exactly once, by the relaunch
+    results = open(os.path.join(out_dir, "train_results.txt")).read()
+    assert results.count("train_runtime") == 1
+    assert os.path.exists(os.path.join(out_dir, "eval_results.txt"))
+    assert os.path.exists(os.path.join(model_dir, "model.safetensors"))
+
+
+@pytest.mark.slow
 def test_local_two_host_moe_expert_parallel_job(tmp_path):
     """Two simulated hosts with ONE device each train a MoE model with
     ep=2 — the expert axis IS the process boundary, so the token
