@@ -63,8 +63,10 @@ def main() -> None:
     from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
 
     # -- corpus on disk (generated once; ~870 bytes/row at the default
-    #    150-word rows) ---------------------------------------------------
-    if not os.path.exists(args.path) or LineCorpus(args.path).__len__() != args.rows:
+    #    150-word rows). One LineCorpus build doubles as the freshness
+    #    check — no second full-file scan.
+    corpus = LineCorpus(args.path) if os.path.exists(args.path) else None
+    if corpus is None or len(corpus) != args.rows:
         rng = np.random.default_rng(0)
         words = ("the a of in on movie film plot actor scene story great "
                  "terrible fine sharp dull rich weak bright dark long short "
@@ -78,8 +80,7 @@ def main() -> None:
                 f.write(json.dumps({"text": text}) + "\n")
         os.replace(args.path + ".tmp", args.path)
         print(f"corpus generated in {time.time() - t0:.1f}s")
-
-    corpus = LineCorpus(args.path)
+        corpus = LineCorpus(args.path)
     file_mb = os.path.getsize(args.path) / 1e6
     tok = WordHashTokenizer(vocab_size=8192)
     ds = StreamingTextDataset(corpus, tok, task="mlm",
@@ -93,9 +94,11 @@ def main() -> None:
                          max_position_embeddings=args.max_len,
                          use_pooler=False)
     model = BertForMaskedLM(mcfg)
+    # two epochs of steps/2 so the history carries a trajectory (fit's
+    # history is per-epoch means — one epoch would make first == final)
     cfg = TrainConfig(task="mlm", dtype="float32", learning_rate=3e-4,
                       scale_lr_by_world_size=False, log_every_steps=0,
-                      epochs=1, steps_per_epoch=args.steps)
+                      epochs=2, steps_per_epoch=max(args.steps // 2, 1))
     trainer = Trainer(cfg, model, init_params(model, mcfg), mesh)
     batcher = ShardedBatcher(ds, args.batch, mesh, shuffle=True, seed=0)
     t0 = time.time()

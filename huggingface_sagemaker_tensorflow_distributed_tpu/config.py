@@ -79,6 +79,12 @@ class TrainConfig:
     # examples and report SQuAD exact-match/F1 alongside span accuracy
     # (0 = off; one extra forward pass over the sampled examples)
     eval_qa_samples: int = 0
+    # fused-MLM static gather capacity as a fraction of each shard's
+    # tokens; must exceed the dataset's masking rate (default 0.15 HF
+    # rate → 0.25 cap). Positions beyond the cap are dropped from loss
+    # AND count (surfaced as the ce_dropped metric) — raise this when
+    # pretraining with a higher mlm_probability
+    fused_mlm_mask_cap: float = 0.25
     from_scratch: bool = False     # random init instead of pretrained weights
 
     # --- data ---

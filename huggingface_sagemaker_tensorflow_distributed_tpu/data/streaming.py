@@ -53,9 +53,9 @@ class LineCorpus:
         with open(path, "rb") as f:
             for line in f:
                 offsets.append(offsets[-1] + len(line))
-        # drop a trailing empty line's phantom record
+        # drop a trailing empty line's phantom record (LF or CRLF)
         n = len(offsets) - 1
-        if n and offsets[-1] - offsets[-2] <= 1:
+        if n and offsets[-1] - offsets[-2] <= 2:
             with open(path, "rb") as f:
                 f.seek(offsets[-2])
                 if not f.readline().strip():

@@ -101,9 +101,13 @@ class BertForMaskedLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, return_fused_inputs: bool = False):
         seq, _ = EncoderBackbone(self.config, name="backbone")(
             input_ids, attention_mask, token_type_ids, deterministic=deterministic)
         table = self.variables["params"]["backbone"]["embeddings"][
             "word_embeddings"]["embedding"]
-        return MlmHead(self.config, name="mlm_head")(seq, table)
+        head = MlmHead(self.config, name="mlm_head")
+        if return_fused_inputs:
+            x, bias = head(seq, table, return_transform=True)
+            return x, table, bias
+        return head(seq, table)

@@ -285,15 +285,20 @@ class MlmHead(nn.Module):
     act: Optional[str] = None
 
     @nn.compact
-    def __call__(self, hidden, embedding_table):
+    def __call__(self, hidden, embedding_table, return_transform: bool = False):
         cfg = self.config
         x = _dense(cfg, embedding_table.shape[1], "transform")(hidden)
         x = ACT2FN[self.act or cfg.hidden_act](x)
         x = _layernorm(cfg, "ln")(x)
-        logits = jnp.einsum("bsh,vh->bsv", x,
-                            embedding_table.astype(cfg.dtype))
         bias = self.param("bias", nn.initializers.zeros,
                           (embedding_table.shape[0],), cfg.param_dtype)
+        if return_transform:
+            # fused vocab-CE path: hand back the post-transform activations
+            # + decoder bias so the [B, S, V] logits never materialize
+            # (ops/pallas_vocab_ce.py; train/trainer.py::make_fused_mlm_loss)
+            return x, bias
+        logits = jnp.einsum("bsh,vh->bsv", x,
+                            embedding_table.astype(cfg.dtype))
         return (logits + bias.astype(cfg.dtype)).astype(jnp.float32)
 
 

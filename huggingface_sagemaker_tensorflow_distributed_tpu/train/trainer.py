@@ -22,6 +22,7 @@ samples/sec/chip meter the north-star metric needs (BASELINE.md).
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Any, Callable, Optional
 
 import flax.struct
@@ -246,6 +247,97 @@ def make_fused_causal_lm_loss(model, block_n: int = 256, block_v: int = 512,
     return loss
 
 
+def make_fused_mlm_loss(model, mask_cap: float = 0.25, block_n: int = 256,
+                        block_v: int = 512, interpret: bool | None = None):
+    """MLM CE without the [B, S, V] logits, exploiting MLM's sparsity:
+    only ~15% of positions carry labels, so the predicted positions are
+    GATHERED into a static-size [K, H] buffer (K = ``mask_cap`` of the
+    shard's tokens, block-aligned) and only those go through the blocked
+    vocab-CE Pallas kernel (``ops/pallas_vocab_ce.py``) — a ~4x token
+    reduction on top of never materializing logits. The decoder bias is
+    folded into the SAME verified kernel by augmenting
+    ``h → [h | 1 | 0…]`` and ``W → [W | b | 0…]`` (128 lanes to keep
+    tiling), so ``h'·W'ᵀ = h·Wᵀ + b`` exactly and the bias cotangent
+    falls out of the concat transpose. Selection uses ``lax.top_k`` on
+    the validity flags (deterministic, index-stable), per dp shard under
+    ``shard_map`` like the causal path. Positions beyond K (never hit at
+    the 15% HF masking rate with cap 25%) are dropped from BOTH loss and
+    count, keeping the mean consistent."""
+    from jax.sharding import PartitionSpec as P
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_vocab_ce import (
+        fused_vocab_cross_entropy,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+        data_axis_names,
+        maybe_current_mesh,
+    )
+
+    def loss(apply_fn, params, batch, rngs, train: bool):
+        hidden, table, bias = model.apply(
+            {"params": params}, batch["input_ids"], batch["attention_mask"],
+            token_type_ids=batch.get("token_type_ids"),
+            deterministic=not train, rngs=rngs, return_fused_inputs=True)
+        labels = batch["labels"]
+        token_valid = (labels != -100) & (batch["attention_mask"] > 0)
+        if "valid" in batch:
+            token_valid = token_valid & (batch["valid"][:, None] > 0)
+        safe_labels = jnp.maximum(labels, 0)
+
+        def ce(h, w, b, lab, valid):
+            bsz, s, h_dim = h.shape
+            n = bsz * s
+            k = min(n, -(-int(n * mask_cap) // block_n) * block_n)
+            flat_h = h.reshape(n, h_dim)
+            flat_valid = valid.reshape(n)
+            flat_lab = lab.reshape(n)
+            # top_k on the flags: masked positions first, index-stable
+            flags, sel = jax.lax.top_k(flat_valid.astype(jnp.int32), k)
+            sel_valid = flags > 0
+            h_sel = flat_h[sel]
+            lab_sel = flat_lab[sel]
+            # fold the decoder bias into the matmul: one extra 128-lane
+            # block of which only the first column is live
+            ones_pad = jnp.concatenate(
+                [jnp.ones((k, 1), h_sel.dtype),
+                 jnp.zeros((k, 127), h_sel.dtype)], axis=1)
+            w_pad = jnp.concatenate(
+                [b[:, None].astype(w.dtype),
+                 jnp.zeros((w.shape[0], 127), w.dtype)], axis=1)
+            per_tok, pred = fused_vocab_cross_entropy(
+                jnp.concatenate([h_sel, ones_pad], axis=1),
+                jnp.concatenate([w, w_pad], axis=1),
+                lab_sel, block_n=block_n, block_v=block_v,
+                interpret=interpret)
+            return per_tok, pred, lab_sel, sel_valid
+
+        mesh = maybe_current_mesh()
+        batch_axes = data_axis_names()
+        if mesh is not None and any(
+                mesh.shape.get(a, 1) > 1 for a in batch_axes):
+            from jax import shard_map
+            # check_vma=False: pallas_call does not annotate varying-mesh
+            # axes on its outputs, which the default vma check rejects
+            ce = shard_map(ce, mesh=mesh,
+                           in_specs=(P(batch_axes), P(), P(), P(batch_axes),
+                                     P(batch_axes)),
+                           out_specs=(P(batch_axes), P(batch_axes),
+                                      P(batch_axes), P(batch_axes)),
+                           check_vma=False)
+        per_tok, pred, lab_sel, sel_valid = ce(hidden, table, bias,
+                                               safe_labels, token_valid)
+        correct = pred == lab_sel
+        loss_val, sums = _masked_sums(per_tok, correct, sel_valid)
+        # supervision dropped by the static cap (0 whenever the masking
+        # rate stays under mask_cap, the designed regime) — surfaced so
+        # an over-aggressive mlm_probability is measurable, not silent
+        sums["ce_dropped"] = (jnp.sum(token_valid.astype(jnp.float32))
+                              - sums["count"])
+        return loss_val, sums
+
+    return loss
+
+
 TASK_LOSSES: dict[str, Callable] = {
     "seq-cls": seq_cls_loss,
     "token-cls": token_cls_loss,
@@ -286,12 +378,20 @@ class Trainer:
             raise ValueError(f"no loss for task {self.task!r}")
         self.loss_fn = TASK_LOSSES[self.task]
         if getattr(config, "fused_vocab_ce", False):
-            if self.task != "causal-lm" or not hasattr(model,
-                                                       "hidden_and_embedding"):
+            if self.task == "causal-lm" and hasattr(model,
+                                                    "hidden_and_embedding"):
+                self.loss_fn = make_fused_causal_lm_loss(model)
+            elif self.task == "mlm" and "return_fused_inputs" in (
+                    inspect.signature(model.__call__).parameters):
+                self.loss_fn = make_fused_mlm_loss(
+                    model, mask_cap=getattr(config, "fused_mlm_mask_cap",
+                                            0.25))
+            else:
                 raise ValueError(
-                    "fused_vocab_ce requires task='causal-lm' and a model "
-                    "exposing hidden_and_embedding (GPT-2 family)")
-            self.loss_fn = make_fused_causal_lm_loss(model)
+                    "fused_vocab_ce requires task='causal-lm' with a model "
+                    "exposing hidden_and_embedding (GPT-2 family) or "
+                    "task='mlm' with a return_fused_inputs-capable MLM "
+                    "model (BERT-family)")
         self.n_chips = world_size(mesh)
         self.dp_size = data_parallel_size(mesh)
         # MoE models sow per-layer load-balance losses into the "losses"
