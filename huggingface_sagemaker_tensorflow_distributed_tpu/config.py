@@ -135,6 +135,9 @@ class TrainConfig:
     pp: int = 1                    # pipeline parallel (GPipe over stacked layers)
     tp: int = 1
     sp: int = 1                    # sequence/context parallel (ring attention)
+    # outer data-parallel axis across slices connected by DCN rather
+    # than ICI (multi-slice); blocks group whole slices/processes
+    dcn_dp: int = 1
     # microbatches per pipeline round-trip (0 → = pp); more microbatches
     # shrink the fill/drain bubble: overhead ~ (pp-1)/(M+pp-1)
     pipeline_microbatches: int = 0
@@ -249,7 +252,7 @@ class TrainConfig:
                 "lr_schedule='cosine' needs warmup_ratio > 0 (schedules "
                 "only engage with a warmup+decay window; without it the "
                 "lr is constant and the flag would be silently ignored)")
-        for ax in ("fsdp", "ep", "pp", "tp", "sp"):
+        for ax in ("fsdp", "ep", "pp", "tp", "sp", "dcn_dp"):
             if getattr(self, ax) <= 0:
                 raise ValueError(f"mesh axis {ax} must be positive")
         if self.pipeline_microbatches < 0:

@@ -2,6 +2,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (  # 
     MeshConfig,
     build_mesh,
     AXIS_DATA,
+    AXIS_DCN,
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_TENSOR,

@@ -70,6 +70,7 @@ def make_replica_divergence_fn(mesh, shardings):
 
     from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
         AXIS_DATA,
+        AXIS_DCN,
         AXIS_EXPERT,
         AXIS_SEQ,
     )
@@ -105,8 +106,10 @@ def make_replica_divergence_fn(mesh, shardings):
             local_checksum, mesh=mesh,
             in_specs=(in_specs,), out_specs=(P(*axes), P(*axes)))(p)
         dev = jnp.zeros((), jnp.float32)
-        for grid, check_axes in ((plain_grid, (AXIS_DATA, AXIS_SEQ, AXIS_EXPERT)),
-                                 (expert_grid, (AXIS_DATA, AXIS_SEQ))):
+        for grid, check_axes in ((plain_grid, (AXIS_DCN, AXIS_DATA,
+                                               AXIS_SEQ, AXIS_EXPERT)),
+                                 (expert_grid, (AXIS_DCN, AXIS_DATA,
+                                                AXIS_SEQ))):
             for ax in check_axes:
                 if ax in axes and mesh.shape[ax] > 1:
                     i = axes.index(ax)

@@ -11,6 +11,12 @@ same trainer; only the mesh shape differs (SURVEY.md §7 "ambient" model).
 
 Axes:
 
+- ``dcn``: OUTERMOST data parallelism across slices/pods connected by
+  data-center network rather than ICI (multi-slice training). Only the
+  once-per-step gradient all-reduce crosses it; every other collective
+  (tensor, seq, expert, pipe) stays inside a slice. Groups devices by
+  ``slice_index`` (TPU multi-slice) or ``process_index`` (CPU
+  simulation), so the axis boundary IS the slow-network boundary.
 - ``data``: pure data parallelism (the reference's only axis —
   ``hvd.size()`` at ``scripts/train.py:112``).
 - ``fsdp``: data parallelism with parameter/optimizer sharding (ZeRO-3
@@ -43,6 +49,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+AXIS_DCN = "dcn"
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_EXPERT = "expert"
@@ -50,22 +57,28 @@ AXIS_PIPE = "pipe"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
 
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR)
+MESH_AXES = (AXIS_DCN, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT, AXIS_PIPE,
+             AXIS_SEQ, AXIS_TENSOR)
 
 
 def data_axis_names() -> tuple[str, ...]:
     """Axes over which a global batch is sharded (and grads reduced).
 
-    ``expert`` is a data axis for everything outside MoE layers: tokens
-    are sharded over it like any other batch split, and the MoE dispatch
-    einsum reshards them expert-major (an all-to-all XLA derives from
-    the sharding annotations)."""
-    return (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
+    ``dcn`` leads: it is pure (cross-slice) data parallelism, so batches
+    shard over it and the gradient reduction's outer ring rides DCN —
+    the only traffic that leaves a slice. ``expert`` is a data axis for
+    everything outside MoE layers: tokens are sharded over it like any
+    other batch split, and the MoE dispatch einsum reshards them
+    expert-major (an all-to-all XLA derives from the sharding
+    annotations)."""
+    return (AXIS_DCN, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
 
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """Mesh shape request. ``dp=-1`` absorbs all remaining devices."""
+    """Mesh shape request. ``dp=-1`` absorbs all remaining devices.
+    ``dcn_dp > 1`` adds an outer data-parallel axis across slices
+    (multi-slice: grads all-reduce hierarchically, outer ring over DCN)."""
 
     dp: int = -1
     fsdp: int = 1
@@ -73,21 +86,24 @@ class MeshConfig:
     pp: int = 1
     tp: int = 1
     sp: int = 1
+    dcn_dp: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int, int]:
-        fixed = self.fsdp * self.ep * self.pp * self.tp * self.sp
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        fixed = (self.dcn_dp * self.fsdp * self.ep * self.pp * self.tp
+                 * self.sp)
         if n_devices % fixed != 0:
             raise ValueError(
-                f"fsdp*ep*pp*tp*sp={fixed} does not divide device count "
-                f"{n_devices}"
+                f"dcn_dp*fsdp*ep*pp*tp*sp={fixed} does not divide device "
+                f"count {n_devices}"
             )
         dp = self.dp if self.dp != -1 else n_devices // fixed
         if dp * fixed != n_devices:
             raise ValueError(
-                f"mesh {dp}x{self.fsdp}x{self.ep}x{self.pp}x{self.sp}x{self.tp} "
-                f"!= {n_devices} devices"
+                f"mesh {self.dcn_dp}x{dp}x{self.fsdp}x{self.ep}x{self.pp}"
+                f"x{self.sp}x{self.tp} != {n_devices} devices"
             )
-        return (dp, self.fsdp, self.ep, self.pp, self.sp, self.tp)
+        return (self.dcn_dp, dp, self.fsdp, self.ep, self.pp, self.sp,
+                self.tp)
 
 
 # Ambient mesh: modules deep inside a model (e.g. the ring-attention
@@ -139,8 +155,45 @@ def build_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     config = config or MeshConfig()
     devices = devices if devices is not None else jax.devices()
     shape = config.resolve(len(devices))
+    if config.dcn_dp > 1:
+        devices = _dcn_grouped(list(devices), config.dcn_dp)
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, MESH_AXES)
+
+
+def _dcn_grouped(devices: list, dcn_dp: int) -> list:
+    """Order devices so consecutive blocks of ``len/dcn_dp`` share a
+    slice (TPU multi-slice ``slice_index``) or a process (CPU/host
+    simulation) — the ``dcn`` axis boundary must be the slow-network
+    boundary or the whole point of the hierarchy is lost. Falls back to
+    the given order when no grouping attribute distinguishes devices
+    (single-process virtual meshes: any split is equally 'local')."""
+    def group_key(d):
+        s = getattr(d, "slice_index", None)
+        return s if s is not None else d.process_index
+    groups: dict = {}
+    for d in devices:
+        groups.setdefault(group_key(d), []).append(d)
+    if len(groups) > 1:
+        if len(groups) % dcn_dp != 0:
+            raise ValueError(
+                f"dcn_dp={dcn_dp} does not divide the {len(groups)} "
+                f"slices/processes — each dcn block must hold whole slices")
+        sizes = {len(g) for g in groups.values()}
+        if len(sizes) > 1:
+            raise ValueError(f"uneven slice sizes {sizes} under dcn_dp")
+        if len(groups) > dcn_dp:
+            # blocks then span multiple slices: the inner (ICI-assumed)
+            # axes cross DCN every collective — legal, but almost never
+            # what you want; dcn_dp should equal the slice count
+            import logging
+            logging.getLogger(__name__).warning(
+                "dcn_dp=%d < %d slices/processes: each dcn block spans "
+                "%d slices, so inner-axis collectives cross DCN; set "
+                "dcn_dp=%d to align the hierarchy with the network",
+                dcn_dp, len(groups), len(groups) // dcn_dp, len(groups))
+        devices = [d for k in sorted(groups) for d in groups[k]]
+    return devices
 
 
 def world_size(mesh: Mesh) -> int:
@@ -149,6 +202,6 @@ def world_size(mesh: Mesh) -> int:
 
 
 def data_parallel_size(mesh: Mesh) -> int:
-    """Number of data-parallel replicas (data × fsdp × expert axes)."""
-    return (mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
-            * mesh.shape.get(AXIS_EXPERT, 1))
+    """Number of data-parallel replicas (dcn × data × fsdp × expert)."""
+    return (mesh.shape.get(AXIS_DCN, 1) * mesh.shape[AXIS_DATA]
+            * mesh.shape[AXIS_FSDP] * mesh.shape.get(AXIS_EXPERT, 1))

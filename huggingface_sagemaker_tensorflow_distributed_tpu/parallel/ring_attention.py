@@ -38,11 +38,9 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.collectives impor
     ppermute_shift,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
-    AXIS_DATA,
-    AXIS_EXPERT,
-    AXIS_FSDP,
     AXIS_SEQ,
     AXIS_TENSOR,
+    data_axis_names,
 )
 
 _NEG_INF = float("-inf")
@@ -146,7 +144,7 @@ def ring_attention(q, k, v, mask=None, scale=None, *, mesh: Mesh,
         raise ValueError(
             f"seq len {q.shape[2]} not divisible by seq axis {seq_size}")
 
-    batch_axes = (AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
+    batch_axes = data_axis_names()   # incl. dcn: batch stays sharded
     qkv_spec = P(batch_axes, AXIS_TENSOR, AXIS_SEQ, None)
     in_specs = [qkv_spec, qkv_spec, qkv_spec]
     args = [q, k, v]
@@ -218,8 +216,9 @@ def ring_attention_or_fallback(q, k, v, mask=None, scale=None,
     if mesh is None or mesh.shape.get(AXIS_SEQ, 1) <= 1:
         return xla_path()
     b, h, s, _ = q.shape
-    dp = (mesh.shape.get(AXIS_DATA, 1) * mesh.shape.get(AXIS_FSDP, 1)
-          * mesh.shape.get(AXIS_EXPERT, 1))
+    dp = 1
+    for ax in data_axis_names():
+        dp *= mesh.shape.get(ax, 1)
     tp = mesh.shape.get(AXIS_TENSOR, 1)
     sp = mesh.shape[AXIS_SEQ]
     # general [b,h,q,k] masks have no ring form — only broadcastable

@@ -207,7 +207,8 @@ def main(argv=None) -> dict:
 
     mesh = build_mesh(MeshConfig(dp=config.dp, fsdp=config.fsdp,
                                  ep=config.ep, pp=config.pp,
-                                 tp=config.tp, sp=config.sp))
+                                 tp=config.tp, sp=config.sp,
+                                 dcn_dp=config.dcn_dp))
     logger.info("mesh: %s", dict(mesh.shape))
 
     # --- model + tokenizer (reference train.py:69,117) ---

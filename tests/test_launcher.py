@@ -238,6 +238,79 @@ def test_kill_relaunch_resume_drill(tmp_path):
 
 
 @pytest.mark.slow
+def test_local_two_host_dcn_axis_job(tmp_path):
+    """Two simulated hosts with ONE device each train over a dcn2 mesh:
+    the ``dcn`` axis boundary IS the process boundary (each host models
+    one slice), so the outer leg of the hierarchical gradient all-reduce
+    genuinely crosses processes — the dp-over-dcn × dp-over-ici
+    groundwork (SURVEY L-1/§5.8: ICI *and* DCN)."""
+    entry = tmp_path / "entry.py"
+    entry.write_text(textwrap.dedent("""
+        import json, os
+        import jax
+        import numpy as np
+        from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+        from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+            ArrayDataset, ShardedBatcher, WordHashTokenizer)
+        from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+            synthetic_text_classification)
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+            BertForSequenceClassification)
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+            EncoderConfig)
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+            AXIS_DCN, MeshConfig, build_mesh, initialize_distributed)
+        from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+        pid, pcount = initialize_distributed()
+        assert pcount == 2, pcount
+        mesh = build_mesh(MeshConfig(dp=-1, dcn_dp=2))
+        assert mesh.shape[AXIS_DCN] == 2
+        # every run along the dcn axis must cross the process boundary:
+        # position 0 and 1 on the axis live in different processes
+        axes = list(mesh.axis_names)
+        devs = np.moveaxis(mesh.devices, axes.index(AXIS_DCN), 0)
+        procs = np.vectorize(lambda d: d.process_index)(devs)
+        assert (procs[0] != procs[1]).all(), procs
+        seq = 16
+        model_cfg = EncoderConfig(
+            vocab_size=256, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, max_position_embeddings=seq)
+        model = BertForSequenceClassification(model_cfg, num_labels=2)
+        params = init_params(model, model_cfg, seed=0)
+        cfg = TrainConfig(dtype="float32", learning_rate=1e-3,
+                          scale_lr_by_world_size=False, log_every_steps=0,
+                          rng_impl="threefry", epochs=1, dcn_dp=2)
+        trainer = Trainer(cfg, model, params, mesh)
+        tok = WordHashTokenizer(vocab_size=256)
+        texts, labels = synthetic_text_classification(32, seed=0)
+        ds = ArrayDataset.from_texts(tok, texts, labels, max_length=seq)
+        batcher = ShardedBatcher(ds, 16, mesh, shuffle=False, seed=0)
+        losses = []
+        for step, batch in enumerate(batcher.global_arrays(0)):
+            trainer.state, m = trainer._train_step(trainer.state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        out_dir = os.environ["TPU_OUTPUT_DATA_DIR"]
+        if jax.process_index() == 0:
+            os.makedirs(out_dir, exist_ok=True)
+            with open(os.path.join(out_dir, "result.json"), "w") as f:
+                json.dump({"losses": losses}, f)
+    """))
+    job = TPUJob(entry_point=str(entry), source_dir=os.getcwd(),
+                 slice_spec="cpu-2", num_hosts=2,
+                 hyperparameters={}, job_root=str(tmp_path / "jobs"),
+                 coordinator_port=8493,
+                 env={"PYTHONPATH": os.getcwd()})
+    handle = job.fit(wait=True)
+    assert handle.returncodes == [0, 0]
+    with open(os.path.join(handle.output_data_dir, "result.json")) as f:
+        result = json.load(f)
+    assert len(result["losses"]) == 2
+    assert all(np.isfinite(l) for l in result["losses"])
+
+
+@pytest.mark.slow
 def test_local_two_host_moe_expert_parallel_job(tmp_path):
     """Two simulated hosts with ONE device each train a MoE model with
     ep=2 — the expert axis IS the process boundary, so the token

@@ -32,14 +32,73 @@ SEQ = 16
 
 
 def test_mesh_resolve():
-    assert MeshConfig(dp=-1).resolve(8) == (8, 1, 1, 1, 1, 1)
-    assert MeshConfig(dp=-1, fsdp=2, tp=2).resolve(8) == (2, 2, 1, 1, 1, 2)
-    assert MeshConfig(dp=-1, ep=4).resolve(8) == (2, 1, 4, 1, 1, 1)
-    assert MeshConfig(dp=-1, pp=4).resolve(8) == (2, 1, 1, 4, 1, 1)
+    assert MeshConfig(dp=-1).resolve(8) == (1, 8, 1, 1, 1, 1, 1)
+    assert MeshConfig(dp=-1, fsdp=2, tp=2).resolve(8) == (1, 2, 2, 1, 1, 1, 2)
+    assert MeshConfig(dp=-1, ep=4).resolve(8) == (1, 2, 1, 4, 1, 1, 1)
+    assert MeshConfig(dp=-1, pp=4).resolve(8) == (1, 2, 1, 1, 4, 1, 1)
+    assert MeshConfig(dp=-1, dcn_dp=2).resolve(8) == (2, 4, 1, 1, 1, 1, 1)
+    assert MeshConfig(dp=-1, dcn_dp=2, tp=2).resolve(8) == (2, 2, 1, 1, 1, 1, 2)
     with pytest.raises(ValueError):
         MeshConfig(dp=3, fsdp=3).resolve(8)
     with pytest.raises(ValueError):
         MeshConfig(fsdp=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(dcn_dp=3).resolve(8)
+
+
+def test_dcn_training_parity(devices8):
+    """dp-over-dcn × dp-over-ici ≡ flat dp: the same global batch on a
+    dcn2×dp4 mesh and a dp8 mesh must give the same loss sequence (both
+    are pure data parallelism; only the collective hierarchy differs).
+    Params stay replicated across dcn (checked via the divergence
+    instrument, which now spans the dcn axis too)."""
+    import jax
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.collectives import (
+        replica_divergence,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
+        param_shardings,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, labels = synthetic_text_classification(64, seed=0)
+    ds = ArrayDataset.from_texts(tok, texts, labels, max_length=SEQ)
+
+    def run(mesh_cfg):
+        mesh = build_mesh(mesh_cfg, devices=devices8)
+        model, params = _tiny()
+        cfg = TrainConfig(dtype="float32", learning_rate=1e-3,
+                          scale_lr_by_world_size=False, log_every_steps=0,
+                          rng_impl="threefry")
+        trainer = Trainer(cfg, model, params, mesh)
+        batcher = ShardedBatcher(ds, 16, mesh, shuffle=False)
+        losses = []
+        for step, batch in enumerate(batcher.global_arrays(0)):
+            if step >= 3:
+                break
+            trainer.state, m = trainer._train_step(trainer.state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses, trainer, mesh
+
+    flat, _, _ = run(MeshConfig(dp=-1))
+    hier, trainer, mesh = run(MeshConfig(dp=-1, dcn_dp=2))
+    assert mesh.shape["dcn"] == 2 and mesh.shape["data"] == 4
+    np.testing.assert_allclose(hier, flat, rtol=1e-5)
+    dev = float(replica_divergence(
+        trainer.state.params, mesh,
+        param_shardings(trainer.state.params, mesh)))
+    assert dev == 0.0, f"params diverged across dcn replicas: {dev}"
 
 
 def _tiny(vocab=256, hidden=64):
