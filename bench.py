@@ -47,6 +47,8 @@ Extra modes (each also prints one JSON line per run):
                        prefill+scan and BART cached greedy + beam.
   --causal-lm          GPT-2 124M training throughput, fused
                        vocab-CE loss vs full-logits baseline.
+  --mlm                BERT-base WWM pretraining throughput, sparse-
+                       gather fused vocab-CE vs full-logits baseline.
 
 Results across rounds are recorded in BENCH_EXTRA.md.
 """
@@ -307,6 +309,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
                 for m in ("gpt2_greedy", "bart_greedy", "bart_beam4")]
     if args.causal_lm:
         return ["gpt2_finetune_fused_ce_samples_per_sec_per_chip"]
+    if args.mlm:
+        return ["bert_base_mlm_fused_ce_samples_per_sec_per_chip"]
     if args.model == "bert-large":
         return ["bert_large_wwm_finetune_samples_per_sec_per_chip"]
     return ["bert_base_finetune_samples_per_sec_per_chip"]
@@ -360,6 +364,9 @@ def _run_child(args: argparse.Namespace) -> None:
     elif args.causal_lm:
         from benchmarks.causal_lm_bench import bench_causal_lm
         bench_causal_lm()
+    elif args.mlm:
+        from benchmarks.mlm_bench import bench_mlm
+        bench_mlm()
     elif args.model == "bert-large":
         bench_bert_large()
     else:
@@ -374,6 +381,7 @@ def main() -> None:
     parser.add_argument("--mesh", action="store_true")
     parser.add_argument("--generate", action="store_true")
     parser.add_argument("--causal-lm", action="store_true", dest="causal_lm")
+    parser.add_argument("--mlm", action="store_true")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)  # internal: run measured body
     args = parser.parse_args()
@@ -381,7 +389,8 @@ def main() -> None:
                               ("--buckets", args.buckets),
                               ("--mesh", args.mesh),
                               ("--generate", args.generate),
-                              ("--causal-lm", args.causal_lm)] if on]
+                              ("--causal-lm", args.causal_lm),
+                              ("--mlm", args.mlm)] if on]
     if len(picked) > 1:
         parser.error(f"pick one mode, got {' and '.join(picked)}")
 

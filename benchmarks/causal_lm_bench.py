@@ -4,96 +4,55 @@
 GPT-2 124M fine-tune through the real ``Trainer.fit`` loop on synthetic
 text, measured twice: with the standard full-logits CE and with the
 fused LM-head + CE Pallas kernel (``ops/pallas_vocab_ce.py``,
-``--fused_vocab_ce``). Emits the FUSED samples/s/chip with
-``vs_baseline`` = fused ÷ unfused — the direct measure of what skipping
-the [B, S, V] logits materialisation buys on chip.
-
-Off-TPU both runs shrink to smoke size (and the fused path is forced
-into interpret mode so the kernel code itself is exercised).
+``--fused_vocab_ce``). ``vs_baseline`` = fused ÷ unfused — the direct
+measure of what skipping the [B, S, V] logits materialisation buys on
+chip. Shared harness: ``benchmarks/fused_ce_common.py``.
 """
 
 from __future__ import annotations
 
-import json
 
-
-def _run(fused: bool, on_tpu: bool) -> float:
-    import jax
+def _model(on_tpu: bool, seq_len: int):
     import jax.numpy as jnp
 
-    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
-    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
-        ArrayDataset,
-        ShardedBatcher,
-        WordHashTokenizer,
-    )
-    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
-        synthetic_text_classification,
-    )
-    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
         Gpt2Config,
         Gpt2LMHeadModel,
     )
-    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
-        MeshConfig,
-        build_mesh,
-    )
-    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
 
-    n_chips = len(jax.devices())
     if on_tpu:
-        per_chip_batch, seq_len, batches = 8, 512, 10
-        model_cfg = Gpt2Config(dtype=jnp.bfloat16, hidden_dropout=0.0,
-                               embd_dropout=0.0, attention_dropout=0.0,
-                               attention_impl="flash")     # 124M
+        cfg = Gpt2Config(dtype=jnp.bfloat16, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0,
+                         attention_impl="flash")                 # 124M
     else:
-        per_chip_batch, seq_len, batches = 2, 64, 4
-        model_cfg = Gpt2Config(vocab_size=512, hidden_size=128, num_layers=2,
-                               num_heads=4, intermediate_size=256,
-                               max_position_embeddings=seq_len,
-                               hidden_dropout=0.0, embd_dropout=0.0,
-                               attention_dropout=0.0)
-    global_batch = per_chip_batch * n_chips
-
-    mesh = build_mesh(MeshConfig(dp=-1))
-    config = TrainConfig(task="causal-lm",
-                         dtype="bfloat16" if on_tpu else "float32",
-                         train_batch_size=per_chip_batch,
-                         max_seq_length=seq_len, log_every_steps=0,
-                         fused_vocab_ce=fused)
-    model = Gpt2LMHeadModel(model_cfg)
-    params = init_params(model, model_cfg, seed=0)
-    trainer = Trainer(config, model, params, mesh)
-    if fused and not on_tpu:
-        from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
-            make_fused_causal_lm_loss,
-        )
-        trainer.loss_fn = make_fused_causal_lm_loss(model, interpret=True)
-
-    tok = WordHashTokenizer(vocab_size=model_cfg.vocab_size)
-    texts, _ = synthetic_text_classification(
-        global_batch * batches, seed=0, min_len=300, max_len=600)
-    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=seq_len)
-    batcher = ShardedBatcher(ds, global_batch, mesh, shuffle=False, seed=0)
-    history = trainer.fit(batcher, epochs=2)
-    return history["train_samples_per_second_per_chip"]
+        cfg = Gpt2Config(vocab_size=512, hidden_size=128, num_layers=2,
+                         num_heads=4, intermediate_size=256,
+                         max_position_embeddings=seq_len,
+                         hidden_dropout=0.0, embd_dropout=0.0,
+                         attention_dropout=0.0)
+    return Gpt2LMHeadModel(cfg), cfg
 
 
 def bench_causal_lm() -> None:
-    from bench import _on_tpu
+    from benchmarks.fused_ce_common import run_fused_vs_unfused
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        make_fused_causal_lm_loss,
+    )
 
-    on_tpu = _on_tpu()
-    unfused = _run(False, on_tpu)
-    fused = _run(True, on_tpu)
-    print(json.dumps({
-        "metric": "gpt2_finetune_fused_ce_samples_per_sec_per_chip",
-        "value": round(fused, 3),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(fused / unfused, 3),   # fused ÷ unfused
-        "detail": {"unfused_samples_per_sec_per_chip": round(unfused, 3),
-                   "model_scale": "gpt2-124M" if on_tpu else "smoke"},
-    }))
+    run_fused_vs_unfused(
+        task="causal-lm",
+        metric="gpt2_finetune_fused_ce_samples_per_sec_per_chip",
+        tpu_scale_label="gpt2-124M",
+        make_model_cfg=_model,
+        make_dataset=lambda tok, texts, seq_len:
+            ArrayDataset.from_lm_texts(tok, texts, max_length=seq_len),
+        tpu_batch=8,
+        make_interpret_loss=lambda model:
+            make_fused_causal_lm_loss(model, interpret=True),
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,87 @@
+"""Shared harness for the fused-vs-unfused vocab-CE training benches
+(``bench.py --causal-lm`` and ``--mlm``).
+
+Runs the same workload twice through the real ``Trainer.fit`` loop —
+standard full-logits loss vs the fused vocab-CE path — and emits the
+fused samples/s/chip with ``vs_baseline`` = fused ÷ unfused. Off-TPU
+both runs shrink to smoke size and the fused path is forced into
+interpret mode so the kernel code itself is exercised."""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+
+def run_fused_vs_unfused(task: str, metric: str, tpu_scale_label: str,
+                         make_model_cfg: Callable[[bool], tuple],
+                         make_dataset: Callable, tpu_batch: int,
+                         make_interpret_loss: Callable) -> None:
+    """``make_model_cfg(on_tpu, seq_len) -> (model, model_cfg)``;
+    ``make_dataset(tok, texts, seq_len) -> ArrayDataset``;
+    ``make_interpret_loss(model) -> loss_fn`` (the interpret-mode fused
+    loss used off-TPU)."""
+    from bench import _on_tpu
+
+    on_tpu = _on_tpu()
+
+    def one(fused: bool) -> float:
+        import jax
+
+        from huggingface_sagemaker_tensorflow_distributed_tpu.config import (
+            TrainConfig,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+            ShardedBatcher,
+            WordHashTokenizer,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+            synthetic_text_classification,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+            init_params,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+            MeshConfig,
+            build_mesh,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.train import (
+            Trainer,
+        )
+
+        n_chips = len(jax.devices())
+        per_chip_batch, seq_len, batches = \
+            (tpu_batch, 512, 10) if on_tpu else (2, 64, 4)
+        model, model_cfg = make_model_cfg(on_tpu, seq_len)
+        global_batch = per_chip_batch * n_chips
+
+        mesh = build_mesh(MeshConfig(dp=-1))
+        config = TrainConfig(task=task,
+                             dtype="bfloat16" if on_tpu else "float32",
+                             train_batch_size=per_chip_batch,
+                             max_seq_length=seq_len, log_every_steps=0,
+                             fused_vocab_ce=fused)
+        params = init_params(model, model_cfg, seed=0)
+        trainer = Trainer(config, model, params, mesh)
+        if fused and not on_tpu:
+            trainer.loss_fn = make_interpret_loss(model)
+
+        tok = WordHashTokenizer(vocab_size=model_cfg.vocab_size)
+        texts, _ = synthetic_text_classification(
+            global_batch * batches, seed=0, min_len=300, max_len=600)
+        ds = make_dataset(tok, texts, seq_len)
+        batcher = ShardedBatcher(ds, global_batch, mesh, shuffle=False,
+                                 seed=0)
+        history = trainer.fit(batcher, epochs=2)
+        return history["train_samples_per_second_per_chip"]
+
+    unfused = one(False)
+    fused = one(True)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(fused, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(fused / unfused, 3),   # fused ÷ unfused
+        "detail": {"unfused_samples_per_sec_per_chip": round(unfused, 3),
+                   "model_scale": tpu_scale_label if on_tpu else "smoke"},
+    }))

@@ -1,0 +1,71 @@
+"""MLM pretraining throughput, fused vs unfused loss (bench.py --mlm).
+
+BERT-base whole-word-masking pretraining through the real
+``Trainer.fit`` loop, measured twice: standard full-logits [B, S, V]
+MLM head vs the sparse-gather fused vocab-CE path
+(``train/trainer.py::make_fused_mlm_loss``: top_k-gather the ~15%
+labeled positions, decoder bias folded into the Pallas kernel).
+``vs_baseline`` = fused ÷ unfused — what skipping the logits buys on
+the reference's own pretraining objective (the recipe behind
+``bert-large-uncased-whole-word-masking``, reference ``launch.py:17``).
+Shared harness: ``benchmarks/fused_ce_common.py``.
+"""
+
+from __future__ import annotations
+
+
+def _model(on_tpu: bool, seq_len: int):
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+        BertForMaskedLM,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+        EncoderConfig,
+    )
+
+    if on_tpu:
+        # BERT-base at the headline-bench shape; the 30522-vocab head is
+        # exactly what the fused path avoids materializing
+        cfg = EncoderConfig(dtype=jnp.bfloat16, hidden_dropout=0.0,
+                            attention_dropout=0.0, use_pooler=False,
+                            attention_impl="flash")
+    else:
+        cfg = EncoderConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                            num_heads=4, intermediate_size=256,
+                            max_position_embeddings=seq_len,
+                            hidden_dropout=0.0, attention_dropout=0.0,
+                            use_pooler=False)
+    return BertForMaskedLM(cfg), cfg
+
+
+def bench_mlm() -> None:
+    from benchmarks.fused_ce_common import run_fused_vs_unfused
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        make_fused_mlm_loss,
+    )
+
+    run_fused_vs_unfused(
+        task="mlm",
+        metric="bert_base_mlm_fused_ce_samples_per_sec_per_chip",
+        tpu_scale_label="bert-base-110M",
+        make_model_cfg=_model,
+        make_dataset=lambda tok, texts, seq_len:
+            ArrayDataset.from_mlm_texts(tok, texts, max_length=seq_len,
+                                        seed=0),
+        tpu_batch=32,
+        make_interpret_loss=lambda model:
+            make_fused_mlm_loss(model, interpret=True),
+    )
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # repo root, for `from bench import ...`
+    bench_mlm()

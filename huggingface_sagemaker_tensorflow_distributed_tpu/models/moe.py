@@ -127,8 +127,9 @@ class MoeFeedForward(nn.Module):
         x = hidden.astype(cfg.dtype)
         # [E,B,C,H]: E sharded over ``expert``, B over the other data
         # axes — the resharding from token-major is the all-to-all
+        non_expert_axes = tuple(a for a in batch_axes if a != AXIS_EXPERT)
         expert_in = jnp.einsum("bsec,bsh->ebch", dispatch, x)
-        expert_in = _constrain(expert_in, AXIS_EXPERT, batch_axes[:2])
+        expert_in = _constrain(expert_in, AXIS_EXPERT, non_expert_axes)
 
         wi = self.param("wi", nn.initializers.normal(cfg.initializer_range),
                         (E, H, F), cfg.param_dtype)
@@ -137,7 +138,7 @@ class MoeFeedForward(nn.Module):
         h = jnp.einsum("ebch,ehf->ebcf", expert_in, wi.astype(cfg.dtype))
         h = ACT2FN[cfg.hidden_act](h)
         out = jnp.einsum("ebcf,efh->ebch", h, wo.astype(cfg.dtype))
-        out = _constrain(out, AXIS_EXPERT, batch_axes[:2])
+        out = _constrain(out, AXIS_EXPERT, non_expert_axes)
 
         y = jnp.einsum("bsec,ebch->bsh", combine.astype(cfg.dtype), out)
         y = nn.Dropout(cfg.hidden_dropout)(y, deterministic=deterministic)
