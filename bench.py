@@ -112,7 +112,8 @@ def train_flops_per_sample(seq_len: int, hidden_size: int = 768,
 
 def build_harness(model_kwargs: dict, per_chip_batch: int, seq_len: int = 512,
                   remat: bool = False, bucket_multiple: int = 0,
-                  min_len: int = 300, max_len: int = 600, batches: int = 14):
+                  min_len: int = 300, max_len: int = 600, batches: int = 14,
+                  opt_state_bf16: bool = False):
     """(trainer, batcher) for one BERT-family benchmark config — the ONE
     place every bench mode builds its harness, so --mesh/--buckets always
     measure the same configuration the headline does."""
@@ -147,7 +148,9 @@ def build_harness(model_kwargs: dict, per_chip_batch: int, seq_len: int = 512,
     config = TrainConfig(dtype="bfloat16" if on_tpu else "float32",
                          train_batch_size=per_chip_batch,
                          max_seq_length=seq_len, log_every_steps=0,
-                         remat=remat, bucket_multiple=bucket_multiple)
+                         remat=remat, bucket_multiple=bucket_multiple,
+                         optimizer_state_dtype="bfloat16" if opt_state_bf16
+                         else "float32")
     model_cfg = EncoderConfig(
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         max_position_embeddings=512,
@@ -218,13 +221,20 @@ def _on_tpu() -> bool:
     return jax.devices()[0].platform == "tpu"
 
 
-def bench_headline() -> None:
+def bench_headline(per_chip_batch: int | None = None,
+                   opt_state_bf16: bool = False) -> None:
     # batch 8 off-TPU keeps the CPU smoke run tractable
-    history = run_finetune({}, per_chip_batch=48 if _on_tpu() else 8)
+    if per_chip_batch is None:
+        per_chip_batch = 48 if _on_tpu() else 8
+    history = run_finetune({}, per_chip_batch=per_chip_batch,
+                           opt_state_bf16=opt_state_bf16)
     emit("bert_base_finetune_samples_per_sec_per_chip",
          history["train_samples_per_second_per_chip"],
          V100_BASELINE_SAMPLES_PER_SEC,
-         flops_per_sample=train_flops_per_sample(512))
+         flops_per_sample=train_flops_per_sample(512),
+         detail={"per_chip_batch": per_chip_batch,
+                 "optimizer_state_dtype":
+                     "bfloat16" if opt_state_bf16 else "float32"})
 
 
 def bench_bert_large() -> None:
@@ -370,7 +380,8 @@ def _run_child(args: argparse.Namespace) -> None:
     elif args.model == "bert-large":
         bench_bert_large()
     else:
-        bench_headline()
+        bench_headline(per_chip_batch=args.batch,
+                       opt_state_bf16=args.opt_state_bf16)
 
 
 def main() -> None:
@@ -382,6 +393,12 @@ def main() -> None:
     parser.add_argument("--generate", action="store_true")
     parser.add_argument("--causal-lm", action="store_true", dest="causal_lm")
     parser.add_argument("--mlm", action="store_true")
+    parser.add_argument("--batch", type=int, default=None,
+                        help="per-chip batch override (headline mode)")
+    parser.add_argument("--opt-state-bf16", action="store_true",
+                        dest="opt_state_bf16",
+                        help="bf16 Adam m/v storage (halved optimizer HBM; "
+                             "headline mode)")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)  # internal: run measured body
     args = parser.parse_args()
@@ -393,6 +410,11 @@ def main() -> None:
                               ("--mlm", args.mlm)] if on]
     if len(picked) > 1:
         parser.error(f"pick one mode, got {' and '.join(picked)}")
+    if (args.batch is not None or args.opt_state_bf16) and picked:
+        # headline-only knobs: other modes hardcode their configuration,
+        # so dropping these silently would mislabel the measurement
+        parser.error("--batch/--opt-state-bf16 apply to the headline mode "
+                     f"only, not {picked[0]}")
 
     if getattr(args, "_child"):
         _run_child(args)

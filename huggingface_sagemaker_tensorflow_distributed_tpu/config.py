@@ -85,6 +85,9 @@ class TrainConfig:
     # AND count (surfaced as the ce_dropped metric) — raise this when
     # pretraining with a higher mlm_probability
     fused_mlm_mask_cap: float = 0.25
+    # pin MLM masks to the seed draw for every epoch (pre-r4 behavior;
+    # ablation knob — default re-draws per epoch like HF's collator)
+    mlm_static_masking: bool = False
     from_scratch: bool = False     # random init instead of pretrained weights
 
     # --- data ---
@@ -109,6 +112,10 @@ class TrainConfig:
     # adafactor = T5's sublinear-memory pretraining optimizer (no
     # weight_decay); lamb = large-batch (pod-scale) BERT
     optimizer: str = "adamw"       # adamw | adam | adafactor | lamb
+    # bf16 storage for Adam's m/v buffers (fp32 compute each step):
+    # halves optimizer HBM — batch-size headroom at the 16G ceiling.
+    # adam/adamw only (adafactor is already sublinear; lamb unsupported)
+    optimizer_state_dtype: str = "float32"   # float32 | bfloat16
     lr_schedule: str = "linear"    # linear | cosine (with warmup_ratio > 0)
     warmup_ratio: float = 0.0
     weight_decay: float = 0.0
@@ -236,6 +243,15 @@ class TrainConfig:
             raise ValueError("learning_rate must be positive")
         if self.optimizer not in ("adamw", "adam", "adafactor", "lamb"):
             raise ValueError(f"unknown optimizer {self.optimizer!r}")
+        if self.optimizer_state_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown optimizer_state_dtype {self.optimizer_state_dtype!r}")
+        if (self.optimizer_state_dtype == "bfloat16"
+                and self.optimizer not in ("adam", "adamw")):
+            raise ValueError(
+                "optimizer_state_dtype='bfloat16' supports adam/adamw only "
+                "(adafactor is already sublinear-memory; lamb's trust "
+                "ratio is untested with quantized moments)")
         if self.optimizer == "adafactor" and self.weight_decay > 0:
             raise ValueError(
                 "weight_decay with adafactor is not supported: optax "

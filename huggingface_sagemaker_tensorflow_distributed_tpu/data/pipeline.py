@@ -101,7 +101,8 @@ class ArrayDataset:
     @classmethod
     def from_mlm_texts(cls, tokenizer, texts, max_length: int = 512,
                        mlm_probability: float = 0.15, whole_word: bool = True,
-                       seed: int = 0) -> "MlmDataset":
+                       seed: int = 0,
+                       static_masking: bool = False) -> "MlmDataset":
         """Masked-LM corpus with (whole-word) masking — the pretraining
         recipe behind the reference's default checkpoint
         ``bert-large-uncased-whole-word-masking`` (reference
@@ -120,7 +121,7 @@ class ArrayDataset:
             mask_token_id=int(tokenizer.mask_token_id),
             vocab_size=int(getattr(tokenizer, "vocab_size")),
             mlm_probability=mlm_probability, whole_word=whole_word,
-            seed=seed)
+            seed=seed, static_masking=static_masking)
 
     @classmethod
     def from_span_corruption_texts(cls, tokenizer, texts,
@@ -383,7 +384,7 @@ class MlmDataset(ArrayDataset):
     def __init__(self, clean_ids: np.ndarray, attention_mask: np.ndarray,
                  word_ids: np.ndarray, mask_token_id: int, vocab_size: int,
                  mlm_probability: float = 0.15, whole_word: bool = True,
-                 seed: int = 0):
+                 seed: int = 0, static_masking: bool = False):
         self._clean_ids = clean_ids
         self._word_ids = word_ids
         self._mask_token_id = mask_token_id
@@ -391,12 +392,17 @@ class MlmDataset(ArrayDataset):
         self._mlm_probability = mlm_probability
         self._whole_word = whole_word
         self._seed = seed
+        self._static = static_masking
         self._epoch: Optional[int] = None
         super().__init__({"attention_mask": attention_mask})
         self.begin_epoch(0)
 
     def begin_epoch(self, epoch: int) -> None:
-        """Re-draw masks for ``epoch`` (idempotent per epoch)."""
+        """Re-draw masks for ``epoch`` (idempotent per epoch).
+        ``static_masking`` pins every epoch to the seed draw — the
+        pre-r4 behavior, kept as an ablation knob."""
+        if self._static:
+            epoch = 0
         if epoch == self._epoch:
             return
         ids, labels = apply_mlm_masking(

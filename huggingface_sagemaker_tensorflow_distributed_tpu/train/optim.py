@@ -18,9 +18,60 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
 import optax
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+
+
+def scale_by_adam_lowp(b1: float = 0.9, b2: float = 0.999,
+                       eps: float = 1e-8,
+                       state_dtype=jnp.bfloat16) -> optax.GradientTransformation:
+    """Adam moment estimation with BOTH moments stored in
+    ``state_dtype`` (bf16 halves optimizer HBM — the m and v buffers are
+    2 of the 3 fp32-param-sized tensors Adam training carries).
+
+    The low-bit storage pattern: STORE low precision, COMPUTE fp32 —
+    every decay/update/sqrt happens after casting the stored moments up,
+    so a step's arithmetic is identical to fp32 Adam except for the
+    quantization of what was stored last step. optax's own ``mu_dtype``
+    covers only the first moment; v's wide dynamic range is safe in
+    bf16 (it shares fp32's exponent) — it is v's MANTISSA that rounds,
+    a relative error of 2^-9 on the denominator, bounded and tested
+    (``tests/test_bf16_quality.py::test_bf16_optimizer_state_quality``).
+    """
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=state_dtype)  # noqa: E731
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params))
+
+    def update(updates, state, params=None):
+        del params
+        f32 = jnp.float32
+        mu = jax.tree.map(
+            lambda g, m: b1 * m.astype(f32) + (1 - b1) * g.astype(f32),
+            updates, state.mu)
+        nu = jax.tree.map(
+            lambda g, v: b2 * v.astype(f32)
+            + (1 - b2) * jnp.square(g.astype(f32)),
+            updates, state.nu)
+        count = optax.safe_int32_increment(state.count)
+        bc1 = 1 - b1 ** count.astype(f32)
+        bc2 = 1 - b2 ** count.astype(f32)
+        out = jax.tree.map(
+            lambda m, v, g: ((m / bc1) / (jnp.sqrt(v / bc2) + eps))
+            .astype(g.dtype),
+            mu, nu, updates)
+        store = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: x.astype(state_dtype), t)
+        return out, optax.ScaleByAdamState(count=count, mu=store(mu),
+                                           nu=store(nu))
+
+    return optax.GradientTransformation(init, update)
 
 
 def build_optimizer(
@@ -48,7 +99,16 @@ def build_optimizer(
     else:
         schedule = lr  # constant — reference behavior (train.py:113)
 
-    if config.optimizer == "adafactor":
+    lowp = config.optimizer_state_dtype == "bfloat16"
+    if lowp and config.optimizer in ("adam", "adamw"):
+        # bf16 m/v storage (fp32 compute): halves optimizer HBM — the
+        # headroom that buys a bigger per-chip batch at the 16G ceiling
+        parts = [scale_by_adam_lowp()]
+        if config.optimizer == "adamw" and config.weight_decay > 0:
+            parts.append(optax.add_decayed_weights(config.weight_decay))
+        parts.append(optax.scale_by_learning_rate(schedule))
+        core = optax.chain(*parts)
+    elif config.optimizer == "adafactor":
         # T5's pretraining optimizer: factored second moments, sublinear
         # optimizer memory — the natural choice for the biggest models.
         # weight_decay is rejected at config validation: optax applies

@@ -136,8 +136,9 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
         return ArrayDataset.from_lm_texts(tokenizer, texts, max_len)
     if config.task == "mlm":
         texts, _ = load_text_classification(config.dataset, split, **kw)
-        return ArrayDataset.from_mlm_texts(tokenizer, texts, max_len,
-                                           seed=config.seed)
+        return ArrayDataset.from_mlm_texts(
+            tokenizer, texts, max_len, seed=config.seed,
+            static_masking=config.mlm_static_masking)
     if config.task == "rtd":
         texts, _ = load_text_classification(config.dataset, split, **kw)
         return ArrayDataset.from_rtd_texts(tokenizer, texts, max_len,
