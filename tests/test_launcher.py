@@ -55,6 +55,56 @@ def test_tpu_vm_command_built_not_run(tmp_path):
     assert handle.procs == []  # constructed, not executed
 
 
+def test_tpu_vm_backend_executes_through_stub_gcloud(tmp_path, monkeypatch):
+    """TPUVMBackend(execute=True) end to end against a stub ``gcloud``
+    on PATH that runs the ``--command=`` payload locally — the launch /
+    log-capture / wait flow actually executes (zero-egress stand-in for
+    a real slice; the command CONTENT is covered by
+    test_tpu_vm_command_built_not_run)."""
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    gcloud = stub_dir / "gcloud"
+    gcloud.write_text(textwrap.dedent("""\
+        #!/bin/bash
+        # stub: find the --command= arg and run it in a local shell,
+        # like the real gcloud would on every worker
+        for a in "$@"; do
+          case "$a" in --command=*) exec bash -c "${a#--command=}";; esac
+        done
+        echo "no --command passed" >&2; exit 9
+    """))
+    gcloud.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{stub_dir}:{os.environ['PATH']}")
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "entry.py").write_text(textwrap.dedent("""
+        import json, os, sys
+        out = os.environ["TPU_OUTPUT_DATA_DIR"]
+        os.makedirs(out, exist_ok=True)
+        with open(os.path.join(out, "ran.json"), "w") as f:
+            json.dump({"argv": sys.argv[1:],
+                       "model_dir": os.environ["TPU_MODEL_DIR"]}, f)
+    """))
+
+    job = TPUJob(entry_point="entry.py", source_dir=str(src),
+                 slice_spec="v5e-8", hyperparameters={"epochs": 2},
+                 job_root=str(tmp_path / "jobs"))
+    backend = TPUVMBackend(tpu_name="stub-slice", zone="us-x1-a",
+                           execute=True)
+    job_dir = str(tmp_path / "jobs" / "j1")
+    os.makedirs(job_dir, exist_ok=True)
+    handle = backend.launch(job, "j1", job_dir)
+    assert handle.procs, "execute=True must spawn the gcloud process"
+    codes = handle.wait(timeout=60)
+    assert codes == [0]
+    with open(os.path.join(handle.output_data_dir, "ran.json")) as f:
+        ran = json.load(f)
+    assert ran["argv"] == ["--epochs", "2"]
+    assert ran["model_dir"] == handle.model_dir
+    assert os.path.exists(os.path.join(job_dir, "gcloud.log"))
+
+
 def test_failed_rank_terminates_survivors(tmp_path):
     """One rank dies, the other hangs (as at a collective): wait() must
     kill the survivor after the grace period and raise — not deadlock."""
