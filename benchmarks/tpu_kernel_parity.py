@@ -1,0 +1,148 @@
+"""Compiled-TPU parity spot-run for the Pallas kernels (VERDICT r3 #1a).
+
+The flash-attention backward (delta folded in-kernel) and the vocab-CE
+kernel were interpret-mode-verified on CPU; this script is the missing
+evidence that they COMPILE under Mosaic and match the XLA reference on
+the real chip at real shapes:
+
+- flash fwd + bwd vs xla attention at B8/H12/S512/D64 (headline shape),
+  causal and non-causal, with a padding mask;
+- fused vocab-CE fwd + both gradients vs full-logits CE at
+  N=2048/H=768/V=50257 (GPT-2 vocab — the VMEM-fit question) and the
+  bias-augmented MLM shape (H=896 = 768+128).
+
+Prints one PASS/FAIL line per check and exits non-zero on any FAIL.
+Run on the chip:  python benchmarks/tpu_kernel_parity.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FAILED = []
+
+
+def check(name: str, got, want, atol: float, rtol: float = 1e-3) -> None:
+    got, want = np.asarray(got, np.float32), np.asarray(want, np.float32)
+    err = np.max(np.abs(got - want) / (np.abs(want) + atol))
+    ok = bool(np.allclose(got, want, atol=atol, rtol=rtol))
+    print(f"{'PASS' if ok else 'FAIL'} {name}: max_rel_err={err:.3e}")
+    if not ok:
+        FAILED.append(name)
+
+
+def flash_parity() -> None:
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        xla_attention,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+
+    B, H, S, D = 8, 12, 512, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32) * 0.1
+    k = jnp.asarray(rng.randn(B, H, S, D), jnp.float32) * 0.1
+    v = jnp.asarray(rng.randn(B, H, S, D), jnp.float32) * 0.1
+    # padding mask: last 64 keys masked on half the batch
+    mask = np.zeros((B, 1, 1, S), np.float32)
+    mask[: B // 2, ..., -64:] = -1e9
+    mask = jnp.asarray(mask)
+
+    for causal in (False, True):
+        tag = "causal" if causal else "full"
+        out_f = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, mask=mask, causal=causal))(q, k, v)
+        from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+            make_causal_mask,
+        )
+        full_mask = mask + make_causal_mask(S, S) if causal else mask
+        out_x = jax.jit(lambda q, k, v: xla_attention(
+            q, k, v, mask=full_mask))(q, k, v)
+        check(f"flash fwd ({tag})", out_f, out_x, atol=2e-5)
+
+        def loss_f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, mask=mask,
+                                           causal=causal) ** 2)
+
+        def loss_x(q, k, v):
+            return jnp.sum(xla_attention(q, k, v, mask=full_mask) ** 2)
+
+        gf = jax.jit(jax.grad(loss_f, argnums=(0, 1, 2)))(q, k, v)
+        gx = jax.jit(jax.grad(loss_x, argnums=(0, 1, 2)))(q, k, v)
+        for name, a, b in zip(("dq", "dk", "dv"), gf, gx):
+            check(f"flash bwd {name} ({tag})", a, b, atol=2e-4)
+
+
+def vocab_ce_parity() -> None:
+    import optax
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_vocab_ce import (
+        fused_vocab_cross_entropy,
+    )
+
+    for label, (n_tok, h_dim, vocab) in (
+            ("gpt2-vocab", (2048, 768, 50257)),
+            ("mlm-bias-aug", (2048, 896, 30522))):
+        rng = np.random.RandomState(1)
+        hidden = jnp.asarray(rng.randn(n_tok, h_dim), jnp.float32) * 0.1
+        weight = jnp.asarray(rng.randn(vocab, h_dim), jnp.float32) * 0.05
+        labels = jnp.asarray(rng.randint(0, vocab, n_tok), jnp.int32)
+
+        def unfused(h, w):
+            logits = h.astype(jnp.float32) @ w.astype(jnp.float32).T
+            return (optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels), jnp.argmax(logits, -1))
+
+        loss_f, pred_f = jax.jit(lambda h, w: fused_vocab_cross_entropy(
+            h, w, labels))(hidden, weight)
+        loss_x, pred_x = jax.jit(unfused)(hidden, weight)
+        check(f"vocab-ce loss ({label})", loss_f, loss_x, atol=1e-4)
+        agree = float(np.mean(np.asarray(pred_f) == np.asarray(pred_x)))
+        print(f"{'PASS' if agree == 1.0 else 'FAIL'} vocab-ce pred "
+              f"({label}): agreement={agree:.4f}")
+        if agree < 1.0:
+            FAILED.append(f"vocab-ce pred ({label})")
+
+        def fl(h, w):
+            per_tok, _ = fused_vocab_cross_entropy(h, w, labels)
+            return jnp.mean(per_tok)
+
+        def xl(h, w):
+            per_tok, _ = unfused(h, w)
+            return jnp.mean(per_tok)
+
+        gf = jax.jit(jax.grad(fl, argnums=(0, 1)))(hidden, weight)
+        gx = jax.jit(jax.grad(xl, argnums=(0, 1)))(hidden, weight)
+        for name, a, b in zip(("dh", "dw"), gf, gx):
+            check(f"vocab-ce {name} ({label})", a, b, atol=1e-5)
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})")
+    on_tpu = dev.platform == "tpu"
+    if not on_tpu:
+        print("WARNING: not a TPU — kernels fall back / interpret "
+              "off-TPU, so these checks prove nothing about Mosaic")
+    flash_parity()
+    vocab_ce_parity()
+    if FAILED:
+        print(f"FAILED: {FAILED}")
+        sys.exit(1)
+    if not on_tpu:
+        # a vacuous pass must not read as compile evidence downstream
+        print("NO-EVIDENCE (not a TPU): checks passed but prove nothing")
+        sys.exit(2)
+    print("ALL PASS")
+
+
+if __name__ == "__main__":
+    main()

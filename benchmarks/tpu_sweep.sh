@@ -1,0 +1,54 @@
+#!/bin/bash
+# The full TPU measurement backlog in priority order (VERDICT r3 #1) —
+# run this the moment the axon tunnel is up. Each step tees to
+# /tmp/tpu_sweep/ so a tunnel drop mid-sweep loses nothing; steps are
+# ordered so the most important evidence lands first.
+#
+#   bash benchmarks/tpu_sweep.sh            # full sweep (~40-60 min)
+#   bash benchmarks/tpu_sweep.sh quick      # parity + headline only
+#
+# NO env overrides: this must see the real chip.
+set -u
+cd "$(dirname "$0")/.."
+OUT=/tmp/tpu_sweep
+mkdir -p "$OUT"
+WORST=0
+run() {  # run <name> <cmd...>  — tee output, never abort the sweep,
+         # but remember the worst rc so the sweep's exit code is honest
+  local name=$1; shift
+  echo "=== $name: $*" | tee -a "$OUT/sweep.log"
+  "$@" 2>&1 | tee "$OUT/$name.log" | tail -3
+  local rc=${PIPESTATUS[0]}
+  [ "$rc" -gt "$WORST" ] && WORST=$rc
+  echo "=== $name done (rc=$rc)" | tee -a "$OUT/sweep.log"
+}
+
+# 1. compiled-kernel parity — the delta-fold flash bwd and the vocab-CE
+#    kernel have never met Mosaic (VERDICT #1a)
+run parity python benchmarks/tpu_kernel_parity.py
+
+# 2. headline bench (VERDICT #1b: >=263, MFU populated)
+run headline python bench.py
+
+[ "${1:-}" = quick ] && exit "$WORST"
+
+# 3. bf16-optimizer-state batch re-sweep: halved Adam HBM should move
+#    the spill wall past batch 48 (the r2 sweep peaked 44-52)
+run headline_b48_bf16opt python bench.py --batch 48 --opt-state-bf16
+run headline_b64_bf16opt python bench.py --batch 64 --opt-state-bf16
+run headline_b80_bf16opt python bench.py --batch 80 --opt-state-bf16
+run headline_b96_bf16opt python bench.py --batch 96 --opt-state-bf16
+
+# 4. the BENCH_EXTRA backlog (VERDICT #1c)
+run buckets    python bench.py --buckets
+run causal_lm  python bench.py --causal-lm
+run mlm        python bench.py --mlm
+run generate   python bench.py --generate
+run bert_large python bench.py --model bert-large
+
+# 5. scaling instrument (collective fraction from a real trace)
+run mesh python bench.py --mesh
+
+echo "sweep complete (worst rc=$WORST) — logs in $OUT; JSON lines:"
+grep -h '"metric"' "$OUT"/*.log | tail -20
+exit "$WORST"
