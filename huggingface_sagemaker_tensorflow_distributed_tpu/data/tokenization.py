@@ -134,8 +134,8 @@ class WordHashTokenizer:
         return {"input_ids": input_ids, "attention_mask": attention_mask,
                 "word_ids": word_ids}
 
-    def encode_qa(self, questions, contexts, start_chars, answer_texts,
-                  max_length: int | None = None,
+    def encode_qa(self, questions, contexts, start_chars=None,
+                  answer_texts=None, max_length: int | None = None,
                   return_offsets: bool = False):
         """Question+context pairs → ids with answer span token positions.
 
@@ -145,7 +145,8 @@ class WordHashTokenizer:
         ``return_offsets`` adds ``offset_starts``/``offset_ends`` — char
         offsets into the context per CONTEXT token, -1 elsewhere (the
         answer-text decoding input, eval-side only so the extra columns
-        never reach the model).
+        never reach the model). ``start_chars``/``answer_texts`` may be
+        None (inference: no labels to build).
         """
         max_length = max_length or self.model_max_length
         n = len(questions)
@@ -166,14 +167,15 @@ class WordHashTokenizer:
             ids = [self.cls_token_id] + q_ids + [self.sep_token_id] + c_ids + [self.sep_token_id]
             segs = [0] * (len(q_ids) + 2) + [1] * (len(c_ids) + 1)
             ctx_offset = len(q_ids) + 2  # token index of first context token
-            a_start = start_chars[r]
-            a_end = a_start + len(answer_texts[r])
             tok_start = tok_end = None
-            for t, (_, s, e) in enumerate(ctx_spans):
-                if s < a_end and e > a_start:  # overlap
-                    if tok_start is None:
-                        tok_start = ctx_offset + t
-                    tok_end = ctx_offset + t
+            if start_chars is not None:
+                a_start = start_chars[r]
+                a_end = a_start + len(answer_texts[r])
+                for t, (_, s, e) in enumerate(ctx_spans):
+                    if s < a_end and e > a_start:  # overlap
+                        if tok_start is None:
+                            tok_start = ctx_offset + t
+                        tok_end = ctx_offset + t
             ids, segs = ids[:max_length], segs[:max_length]
             input_ids[r, : len(ids)] = ids
             attention_mask[r, : len(ids)] = 1
@@ -280,13 +282,14 @@ class HFTokenizer:
                         max_length=max_length, return_tensors="np")
         return self._with_word_ids(out, len(texts), max_length)
 
-    def encode_qa(self, questions, contexts, start_chars, answer_texts,
-                  max_length: int | None = None,
+    def encode_qa(self, questions, contexts, start_chars=None,
+                  answer_texts=None, max_length: int | None = None,
                   return_offsets: bool = False):
         """Question+context → ids + answer token span via offset mapping.
         ``return_offsets`` adds ``offset_starts``/``offset_ends`` (char
         offsets into the context per CONTEXT token, -1 elsewhere) for
-        answer-text decoding at eval."""
+        answer-text decoding at eval. ``start_chars``/``answer_texts``
+        may be None (inference: no labels to build)."""
         max_length = max_length or self.model_max_length
         out = self._tok(questions, contexts, truncation="only_second",
                         padding="max_length", max_length=max_length,
@@ -298,8 +301,9 @@ class HFTokenizer:
         offset_ends = np.full((n, max_length), -1, np.int32)
         offsets = out["offset_mapping"]
         for r in range(n):
-            a_start = start_chars[r]
-            a_end = a_start + len(answer_texts[r])
+            labeled = start_chars is not None
+            a_start = start_chars[r] if labeled else 0
+            a_end = a_start + (len(answer_texts[r]) if labeled else 0)
             seq_ids = out.sequence_ids(r)
             tok_start = tok_end = None
             for t, (s, e) in enumerate(offsets[r]):
@@ -307,7 +311,7 @@ class HFTokenizer:
                     continue
                 offset_starts[r, t] = s
                 offset_ends[r, t] = e
-                if s < a_end and e > a_start:
+                if labeled and s < a_end and e > a_start:
                     if tok_start is None:
                         tok_start = t
                     tok_end = t

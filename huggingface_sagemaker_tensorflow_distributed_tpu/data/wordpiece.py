@@ -334,14 +334,15 @@ class WordPieceTokenizer:
         return {"input_ids": input_ids, "attention_mask": attention_mask,
                 "word_ids": word_ids}
 
-    def encode_qa(self, questions, contexts, start_chars, answer_texts,
-                  max_length: int | None = None,
+    def encode_qa(self, questions, contexts, start_chars=None,
+                  answer_texts=None, max_length: int | None = None,
                   return_offsets: bool = False):
         """Question+context pairs → ids + answer token spans via the
         code-point offsets the core emits (HF offset_mapping semantics,
         truncation="only_second"). ``return_offsets`` adds
         ``offset_starts``/``offset_ends`` (char offsets into the context
-        per CONTEXT token, -1 elsewhere) for answer-text decoding."""
+        per CONTEXT token, -1 elsewhere) for answer-text decoding.
+        ``start_chars``/``answer_texts`` may be None (inference)."""
         max_length = max_length or self.model_max_length
         n = len(questions)
         q_ids, _, _, _, q_cnt = self._tokenize_batch(list(questions), max_length)
@@ -368,8 +369,9 @@ class WordPieceTokenizer:
             attention_mask[r, :len(ids)] = 1
             token_type_ids[r, :len(seg)] = seg
             ctx_offset = nq + 2
-            a_start = start_chars[r]
-            a_end = a_start + len(answer_texts[r])
+            labeled = start_chars is not None
+            a_start = start_chars[r] if labeled else 0
+            a_end = a_start + (len(answer_texts[r]) if labeled else 0)
             tok_start = tok_end = None
             last_end = 0
             for t in range(nc):
@@ -378,7 +380,7 @@ class WordPieceTokenizer:
                     continue
                 offset_starts[r, ctx_offset + t] = s
                 offset_ends[r, ctx_offset + t] = e
-                if s < a_end and e > a_start:
+                if labeled and s < a_end and e > a_start:
                     if tok_start is None:
                         tok_start = ctx_offset + t
                     tok_end = ctx_offset + t

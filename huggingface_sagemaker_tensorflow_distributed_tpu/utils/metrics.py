@@ -101,7 +101,8 @@ def squad_em_f1(predictions: Sequence[str], references: Sequence[str]) -> dict:
 
 def extract_answer_spans(start_logits, end_logits, offset_starts,
                          offset_ends, contexts: Sequence[str],
-                         max_answer_len: int = 30) -> list[str]:
+                         max_answer_len: int = 30,
+                         with_spans: bool = False):
     """Decode predicted answer texts from span logits (HF run_qa's n-best
     search collapsed to the argmax pair): best (s, e) with s ≤ e ≤
     s + max_answer_len over CONTEXT tokens only (offsets ≥ 0); a winning
@@ -109,7 +110,9 @@ def extract_answer_spans(start_logits, end_logits, offset_starts,
 
     ``offset_starts``/``offset_ends`` are char offsets into each context,
     -1 outside context tokens — the ``return_offsets=True`` output of the
-    tokenizers' ``encode_qa``."""
+    tokenizers' ``encode_qa``. With ``with_spans`` each element is
+    ``(text, start_token, end_token)`` (tokens -1/-1 on a no-answer
+    decode) so callers can report indices CONSISTENT with the text."""
     import numpy as np
 
     out = []
@@ -117,19 +120,17 @@ def extract_answer_spans(start_logits, end_logits, offset_starts,
     e_l = np.asarray(end_logits)
     for r in range(len(contexts)):
         idx = np.flatnonzero(np.asarray(offset_starts[r]) >= 0)
-        if len(idx) == 0:
-            out.append("")
-            continue
-        # pair-score matrix over context tokens, upper-triangular within
-        # the answer-length window (seq ≤ 512 ⇒ tiny)
-        pair = s_l[r][idx][:, None] + e_l[r][idx][None, :]
-        d = idx[None, :] - idx[:, None]
-        pair = np.where((d >= 0) & (d <= max_answer_len), pair, -np.inf)
-        s_i, e_i = np.unravel_index(np.argmax(pair), pair.shape)
-        if not np.isfinite(pair[s_i, e_i]):
-            out.append("")
-            continue
-        s_tok, e_tok = int(idx[s_i]), int(idx[e_i])
-        out.append(contexts[r][offset_starts[r][s_tok]:
-                               offset_ends[r][e_tok]])
+        text, s_tok, e_tok = "", -1, -1
+        if len(idx):
+            # pair-score matrix over context tokens, upper-triangular
+            # within the answer-length window (seq ≤ 512 ⇒ tiny)
+            pair = s_l[r][idx][:, None] + e_l[r][idx][None, :]
+            d = idx[None, :] - idx[:, None]
+            pair = np.where((d >= 0) & (d <= max_answer_len), pair, -np.inf)
+            s_i, e_i = np.unravel_index(np.argmax(pair), pair.shape)
+            if np.isfinite(pair[s_i, e_i]):
+                s_tok, e_tok = int(idx[s_i]), int(idx[e_i])
+                text = contexts[r][offset_starts[r][s_tok]:
+                                   offset_ends[r][e_tok]]
+        out.append((text, s_tok, e_tok) if with_spans else text)
     return out

@@ -99,7 +99,23 @@ def predict(args) -> list[dict]:
 
     max_len = min(args.max_seq_length,
                   getattr(config, "max_position_embeddings", args.max_seq_length))
-    enc = _encode(tokenizer, texts, contexts, max_len)
+    qa_offsets = None
+    if (args.task == "qa" and contexts is not None
+            and hasattr(tokenizer, "encode_qa")):
+        # QA gets the eval-metric encoding: only_second truncation plus
+        # char offsets, so the answer decodes by slicing the ORIGINAL
+        # context (exact surface text) with the joint span search
+        enc = dict(tokenizer.encode_qa(texts, contexts, max_length=max_len,
+                                       return_offsets=True))
+        # encode_qa pads to max_length; trim every column to the longest
+        # real row (the 'longest' contract of _encode) so the jitted
+        # width tracks the batch
+        width = max(int(np.asarray(enc["attention_mask"]).sum(1).max()), 1)
+        enc = {k: v[:, :width] if getattr(v, "ndim", 1) == 2 else v
+               for k, v in enc.items()}
+        qa_offsets = (enc["offset_starts"], enc["offset_ends"])
+    else:
+        enc = _encode(tokenizer, texts, contexts, max_len)
     ids = jnp.asarray(enc["input_ids"])
     mask = jnp.asarray(enc["attention_mask"])
     token_types = (jnp.asarray(enc["token_type_ids"])
@@ -158,13 +174,28 @@ def predict(args) -> list[dict]:
                             "labels": pred[r][am[r] > 0].tolist()})
     elif args.task == "qa":
         start, end = out
-        s = np.asarray(jnp.argmax(start, -1))
-        e = np.asarray(jnp.argmax(end, -1))
-        for r, text in enumerate(texts):
-            lo, hi = int(s[r]), int(e[r])
-            span_ids = np.asarray(ids[r])[lo: hi + 1] if hi >= lo else []
-            results.append({"text": text, "start": lo, "end": hi,
-                            "answer": tokenizer.decode(span_ids)})
+        if qa_offsets is not None:
+            # the eval metric's decode (utils/metrics.py): joint argmax
+            # over context-token pairs, sliced from the original context;
+            # start/end report the SAME winning span, so a result row is
+            # internally consistent
+            from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import (
+                extract_answer_spans,
+            )
+            spans = extract_answer_spans(start, end, qa_offsets[0],
+                                         qa_offsets[1], contexts,
+                                         with_spans=True)
+            for text, (answer, s_tok, e_tok) in zip(texts, spans):
+                results.append({"text": text, "start": s_tok,
+                                "end": e_tok, "answer": answer})
+        else:
+            s = np.asarray(jnp.argmax(start, -1))
+            e = np.asarray(jnp.argmax(end, -1))
+            for r, text in enumerate(texts):
+                lo, hi = int(s[r]), int(e[r])
+                span_ids = np.asarray(ids[r])[lo: hi + 1] if hi >= lo else []
+                results.append({"text": text, "start": lo, "end": hi,
+                                "answer": tokenizer.decode(span_ids)})
     elif args.task == "rtd":
         # per-token probability that the token was replaced (ELECTRA
         # discriminator; sigmoid of the binary logit)
