@@ -320,19 +320,25 @@ class BartForConditionalGeneration(nn.Module):
                             attn_mask=_padding_mask(attention_mask),
                             deterministic=deterministic)
 
+    def _teacher_forcing_mask(self, decoder_input_ids,
+                              decoder_attention_mask):
+        dec_len = decoder_input_ids.shape[1]
+        i = jnp.arange(dec_len)[:, None]
+        j = jnp.arange(dec_len)[None, :]
+        causal = jnp.where(j <= i, 0.0, NEG_INF)[None, None]
+        if decoder_attention_mask is not None:
+            return causal + _padding_mask(decoder_attention_mask)
+        return causal
+
     def decode(self, decoder_input_ids, encoder_hidden,
                encoder_attention_mask=None, decoder_attention_mask=None,
                deterministic: bool = True, decode: bool = False):
         cfg = self.config
-        dec_len = decoder_input_ids.shape[1]
         if decode:
             self_mask = None   # cache supplies causal masking
         else:
-            i = jnp.arange(dec_len)[:, None]
-            j = jnp.arange(dec_len)[None, :]
-            self_mask = jnp.where(j <= i, 0.0, NEG_INF)[None, None]
-            if decoder_attention_mask is not None:
-                self_mask = self_mask + _padding_mask(decoder_attention_mask)
+            self_mask = self._teacher_forcing_mask(decoder_input_ids,
+                                                   decoder_attention_mask)
         enc_mask = (None if encoder_attention_mask is None
                     else _padding_mask(encoder_attention_mask))
         x = self.decoder(self._embed_tokens(decoder_input_ids),
@@ -346,3 +352,21 @@ class BartForConditionalGeneration(nn.Module):
         enc = self.encode(input_ids, attention_mask, deterministic)
         return self.decode(decoder_input_ids, enc, attention_mask,
                            decoder_attention_mask, deterministic)
+
+    def seq2seq_hidden_and_embedding(self, input_ids, attention_mask=None,
+                                     decoder_input_ids=None,
+                                     decoder_attention_mask=None,
+                                     deterministic: bool = True):
+        """(pre-head decoder hidden [B, T, H] cast to compute dtype, tied
+        embedding [V, H]) — the fused vocab-CE path; ``hidden·Wᵀ`` equals
+        ``decode``'s logits without materializing [B, T, V]."""
+        cfg = self.config
+        enc = self.encode(input_ids, attention_mask, deterministic)
+        x = self.decoder(self._embed_tokens(decoder_input_ids),
+                         attn_mask=self._teacher_forcing_mask(
+                             decoder_input_ids, decoder_attention_mask),
+                         enc_hidden=enc,
+                         enc_mask=_padding_mask(attention_mask)
+                         if attention_mask is not None else None,
+                         deterministic=deterministic)
+        return x.astype(cfg.dtype), self.shared.embedding

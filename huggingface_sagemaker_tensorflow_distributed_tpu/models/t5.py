@@ -416,17 +416,11 @@ class T5ForConditionalGeneration(nn.Module):
                decode: bool = False):
         """Decoder forward → vocab logits. ``decode=True`` uses/updates the
         incremental cache (mask built from the cache index internally)."""
-        dec_len = decoder_input_ids.shape[1]
         if decode:
             self_mask = None  # cache supplies causal masking
         else:
-            i = jnp.arange(dec_len)[:, None]
-            j = jnp.arange(dec_len)[None, :]
-            causal = jnp.where(j <= i, 0.0, NEG_INF)[None, None]
-            if decoder_attention_mask is not None:
-                self_mask = causal + _padding_mask(decoder_attention_mask)
-            else:
-                self_mask = causal
+            self_mask = self._teacher_forcing_mask(decoder_input_ids,
+                                                   decoder_attention_mask)
         enc_mask = None
         if encoder_attention_mask is not None:
             enc_mask = _padding_mask(encoder_attention_mask)
@@ -441,6 +435,38 @@ class T5ForConditionalGeneration(nn.Module):
         enc = self.encode(input_ids, attention_mask, deterministic)
         return self.decode(decoder_input_ids, enc, attention_mask,
                            decoder_attention_mask, deterministic)
+
+    def seq2seq_hidden_and_embedding(self, input_ids, attention_mask=None,
+                                     decoder_input_ids=None,
+                                     decoder_attention_mask=None,
+                                     deterministic: bool = True):
+        """(pre-head decoder hidden [B, T, H] with the tied-head scaling
+        already applied, LM weight [V, H]) — the fused vocab-CE path
+        (``train/trainer.py::make_fused_seq2seq_loss``): ``hidden·Wᵀ``
+        equals ``__call__``'s logits, but [B, T, V] never materializes."""
+        cfg = self.config
+        enc = self.encode(input_ids, attention_mask, deterministic)
+        hidden = self.decoder(
+            self.shared(decoder_input_ids),
+            attn_mask=self._teacher_forcing_mask(decoder_input_ids,
+                                                 decoder_attention_mask),
+            enc_hidden=enc,
+            enc_mask=_padding_mask(attention_mask)
+            if attention_mask is not None else None,
+            deterministic=deterministic)
+        if cfg.tie_word_embeddings:
+            return hidden * (cfg.d_model ** -0.5), self.shared.embedding
+        return hidden, self.lm_head.variables["params"]["kernel"].T
+
+    def _teacher_forcing_mask(self, decoder_input_ids,
+                              decoder_attention_mask):
+        dec_len = decoder_input_ids.shape[1]
+        i = jnp.arange(dec_len)[:, None]
+        j = jnp.arange(dec_len)[None, :]
+        causal = jnp.where(j <= i, 0.0, NEG_INF)[None, None]
+        if decoder_attention_mask is not None:
+            return causal + _padding_mask(decoder_attention_mask)
+        return causal
 
 
 def shift_right(labels, decoder_start_token_id: int, pad_token_id: int = 0,

@@ -184,6 +184,43 @@ def rtd_loss(apply_fn, params, batch, rngs, train: bool):
     return _masked_sums(per_tok, correct, token_valid)
 
 
+def _make_sharded_fused_ce(block_n: int, block_v: int,
+                           interpret: bool | None):
+    """The shard_mapped blocked-vocab CE call the fused losses share:
+    ``ce(hidden [B,T,H], weight [V,H], labels [B,T]) → (per_tok, pred)``,
+    per-dp-shard through the Pallas kernel, weight cotangent psummed by
+    the shard_map transpose."""
+    from jax.sharding import PartitionSpec as P
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_vocab_ce import (
+        fused_vocab_cross_entropy,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+        data_axis_names,
+        maybe_current_mesh,
+    )
+
+    def ce(h, w, lab):
+        n = h.shape[0] * h.shape[1]
+        per_tok, pred = fused_vocab_cross_entropy(
+            h.reshape(n, h.shape[2]), w, lab.reshape(n),
+            block_n=block_n, block_v=block_v, interpret=interpret)
+        return per_tok.reshape(lab.shape), pred.reshape(lab.shape)
+
+    mesh = maybe_current_mesh()
+    batch_axes = data_axis_names()
+    if mesh is not None and any(
+            mesh.shape.get(a, 1) > 1 for a in batch_axes):
+        from jax import shard_map
+        # check_vma=False: pallas_call does not annotate varying-mesh
+        # axes on its outputs, which the default vma check rejects
+        ce = shard_map(ce, mesh=mesh,
+                       in_specs=(P(batch_axes), P(), P(batch_axes)),
+                       out_specs=(P(batch_axes), P(batch_axes)),
+                       check_vma=False)
+    return ce
+
+
 def make_fused_causal_lm_loss(model, block_n: int = 256, block_v: int = 512,
                               interpret: bool | None = None):
     """``causal_lm_loss`` without the [B, S, V] logits: the model exposes
@@ -196,15 +233,6 @@ def make_fused_causal_lm_loss(model, block_n: int = 256, block_v: int = 512,
     would break the token-block tiling: S-1 is odd), labels are shifted
     left with a -100 pad so every position is computed and the last is
     masked — identical masked sums to ``causal_lm_loss``."""
-    from jax.sharding import PartitionSpec as P
-
-    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_vocab_ce import (
-        fused_vocab_cross_entropy,
-    )
-    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
-        data_axis_names,
-        maybe_current_mesh,
-    )
 
     def loss(apply_fn, params, batch, rngs, train: bool):
         hidden, embedding = model.apply(
@@ -221,26 +249,36 @@ def make_fused_causal_lm_loss(model, block_n: int = 256, block_v: int = 512,
         if "valid" in batch:
             token_valid = token_valid & (batch["valid"][:, None] > 0)
         safe_labels = jnp.maximum(shifted, 0)
-
-        def ce(h, w, lab):
-            n = h.shape[0] * h.shape[1]
-            per_tok, pred = fused_vocab_cross_entropy(
-                h.reshape(n, h.shape[2]), w, lab.reshape(n),
-                block_n=block_n, block_v=block_v, interpret=interpret)
-            return per_tok.reshape(lab.shape), pred.reshape(lab.shape)
-
-        mesh = maybe_current_mesh()
-        batch_axes = data_axis_names()
-        if mesh is not None and any(
-                mesh.shape.get(a, 1) > 1 for a in batch_axes):
-            from jax import shard_map
-            # check_vma=False: pallas_call does not annotate varying-mesh
-            # axes on its outputs, which the default vma check rejects
-            ce = shard_map(ce, mesh=mesh,
-                           in_specs=(P(batch_axes), P(), P(batch_axes)),
-                           out_specs=(P(batch_axes), P(batch_axes)),
-                           check_vma=False)
+        ce = _make_sharded_fused_ce(block_n, block_v, interpret)
         per_tok, pred = ce(hidden, embedding, safe_labels)
+        correct = pred == safe_labels
+        return _masked_sums(per_tok, correct, token_valid)
+
+    return loss
+
+
+def make_fused_seq2seq_loss(model, block_n: int = 256, block_v: int = 512,
+                            interpret: bool | None = None):
+    """``seq2seq_loss`` without the [B, T, V] logits: the encoder-decoder
+    model exposes ``seq2seq_hidden_and_embedding`` (pre-head decoder
+    hidden + LM weight — T5 tied/untied and BART) and the blocked-vocab
+    Pallas kernel computes CE + argmax on chip, shard_mapped per dp
+    shard like the causal path. No label shifting: seq2seq labels align
+    with decoder positions (teacher forcing is in decoder_input_ids)."""
+
+    def loss(apply_fn, params, batch, rngs, train: bool):
+        hidden, weight = model.apply(
+            {"params": params}, batch["input_ids"], batch["attention_mask"],
+            batch["decoder_input_ids"], batch.get("decoder_attention_mask"),
+            deterministic=not train, rngs=rngs,
+            method=model.seq2seq_hidden_and_embedding)       # [B,T,H], [V,H]
+        labels = batch["labels"]
+        token_valid = labels != -100
+        if "valid" in batch:
+            token_valid = token_valid & (batch["valid"][:, None] > 0)
+        safe_labels = jnp.maximum(labels, 0)
+        ce = _make_sharded_fused_ce(block_n, block_v, interpret)
+        per_tok, pred = ce(hidden, weight, safe_labels)
         correct = pred == safe_labels
         return _masked_sums(per_tok, correct, token_valid)
 
@@ -386,12 +424,16 @@ class Trainer:
                 self.loss_fn = make_fused_mlm_loss(
                     model, mask_cap=getattr(config, "fused_mlm_mask_cap",
                                             0.25))
+            elif self.task == "seq2seq" and hasattr(
+                    model, "seq2seq_hidden_and_embedding"):
+                self.loss_fn = make_fused_seq2seq_loss(model)
             else:
                 raise ValueError(
                     "fused_vocab_ce requires task='causal-lm' with a model "
-                    "exposing hidden_and_embedding (GPT-2 family) or "
+                    "exposing hidden_and_embedding (GPT-2 family), "
                     "task='mlm' with a return_fused_inputs-capable MLM "
-                    "model (BERT-family)")
+                    "model (BERT-family), or task='seq2seq' with a model "
+                    "exposing seq2seq_hidden_and_embedding (T5/BART)")
         self.n_chips = world_size(mesh)
         self.dp_size = data_parallel_size(mesh)
         # MoE models sow per-layer load-balance losses into the "losses"
