@@ -251,7 +251,7 @@ _HF_CONFIG_EXPORTERS = {
 # families whose Encoder stack supports per-layer MoE FFNs / pipelining
 # (T5 has its own blocks; ALBERT shares one layer across the stack)
 _MOE_FAMILIES = ("bert", "roberta", "distilbert", "electra")
-_PIPELINE_FAMILIES = _MOE_FAMILIES + ("gpt2",)
+_PIPELINE_FAMILIES = _MOE_FAMILIES + ("gpt2", "t5", "bart", "mbart")
 
 _MOE_CONFIG_KEYS = ("num_experts", "expert_top_k", "moe_every",
                     "expert_capacity_factor", "router_aux_coef")
@@ -384,6 +384,12 @@ def from_pretrained(
                 bb["pipelined_h"] = stack_layer_params(
                     layers, config.num_layers, GPT2_LAYER_LEAVES, "h_{}")
                 loaded = {**loaded, "backbone": bb}
+            elif family in ("t5", "bart", "mbart"):
+                from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                    convert_encdec_stacks,
+                )
+                loaded = convert_encdec_stacks(loaded, family, config,
+                                               to_stacked=True)
         params, missing = merge_into(params, loaded)
         logger.info("loaded %s (%s) — %d fresh head params", model_name_or_path,
                     family, len(missing))
@@ -476,6 +482,12 @@ def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderCo
                 bb.pop("pipelined_h"), config.num_layers,
                 GPT2_LAYER_LEAVES, "h_{}"))
             params = {**params, "backbone": bb}
+        elif family in ("t5", "bart", "mbart"):
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                convert_encdec_stacks,
+            )
+            params = convert_encdec_stacks(params, family, config,
+                                           to_stacked=False)
     state = params_to_hf(params, family)
     state = {k: np.ascontiguousarray(v) for k, v in state.items()}
     from safetensors.numpy import save_file

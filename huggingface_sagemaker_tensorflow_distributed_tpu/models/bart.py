@@ -69,6 +69,10 @@ class BartConfig:
     # mBART variant: pre-LN blocks + a final LayerNorm per stack
     normalize_before: bool = False
     stack_final_ln: bool = False
+    # GPipe pipeline parallelism over both stacks (models/pipeline.py::
+    # PipelinedBartStack): 0 = dense; generation reloads dense
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
 
 
 def bart_config_from_hf(hf_config: dict, **overrides) -> BartConfig:
@@ -305,8 +309,15 @@ class BartForConditionalGeneration(nn.Module):
             cfg.vocab_size, cfg.d_model,
             embedding_init=nn.initializers.normal(cfg.init_std),
             dtype=cfg.dtype, param_dtype=cfg.param_dtype)
-        self.encoder = BartStack(cfg, is_decoder=False)
-        self.decoder = BartStack(cfg, is_decoder=True)
+        if cfg.pipeline_stages:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                PipelinedBartStack,
+            )
+            self.encoder = PipelinedBartStack(cfg, is_decoder=False)
+            self.decoder = PipelinedBartStack(cfg, is_decoder=True)
+        else:
+            self.encoder = BartStack(cfg, is_decoder=False)
+            self.decoder = BartStack(cfg, is_decoder=True)
 
     def _embed_tokens(self, ids):
         cfg = self.config

@@ -74,9 +74,67 @@ def _stacked_name(path: tuple) -> str:
     return "_".join(path[-2:])
 
 
+def full_stacked_name(path: tuple) -> str:
+    """T5 needs the FULL path joined: self_attn and cross_attn share
+    query/key/value/attention_out leaf names, so the two-component name
+    would collide. The ``pipelined_`` prefix keys the sharding rules."""
+    return "pipelined_" + "_".join(path)
+
+
+def t5_layer_leaves(is_decoder: bool, gated: bool) -> tuple:
+    """Per-block leaf paths of ``T5Block`` (bias-free by design; RMS
+    scales only). Decoder blocks add cross-attention."""
+    leaves = [
+        ("attn_ln", "scale"),
+        ("self_attn", "query", "kernel"), ("self_attn", "key", "kernel"),
+        ("self_attn", "value", "kernel"),
+        ("self_attn", "attention_out", "kernel"),
+    ]
+    if is_decoder:
+        leaves += [
+            ("cross_ln", "scale"),
+            ("cross_attn", "query", "kernel"),
+            ("cross_attn", "key", "kernel"),
+            ("cross_attn", "value", "kernel"),
+            ("cross_attn", "attention_out", "kernel"),
+        ]
+    leaves.append(("ffn_ln", "scale"))
+    if gated:
+        leaves += [("ffn", "wi_0", "kernel"), ("ffn", "wi_1", "kernel")]
+    else:
+        leaves.append(("ffn", "wi", "kernel"))
+    leaves.append(("ffn", "wo", "kernel"))
+    return tuple(leaves)
+
+
+def bart_layer_leaves(is_decoder: bool) -> tuple:
+    """Per-layer leaf paths of ``BartEncoderLayer``/``BartDecoderLayer``
+    (biased projections, scale+bias LayerNorms)."""
+    def attn(prefix, ln_name):
+        return [
+            (ln_name, "scale"), (ln_name, "bias"),
+            (prefix, "query", "kernel"), (prefix, "query", "bias"),
+            (prefix, "key", "kernel"), (prefix, "key", "bias"),
+            (prefix, "value", "kernel"), (prefix, "value", "bias"),
+            (prefix, "attention_out", "kernel"),
+            (prefix, "attention_out", "bias"),
+        ]
+
+    leaves = attn("self_attn", "self_attn_ln")
+    if is_decoder:
+        leaves += attn("cross_attn", "cross_ln")
+    leaves += [
+        ("ffn_ln", "scale"), ("ffn_ln", "bias"),
+        ("fc1", "kernel"), ("fc1", "bias"),
+        ("fc2", "kernel"), ("fc2", "bias"),
+    ]
+    return tuple(leaves)
+
+
 def stack_layer_params(layer_params: dict, num_layers: int,
                        leaves: tuple = _LAYER_LEAVES,
-                       layer_fmt: str = "layer_{}") -> dict:
+                       layer_fmt: str = "layer_{}",
+                       name_fn=_stacked_name) -> dict:
     """Per-layer dense params (``layer_{i}/...``) → the stacked flat
     tree the pipelined modules declare (leading dim = num_layers)."""
     out: dict[str, Any] = {}
@@ -87,13 +145,14 @@ def stack_layer_params(layer_params: dict, num_layers: int,
             for key in path:
                 node = node[key]
             stacked.append(np.asarray(node))
-        out[_stacked_name(path)] = np.stack(stacked, axis=0)
+        out[name_fn(path)] = np.stack(stacked, axis=0)
     return out
 
 
 def unstack_layer_params(stacked: dict, num_layers: int,
                          leaves: tuple = _LAYER_LEAVES,
-                         layer_fmt: str = "layer_{}") -> dict:
+                         layer_fmt: str = "layer_{}",
+                         name_fn=_stacked_name) -> dict:
     """Inverse of :func:`stack_layer_params` (for HF-layout export)."""
     out: dict[str, Any] = {}
     for i in range(num_layers):
@@ -102,28 +161,32 @@ def unstack_layer_params(stacked: dict, num_layers: int,
             node = layer
             for key in path[:-1]:
                 node = node.setdefault(key, {})
-            node[path[-1]] = np.asarray(stacked[_stacked_name(path)])[i]
+            node[path[-1]] = np.asarray(stacked[name_fn(path)])[i]
         out[layer_fmt.format(i)] = layer
     return out
 
 
-def _layer_tree(flat: dict, index, leaves: tuple = _LAYER_LEAVES) -> dict:
+def _layer_tree(flat: dict, index, leaves: tuple = _LAYER_LEAVES,
+                name_fn=_stacked_name) -> dict:
     """One layer's block-structured params from the stacked tree."""
     tree: dict[str, Any] = {}
     for path in leaves:
         node = tree
         for key in path[:-1]:
             node = node.setdefault(key, {})
-        node[path[-1]] = flat[_stacked_name(path)][index]
+        node[path[-1]] = flat[name_fn(path)][index]
     return tree
 
 
-def gpipe_schedule(stage_fn, staged, hidden, attn_mask, *, pp: int,
+def gpipe_schedule(stage_fn, staged, hidden, riders, *, pp: int,
                    microbatches: int, deterministic: bool, base_key):
     """The scan/vmap/roll GPipe schedule (module docstring), shared by
-    every pipelined family. ``stage_fn(p_stage, x, m, key) -> x`` applies
-    one stage's layers; ``staged`` is the [pp, lps, ...] param tree;
-    ``attn_mask`` is the additive [B, 1, 1, S] mask (never None here)."""
+    every pipelined family. ``stage_fn(p_stage, x, *riders, key) -> x``
+    applies one stage's layers; ``staged`` is the [pp, lps, ...] param
+    tree; ``riders`` is a tuple of [B, ...] arrays that travel WITH each
+    microbatch through the stages — attention masks, and for
+    encoder-decoder stacks the per-microbatch encoder outputs/masks that
+    cross-attention consumes at every stage."""
     from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
         AXIS_PIPE,
         data_axis_names,
@@ -141,45 +204,123 @@ def gpipe_schedule(stage_fn, staged, hidden, attn_mask, *, pp: int,
     mb = B // M
     batch_axes = data_axis_names()
 
-    x_mb = hidden.reshape(M, mb, S, H)
-    m_mb = attn_mask.reshape(M, mb, 1, 1, attn_mask.shape[-1])
-    pad_x = jnp.zeros((pp - 1, mb, S, H), hidden.dtype)
-    pad_m = jnp.zeros((pp - 1, mb, 1, 1, attn_mask.shape[-1]),
-                      attn_mask.dtype)
-    xs_feed = jnp.concatenate([x_mb, pad_x], axis=0)    # [T, ...]
-    ms_feed = jnp.concatenate([m_mb, pad_m], axis=0)
+    def to_feed(a):
+        # [B, ...] → [M + pp - 1, mb, ...] with zero fill-bubble padding
+        a_mb = a.reshape(M, mb, *a.shape[1:])
+        pad = jnp.zeros((pp - 1, mb, *a.shape[1:]), a.dtype)
+        return jnp.concatenate([a_mb, pad], axis=0)
 
-    state_x = jnp.zeros((pp, mb, S, H), hidden.dtype)
-    state_m = jnp.zeros((pp, mb, 1, 1, attn_mask.shape[-1]),
-                        attn_mask.dtype)
+    def state0(a):
+        return jnp.zeros((pp, mb, *a.shape[1:]), a.dtype)
+
+    feeds = (to_feed(hidden),) + tuple(to_feed(r) for r in riders)
+    states = (state0(hidden),) + tuple(state0(r) for r in riders)
 
     def tick(carry, feed):
-        sx, sm, t = carry
-        in_x, in_m = feed
+        state, t = carry
         # stage 0 ingests the next microbatch; the rolled-in garbage
         # at slot 0 is overwritten
-        sx = sx.at[0].set(in_x)
-        sm = sm.at[0].set(in_m)
+        state = tuple(s.at[0].set(f) for s, f in zip(state, feed))
+        sx, *srs = state
         sx = constrain_if_mesh(sx, AXIS_PIPE, batch_axes)
         if deterministic:
-            out = jax.vmap(lambda p, x, m: stage_fn(p, x, m, None))(
-                staged, sx, sm)
+            out = jax.vmap(lambda p, x, *rs: stage_fn(p, x, *rs, None))(
+                staged, sx, *srs)
         else:
             tick_key = jax.random.fold_in(base_key, t)
             keys = jax.vmap(lambda s: jax.random.fold_in(tick_key, s))(
                 jnp.arange(pp))
-            out = jax.vmap(stage_fn)(staged, sx, sm, keys)
+            out = jax.vmap(stage_fn)(staged, sx, *srs, keys)
         out = constrain_if_mesh(out, AXIS_PIPE, batch_axes)
         y = out[-1]                     # last stage's finished microbatch
-        sx = jnp.roll(out, 1, axis=0)   # stage s → stage s+1
-        sm = jnp.roll(sm, 1, axis=0)
-        return (sx, sm, t + 1), y
+        state = (jnp.roll(out, 1, axis=0),) + tuple(
+            jnp.roll(s, 1, axis=0) for s in srs)  # stage s → stage s+1
+        return (state, t + 1), y
 
-    (_, _, _), ys = jax.lax.scan(
-        tick, (state_x, state_m, jnp.zeros((), jnp.int32)),
-        (xs_feed, ms_feed))
+    (_, _), ys = jax.lax.scan(
+        tick, (states, jnp.zeros((), jnp.int32)), feeds)
     # first pp-1 tick outputs are fill-bubble garbage
     return ys[pp - 1:].reshape(B, S, H)
+
+
+def convert_encdec_stacks(tree: dict, family: str, config,
+                          to_stacked: bool) -> dict:
+    """Per-layer ↔ stacked conversion of BOTH stacks of a pipelined
+    encoder-decoder checkpoint tree (T5: ``block_{i}`` + the block-0
+    rel_bias ↔ stack-level embed move; BART/mBART: ``layer_{i}``). One
+    helper for the four call sites in ``auto.from_pretrained`` /
+    ``auto.save_pretrained`` so the two directions cannot drift."""
+    if family == "t5":
+        stacks = (("encoder", config.num_layers, False),
+                  ("decoder", config.num_decoder_layers, True))
+        layer_fmt = "block_{}"
+
+        def leaves_fn(dec):
+            return t5_layer_leaves(dec, config.is_gated_act)
+        rel_move = True
+    else:
+        stacks = (("encoder", config.encoder_layers, False),
+                  ("decoder", config.decoder_layers, True))
+        layer_fmt = "layer_{}"
+        leaves_fn = bart_layer_leaves
+        rel_move = False
+    prefix = layer_fmt.split("{")[0]
+    tree = dict(tree)
+    for stack, n, dec in stacks:
+        if stack not in tree:
+            continue
+        st = dict(tree[stack])
+        leaves = leaves_fn(dec)
+        if to_stacked:
+            blocks = {k: st.pop(k) for k in list(st)
+                      if k.startswith(prefix)}
+            if rel_move:
+                blk0 = dict(blocks[layer_fmt.format(0)])
+                blk0["self_attn"] = dict(blk0["self_attn"])
+                st["rel_bias"] = blk0["self_attn"].pop("rel_bias")
+                blocks[layer_fmt.format(0)] = blk0
+            st.update(stack_layer_params(blocks, n, leaves, layer_fmt,
+                                         full_stacked_name))
+        else:
+            stacked = {full_stacked_name(p): st.pop(full_stacked_name(p))
+                       for p in leaves}
+            st.update(unstack_layer_params(stacked, n, leaves, layer_fmt,
+                                           full_stacked_name))
+            if rel_move:
+                blk0 = dict(st[layer_fmt.format(0)])
+                blk0["self_attn"] = dict(blk0["self_attn"])
+                blk0["self_attn"]["rel_bias"] = st.pop("rel_bias")
+                st[layer_fmt.format(0)] = blk0
+        tree[stack] = st
+    return tree
+
+
+def _encdec_schedule_inputs(is_decoder: bool, B: int, S: int, attn_mask,
+                            enc_hidden, enc_mask, decode: bool,
+                            family: str):
+    """Shared encoder-decoder schedule plumbing: the loud decode guard,
+    the attn-mask default/broadcast, and the rider assembly (decoder
+    cross-attention inputs travel per microbatch)."""
+    if decode:
+        raise ValueError(
+            "pipeline_stages and incremental decode cannot combine: "
+            "the KV cache is stage-local state. Export the pipelined "
+            "checkpoint and reload it dense (pipeline_stages=0) for "
+            "generation")
+    if attn_mask is None:
+        attn_mask = jnp.zeros((B, 1, 1, S), jnp.float32)
+    attn_mask = jnp.broadcast_to(
+        attn_mask, jnp.broadcast_shapes(attn_mask.shape, (B, 1, 1, S)))
+    riders = [attn_mask]
+    if is_decoder:
+        if enc_hidden is None:
+            raise ValueError(f"pipelined {family} decoder needs enc_hidden")
+        if enc_mask is None:
+            enc_mask = jnp.zeros((B, 1, 1, enc_hidden.shape[1]), jnp.float32)
+        enc_mask = jnp.broadcast_to(enc_mask,
+                                    (B, 1, 1, enc_hidden.shape[1]))
+        riders += [enc_hidden, enc_mask]
+    return tuple(riders)
 
 
 def _check_pipeline_shape(pp: int, num_layers: int) -> int:
@@ -255,9 +396,253 @@ class PipelinedEncoder(nn.Module):
             stage_fn = jax.checkpoint(stage_fn)
 
         return gpipe_schedule(
-            stage_fn, staged, hidden, attn_mask, pp=pp,
+            stage_fn, staged, hidden, (attn_mask,), pp=pp,
             microbatches=cfg.pipeline_microbatches,
             deterministic=deterministic, base_key=base_key)
+
+
+class PipelinedT5Stack(nn.Module):
+    """T5 encoder OR decoder stack under the GPipe schedule — pipeline
+    parallelism for the encoder-decoder family (training/scoring path;
+    generation's KV cache is stage-local state, so decode reloads dense,
+    enforced loudly like ``PipelinedGpt2Stack``).
+
+    The two heterogeneities that kept T5 out of the r3 pipelined matrix
+    are handled structurally:
+
+    - the relative-position bias lives ONLY on block 0 in the dense
+      stack (HF parity) — here its embed is declared at STACK level and
+      the [1, heads, q, k] bias is computed once outside the schedule,
+      then closed over by every stage (it is microbatch-invariant, so it
+      doesn't ride the pipeline). Blocks run ``has_rel_bias=False`` with
+      the bias passed in — bitwise the dense math.
+    - decoder cross-attention consumes per-microbatch encoder outputs —
+      ``enc_hidden``/``enc_mask`` travel as schedule RIDERS alongside
+      the hidden state, so each stage sees the right microbatch's
+      encoder context.
+    """
+
+    config: Any  # T5Config (annotated loosely to avoid a cycle)
+    is_decoder: bool = False
+
+    def _declare_stacked(self, leaves) -> dict:
+        cfg = self.config
+        L = cfg.num_decoder_layers if self.is_decoder else cfg.num_layers
+        H, F = cfg.d_model, cfg.d_ff
+        inner = cfg.num_heads * cfg.d_kv
+        std_in = cfg.initializer_factor * cfg.d_model ** -0.5
+        std_out = cfg.initializer_factor * cfg.d_ff ** -0.5
+        ones = nn.initializers.ones
+        shape_by_leaf = {
+            ("attn_ln", "scale"): ((L, H), ones),
+            ("cross_ln", "scale"): ((L, H), ones),
+            ("ffn_ln", "scale"): ((L, H), ones),
+            ("ffn", "wi", "kernel"): ((L, H, F), nn.initializers.normal(std_in)),
+            ("ffn", "wi_0", "kernel"): ((L, H, F), nn.initializers.normal(std_in)),
+            ("ffn", "wi_1", "kernel"): ((L, H, F), nn.initializers.normal(std_in)),
+            ("ffn", "wo", "kernel"): ((L, F, H), nn.initializers.normal(std_out)),
+        }
+        out = {}
+        for path in leaves:
+            if path in shape_by_leaf:
+                shape, init = shape_by_leaf[path]
+            elif path[-2] == "attention_out":
+                shape, init = (L, inner, H), nn.initializers.normal(std_in)
+            else:  # query/key/value projections
+                shape, init = (L, H, inner), nn.initializers.normal(std_in)
+            name = full_stacked_name(path)
+            out[name] = self.param(name, init, shape, cfg.param_dtype)
+        return out
+
+    @nn.compact
+    def __call__(self, embeds, attn_mask=None, enc_hidden=None,
+                 enc_mask=None, deterministic: bool = True,
+                 decode: bool = False):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+            T5Block,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+            relative_position_bucket,
+        )
+
+        cfg = self.config
+        if cfg.attention_impl == "ring":
+            # the pipelined stack threads a DENSE [1,h,S,S] bias, which
+            # the ring branch would misread as a raw bias table — reject
+            # loudly like the other invalid combos (pp+MoE, flash+sp)
+            raise ValueError(
+                "pipeline_stages cannot combine with attention_impl="
+                "'ring' (sequence parallelism) for T5: scale long "
+                "sequences with sp OR pipeline with pp, not both")
+        pp = cfg.pipeline_stages
+        n_layers = cfg.num_decoder_layers if self.is_decoder else cfg.num_layers
+        lps = _check_pipeline_shape(pp, n_layers)
+        leaves = t5_layer_leaves(self.is_decoder, cfg.is_gated_act)
+
+        hidden = nn.Dropout(cfg.dropout_rate)(embeds,
+                                              deterministic=deterministic)
+        B, S, _ = hidden.shape
+        riders = _encdec_schedule_inputs(
+            self.is_decoder, B, S, attn_mask, enc_hidden, enc_mask,
+            decode, "T5")
+
+        flat = self._declare_stacked(leaves)
+        staged = jax.tree.map(
+            lambda a: a.reshape(pp, lps, *a.shape[1:]), flat)
+
+        # stack-level relative-position bias (same init/name semantics as
+        # T5Attention._rel_bias_embed; conversion moves it from/to the
+        # dense block_0/self_attn/rel_bias) — microbatch-invariant
+        rel = nn.Embed(cfg.relative_attention_num_buckets, cfg.num_heads,
+                       embedding_init=nn.initializers.normal(
+                           cfg.initializer_factor * cfg.d_model ** -0.5),
+                       dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                       name="rel_bias")
+        ctx = jnp.arange(S)[:, None]
+        mem = jnp.arange(S)[None, :]
+        buckets = relative_position_bucket(
+            mem - ctx, bidirectional=not self.is_decoder,
+            num_buckets=cfg.relative_attention_num_buckets,
+            max_distance=cfg.relative_attention_max_distance)
+        position_bias = rel(buckets).transpose(2, 0, 1)[None]
+
+        block = T5Block(cfg, is_decoder=self.is_decoder, has_rel_bias=False)
+        base_key = None if deterministic else self.make_rng("dropout")
+
+        def stage_fn(p_stage, x, *args):
+            *rs, key = args
+            m = rs[0]
+            eh = rs[1] if self.is_decoder else None
+            em = rs[2] if self.is_decoder else None
+            for i in range(lps):
+                p_i = _layer_tree(p_stage, i, leaves, full_stacked_name)
+                if deterministic:
+                    x, _ = block.apply({"params": p_i}, x, m, eh, em,
+                                       position_bias, True, False)
+                else:
+                    x, _ = block.apply(
+                        {"params": p_i}, x, m, eh, em, position_bias,
+                        False, False,
+                        rngs={"dropout": jax.random.fold_in(key, i)})
+            return x
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(stage_fn)
+
+        hidden = gpipe_schedule(
+            stage_fn, staged, hidden, riders, pp=pp,
+            microbatches=cfg.pipeline_microbatches,
+            deterministic=deterministic, base_key=base_key)
+
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+            RMSNorm,
+        )
+        hidden = RMSNorm(cfg, name="final_ln")(hidden)
+        return nn.Dropout(cfg.dropout_rate)(hidden,
+                                            deterministic=deterministic)
+
+
+class PipelinedBartStack(nn.Module):
+    """BART/mBART encoder OR decoder layers under the GPipe schedule.
+    Simpler than T5 (uniform layers, no relative bias): the decoder's
+    cross-attention inputs ride the schedule per microbatch exactly as
+    in ``PipelinedT5Stack``. Embeddings + learned positions + embed_ln
+    (and mBART's per-stack final_ln) stay at stack level; generation's
+    KV cache reloads dense, enforced loudly."""
+
+    config: Any  # BartConfig (annotated loosely to avoid a cycle)
+    is_decoder: bool = False
+
+    def _declare_stacked(self, leaves) -> dict:
+        cfg = self.config
+        L = cfg.decoder_layers if self.is_decoder else cfg.encoder_layers
+        H = cfg.d_model
+        F = cfg.decoder_ffn_dim if self.is_decoder else cfg.encoder_ffn_dim
+        kernel = nn.initializers.normal(cfg.init_std)
+        zeros, ones = nn.initializers.zeros, nn.initializers.ones
+        out = {}
+        for path in leaves:
+            name = full_stacked_name(path)
+            if path[-1] == "scale":
+                shape, init = (L, H), ones
+            elif path[-1] == "bias":
+                if path[0] == "fc1":
+                    shape, init = (L, F), zeros
+                else:
+                    shape, init = (L, H), zeros
+            elif path[0] == "fc1":
+                shape, init = (L, H, F), kernel
+            elif path[0] == "fc2":
+                shape, init = (L, F, H), kernel
+            else:  # attention projections, all [H, H] in BART
+                shape, init = (L, H, H), kernel
+            out[name] = self.param(name, init, shape, cfg.param_dtype)
+        return out
+
+    @nn.compact
+    def __call__(self, embeds, attn_mask=None, enc_hidden=None,
+                 enc_mask=None, deterministic: bool = True,
+                 decode: bool = False):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.bart import (
+            _POS_OFFSET,
+            BartDecoderLayer,
+            BartEncoderLayer,
+            _ln,
+        )
+
+        cfg = self.config
+        pp = cfg.pipeline_stages
+        n_layers = cfg.decoder_layers if self.is_decoder else cfg.encoder_layers
+        lps = _check_pipeline_shape(pp, n_layers)
+        leaves = bart_layer_leaves(self.is_decoder)
+
+        positions = nn.Embed(
+            cfg.max_position_embeddings + _POS_OFFSET, cfg.d_model,
+            embedding_init=nn.initializers.normal(cfg.init_std),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="embed_positions")
+        pos_ids = jnp.arange(embeds.shape[1])[None, :] + _POS_OFFSET
+        hidden = _ln(cfg, "embed_ln")(embeds + positions(pos_ids))
+        hidden = nn.Dropout(cfg.dropout)(hidden, deterministic=deterministic)
+        B, S, _ = hidden.shape
+
+        flat = self._declare_stacked(leaves)
+        staged = jax.tree.map(
+            lambda a: a.reshape(pp, lps, *a.shape[1:]), flat)
+
+        riders = _encdec_schedule_inputs(
+            self.is_decoder, B, S, attn_mask, enc_hidden, enc_mask,
+            decode, "BART")
+        layer = (BartDecoderLayer(cfg) if self.is_decoder
+                 else BartEncoderLayer(cfg))
+        base_key = None if deterministic else self.make_rng("dropout")
+
+        def stage_fn(p_stage, x, *args):
+            *rs, key = args
+            m = rs[0]
+            for i in range(lps):
+                p_i = _layer_tree(p_stage, i, leaves, full_stacked_name)
+                rngs = (None if key is None
+                        else {"dropout": jax.random.fold_in(key, i)})
+                if self.is_decoder:
+                    x = layer.apply({"params": p_i}, x, m, rs[1], rs[2],
+                                    deterministic, False,
+                                    **({"rngs": rngs} if rngs else {}))
+                else:
+                    x = layer.apply({"params": p_i}, x, m, deterministic,
+                                    **({"rngs": rngs} if rngs else {}))
+            return x
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(stage_fn)
+
+        hidden = gpipe_schedule(
+            stage_fn, staged, hidden, riders, pp=pp,
+            microbatches=cfg.pipeline_microbatches,
+            deterministic=deterministic, base_key=base_key)
+        if cfg.stack_final_ln:
+            hidden = _ln(cfg, "final_ln")(hidden)
+        return hidden
 
 
 class PipelinedGpt2Stack(nn.Module):
@@ -326,6 +711,6 @@ class PipelinedGpt2Stack(nn.Module):
             stage_fn = jax.checkpoint(stage_fn)
 
         return gpipe_schedule(
-            stage_fn, staged, hidden, attn_mask, pp=pp,
+            stage_fn, staged, hidden, (attn_mask,), pp=pp,
             microbatches=cfg.pipeline_microbatches,
             deterministic=deterministic, base_key=base_key)

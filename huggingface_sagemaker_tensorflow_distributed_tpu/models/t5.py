@@ -76,6 +76,11 @@ class T5Config:
     # materialize the bias from the same table and run XLA —
     # numerics-identical (tests/test_t5_ring.py).
     attention_impl: str = "xla"
+    # GPipe pipeline parallelism over BOTH stacks (models/pipeline.py::
+    # PipelinedT5Stack): 0 = dense. Training/scoring path; generation
+    # (KV cache) reloads dense like GPT-2's pipelined stack.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
 
     @property
     def is_gated_act(self) -> bool:
@@ -388,8 +393,17 @@ class T5ForConditionalGeneration(nn.Module):
             cfg.vocab_size, cfg.d_model,
             embedding_init=nn.initializers.normal(cfg.initializer_factor),
             dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="shared")
-        self.encoder = T5Stack(cfg, is_decoder=False, name="encoder")
-        self.decoder = T5Stack(cfg, is_decoder=True, name="decoder")
+        if cfg.pipeline_stages:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                PipelinedT5Stack,
+            )
+            self.encoder = PipelinedT5Stack(cfg, is_decoder=False,
+                                            name="encoder")
+            self.decoder = PipelinedT5Stack(cfg, is_decoder=True,
+                                            name="decoder")
+        else:
+            self.encoder = T5Stack(cfg, is_decoder=False, name="encoder")
+            self.decoder = T5Stack(cfg, is_decoder=True, name="decoder")
         if not cfg.tie_word_embeddings:
             self.lm_head = nn.Dense(
                 cfg.vocab_size, use_bias=False, dtype=cfg.dtype,

@@ -62,6 +62,13 @@ _PARAM_RULES: Sequence[tuple[str, tuple]] = (
     (r"pipelined_h/(qkv|fc_in)_kernel$", (AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR)),
     (r"pipelined_h/(attn_out|fc_out)_kernel$", (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
     (r"pipelined_h/", (AXIS_PIPE,)),
+    # pipelined T5/BART stacks (flat ``pipelined_<path>`` leaf names
+    # inside encoder/decoder): stacked [L, ...], stage dim over pipe
+    (r"pipelined_.*(query|key|value|wi|wi_0|wi_1|fc1)_kernel$",
+     (AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR)),
+    (r"pipelined_.*(attention_out|wo|fc2)_kernel$",
+     (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
+    (r"pipelined_", (AXIS_PIPE,)),
     # attention projections: kernel shape (in, out)
     (r"(query|key|value|q_proj|k_proj|v_proj|qkv).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
     (r"(attention_out|out_proj|o_proj|attn_out).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),

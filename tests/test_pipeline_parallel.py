@@ -422,3 +422,339 @@ def test_gpt2_pipelined_decode_raises():
     with pytest.raises(ValueError, match="decode"):
         model.apply({"params": params}, ids, mask, deterministic=True,
                     decode=True, mutable=["cache"])
+
+
+# --- T5 (encoder-decoder) pipeline ---------------------------------------
+
+def _t5_cfg(pp=0, **kw):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import T5Config
+    base = dict(vocab_size=256, d_model=32, d_kv=8, d_ff=64, num_layers=L,
+                num_decoder_layers=L, num_heads=4, dropout_rate=0.0,
+                pipeline_stages=pp)
+    base.update(kw)
+    return T5Config(**base)
+
+
+def _t5_inputs(batch=8, tgt=8):
+    rng = np.random.RandomState(1)
+    ids = jnp.asarray(rng.randint(5, 250, (batch, SEQ)), jnp.int32)
+    mask = jnp.ones((batch, SEQ), jnp.int32)
+    dec = jnp.asarray(rng.randint(5, 250, (batch, tgt)), jnp.int32)
+    dmask = jnp.ones((batch, tgt), jnp.int32)
+    return ids, mask, dec, dmask
+
+
+def _t5_transplant(dense_params, pp_params, gated=False):
+    """Dense T5 params → the pipelined layout (what auto.from_pretrained
+    does through the checkpoint; done in-memory here)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+        t5_layer_leaves,
+        full_stacked_name,
+    )
+    out = jax.tree.map(lambda x: x, pp_params)
+    out["shared"] = dense_params["shared"]
+    if "lm_head" in dense_params:
+        out["lm_head"] = dense_params["lm_head"]
+    for stack, dec in (("encoder", False), ("decoder", True)):
+        blocks = {k: v for k, v in dense_params[stack].items()
+                  if k.startswith("block_")}
+        blk0 = dict(blocks["block_0"])
+        blk0["self_attn"] = dict(blk0["self_attn"])
+        rel = blk0["self_attn"].pop("rel_bias")
+        blocks = dict(blocks, block_0=blk0)
+        stacked = stack_layer_params(blocks, L, t5_layer_leaves(dec, gated),
+                                     "block_{}", full_stacked_name)
+        out[stack] = {
+            **{k: jnp.asarray(v) for k, v in stacked.items()},
+            "rel_bias": rel,
+            "final_ln": dense_params[stack]["final_ln"],
+        }
+    return out
+
+
+def test_t5_pipelined_matches_dense_forward():
+    """Same weights → identical seq2seq logits: the schedule (with
+    cross-attention riders and the stack-level rel bias) is a
+    re-ordering of the dense math."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5ForConditionalGeneration,
+    )
+
+    dense_cfg = _t5_cfg(pp=0)
+    dense = T5ForConditionalGeneration(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+    pp_cfg = _t5_cfg(pp=2, pipeline_microbatches=4)
+    piped = T5ForConditionalGeneration(pp_cfg)
+    pp_params = _t5_transplant(dense_params, init_params(piped, pp_cfg))
+
+    ids, mask, dec, dmask = _t5_inputs()
+    out_dense = dense.apply({"params": dense_params}, ids, mask, dec, dmask,
+                            deterministic=True)
+    out_pp = piped.apply({"params": pp_params}, ids, mask, dec, dmask,
+                         deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=2e-5)
+
+
+def test_t5_pipelined_gated_untied_matches_dense_forward():
+    """The t5-v1.1 shape: gated-gelu FFN (wi_0/wi_1) + untied lm_head."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5ForConditionalGeneration,
+    )
+
+    kw = dict(feed_forward_proj="gated-gelu", tie_word_embeddings=False)
+    dense_cfg = _t5_cfg(pp=0, **kw)
+    dense = T5ForConditionalGeneration(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+    pp_cfg = _t5_cfg(pp=2, **kw)
+    piped = T5ForConditionalGeneration(pp_cfg)
+    pp_params = _t5_transplant(dense_params, init_params(piped, pp_cfg),
+                               gated=True)
+
+    ids, mask, dec, dmask = _t5_inputs()
+    out_dense = dense.apply({"params": dense_params}, ids, mask, dec, dmask,
+                            deterministic=True)
+    out_pp = piped.apply({"params": pp_params}, ids, mask, dec, dmask,
+                         deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=1e-4, rtol=1e-3)
+
+
+def test_t5_pp_mesh_training_matches_single_device(devices8):
+    """dp2 x pp2 x tp2 training of the pipelined T5 == single-device
+    dense training, loss for loss (seq2seq task through the Trainer)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_summarization,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5ForConditionalGeneration,
+    )
+
+    tok = WordHashTokenizer(vocab_size=256)
+    sources, targets = synthetic_summarization(32, seed=5)
+    ds = ArrayDataset.from_seq2seq(tok, sources, targets,
+                                   max_source_length=SEQ,
+                                   max_target_length=8)
+
+    def run(mesh_cfg, devices, pp):
+        mesh = build_mesh(mesh_cfg, devices=devices)
+        model_cfg = _t5_cfg(pp=pp, pipeline_microbatches=4)
+        model = T5ForConditionalGeneration(model_cfg)
+        params = init_params(model, model_cfg, seed=0)
+        if pp:
+            dense_cfg = _t5_cfg(pp=0)
+            dense_params = init_params(
+                T5ForConditionalGeneration(dense_cfg), dense_cfg, seed=0)
+            params = _t5_transplant(dense_params, params)
+        cfg = TrainConfig(task="seq2seq", dtype="float32",
+                          learning_rate=1e-3, scale_lr_by_world_size=False,
+                          log_every_steps=0, rng_impl="threefry",
+                          pp=pp or 1)
+        trainer = Trainer(cfg, model, params, mesh)
+        batcher = ShardedBatcher(ds, 16, mesh, shuffle=False)
+        losses = []
+        for step, batch in enumerate(batcher.global_arrays(0)):
+            if step >= 2:
+                break
+            trainer.state, m = trainer._train_step(trainer.state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    single = run(MeshConfig(), devices8[:1], pp=0)
+    sharded = run(MeshConfig(dp=2, pp=2, tp=2), devices8, pp=2)
+    np.testing.assert_allclose(sharded, single, atol=3e-5)
+
+
+def test_t5_hf_checkpoint_roundtrips_through_pipelined(tmp_path):
+    """dense export → pipelined load → pipelined export → dense load:
+    weights (incl. block 0's rel bias ↔ the stack-level embed) survive
+    the full cycle."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5ForConditionalGeneration,
+    )
+
+    dense_cfg = _t5_cfg()
+    dense = T5ForConditionalGeneration(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+    out = str(tmp_path / "t5-dense")
+    auto_models.save_pretrained(out, dense_params, "t5", dense_cfg)
+
+    model, params, fam, cfg = auto_models.from_pretrained(
+        out, task="seq2seq", pipeline_stages=2, dropout_rate=0.0)
+    assert fam == "t5" and cfg.pipeline_stages == 2
+    np.testing.assert_allclose(
+        np.asarray(params["encoder"]["rel_bias"]["embedding"]),
+        np.asarray(dense_params["encoder"]["block_0"]["self_attn"]
+                   ["rel_bias"]["embedding"]), atol=1e-6)
+    # pipelined logits == dense logits through the checkpoint
+    ids, mask, dec, dmask = _t5_inputs(batch=4)
+    out_dense = dense.apply({"params": dense_params}, ids, mask, dec, dmask,
+                            deterministic=True)
+    out_pp = model.apply({"params": params}, ids, mask, dec, dmask,
+                         deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=2e-5)
+
+    out2 = str(tmp_path / "t5-pp-export")
+    auto_models.save_pretrained(out2, params, "t5", cfg)
+    _, dense2, _, cfg2 = auto_models.from_pretrained(out2, task="seq2seq")
+    assert cfg2.pipeline_stages == 0
+    np.testing.assert_allclose(
+        np.asarray(dense2["decoder"]["block_1"]["cross_attn"]["query"]
+                   ["kernel"]),
+        np.asarray(dense_params["decoder"]["block_1"]["cross_attn"]["query"]
+                   ["kernel"]), atol=1e-6)
+
+
+def test_t5_pipelined_decode_raises():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5ForConditionalGeneration,
+    )
+
+    cfg = _t5_cfg(pp=2)
+    model = T5ForConditionalGeneration(cfg)
+    params = init_params(model, cfg)
+    ids, mask, dec, dmask = _t5_inputs(batch=2)
+    enc = model.apply({"params": params}, ids, mask,
+                      method=model.encode)
+    with pytest.raises(ValueError, match="decode"):
+        model.apply({"params": params}, dec, enc, mask, dmask, True, True,
+                    method=model.decode, mutable=["cache"])
+
+
+# --- BART/mBART (encoder-decoder) pipeline -------------------------------
+
+def _bart_cfg(pp=0, **kw):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bart import (
+        BartConfig,
+    )
+    base = dict(vocab_size=256, d_model=32, encoder_layers=L,
+                decoder_layers=L, encoder_attention_heads=4,
+                decoder_attention_heads=4, encoder_ffn_dim=64,
+                decoder_ffn_dim=64, max_position_embeddings=64,
+                dropout=0.0, attention_dropout=0.0, activation_dropout=0.0,
+                pipeline_stages=pp)
+    base.update(kw)
+    return BartConfig(**base)
+
+
+def _bart_transplant(dense_params, pp_params):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+        bart_layer_leaves,
+        full_stacked_name,
+    )
+    out = jax.tree.map(lambda x: x, pp_params)
+    out["shared"] = dense_params["shared"]
+    for stack, dec in (("encoder", False), ("decoder", True)):
+        blocks = {k: v for k, v in dense_params[stack].items()
+                  if k.startswith("layer_")}
+        stacked = stack_layer_params(blocks, L, bart_layer_leaves(dec),
+                                     "layer_{}", full_stacked_name)
+        keep = {k: v for k, v in dense_params[stack].items()
+                if not k.startswith("layer_")}
+        out[stack] = {**{k: jnp.asarray(v) for k, v in stacked.items()},
+                      **keep}
+    return out
+
+
+@pytest.mark.parametrize("variant", ["bart", "mbart"])
+def test_bart_pipelined_matches_dense_forward(variant):
+    """Same weights → identical logits for post-LN BART and pre-LN
+    mBART (stack final_ln at stack level)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bart import (
+        BartForConditionalGeneration,
+    )
+
+    kw = (dict(normalize_before=True, stack_final_ln=True)
+          if variant == "mbart" else {})
+    dense_cfg = _bart_cfg(pp=0, **kw)
+    dense = BartForConditionalGeneration(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+    pp_cfg = _bart_cfg(pp=2, pipeline_microbatches=4, **kw)
+    piped = BartForConditionalGeneration(pp_cfg)
+    pp_params = _bart_transplant(dense_params, init_params(piped, pp_cfg))
+
+    rng = np.random.RandomState(2)
+    ids = jnp.asarray(rng.randint(5, 250, (8, SEQ)), jnp.int32)
+    mask = jnp.ones((8, SEQ), jnp.int32)
+    dec = jnp.asarray(rng.randint(5, 250, (8, 8)), jnp.int32)
+    dmask = jnp.ones((8, 8), jnp.int32)
+    out_dense = dense.apply({"params": dense_params}, ids, mask, dec, dmask,
+                            deterministic=True)
+    out_pp = piped.apply({"params": pp_params}, ids, mask, dec, dmask,
+                         deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=1e-4, rtol=1e-3)
+
+
+def test_bart_hf_checkpoint_roundtrips_through_pipelined(tmp_path):
+    """dense export → pipelined load → identical logits → pipelined
+    export → dense load with surviving weights."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bart import (
+        BartForConditionalGeneration,
+    )
+
+    dense_cfg = _bart_cfg()
+    dense = BartForConditionalGeneration(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+    out = str(tmp_path / "bart-dense")
+    auto_models.save_pretrained(out, dense_params, "bart", dense_cfg)
+
+    model, params, fam, cfg = auto_models.from_pretrained(
+        out, task="seq2seq", pipeline_stages=2, dropout=0.0,
+        attention_dropout=0.0, activation_dropout=0.0)
+    assert fam == "bart" and cfg.pipeline_stages == 2
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(rng.randint(5, 250, (4, SEQ)), jnp.int32)
+    mask = jnp.ones((4, SEQ), jnp.int32)
+    dec = jnp.asarray(rng.randint(5, 250, (4, 8)), jnp.int32)
+    dmask = jnp.ones((4, 8), jnp.int32)
+    out_dense = dense.apply({"params": dense_params}, ids, mask, dec, dmask,
+                            deterministic=True)
+    out_pp = model.apply({"params": params}, ids, mask, dec, dmask,
+                         deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=1e-4, rtol=1e-3)
+
+    out2 = str(tmp_path / "bart-pp-export")
+    auto_models.save_pretrained(out2, params, "bart", cfg)
+    _, dense2, _, cfg2 = auto_models.from_pretrained(out2, task="seq2seq")
+    assert cfg2.pipeline_stages == 0
+    np.testing.assert_allclose(
+        np.asarray(dense2["decoder"]["layer_1"]["cross_attn"]["query"]
+                   ["kernel"]),
+        np.asarray(dense_params["decoder"]["layer_1"]["cross_attn"]["query"]
+                   ["kernel"]), atol=1e-6)
+
+
+def test_bart_pipelined_decode_raises():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bart import (
+        BartForConditionalGeneration,
+    )
+
+    cfg = _bart_cfg(pp=2)
+    model = BartForConditionalGeneration(cfg)
+    params = init_params(model, cfg)
+    rng = np.random.RandomState(4)
+    ids = jnp.asarray(rng.randint(5, 250, (2, SEQ)), jnp.int32)
+    mask = jnp.ones((2, SEQ), jnp.int32)
+    dec = jnp.asarray(rng.randint(5, 250, (2, 4)), jnp.int32)
+    enc = model.apply({"params": params}, ids, mask, method=model.encode)
+    with pytest.raises(ValueError, match="decode"):
+        model.apply({"params": params}, dec, enc, mask, None, True, True,
+                    method=model.decode, mutable=["cache"])
+
+
+def test_t5_pipelined_rejects_ring_attention():
+    """pp + sp (ring) is an invalid combo for T5: the pipelined stack
+    threads a dense bias the ring path would misread — reject loudly."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5ForConditionalGeneration,
+    )
+
+    cfg = _t5_cfg(pp=2, attention_impl="ring")
+    model = T5ForConditionalGeneration(cfg)
+    with pytest.raises(ValueError, match="ring"):
+        init_params(model, cfg)
