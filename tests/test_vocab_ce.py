@@ -306,3 +306,66 @@ def test_fused_mlm_training_matches_unfused(devices8):
     for hs in (32, 128):
         np.testing.assert_allclose(run(True, hs), run(False, hs), rtol=2e-5,
                                    err_msg=f"hidden_size={hs}")
+
+
+def test_fused_seq2seq_composes_with_pipelined_t5(devices8):
+    """The two r4 features compose: a PIPELINED T5 under
+    --fused_vocab_ce trains with the same loss sequence as the pipelined
+    model under the unfused full-logits loss (the fused path calls
+    seq2seq_hidden_and_embedding, which routes through the pipelined
+    decoder and its schedule riders)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_summarization,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5Config,
+        T5ForConditionalGeneration,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+    src_len, tgt_len = 16, 8
+    tok = WordHashTokenizer(vocab_size=256)
+    sources, targets = synthetic_summarization(32, seed=6)
+    ds = ArrayDataset.from_seq2seq(tok, sources, targets,
+                                   max_source_length=src_len,
+                                   max_target_length=tgt_len)
+
+    def run(fused):
+        mesh = build_mesh(MeshConfig(dp=-1, pp=2), devices=jax.devices())
+        model_cfg = T5Config(vocab_size=256, d_model=128, d_kv=32,
+                             d_ff=256, num_layers=2, num_decoder_layers=2,
+                             num_heads=4, dropout_rate=0.0,
+                             pipeline_stages=2, pipeline_microbatches=4)
+        model = T5ForConditionalGeneration(model_cfg)
+        params = init_params(model, model_cfg, seed=0)
+        cfg = TrainConfig(task="seq2seq", dtype="float32",
+                          learning_rate=1e-3, scale_lr_by_world_size=False,
+                          log_every_steps=0, fused_vocab_ce=fused,
+                          rng_impl="threefry", pp=2)
+        trainer = Trainer(cfg, model, params, mesh)
+        if fused:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+                make_fused_seq2seq_loss,
+            )
+            trainer.loss_fn = make_fused_seq2seq_loss(model, interpret=True)
+        batcher = ShardedBatcher(ds, 16, mesh, shuffle=False)
+        losses = []
+        for step, batch in enumerate(batcher.global_arrays(0)):
+            if step >= 2:
+                break
+            trainer.state, m = trainer._train_step(trainer.state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5)
