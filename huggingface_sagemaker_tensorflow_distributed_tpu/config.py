@@ -88,6 +88,9 @@ class TrainConfig:
     # pin MLM masks to the seed draw for every epoch (pre-r4 behavior;
     # ablation knob — default re-draws per epoch like HF's collator)
     mlm_static_masking: bool = False
+    # causal-lm pretraining: pack documents EOS-joined into completely
+    # full rows (zero pad waste — every MXU cycle on real tokens)
+    packed_sequences: bool = False
     from_scratch: bool = False     # random init instead of pretrained weights
 
     # --- data ---
@@ -252,6 +255,16 @@ class TrainConfig:
                 "optimizer_state_dtype='bfloat16' supports adam/adamw only "
                 "(adafactor is already sublinear-memory; lamb's trust "
                 "ratio is untested with quantized moments)")
+        if self.packed_sequences and self.task != "causal-lm":
+            raise ValueError(
+                "packed_sequences is a causal-lm pretraining layout "
+                "(EOS-joined documents chunked into full rows); other "
+                "tasks need per-example boundaries")
+        if self.packed_sequences and self.streaming:
+            raise ValueError(
+                "packed_sequences does not combine with --streaming "
+                "(the streaming tier tokenizes rows independently; "
+                "packing needs the whole token stream) — pick one")
         if self.optimizer == "adafactor" and self.weight_decay > 0:
             raise ValueError(
                 "weight_decay with adafactor is not supported: optax "

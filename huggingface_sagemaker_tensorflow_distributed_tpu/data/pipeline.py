@@ -240,15 +240,62 @@ class ArrayDataset:
         return cls({"input_ids": ids, "attention_mask": am, "labels": labels})
 
     @classmethod
-    def from_lm_texts(cls, tokenizer, texts, max_length: int = 512) -> "ArrayDataset":
+    def from_lm_texts(cls, tokenizer, texts, max_length: int = 512,
+                      packed: bool = False,
+                      eos_token_id: Optional[int] = None) -> "ArrayDataset":
         """Causal-LM corpus: labels are the input ids themselves (the
-        trainer's causal-lm loss shifts them); pad positions get -100."""
-        enc = tokenizer(texts, truncation=True, padding="max_length",
-                        max_length=max_length)
-        ids = np.asarray(enc["input_ids"], np.int32)
-        mask = np.asarray(enc["attention_mask"], np.int32)
-        labels = np.where(mask > 0, ids, -100).astype(np.int32)
-        return cls({"input_ids": ids, "attention_mask": mask, "labels": labels})
+        trainer's causal-lm loss shifts them); pad positions get -100.
+
+        ``packed=True`` is the TPU pretraining layout: documents are
+        tokenized without padding, joined by EOS, and chunked into
+        completely-full ``max_length`` rows — zero pad waste, so every
+        MXU cycle trains on real tokens (GPT-2-style packing; documents
+        attend across boundaries, the standard trade). The tail chunk
+        that would need padding is dropped."""
+        if not packed:
+            enc = tokenizer(texts, truncation=True, padding="max_length",
+                            max_length=max_length)
+            ids = np.asarray(enc["input_ids"], np.int32)
+            mask = np.asarray(enc["attention_mask"], np.int32)
+            labels = np.where(mask > 0, ids, -100).astype(np.int32)
+            return cls({"input_ids": ids, "attention_mask": mask,
+                        "labels": labels})
+        if eos_token_id is None:
+            eos_token_id = getattr(tokenizer, "eos_token_id", None)
+        if eos_token_id is None:
+            eos_token_id = getattr(tokenizer, "sep_token_id", None)
+        if eos_token_id is None:
+            raise ValueError(
+                "packed=True joins documents with EOS, but the tokenizer "
+                "has neither eos_token_id nor sep_token_id — pass "
+                "eos_token_id explicitly")
+        vocab = getattr(tokenizer, "vocab_size", None)
+        if vocab is not None and not 0 <= int(eos_token_id) < int(vocab):
+            raise ValueError(
+                f"packed=True separator id {eos_token_id} is outside the "
+                f"tokenizer vocab ({vocab}): the model would embed an "
+                "out-of-range id every document boundary (a config.json "
+                "with the default GPT-2 eos 50256 on a small-vocab test "
+                "model is the usual culprit) — pass a valid eos_token_id")
+        # one batched call (longest + no truncation: every row at its
+        # natural length), then mask-filter per row
+        enc = tokenizer(list(texts), truncation=False, padding="longest",
+                        max_length=1 << 20, add_special_tokens=False)
+        all_ids = np.asarray(enc["input_ids"])
+        all_mask = np.asarray(enc["attention_mask"]) > 0
+        stream: list[int] = []
+        for r in range(all_ids.shape[0]):
+            stream.extend(all_ids[r][all_mask[r]].tolist())
+            stream.append(int(eos_token_id))
+        n_rows = len(stream) // max_length
+        if n_rows == 0:
+            raise ValueError(
+                f"packed corpus shorter than one {max_length}-token row")
+        ids = np.asarray(stream[: n_rows * max_length],
+                         np.int32).reshape(n_rows, max_length)
+        mask = np.ones_like(ids)
+        return cls({"input_ids": ids, "attention_mask": mask,
+                    "labels": ids.copy()})
 
     @classmethod
     def from_token_classification(cls, tokenizer, sentences, word_tags,

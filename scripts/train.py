@@ -133,7 +133,10 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
         # any text source works as an LM corpus; classification labels
         # are simply ignored
         texts, _ = load_text_classification(config.dataset, split, **kw)
-        return ArrayDataset.from_lm_texts(tokenizer, texts, max_len)
+        return ArrayDataset.from_lm_texts(
+            tokenizer, texts, max_len,
+            packed=config.packed_sequences,
+            eos_token_id=getattr(model_config, "eos_token_id", None))
     if config.task == "mlm":
         texts, _ = load_text_classification(config.dataset, split, **kw)
         return ArrayDataset.from_mlm_texts(

@@ -278,3 +278,59 @@ def test_vendored_reviews_loads():
         assert set(labels) == {0, 1}
         assert sum(labels) == n // 2
         assert all("." in t and len(t.split()) >= 8 for t in texts[:50])
+
+
+def test_packed_lm_corpus_zero_padding():
+    """packed=True: EOS-joined documents chunked into completely full
+    rows — zero pad tokens, token stream preserved in order, tail
+    dropped. The TPU pretraining layout (every MXU cycle on real
+    tokens vs ~50% pad at IMDb-like lengths)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        WordHashTokenizer,
+    )
+
+    tok = WordHashTokenizer(vocab_size=512)
+    texts = [f"doc {i} " + "word " * (5 + i % 7) for i in range(40)]
+    L = 32
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=L, packed=True,
+                                    eos_token_id=3)
+    ids = ds.columns["input_ids"]
+    am = ds.columns["attention_mask"]
+    labels = ds.columns["labels"]
+    assert ids.shape[1] == L and ids.shape[0] >= 2
+    # ZERO padding anywhere
+    assert am.all() and (labels != -100).all()
+    np.testing.assert_array_equal(labels, ids)
+    # the flat stream equals the per-doc tokenization joined by EOS
+    want = []
+    for t in texts:
+        enc = tok([t], truncation=False, padding="longest",
+                  add_special_tokens=False)
+        m = np.asarray(enc["attention_mask"][0]) > 0
+        want.extend(int(x) for x in np.asarray(enc["input_ids"][0])[m])
+        want.append(3)
+    np.testing.assert_array_equal(ids.reshape(-1),
+                                  np.asarray(want[: ids.size], np.int32))
+    # unpacked comparison: same corpus wastes most positions on padding
+    dense = ArrayDataset.from_lm_texts(tok, texts, max_length=L)
+    pad_frac = 1.0 - dense.columns["attention_mask"].mean()
+    assert pad_frac > 0.4
+
+
+def test_packed_corpus_too_small_raises():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        WordHashTokenizer,
+    )
+
+    tok = WordHashTokenizer(vocab_size=512)
+    with pytest.raises(ValueError, match="packed"):
+        ArrayDataset.from_lm_texts(tok, ["two words"], max_length=512,
+                                   packed=True, eos_token_id=3)
+    # an out-of-vocab separator (e.g. GPT-2's default eos 50256 on a
+    # small-vocab test config) must fail loudly, not train to NaN
+    with pytest.raises(ValueError, match="outside the"):
+        ArrayDataset.from_lm_texts(tok, ["some words here"] * 20,
+                                   max_length=16, packed=True,
+                                   eos_token_id=50256)
