@@ -265,6 +265,11 @@ class TrainConfig:
                 "packed_sequences does not combine with --streaming "
                 "(the streaming tier tokenizes rows independently; "
                 "packing needs the whole token stream) — pick one")
+        if self.streaming and self.span_corruption:
+            raise ValueError(
+                "--streaming does not implement span corruption (the "
+                "streaming seq2seq tier encodes supervised source/target "
+                "rows); drop --streaming for span-corruption pretraining")
         if self.optimizer == "adafactor" and self.weight_decay > 0:
             raise ValueError(
                 "weight_decay with adafactor is not supported: optax "

@@ -270,6 +270,12 @@ class ArrayDataset:
                 "has neither eos_token_id nor sep_token_id — pass "
                 "eos_token_id explicitly")
         vocab = getattr(tokenizer, "vocab_size", None)
+        try:
+            # HF vocab_size excludes ADDED tokens (a post-training eos is
+            # legal); len(tokenizer) is the total when exposed
+            vocab = max(int(vocab), len(tokenizer))
+        except TypeError:
+            pass
         if vocab is not None and not 0 <= int(eos_token_id) < int(vocab):
             raise ValueError(
                 f"packed=True separator id {eos_token_id} is outside the "
@@ -277,16 +283,20 @@ class ArrayDataset:
                 "out-of-range id every document boundary (a config.json "
                 "with the default GPT-2 eos 50256 on a small-vocab test "
                 "model is the usual culprit) — pass a valid eos_token_id")
-        # one batched call (longest + no truncation: every row at its
-        # natural length), then mask-filter per row
-        enc = tokenizer(list(texts), truncation=False, padding="longest",
-                        max_length=1 << 20, add_special_tokens=False)
-        all_ids = np.asarray(enc["input_ids"])
-        all_mask = np.asarray(enc["attention_mask"]) > 0
+        # chunked batched tokenization (longest + no truncation): each
+        # chunk pads only to its own longest row, so peak memory stays
+        # O(total tokens) even with one outlier-length document
         stream: list[int] = []
-        for r in range(all_ids.shape[0]):
-            stream.extend(all_ids[r][all_mask[r]].tolist())
-            stream.append(int(eos_token_id))
+        texts = list(texts)
+        for lo in range(0, len(texts), 1024):
+            enc = tokenizer(texts[lo: lo + 1024], truncation=False,
+                            padding="longest", max_length=1 << 20,
+                            add_special_tokens=False)
+            all_ids = np.asarray(enc["input_ids"])
+            all_mask = np.asarray(enc["attention_mask"]) > 0
+            for r in range(all_ids.shape[0]):
+                stream.extend(all_ids[r][all_mask[r]].tolist())
+                stream.append(int(eos_token_id))
         n_rows = len(stream) // max_length
         if n_rows == 0:
             raise ValueError(

@@ -67,27 +67,40 @@ class LineCorpus:
     def __len__(self) -> int:
         return len(self._offsets) - 1
 
-    def read_rows(self, idx: np.ndarray) -> tuple[list[str], Optional[list[int]]]:
-        """Texts (and labels for jsonl rows that carry them) for ``idx``,
-        in ``idx`` order. Reads happen in file order for seek locality."""
+    def _read_lines(self, idx: np.ndarray) -> list[str]:
+        """Raw decoded lines for ``idx``, in ``idx`` order (the ONE
+        seek/read/decode path — reads happen in file order for seek
+        locality)."""
         order = np.argsort(idx, kind="stable")
-        texts: list[Optional[str]] = [None] * len(idx)
-        labels: list[Optional[int]] = [None] * len(idx)
-        any_label = False
+        out: list[Optional[str]] = [None] * len(idx)
         with open(self.path, "rb") as f:
             for j in order:
                 r = int(idx[j])
                 f.seek(self._offsets[r])
                 raw = f.read(int(self._offsets[r + 1] - self._offsets[r]))
-                line = raw.decode("utf-8").rstrip("\r\n")
-                if self._jsonl:
-                    rec = json.loads(line)
-                    texts[j] = rec[self.text_key]
-                    if self.label_key in rec:
-                        labels[j] = int(rec[self.label_key])
-                        any_label = True
-                else:
-                    texts[j] = line
+                out[j] = raw.decode("utf-8").rstrip("\r\n")
+        return out
+
+    def read_records(self, idx: np.ndarray) -> list[dict]:
+        """Raw jsonl records for ``idx``, in ``idx`` order (jsonl files
+        only — .txt lines carry no fields)."""
+        if not self._jsonl:
+            raise ValueError("read_records needs a .jsonl corpus")
+        return [json.loads(line) for line in self._read_lines(idx)]
+
+    def read_rows(self, idx: np.ndarray) -> tuple[list[str], Optional[list[int]]]:
+        """Texts (and labels for jsonl rows that carry them) for ``idx``,
+        in ``idx`` order."""
+        if not self._jsonl:
+            return self._read_lines(idx), None
+        texts: list[Optional[str]] = [None] * len(idx)
+        labels: list[Optional[int]] = [None] * len(idx)
+        any_label = False
+        for j, rec in enumerate(self.read_records(idx)):
+            texts[j] = rec[self.text_key]
+            if self.label_key in rec:
+                labels[j] = int(rec[self.label_key])
+                any_label = True
         return texts, (labels if any_label else None)
 
 
@@ -105,10 +118,12 @@ class StreamingTextDataset:
     def __init__(self, corpus: LineCorpus, tokenizer, task: str = "mlm",
                  max_length: int = 512, mlm_probability: float = 0.15,
                  whole_word: bool = True, seed: int = 0,
-                 num_labels: Optional[int] = None):
-        if task not in ("mlm", "causal-lm", "seq-cls"):
+                 num_labels: Optional[int] = None,
+                 seq2seq_kwargs: Optional[dict] = None):
+        if task not in ("mlm", "causal-lm", "seq-cls", "seq2seq"):
             raise ValueError(
-                f"streaming tier supports mlm/causal-lm/seq-cls, got {task!r}")
+                "streaming tier supports mlm/causal-lm/seq-cls/seq2seq, "
+                f"got {task!r}")
         if task == "mlm" and getattr(tokenizer, "mask_token_id", None) is None:
             raise ValueError("tokenizer has no [MASK] token — MLM needs one")
         self.corpus = corpus
@@ -119,6 +134,9 @@ class StreamingTextDataset:
         self.whole_word = whole_word
         self.seed = seed
         self.num_labels = num_labels
+        # from_seq2seq pass-through (max_target_length,
+        # decoder_start_token_id, pad/eos ids, source_key/target_key)
+        self.seq2seq_kwargs = dict(seq2seq_kwargs or {})
         self._epoch = 0
 
     def __len__(self) -> int:
@@ -136,6 +154,20 @@ class StreamingTextDataset:
     def __getitem__(self, idx) -> dict[str, np.ndarray]:
         if not isinstance(idx, np.ndarray):
             idx = np.atleast_1d(np.asarray(idx, np.int64))
+        if self.task == "seq2seq":
+            # per-batch encode through the SAME builder the materialized
+            # tier uses — bit-identical columns by construction
+            from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (
+                ArrayDataset,
+            )
+            kw = dict(self.seq2seq_kwargs)
+            src_key = kw.pop("source_key", "source")
+            tgt_key = kw.pop("target_key", "target")
+            records = self.corpus.read_records(idx)
+            return ArrayDataset.from_seq2seq(
+                self.tokenizer, [r[src_key] for r in records],
+                [r[tgt_key] for r in records],
+                max_source_length=self.max_length, **kw).columns
         texts, labels = self.corpus.read_rows(idx)
         if self.task == "seq-cls":
             if labels is None:

@@ -69,7 +69,7 @@ def _check_num_labels(labels, num_labels: int, task: str) -> None:
 
 
 def build_streaming_dataset(config: TrainConfig, tokenizer, split: str,
-                            max_len: int, max_samples):
+                            max_len: int, max_samples, model_config=None):
     """--streaming true: corpus stays on disk, tokenized per batch
     (fixes the reference's materialize-everything quirk, reference
     ``scripts/train.py:80-83``). Sources: ``dataset_path/{split}.jsonl``
@@ -91,28 +91,46 @@ def build_streaming_dataset(config: TrainConfig, tokenizer, split: str,
         import tempfile
 
         n = max_samples or 2000
-        path = os.path.join(tempfile.gettempdir(),
-                            f"stream_synth_{split}_{n}_{config.seed}.jsonl")
+        path = os.path.join(
+            tempfile.gettempdir(),
+            f"stream_synth_{config.task}_{split}_{n}_{config.seed}.jsonl")
         if not os.path.exists(path):
-            texts, labels = load_text_classification(
-                "synthetic", split, max_samples=n, seed=config.seed)
+            if config.task == "seq2seq":
+                sources, targets = load_seq2seq(
+                    "synthetic", split, max_samples=n, seed=config.seed)
+                rows = [{"source": s, "target": t}
+                        for s, t in zip(sources, targets)]
+            else:
+                texts, labels = load_text_classification(
+                    "synthetic", split, max_samples=n, seed=config.seed)
+                rows = [{"text": t, "label": l}
+                        for t, l in zip(texts, labels)]
             # per-process unique tmp + atomic replace: multiple local
             # hosts may race to build the same (deterministic) cache file
             fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
                                        suffix=".tmp")
             with os.fdopen(fd, "w") as f:
-                for t, l in zip(texts, labels):
-                    f.write(_json.dumps({"text": t, "label": l}) + "\n")
+                for rec in rows:
+                    f.write(_json.dumps(rec) + "\n")
             os.replace(tmp, path)
     else:
         raise ValueError(
             "--streaming needs --dataset_path (train.jsonl/.txt) or "
             "--dataset synthetic")
     corpus = LineCorpus(path, max_rows=max_samples)
+    seq2seq_kwargs = None
+    if config.task == "seq2seq":
+        seq2seq_kwargs = dict(
+            max_target_length=config.max_target_length,
+            decoder_start_token_id=getattr(model_config,
+                                           "decoder_start_token_id", 0),
+            pad_token_id=getattr(model_config, "pad_token_id", 0),
+            eos_token_id=getattr(model_config, "eos_token_id", 1))
     return StreamingTextDataset(corpus, tokenizer, task=config.task,
                                 max_length=max_len, seed=config.seed,
                                 num_labels=config.num_labels
-                                if config.task == "seq-cls" else None)
+                                if config.task == "seq-cls" else None,
+                                seq2seq_kwargs=seq2seq_kwargs)
 
 
 def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
@@ -124,7 +142,7 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
               seed=config.seed)
     if config.streaming and split == "train":
         return build_streaming_dataset(config, tokenizer, split, max_len,
-                                       max_samples)
+                                       max_samples, model_config)
     if config.task == "seq-cls":
         texts, labels = load_text_classification(config.dataset, split, **kw)
         _check_num_labels(labels, config.num_labels, config.task)

@@ -192,3 +192,31 @@ def test_streaming_cli_mlm(tmp_path, devices8):
     ])
     text = (tmp_path / "out" / "train_results.txt").read_text()
     assert "train_runtime" in text and "loss" in text
+
+
+def test_streaming_seq2seq_matches_materialized(tmp_path):
+    """seq2seq streaming encodes each batch through the SAME from_seq2seq
+    builder — bit-identical columns to the materialized dataset."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_summarization,
+    )
+
+    sources, targets = synthetic_summarization(32, seed=2)
+    path = tmp_path / "train.jsonl"
+    with open(path, "w") as f:
+        for s, t in zip(sources, targets):
+            f.write(json.dumps({"source": s, "target": t}) + "\n")
+    tok = WordHashTokenizer(vocab_size=512)
+    kw = dict(max_target_length=12, decoder_start_token_id=0,
+              pad_token_id=0, eos_token_id=1)
+    mat = ArrayDataset.from_seq2seq(tok, sources, targets,
+                                    max_source_length=SEQ, **kw)
+    stream = StreamingTextDataset(LineCorpus(str(path)), tok,
+                                  task="seq2seq", max_length=SEQ,
+                                  seq2seq_kwargs=kw)
+    assert len(stream) == len(mat)
+    idx = np.array([5, 0, 31, 17])
+    a, b = mat[idx], stream[idx]
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
