@@ -45,24 +45,33 @@ class LineCorpus:
 
     def __init__(self, path: str, text_key: str = "text",
                  label_key: str = "label", max_rows: Optional[int] = None):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.data.native import (
+            native_line_boundaries,
+        )
+
         self.path = path
         self.text_key = text_key
         self.label_key = label_key
         self._jsonl = path.endswith((".jsonl", ".json"))
-        offsets = [0]
-        with open(path, "rb") as f:
-            for line in f:
-                offsets.append(offsets[-1] + len(line))
-        # drop a trailing empty line's phantom record (LF or CRLF)
-        n = len(offsets) - 1
-        if n and offsets[-1] - offsets[-2] <= 2:
+        boundaries = native_line_boundaries(path)
+        if boundaries is None:
+            # no native toolchain: the Python line loop builds the
+            # identical index (test-enforced)
+            offsets = [0]
             with open(path, "rb") as f:
-                f.seek(offsets[-2])
+                for line in f:
+                    offsets.append(offsets[-1] + len(line))
+            boundaries = np.asarray(offsets, np.int64)
+        # drop a trailing empty line's phantom record (LF or CRLF)
+        n = len(boundaries) - 1
+        if n and boundaries[-1] - boundaries[-2] <= 2:
+            with open(path, "rb") as f:
+                f.seek(int(boundaries[-2]))
                 if not f.readline().strip():
                     n -= 1
         if max_rows is not None:
             n = min(n, max_rows)
-        self._offsets = np.asarray(offsets[: n + 1], np.int64)
+        self._offsets = np.asarray(boundaries[: n + 1], np.int64)
 
     def __len__(self) -> int:
         return len(self._offsets) - 1
@@ -126,6 +135,11 @@ class StreamingTextDataset:
                 f"got {task!r}")
         if task == "mlm" and getattr(tokenizer, "mask_token_id", None) is None:
             raise ValueError("tokenizer has no [MASK] token — MLM needs one")
+        if task == "seq2seq" and not corpus._jsonl:
+            raise ValueError(
+                "seq2seq streaming needs a .jsonl corpus with "
+                "source/target fields (.txt lines carry no fields) — "
+                "failing now beats a KeyError at the first batch")
         self.corpus = corpus
         self.tokenizer = tokenizer
         self.task = task

@@ -22,9 +22,14 @@
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <thread>
 #include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
 namespace {
 
@@ -96,6 +101,68 @@ void dl_row_lengths(const int32_t* mask, int64_t n_rows, int64_t row_elems,
     threads.emplace_back(work, lo, hi);
   }
   for (auto& th : threads) th.join();
+}
+
+// Newline index of a text/jsonl corpus: parallel memchr scan with pread
+// (no shared file position), used by the streaming tier's LineCorpus to
+// build its line-offset index at disk bandwidth instead of a Python
+// line loop. Returns the newline count; when out != null, fills up to
+// cap sorted byte positions (a caller seeing count > cap re-calls with
+// an exact buffer — one scan in the common generous-guess case).
+// Returns -1 when the file cannot be opened/stat'd.
+int64_t dl_line_index(const char* path, int64_t* out, int64_t cap,
+                      int32_t n_threads) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -1; }
+  const int64_t size = (int64_t)st.st_size;
+  if (size == 0) { close(fd); return 0; }
+  if (n_threads < 1) n_threads = 1;
+  n_threads = (int32_t)std::min<int64_t>(n_threads, (size + (1 << 20) - 1) >> 20);
+  if (n_threads < 1) n_threads = 1;
+  std::vector<std::vector<int64_t>> found((size_t)n_threads);
+  std::atomic<bool> io_error{false};
+  int64_t chunk = (size + n_threads - 1) / n_threads;
+  auto scan = [&](int32_t t) {
+    int64_t lo = (int64_t)t * chunk, hi = std::min<int64_t>(lo + chunk, size);
+    std::vector<char> buf((size_t)std::min<int64_t>(hi - lo, 4 << 20));
+    int64_t pos = lo;
+    while (pos < hi) {
+      int64_t want = std::min<int64_t>((int64_t)buf.size(), hi - pos);
+      int64_t got = pread(fd, buf.data(), (size_t)want, (off_t)pos);
+      if (got <= 0) { io_error.store(true); return; }
+      const char* p = buf.data();
+      const char* end = p + got;
+      while ((p = (const char*)memchr(p, '\n', (size_t)(end - p)))) {
+        found[(size_t)t].push_back(pos + (p - buf.data()));
+        p++;
+      }
+      pos += got;
+    }
+  };
+  if (n_threads == 1) {
+    scan(0);
+  } else {
+    std::vector<std::thread> threads;
+    for (int32_t t = 0; t < n_threads; t++) threads.emplace_back(scan, t);
+    for (auto& th : threads) th.join();
+  }
+  close(fd);
+  if (io_error.load()) return -1;
+  int64_t total = 0;
+  for (auto& v : found) total += (int64_t)v.size();
+  if (out) {
+    int64_t k = 0;
+    for (auto& v : found) {             // threads cover ascending ranges
+      for (int64_t p : v) {
+        if (k >= cap) break;
+        out[k++] = p;
+      }
+      if (k >= cap) break;
+    }
+  }
+  return total;
 }
 
 }  // extern "C"

@@ -163,6 +163,16 @@ def test_streaming_rejects_buckets_and_bad_tasks(corpus_file):
         StreamingTextDataset(LineCorpus(path), tok, task="qa")
 
 
+def test_streaming_seq2seq_rejects_txt_corpus(tmp_path):
+    """A .txt corpus has no source/target fields: fail at construction,
+    not minutes later at the first batch."""
+    p = tmp_path / "c.txt"
+    p.write_text("one\ntwo\n")
+    tok = WordHashTokenizer(vocab_size=512)
+    with pytest.raises(ValueError, match="jsonl"):
+        StreamingTextDataset(LineCorpus(str(p)), tok, task="seq2seq")
+
+
 def test_streaming_cli_mlm(tmp_path, devices8):
     """scripts/train.py --streaming true trains MLM end to end from a
     disk corpus and writes the same results contract."""
@@ -220,3 +230,31 @@ def test_streaming_seq2seq_matches_materialized(tmp_path):
     assert set(a) == set(b)
     for k in a:
         np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_native_line_boundaries_matches_python(tmp_path):
+    """The C++ pread+memchr indexer and the Python line loop build the
+    IDENTICAL boundary array — with and without a trailing newline, and
+    with CRLF rows (skips when no toolchain: the fallback IS the loop)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.native import (
+        native_line_boundaries,
+    )
+
+    cases = {
+        "lf.jsonl": b'{"text": "a"}\n{"text": "bb"}\n{"text": "ccc"}\n',
+        "no_trail.txt": b"alpha\nbeta\ngamma",
+        "crlf.txt": b"one\r\ntwo\r\nthree\r\n",
+        "empty.txt": b"",
+    }
+    for name, payload in cases.items():
+        p = tmp_path / name
+        p.write_bytes(payload)
+        native = native_line_boundaries(str(p))
+        if native is None:
+            pytest.skip("no native toolchain")
+        offsets = [0]
+        with open(p, "rb") as f:
+            for line in f:
+                offsets.append(offsets[-1] + len(line))
+        np.testing.assert_array_equal(native, np.asarray(offsets, np.int64),
+                                      err_msg=name)
