@@ -250,8 +250,8 @@ _HF_CONFIG_EXPORTERS = {
 
 # families whose Encoder stack supports per-layer MoE FFNs / pipelining
 # (T5 has its own blocks; ALBERT shares one layer across the stack)
-_MOE_FAMILIES = ("bert", "roberta", "distilbert", "electra")
-_PIPELINE_FAMILIES = _MOE_FAMILIES + ("gpt2", "t5", "bart", "mbart")
+_MOE_FAMILIES = ("bert", "roberta", "distilbert", "electra", "gpt2")
+_PIPELINE_FAMILIES = _MOE_FAMILIES + ("t5", "bart", "mbart")
 
 _MOE_CONFIG_KEYS = ("num_experts", "expert_top_k", "moe_every",
                     "expert_capacity_factor", "router_aux_coef")

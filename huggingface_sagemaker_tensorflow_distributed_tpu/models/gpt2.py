@@ -29,7 +29,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import ACT2FN
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    ACT2FN,
+    is_moe_layer,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
     dot_product_attention,
     make_attention_mask,
@@ -76,6 +79,14 @@ class Gpt2Config:
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"
     remat: bool = False
+    # Mixture-of-Experts (models/moe.py, shared with the encoder
+    # families): every moe_every-th block's MLP becomes a token-routed
+    # expert bank (Mixtral-style decoder MoE). 0 = dense everywhere.
+    num_experts: int = 0
+    expert_top_k: int = 2
+    moe_every: int = 2
+    expert_capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
     # GPipe pipeline parallelism over the block stack (models/pipeline.py;
     # training/scoring path only — decode keeps the dense stack)
     pipeline_stages: int = 0
@@ -104,11 +115,8 @@ def gpt2_config_from_hf(hf_config: dict, **overrides) -> Gpt2Config:
                       else hf_config.get("eos_token_id", 50256)),
     )
     kw.update(overrides)
-    # MoE/pooler knobs target EncoderConfig; GPT-2 does not support them
-    # (pipeline_stages it does — PipelinedGpt2Stack)
-    for key in ("use_pooler", "num_experts", "expert_top_k", "moe_every",
-                "expert_capacity_factor", "router_aux_coef"):
-        kw.pop(key, None)
+    # pooler is an encoder-family knob; MoE IS supported (decoder MoE)
+    kw.pop("use_pooler", None)
     return Gpt2Config(**kw)
 
 
@@ -221,9 +229,13 @@ class Gpt2Mlp(nn.Module):
 
 
 class Gpt2Block(nn.Module):
-    """Pre-LN transformer block (GPT-2 ordering)."""
+    """Pre-LN transformer block (GPT-2 ordering). On MoE placements
+    (``is_moe_layer``) the MLP is the shared token-routed expert bank
+    (``models/moe.py::MoeFeedForward`` — duck-typed on the config's
+    num_experts/intermediate_size/hidden_act fields)."""
 
     config: Gpt2Config
+    layer_index: int = 0
 
     @nn.compact
     def __call__(self, hidden, attn_mask=None, deterministic: bool = True,
@@ -232,8 +244,14 @@ class Gpt2Block(nn.Module):
         attn = Gpt2Attention(cfg, name="attention")(
             _layernorm(cfg, "ln_1")(hidden), attn_mask, deterministic, decode)
         hidden = hidden + attn
-        mlp = Gpt2Mlp(cfg, name="mlp")(
-            _layernorm(cfg, "ln_2")(hidden), deterministic)
+        x = _layernorm(cfg, "ln_2")(hidden)
+        if is_moe_layer(cfg, self.layer_index):
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.moe import (
+                MoeFeedForward,
+            )
+            mlp = MoeFeedForward(cfg, name="moe")(x, deterministic)
+        else:
+            mlp = Gpt2Mlp(cfg, name="mlp")(x, deterministic)
         return hidden + mlp
 
 
@@ -283,6 +301,9 @@ class Gpt2Model(nn.Module):
                     "the KV cache is stage-local state the dense stack owns; "
                     "export the pipelined checkpoint and reload it dense "
                     "(pipeline_stages=0) for generation")
+            if cfg.num_experts:
+                raise ValueError("pipeline_stages and num_experts cannot "
+                                 "combine (pipelined MoE is not supported)")
             from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
                 PipelinedGpt2Stack,
             )
@@ -293,8 +314,8 @@ class Gpt2Model(nn.Module):
             if cfg.remat:
                 block_cls = nn.remat(Gpt2Block, static_argnums=(3, 4))
             for i in range(cfg.num_layers):
-                x = block_cls(cfg, name=f"h_{i}")(x, additive_mask,
-                                                  deterministic, decode)
+                x = block_cls(cfg, name=f"h_{i}", layer_index=i)(
+                    x, additive_mask, deterministic, decode)
         x = _layernorm(cfg, "ln_f")(x)
         return x, wte.embedding
 

@@ -235,7 +235,10 @@ def make_fused_causal_lm_loss(model, block_n: int = 256, block_v: int = 512,
     masked — identical masked sums to ``causal_lm_loss``."""
 
     def loss(apply_fn, params, batch, rngs, train: bool):
-        hidden, embedding = model.apply(
+        # the PASSED apply_fn, not model.apply: the Trainer wraps it to
+        # collect sown MoE aux losses (mutable=["losses"]) — calling the
+        # model directly would silently drop router load balancing
+        hidden, embedding = apply_fn(
             {"params": params}, batch["input_ids"], batch["attention_mask"],
             deterministic=not train, rngs=rngs,
             method=model.hidden_and_embedding)               # [B,S,H], [V,H]
@@ -267,7 +270,8 @@ def make_fused_seq2seq_loss(model, block_n: int = 256, block_v: int = 512,
     with decoder positions (teacher forcing is in decoder_input_ids)."""
 
     def loss(apply_fn, params, batch, rngs, train: bool):
-        hidden, weight = model.apply(
+        # apply_fn, not model.apply — see make_fused_causal_lm_loss
+        hidden, weight = apply_fn(
             {"params": params}, batch["input_ids"], batch["attention_mask"],
             batch["decoder_input_ids"], batch.get("decoder_attention_mask"),
             deterministic=not train, rngs=rngs,
@@ -312,7 +316,8 @@ def make_fused_mlm_loss(model, mask_cap: float = 0.25, block_n: int = 256,
     )
 
     def loss(apply_fn, params, batch, rngs, train: bool):
-        hidden, table, bias = model.apply(
+        # apply_fn, not model.apply — see make_fused_causal_lm_loss
+        hidden, table, bias = apply_fn(
             {"params": params}, batch["input_ids"], batch["attention_mask"],
             token_type_ids=batch.get("token_type_ids"),
             deterministic=not train, rngs=rngs, return_fused_inputs=True)

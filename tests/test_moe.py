@@ -257,3 +257,142 @@ def test_divergence_check_tolerates_expert_sharding(devices8):
     cfg = TrainConfig(dtype="float32", log_every_steps=0)
     trainer = Trainer(cfg, model, params, mesh)
     assert trainer.check_replica_divergence() < 1e-6
+
+
+# --- GPT-2 decoder MoE (Mixtral-style; shared MoeFeedForward) -------------
+
+def _gpt2_moe_cfg(**kw):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+    )
+    base = dict(vocab_size=256, hidden_size=32, num_layers=2, num_heads=4,
+                intermediate_size=64, max_position_embeddings=SEQ,
+                hidden_dropout=0.0, embd_dropout=0.0, attention_dropout=0.0,
+                num_experts=4, expert_top_k=2)
+    base.update(kw)
+    return Gpt2Config(**base)
+
+
+def test_gpt2_moe_training_learns(devices8):
+    """GPT-2 with a token-routed expert MLP on every 2nd block trains
+    causal-lm end to end on a dp×ep mesh (decoder MoE — the same
+    MoeFeedForward the encoder families share, aux loss included)."""
+    import jax
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2LMHeadModel,
+    )
+
+    mesh = build_mesh(MeshConfig(dp=-1, ep=2), devices=devices8)
+    model_cfg = _gpt2_moe_cfg()
+    model = Gpt2LMHeadModel(model_cfg)
+    params = init_params(model, model_cfg, seed=0)
+    assert "moe" in params["backbone"]["h_1"]      # GShard placement
+    assert "mlp" in params["backbone"]["h_0"]
+    cfg = TrainConfig(task="causal-lm", dtype="float32", learning_rate=3e-3,
+                      scale_lr_by_world_size=False, log_every_steps=0,
+                      rng_impl="threefry", epochs=2, num_experts=4, ep=2)
+    trainer = Trainer(cfg, model, params, mesh)
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(64, seed=0)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=SEQ)
+    hist = trainer.fit(ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0))
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert np.isfinite(hist["loss"][-1])
+
+
+def test_gpt2_moe_export_reload_roundtrip(tmp_path):
+    """GPT-2 MoE export persists the expert bank (moe.safetensors
+    sidecar + MoE fields in config.json) and reloads identically."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2LMHeadModel,
+    )
+
+    model_cfg = _gpt2_moe_cfg()
+    model = Gpt2LMHeadModel(model_cfg)
+    params = init_params(model, model_cfg)
+    out = str(tmp_path / "gpt2-moe")
+    auto_models.save_pretrained(out, params, "gpt2", model_cfg)
+
+    _, params2, family, cfg2 = auto_models.from_pretrained(
+        out, task="causal-lm")
+    assert family == "gpt2"
+    assert cfg2.num_experts == 4 and cfg2.expert_top_k == 2
+    moe1 = params["backbone"]["h_1"]["moe"]
+    moe2 = params2["backbone"]["h_1"]["moe"]
+    for key in ("router", "wi", "wo"):
+        np.testing.assert_array_equal(np.asarray(moe1[key]),
+                                      np.asarray(moe2[key]))
+
+
+def test_gpt2_moe_generation_works(tmp_path):
+    """Decode path with MoE blocks: cached greedy generation runs (MoE
+    has no cache state of its own — routing is per-step stateless)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2LMHeadModel,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        generate_causal,
+    )
+
+    model_cfg = _gpt2_moe_cfg()
+    model = Gpt2LMHeadModel(model_cfg)
+    params = init_params(model, model_cfg, seed=0)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(5, 250, (2, 6)), jnp.int32)
+    mask = jnp.ones((2, 6), jnp.int32)
+    out = generate_causal(model, params, ids, mask, max_new_tokens=4)
+    assert np.asarray(out).shape == (2, 4)
+
+
+def test_gpt2_moe_rejects_pipeline():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2LMHeadModel,
+    )
+
+    cfg = _gpt2_moe_cfg(pipeline_stages=2)
+    model = Gpt2LMHeadModel(cfg)
+    with pytest.raises(ValueError, match="num_experts"):
+        init_params(model, cfg)
+
+
+def test_gpt2_moe_aux_loss_flows_through_fused_ce(devices8):
+    """The fused losses must route through the Trainer's wrapped
+    apply_fn so MoE router aux losses are collected (a direct
+    model.apply drops flax sow silently): fused and unfused training
+    losses must MATCH on an MoE model — both including aux."""
+    import jax
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2LMHeadModel,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        make_fused_causal_lm_loss,
+    )
+
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(16, seed=2)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=SEQ)
+
+    def first_loss(fused):
+        mesh = build_mesh(MeshConfig(dp=-1), devices=devices8)
+        model_cfg = _gpt2_moe_cfg(hidden_size=128, intermediate_size=256,
+                                  router_aux_coef=1.0)  # aux is VISIBLE
+        model = Gpt2LMHeadModel(model_cfg)
+        params = init_params(model, model_cfg, seed=0)
+        cfg = TrainConfig(task="causal-lm", dtype="float32",
+                          learning_rate=1e-3, scale_lr_by_world_size=False,
+                          log_every_steps=0, rng_impl="threefry",
+                          num_experts=4, fused_vocab_ce=fused)
+        trainer = Trainer(cfg, model, params, mesh)
+        if fused:
+            trainer.loss_fn = make_fused_causal_lm_loss(model,
+                                                        interpret=True)
+        batch = next(ShardedBatcher(ds, 16, mesh, shuffle=False,
+                                    seed=0).global_arrays(0))
+        _, m = trainer._train_step(trainer.state, batch)
+        return float(jax.device_get(m["loss"]))
+
+    np.testing.assert_allclose(first_loss(True), first_loss(False),
+                               rtol=2e-5)
