@@ -5,11 +5,20 @@ kernel were interpret-mode-verified on CPU; this script is the missing
 evidence that they COMPILE under Mosaic and match the XLA reference on
 the real chip at real shapes:
 
-- flash fwd + bwd vs xla attention at B8/H12/S512/D64 (headline shape),
-  causal and non-causal, with a padding mask;
+- flash fwd + bwd at B8/H12/S512/D64 (headline shape), causal and
+  non-causal, with a padding mask — both the Pallas kernel AND the XLA
+  attention are compared against a float64 NumPy reference (forward and
+  analytic gradients), and flash passes iff its error is within 2x of
+  XLA's own error against that anchor. Comparing the two fp32 paths to
+  each other with CPU-calibrated tolerances is wrong on TPU: compiled
+  MXU fp32 matmuls round differently per schedule, so BOTH paths sit
+  ~5e-5 (full) / ~1e-3 (causal, -1e30 mask arithmetic) from the true
+  answer, and "flash == xla to 2e-5" is unsatisfiable even for a
+  correct kernel (measured r4: flash 4.6e-5 vs xla 6.4e-5 from fp64);
 - fused vocab-CE fwd + both gradients vs full-logits CE at
   N=2048/H=768/V=50257 (GPT-2 vocab — the VMEM-fit question) and the
-  bias-augmented MLM shape (H=896 = 768+128).
+  bias-augmented MLM shape (H=896 = 768+128). Here both paths reduce
+  in fp32 the same way, so direct comparison is sound.
 
 Prints one PASS/FAIL line per check and exits non-zero on any FAIL.
 Run on the chip:  python benchmarks/tpu_kernel_parity.py
@@ -38,6 +47,18 @@ def check(name: str, got, want, atol: float, rtol: float = 1e-3) -> None:
         FAILED.append(name)
 
 
+def check_anchored(name: str, flash, xla, ref64, floor: float = 1e-6) -> None:
+    """PASS iff the Pallas result is as close to the float64 anchor as
+    the XLA path is (within 2x + a floor for near-exact cases)."""
+    ef = float(np.max(np.abs(np.asarray(flash, np.float64) - ref64)))
+    ex = float(np.max(np.abs(np.asarray(xla, np.float64) - ref64)))
+    ok = ef <= 2.0 * ex + floor
+    print(f"{'PASS' if ok else 'FAIL'} {name}: flash_vs_fp64={ef:.3e} "
+          f"xla_vs_fp64={ex:.3e} ratio={ef / max(ex, 1e-12):.2f}")
+    if not ok:
+        FAILED.append(name)
+
+
 def flash_parity() -> None:
     from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
         xla_attention,
@@ -46,27 +67,48 @@ def flash_parity() -> None:
         flash_attention,
     )
 
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        make_causal_mask,
+    )
+
     B, H, S, D = 8, 12, 512, 64
+    scale = D ** -0.5
     rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32) * 0.1
-    k = jnp.asarray(rng.randn(B, H, S, D), jnp.float32) * 0.1
-    v = jnp.asarray(rng.randn(B, H, S, D), jnp.float32) * 0.1
+    qn = rng.randn(B, H, S, D) * 0.1
+    kn = rng.randn(B, H, S, D) * 0.1
+    vn = rng.randn(B, H, S, D) * 0.1
     # padding mask: last 64 keys masked on half the batch
-    mask = np.zeros((B, 1, 1, S), np.float32)
-    mask[: B // 2, ..., -64:] = -1e9
-    mask = jnp.asarray(mask)
+    mn = np.zeros((B, 1, 1, S))
+    mn[: B // 2, ..., -64:] = -1e9
+    q, k, v, mask = (jnp.asarray(a, jnp.float32) for a in (qn, kn, vn, mn))
+
+    def ref64(causal):
+        """fp64 forward + analytic grads of sum(out^2) — the anchor."""
+        s = np.einsum("bhqd,bhkd->bhqk", qn, kn) * scale + mn
+        if causal:
+            pos = np.arange(S)
+            s = s + np.where(pos[None, :] <= pos[:, None], 0.0, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out = np.einsum("bhqk,bhkd->bhqd", p, vn)
+        dout = 2.0 * out
+        dv_ = np.einsum("bhqk,bhqd->bhkd", p, dout)
+        dp = np.einsum("bhqd,bhkd->bhqk", dout, vn)
+        ds = p * (dp - np.sum(dp * p, -1, keepdims=True))
+        dq_ = scale * np.einsum("bhqk,bhkd->bhqd", ds, kn)
+        dk_ = scale * np.einsum("bhqk,bhqd->bhkd", ds, qn)
+        return out, dq_, dk_, dv_
 
     for causal in (False, True):
         tag = "causal" if causal else "full"
+        r_out, r_dq, r_dk, r_dv = ref64(causal)
+        full_mask = mask + make_causal_mask(S, S) if causal else mask
+
         out_f = jax.jit(lambda q, k, v: flash_attention(
             q, k, v, mask=mask, causal=causal))(q, k, v)
-        from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
-            make_causal_mask,
-        )
-        full_mask = mask + make_causal_mask(S, S) if causal else mask
         out_x = jax.jit(lambda q, k, v: xla_attention(
             q, k, v, mask=full_mask))(q, k, v)
-        check(f"flash fwd ({tag})", out_f, out_x, atol=2e-5)
+        check_anchored(f"flash fwd ({tag})", out_f, out_x, r_out)
 
         def loss_f(q, k, v):
             return jnp.sum(flash_attention(q, k, v, mask=mask,
@@ -77,8 +119,9 @@ def flash_parity() -> None:
 
         gf = jax.jit(jax.grad(loss_f, argnums=(0, 1, 2)))(q, k, v)
         gx = jax.jit(jax.grad(loss_x, argnums=(0, 1, 2)))(q, k, v)
-        for name, a, b in zip(("dq", "dk", "dv"), gf, gx):
-            check(f"flash bwd {name} ({tag})", a, b, atol=2e-4)
+        for name, a, b, r in zip(("dq", "dk", "dv"), gf, gx,
+                                 (r_dq, r_dk, r_dv)):
+            check_anchored(f"flash bwd {name} ({tag})", a, b, r)
 
 
 def vocab_ce_parity() -> None:
