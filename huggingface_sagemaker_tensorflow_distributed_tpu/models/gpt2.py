@@ -249,7 +249,13 @@ class Gpt2Block(nn.Module):
             from huggingface_sagemaker_tensorflow_distributed_tpu.models.moe import (
                 MoeFeedForward,
             )
-            mlp = MoeFeedForward(cfg, name="moe")(x, deterministic)
+            # causal slot priority (no future-token influence on drops);
+            # wo follows the 1/sqrt(2*n_layer) residual-flow init like
+            # every other residual write in the model
+            mlp = MoeFeedForward(
+                cfg, causal=True,
+                out_init_std=cfg.initializer_range / (2 * cfg.num_layers) ** 0.5,
+                name="moe")(x, deterministic)
         else:
             mlp = Gpt2Mlp(cfg, name="mlp")(x, deterministic)
         return hidden + mlp

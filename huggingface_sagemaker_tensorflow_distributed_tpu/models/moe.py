@@ -57,9 +57,29 @@ class MoeFeedForward(nn.Module):
     group (tokens compete for expert slots within their own row — keeps
     the dispatch tensor O(S·E·C) per row and routing independent of the
     data sharding).
+
+    Capacity-slot priority has two modes:
+
+    - bidirectional (default): round-major, GShard-style — every top-1
+      choice outranks any top-2 choice, so congestion preferentially
+      drops second choices.
+    - ``causal=True``: position-major — a token's slot index counts only
+      assignments from strictly-earlier tokens (any round). Required for
+      causal LMs: under round-major priority, whether token i's
+      second-choice slot survives depends on the top-1 routing of tokens
+      j > i, which leaks future-token information through the capacity
+      drop pattern. (Capacity drops themselves remain a train-time-only
+      phenomenon: incremental decode processes one token with no slot
+      competition — the standard capacity-MoE asymmetry.)
+
+    ``out_init_std`` overrides the output-projection init so residual
+    -flow conventions (e.g. GPT-2's 1/sqrt(2·n_layer) scaling on every
+    residual write) carry over to the expert bank.
     """
 
     config: EncoderConfig
+    causal: bool = False
+    out_init_std: float | None = None
 
     @nn.compact
     def __call__(self, hidden, deterministic: bool = True):
@@ -84,22 +104,38 @@ class MoeFeedForward(nn.Module):
         logits = jnp.einsum("bsh,he->bse", hidden.astype(jnp.float32), router)
         probs = jax.nn.softmax(logits, axis=-1)                    # [B,S,E]
 
-        # --- top-k greedy assignment with per-expert capacity ----------
+        # --- top-k greedy choice collection ----------------------------
         remaining = probs
-        counts = jnp.zeros((B, E), jnp.float32)    # slots used per expert
-        combine = jnp.zeros((B, S, E, C), jnp.float32)
-        gate_total = jnp.zeros((B, S), jnp.float32)
-        top1_mask = None
+        masks, gates = [], []
         for _ in range(k):
             idx = jnp.argmax(remaining, axis=-1)                   # [B,S]
             mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)       # [B,S,E]
-            gate = jnp.sum(remaining * mask, axis=-1)              # [B,S]
+            gates.append(jnp.sum(remaining * mask, axis=-1))       # [B,S]
             remaining = remaining * (1.0 - mask)
-            if top1_mask is None:
-                top1_mask = mask
-            # slot index within the expert buffer: earlier tokens first
-            pos = jnp.cumsum(mask, axis=1) - 1.0 + counts[:, None, :]
-            counts = counts + jnp.sum(mask, axis=1)
+            masks.append(mask)
+        top1_mask = masks[0]
+
+        # --- capacity-slot assignment ----------------------------------
+        if self.causal:
+            # position-major: slot = #assignments to the chosen expert
+            # from strictly-earlier tokens (any round). Rounds of one
+            # token hit distinct experts, so slots stay collision-free,
+            # and nothing about token i depends on tokens j > i.
+            total = sum(masks)                                     # [B,S,E]
+            prefix = jnp.cumsum(total, axis=1) - total
+            slot_pos = [prefix] * k
+        else:
+            # round-major (GShard): all round-r slots precede round-r+1
+            slot_pos = []
+            counts = jnp.zeros((B, E), jnp.float32)
+            for mask in masks:
+                slot_pos.append(
+                    jnp.cumsum(mask, axis=1) - 1.0 + counts[:, None, :])
+                counts = counts + jnp.sum(mask, axis=1)
+
+        combine = jnp.zeros((B, S, E, C), jnp.float32)
+        gate_total = jnp.zeros((B, S), jnp.float32)
+        for mask, gate, pos in zip(masks, gates, slot_pos):
             slot = jnp.sum(pos * mask, axis=-1)                    # [B,S]
             kept = (slot < C) & (gate > 0.0)
             slot_oh = jax.nn.one_hot(jnp.where(kept, slot, 0).astype(jnp.int32),
@@ -133,8 +169,12 @@ class MoeFeedForward(nn.Module):
 
         wi = self.param("wi", nn.initializers.normal(cfg.initializer_range),
                         (E, H, F), cfg.param_dtype)
-        wo = self.param("wo", nn.initializers.normal(cfg.initializer_range),
-                        (E, F, H), cfg.param_dtype)
+        wo = self.param(
+            "wo",
+            nn.initializers.normal(self.out_init_std
+                                   if self.out_init_std is not None
+                                   else cfg.initializer_range),
+            (E, F, H), cfg.param_dtype)
         h = jnp.einsum("ebch,ehf->ebcf", expert_in, wi.astype(cfg.dtype))
         h = ACT2FN[cfg.hidden_act](h)
         out = jnp.einsum("ebcf,efh->ebch", h, wo.astype(cfg.dtype))

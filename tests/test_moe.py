@@ -396,3 +396,60 @@ def test_gpt2_moe_aux_loss_flows_through_fused_ce(devices8):
 
     np.testing.assert_allclose(first_loss(True), first_loss(False),
                                rtol=2e-5)
+
+
+def test_causal_slot_priority_no_future_leak():
+    """Position-major slot assignment (``causal=True``): under capacity
+    congestion, changing the LAST token of a sequence must not change
+    the MoE output at any earlier position. Round-major (encoder)
+    priority violates this by design — a late token's top-1 can displace
+    an early token's top-2 — which is exactly the future-token channel a
+    causal LM must not have."""
+    cfg = _moe_cfg(expert_capacity_factor=0.3)   # heavy congestion
+    layer = MoeFeedForward(cfg, causal=True)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (2, SEQ, 32), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+
+    y, _ = layer.apply({"params": params}, x, mutable=["losses"])
+    # perturb only the final position (both rows)
+    x2 = x.at[:, -1, :].set(jax.random.normal(jax.random.PRNGKey(2),
+                                              (2, 32), jnp.float32))
+    y2, _ = layer.apply({"params": params}, x2, mutable=["losses"])
+    np.testing.assert_array_equal(jax.device_get(y[:, :-1]),
+                                  jax.device_get(y2[:, :-1]))
+
+
+def test_round_major_priority_is_not_causal():
+    """Sanity check that the default (round-major) priority DOES react
+    to future tokens under the same congestion — i.e. the causal mode
+    is a real behavioral switch, not a no-op."""
+    cfg = _moe_cfg(expert_capacity_factor=0.3)
+    layer = MoeFeedForward(cfg)                  # causal=False
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, SEQ, 32), jnp.float32)
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    y, _ = layer.apply({"params": params}, x, mutable=["losses"])
+    x2 = x.at[:, -1, :].set(jax.random.normal(jax.random.PRNGKey(2),
+                                              (2, 32), jnp.float32))
+    y2, _ = layer.apply({"params": params}, x2, mutable=["losses"])
+    assert not np.array_equal(jax.device_get(y[:, :-1]),
+                              jax.device_get(y2[:, :-1]))
+
+
+def test_gpt2_moe_residual_flow_init():
+    """The expert output projection follows GPT-2's 1/sqrt(2*n_layer)
+    residual-flow init (like attn c_proj and dense mlp fc_out), and the
+    other expert weights keep the plain initializer_range."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2LMHeadModel,
+    )
+
+    model_cfg = _gpt2_moe_cfg(num_layers=8, hidden_size=64,
+                              intermediate_size=128, moe_every=2)
+    model = Gpt2LMHeadModel(model_cfg)
+    params = init_params(model, model_cfg, seed=0)
+    moe = params["backbone"]["h_1"]["moe"]
+    expected = model_cfg.initializer_range / (2 * model_cfg.num_layers) ** 0.5
+    assert np.std(np.asarray(moe["wo"])) == pytest.approx(expected, rel=0.15)
+    assert np.std(np.asarray(moe["wi"])) == pytest.approx(
+        model_cfg.initializer_range, rel=0.15)
