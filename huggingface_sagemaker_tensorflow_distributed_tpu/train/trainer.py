@@ -450,6 +450,53 @@ class Trainer:
         self.tx, self.scaled_lr = build_optimizer(
             config, world_size=self.dp_size, total_steps=total_steps)
 
+        # LoRA (models/lora.py): params become {"model": frozen base,
+        # "lora": adapters}; the loss merges W + (alpha/r)·A·B inside the
+        # jitted step (stop_gradient on the base — XLA drops its grad
+        # tree), and the optimizer runs on the adapters only, so no Adam
+        # m/v mirrors exist for the base model.
+        self._lora_scaling = None
+        if getattr(config, "lora_rank", 0) > 0:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.lora import (
+                count_params,
+                freeze_except,
+                init_lora_params,
+                lora_scaling,
+                merge_lora,
+                trainable_labels,
+            )
+
+            lora = init_lora_params(params, config.lora_rank,
+                                    config.lora_targets, seed=config.seed)
+            self._lora_scaling = lora_scaling(config.lora_rank,
+                                              config.lora_alpha)
+            head_rx = config.lora_train_heads
+            base_labels = trainable_labels(params, head_rx)
+            n_heads = sum(int(np.prod(p.shape)) for p, lab in zip(
+                jax.tree.leaves(params), jax.tree.leaves(base_labels))
+                if lab == "train")
+            logger.info(
+                "LoRA r=%d alpha=%g targets=%s: %d adapter + %d head "
+                "trainable / %d frozen params", config.lora_rank,
+                config.lora_alpha, config.lora_targets, count_params(lora),
+                n_heads, count_params(params) - n_heads)
+            params = {"model": params, "lora": lora}
+
+            inner_loss, scaling = self.loss_fn, self._lora_scaling
+
+            def lora_loss(apply_fn, split, batch, rngs, train):
+                merged = merge_lora(freeze_except(split["model"], head_rx),
+                                    split["lora"], scaling)
+                return inner_loss(apply_fn, merged, batch, rngs, train)
+
+            self.loss_fn = lora_loss
+            self.tx = optax.multi_transform(
+                {"train": self.tx, "freeze": optax.set_to_zero()},
+                param_labels={
+                    "model": base_labels,
+                    "lora": jax.tree.map(lambda _: "train", params["lora"]),
+                })
+
         state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -551,6 +598,20 @@ class Trainer:
     def _eval_step_impl(self, params, batch):
         _, sums = self.loss_fn(self.model.apply, params, batch, {}, False)
         return sums
+
+    @property
+    def export_params(self):
+        """Deployable model params: with LoRA active, the base weights
+        with adapters merged in (what ``save_pretrained``/``generate``
+        should see); otherwise ``state.params`` unchanged."""
+        if self._lora_scaling is None:
+            return self.state.params
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.lora import (
+            merge_lora,
+        )
+
+        return merge_lora(self.state.params["model"],
+                          self.state.params["lora"], self._lora_scaling)
 
     # -- host-side loops ----------------------------------------------------
 

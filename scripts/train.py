@@ -342,7 +342,7 @@ def main(argv=None) -> dict:
 
                 n = min(config.eval_rouge_samples, len(eval_ds))
                 cols = eval_ds[np.arange(n)]
-                out = generate(model, trainer.state.params,
+                out = generate(model, trainer.export_params,
                                cols["input_ids"], cols["attention_mask"],
                                max_new_tokens=config.max_target_length)
                 preds = [tokenizer.decode(r) for r in np.asarray(out)]
@@ -369,10 +369,13 @@ def main(argv=None) -> dict:
                                           return_offsets=True)
                 preds: list = []
                 bs = global_eval_batch
+                # hoisted: export_params re-merges LoRA adapters on every
+                # read — do it once, not once per eval batch
+                eval_params = trainer.export_params
                 for lo in range(0, len(questions), bs):
                     sl = slice(lo, min(lo + bs, len(questions)))
                     s_log, e_log = model.apply(
-                        {"params": trainer.state.params},
+                        {"params": eval_params},
                         jnp.asarray(enc["input_ids"][sl]),
                         jnp.asarray(enc["attention_mask"][sl]),
                         token_type_ids=jnp.asarray(enc["token_type_ids"][sl])
@@ -388,10 +391,31 @@ def main(argv=None) -> dict:
             results["eval"] = eval_results
 
         # --- terminal export, HF layout (reference train.py:182-183) ---
-        auto_models.save_pretrained(config.model_dir, trainer.state.params,
+        auto_models.save_pretrained(config.model_dir, trainer.export_params,
                                     family, model_config)
+        adapters = None
+        if config.lora_rank > 0:
+            adapters = trainer.state.params["lora"]
+            if jax.process_count() > 1:
+                # stacked (pipelined) adapters can shard across hosts —
+                # gather collectively BEFORE the host-0 gate, same
+                # discipline as save_pretrained
+                from jax.experimental import multihost_utils
+
+                adapters = multihost_utils.process_allgather(adapters,
+                                                             tiled=True)
         if jax.process_index() == 0:
             tokenizer.save_pretrained(config.model_dir)
+            if adapters is not None:
+                # adapter sidecar next to the merged export: deployment
+                # can ship megabytes instead of the full model
+                from huggingface_sagemaker_tensorflow_distributed_tpu.models.lora import (
+                    save_adapters,
+                )
+                save_adapters(
+                    os.path.join(config.model_dir, "adapter"),
+                    adapters, rank=config.lora_rank,
+                    alpha=config.lora_alpha, targets=config.lora_targets)
     finally:
         # commits any in-flight ASYNC checkpoint write even when fit/eval
         # raise — a crash after "save started" must not lose the checkpoint
