@@ -45,6 +45,7 @@ run causal_lm  python bench.py --causal-lm
 run mlm        python bench.py --mlm
 run generate   python bench.py --generate
 run bert_large python bench.py --model bert-large
+run bert_large_lora python bench.py --lora
 
 # 5. scaling instrument (collective fraction from a real trace)
 run mesh python bench.py --mesh
