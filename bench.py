@@ -116,7 +116,8 @@ def train_flops_per_sample(seq_len: int, hidden_size: int = 768,
 def build_harness(model_kwargs: dict, per_chip_batch: int, seq_len: int = 512,
                   remat: bool = False, bucket_multiple: int = 0,
                   min_len: int = 300, max_len: int = 600, batches: int = 14,
-                  opt_state_bf16: bool = False, lora_rank: int = 0):
+                  opt_state_bf16: bool = False, lora_rank: int = 0,
+                  lora_targets: str = "attention"):
     """(trainer, batcher) for one BERT-family benchmark config — the ONE
     place every bench mode builds its harness, so --mesh/--buckets always
     measure the same configuration the headline does."""
@@ -153,7 +154,8 @@ def build_harness(model_kwargs: dict, per_chip_batch: int, seq_len: int = 512,
                          max_seq_length=seq_len, log_every_steps=0,
                          remat=remat, bucket_multiple=bucket_multiple,
                          optimizer_state_dtype="bfloat16" if opt_state_bf16
-                         else "float32", lora_rank=lora_rank)
+                         else "float32", lora_rank=lora_rank,
+                         lora_targets=lora_targets)
     model_cfg = EncoderConfig(
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         max_position_embeddings=512,
@@ -240,6 +242,13 @@ def bench_headline(per_chip_batch: int | None = None,
                      "bfloat16" if opt_state_bf16 else "float32"})
 
 
+def _bert_large_flops_per_sample() -> float:
+    """One source of truth for the BERT-large full-train FLOPs figure —
+    both bert-large modes must report MFU under the same convention."""
+    return train_flops_per_sample(512, **{
+        k: v for k, v in BERT_LARGE.items() if k != "num_heads"})
+
+
 def bench_lora() -> None:
     """BERT-large + LoRA r=8 (attention targets, trainable head): the
     base model's fp32 Adam m/v (2x 1.36G) and backbone grad tree vanish,
@@ -248,21 +257,21 @@ def bench_lora() -> None:
     bert-large mode, so the samples/s and vs_baseline compare directly
     (baseline: the reference's full fine-tune on V100)."""
     batch = 32 if _on_tpu() else 1
+    targets = "attention"
     history = run_finetune(BERT_LARGE, per_chip_batch=batch,
-                           lora_rank=8)
+                           lora_rank=8, lora_targets=targets)
     # FLOPs convention: full fine-tune is ~3x forward (fwd + dX + dW);
     # with the backbone's dW matmuls dead-code-eliminated (stop-gradient
     # base, models/lora.py) the hardware executes ~2x forward, so MFU
     # must be computed against 2/3 of the full-train FLOPs — the 3x
     # figure would overstate utilization by ~1.5x
-    full_flops = train_flops_per_sample(512, **{
-        k: v for k, v in BERT_LARGE.items() if k != "num_heads"})
+    full_flops = _bert_large_flops_per_sample()
     emit("bert_large_lora_r8_samples_per_sec_per_chip",
          history["train_samples_per_second_per_chip"],
          V100_BERT_LARGE_SAMPLES_PER_SEC,
          flops_per_sample=full_flops * 2.0 / 3.0,
          detail={"per_chip_batch": batch, "lora_rank": 8,
-                 "lora_targets": "attention",
+                 "lora_targets": targets,
                  "flops_convention": "fwd+dx only (no backbone dW)"})
 
 
@@ -274,8 +283,7 @@ def bench_bert_large() -> None:
     emit("bert_large_wwm_finetune_samples_per_sec_per_chip",
          history["train_samples_per_second_per_chip"],
          V100_BERT_LARGE_SAMPLES_PER_SEC,
-         flops_per_sample=train_flops_per_sample(512, **{
-             k: v for k, v in BERT_LARGE.items() if k != "num_heads"}))
+         flops_per_sample=_bert_large_flops_per_sample())
 
 
 # ---------------------------------------------------------------------------
