@@ -353,7 +353,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
         return ["bert_base_bucketed_samples_per_sec_per_chip"]
     if args.generate:
         return [f"generate_{m}_tokens_per_sec_per_chip"
-                for m in ("gpt2_greedy", "bart_greedy", "bart_beam4")]
+                for m in ("gpt2_greedy", "gpt2_greedy_int8",
+                          "bart_greedy", "bart_beam4")]
     if args.causal_lm:
         return ["gpt2_finetune_fused_ce_samples_per_sec_per_chip"]
     if args.mlm:

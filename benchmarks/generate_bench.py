@@ -7,6 +7,10 @@ token-exact against HF; this mode measures them. Three lines:
 
 - ``gpt2_greedy``      GPT-2 (124M shape) prefill + jitted-scan greedy
                        continuation — the decoder-only path.
+- ``gpt2_greedy_int8`` same, int8 weight-only dense kernels
+                       (models/quant.py) — the HBM-bandwidth story:
+                       decode re-reads all weights per token, so 1/4
+                       the kernel bytes should show up as tokens/s.
 - ``bart_greedy``      BART-base encoder once + cached greedy decode —
                        the encoder-decoder path.
 - ``bart_beam4``       same, beam search at 4 beams (beams flattened
@@ -91,6 +95,15 @@ def bench_generate() -> None:
         rng.randint(0, gpt2_cfg.vocab_size, (batch, prompt_len)), jnp.int32)
     results["gpt2_greedy"] = _bench_one(
         lambda: generate_causal(gpt2, gpt2_params, prompt,
+                                max_new_tokens=new_tokens),
+        new_tokens, batch)
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+        quantize_gpt2,
+    )
+    q_gpt2, q_params, _ = quantize_gpt2(gpt2, gpt2_params)
+    results["gpt2_greedy_int8"] = _bench_one(
+        lambda: generate_causal(q_gpt2, q_params, prompt,
                                 max_new_tokens=new_tokens),
         new_tokens, batch)
 

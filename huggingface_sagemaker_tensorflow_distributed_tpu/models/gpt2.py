@@ -91,6 +91,9 @@ class Gpt2Config:
     # training/scoring path only — decode keeps the dense stack)
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
+    # int8 weight-only dense kernels for generation (models/quant.py;
+    # load via quantize_gpt2 — never trained in this form)
+    weight_quant: str = "none"            # none | int8
 
 
 def gpt2_config_from_hf(hf_config: dict, **overrides) -> Gpt2Config:
@@ -121,7 +124,12 @@ def gpt2_config_from_hf(hf_config: dict, **overrides) -> Gpt2Config:
 
 
 def _dense(cfg: Gpt2Config, features: int, name: str,
-           std: Optional[float] = None) -> nn.Dense:
+           std: Optional[float] = None) -> nn.Module:
+    if cfg.weight_quant == "int8":
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+            Int8Dense,
+        )
+        return Int8Dense(features, dtype=cfg.dtype, name=name)
     return nn.Dense(
         features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
         kernel_init=nn.initializers.normal(std or cfg.initializer_range),

@@ -1,0 +1,123 @@
+"""Int8 weight-only quantization for generation.
+
+Beyond-parity capability. Autoregressive decode on TPU is HBM-bandwidth
+-bound: every generated token re-reads the full weight set, so halving
+(bf16) or quartering (fp32) the bytes behind each matmul raises decode
+throughput roughly in proportion — compute stays in the model dtype and
+the MXU never sees int8. Symmetric per-output-channel scales keep the
+scheme zero-point-free, which is what XLA fuses cleanly: the dequant
+(``int8 -> dtype multiply``) is a producer elementwise op folded into
+the matmul's operand read, so the bf16 weight tensor never round-trips
+through HBM.
+
+The reference has no quantization story at all (its serving path is
+``save_pretrained`` and whatever the downstream endpoint does,
+reference ``scripts/train.py:182-183``); this is in-repo and targeted
+at the decode bench (``bench.py --generate``).
+
+Scope: GPT-2-family dense layers (qkv / attn_out / fc_in / fc_out —
+``models/gpt2.py::_dense`` is the single chokepoint). Embeddings and
+the tied LM head stay full precision: wte is a lookup (no bandwidth
+win) and its transpose is the output projection, where quantization
+error lands directly on the logits.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from flax.traverse_util import flatten_dict, unflatten_dict
+
+# GPT-2 dense-kernel leaves that become int8 (path regex against the
+# "/"-joined param path ending in "/kernel")
+GPT2_QUANT_TARGETS = r"(qkv|attn_out|fc_in|fc_out)/kernel$"
+
+
+class Int8Dense(nn.Module):
+    """Drop-in for ``nn.Dense`` holding an int8 kernel + per-output
+    -channel fp32 scales. Params come from :func:`quantize_params`
+    (init gives zeros/ones placeholders — a quantized model is loaded,
+    never trained; training stays full precision)."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        q = self.param("kernel_q", nn.initializers.zeros,
+                       (in_features, self.features), jnp.int8)
+        scale = self.param("kernel_scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        # dequant is elementwise on the weight: XLA fuses it into the
+        # dot's operand read; only int8 bytes cross HBM
+        w = q.astype(self.dtype) * scale.astype(self.dtype)[None, :]
+        return x @ w + bias.astype(self.dtype)
+
+
+def quantize_kernel(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-output-channel int8: scale = max|w|/127 per column,
+    q = round(w/scale). Returns (q int8 [in, out], scale fp32 [out])."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=0)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(w / scale[None, :]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def quantize_params(params: Any,
+                    targets: str = GPT2_QUANT_TARGETS) -> tuple[Any, dict]:
+    """Rewrite targeted ``.../kernel`` leaves into ``kernel_q`` +
+    ``kernel_scale`` (the :class:`Int8Dense` layout); everything else
+    passes through. Returns (quantized tree, stats dict)."""
+    rx = re.compile(targets)
+    flat = flatten_dict(params)
+    out: dict = {}
+    n_quant = bytes_before = bytes_after = 0
+    for path, leaf in flat.items():
+        path_s = "/".join(str(p) for p in path)
+        if rx.search(path_s) and getattr(leaf, "ndim", 0) == 2:
+            q, scale = quantize_kernel(np.asarray(leaf))
+            out[path[:-1] + ("kernel_q",)] = jnp.asarray(q)
+            out[path[:-1] + ("kernel_scale",)] = jnp.asarray(scale)
+            n_quant += 1
+            bytes_before += leaf.size * np.dtype(
+                np.asarray(leaf).dtype).itemsize
+            bytes_after += q.size + scale.size * 4
+        else:
+            out[path] = leaf
+    if n_quant == 0:
+        raise ValueError(f"quant target {targets!r} matched no kernels")
+    stats = {"kernels_quantized": n_quant, "bytes_before": bytes_before,
+             "bytes_after": bytes_after}
+    return unflatten_dict(out), stats
+
+
+def quantize_gpt2(model, params) -> tuple[Any, Any, dict]:
+    """(model, params) -> (int8 model, int8 params, stats) for
+    generation. The returned model is the same architecture with
+    ``weight_quant='int8'`` (``models/gpt2.py::_dense`` swaps in
+    :class:`Int8Dense`); KV cache, prefill+scan decode and sampling are
+    untouched."""
+    import dataclasses
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+    )
+
+    cfg = model.config
+    if not isinstance(cfg, Gpt2Config):
+        raise ValueError(
+            "int8 weight-only quantization currently covers the "
+            "GPT-2 family only (the decode-bound one); got "
+            f"{type(cfg).__name__}")
+    qcfg = dataclasses.replace(cfg, weight_quant="int8")
+    qmodel = type(model)(qcfg)
+    qparams, stats = quantize_params(params)
+    return qmodel, qparams, stats

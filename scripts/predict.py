@@ -87,6 +87,20 @@ def predict(args) -> list[dict]:
         args.model_dir, task=args.task, num_labels=args.num_labels)
     tokenizer = load_tokenizer(args.model_dir, vocab_size=config.vocab_size)
 
+    if getattr(args, "quantize", "none") == "int8":
+        # int8 weight-only decode (models/quant.py): HBM-bound decode
+        # reads 1/4 the kernel bytes; compute stays in the model dtype
+        if args.task != "causal-lm":
+            raise SystemExit("--quantize int8 covers --task causal-lm "
+                             "(GPT-2 family) only")
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+            quantize_gpt2,
+        )
+        model, params, stats = quantize_gpt2(model, params)
+        print(f"int8: {stats['kernels_quantized']} kernels, "
+              f"{stats['bytes_before']/1e6:.1f} -> "
+              f"{stats['bytes_after']/1e6:.1f} MB", file=sys.stderr)
+
     if args.input_file:
         rows = [json.loads(l) for l in open(args.input_file) if l.strip()]
         texts = [r["text"] for r in rows]
@@ -248,6 +262,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--input_file", default=None,
                     help="jsonl with {'text': ..., 'context'?: ...}")
     ap.add_argument("--num_labels", type=int, default=2)
+    ap.add_argument("--quantize", choices=["none", "int8"], default="none",
+                    help="int8 weight-only dense kernels for causal-lm "
+                         "generation (HBM-bound decode speedup)")
     ap.add_argument("--max_seq_length", type=int, default=512)
     ap.add_argument("--max_new_tokens", type=int, default=64)
     ap.add_argument("--num_beams", type=int, default=1)
