@@ -73,6 +73,8 @@ class BartConfig:
     # PipelinedBartStack): 0 = dense; generation reloads dense
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
+    # int8 weight-only dense kernels for generation (models/quant.py)
+    weight_quant: str = "none"           # none | int8
 
 
 def bart_config_from_hf(hf_config: dict, **overrides) -> BartConfig:
@@ -106,7 +108,12 @@ def bart_config_from_hf(hf_config: dict, **overrides) -> BartConfig:
     return BartConfig(**kw)
 
 
-def _dense(cfg, features: int, name: str) -> nn.Dense:
+def _dense(cfg, features: int, name: str) -> nn.Module:
+    if cfg.weight_quant == "int8":
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+            Int8Dense,
+        )
+        return Int8Dense(features, dtype=cfg.dtype, name=name)
     return nn.Dense(features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                     kernel_init=nn.initializers.normal(cfg.init_std),
                     name=name)

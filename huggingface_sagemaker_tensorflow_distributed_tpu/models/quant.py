@@ -15,11 +15,13 @@ The reference has no quantization story at all (its serving path is
 reference ``scripts/train.py:182-183``); this is in-repo and targeted
 at the decode bench (``bench.py --generate``).
 
-Scope: GPT-2-family dense layers (qkv / attn_out / fc_in / fc_out —
-``models/gpt2.py::_dense`` is the single chokepoint). Embeddings and
-the tied LM head stay full precision: wte is a lookup (no bandwidth
-win) and its transpose is the output projection, where quantization
-error lands directly on the logits.
+Scope: the dense kernels of the generating families — GPT-2
+(qkv / attn_out / fc_in / fc_out), T5 (query/key/value/attention_out,
+wi / wi_0 / wi_1 / wo) and BART/mBART (q/k/v/o, fc1/fc2); each family's
+``_dense`` helper is its single chokepoint. Embeddings and LM heads
+(tied or not) stay full precision: embedding tables are lookups (no
+bandwidth win) and the output projection is where quantization error
+lands directly on the logits.
 """
 
 from __future__ import annotations
@@ -32,9 +34,11 @@ import numpy as np
 from flax import linen as nn
 from flax.traverse_util import flatten_dict, unflatten_dict
 
-# GPT-2 dense-kernel leaves that become int8 (path regex against the
-# "/"-joined param path ending in "/kernel")
+# per-family dense-kernel leaves that become int8 (path regex against
+# the "/"-joined param path ending in "/kernel"); LM heads excluded
 GPT2_QUANT_TARGETS = r"(qkv|attn_out|fc_in|fc_out)/kernel$"
+T5_QUANT_TARGETS = r"(query|key|value|attention_out|wi|wi_0|wi_1|wo)/kernel$"
+BART_QUANT_TARGETS = r"(query|key|value|attention_out|fc1|fc2)/kernel$"
 
 
 class Int8Dense(nn.Module):
@@ -45,6 +49,7 @@ class Int8Dense(nn.Module):
 
     features: int
     dtype: Any = jnp.float32
+    use_bias: bool = True                 # False for T5's bias-free denses
 
     @nn.compact
     def __call__(self, x):
@@ -53,12 +58,15 @@ class Int8Dense(nn.Module):
                        (in_features, self.features), jnp.int8)
         scale = self.param("kernel_scale", nn.initializers.ones,
                            (self.features,), jnp.float32)
-        bias = self.param("bias", nn.initializers.zeros,
-                          (self.features,), jnp.float32)
         # dequant is elementwise on the weight: XLA fuses it into the
         # dot's operand read; only int8 bytes cross HBM
         w = q.astype(self.dtype) * scale.astype(self.dtype)[None, :]
-        return x @ w + bias.astype(self.dtype)
+        y = x @ w
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
 
 
 def quantize_kernel(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
@@ -71,8 +79,7 @@ def quantize_kernel(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     return q, scale
 
 
-def quantize_params(params: Any,
-                    targets: str = GPT2_QUANT_TARGETS) -> tuple[Any, dict]:
+def quantize_params(params: Any, targets: str) -> tuple[Any, dict]:
     """Rewrite targeted ``.../kernel`` leaves into ``kernel_q`` +
     ``kernel_scale`` (the :class:`Int8Dense` layout); everything else
     passes through. Returns (quantized tree, stats dict)."""
@@ -99,25 +106,37 @@ def quantize_params(params: Any,
     return unflatten_dict(out), stats
 
 
-def quantize_gpt2(model, params) -> tuple[Any, Any, dict]:
+def quantize_for_generation(model, params) -> tuple[Any, Any, dict]:
     """(model, params) -> (int8 model, int8 params, stats) for
     generation. The returned model is the same architecture with
-    ``weight_quant='int8'`` (``models/gpt2.py::_dense`` swaps in
-    :class:`Int8Dense`); KV cache, prefill+scan decode and sampling are
-    untouched."""
+    ``weight_quant='int8'`` (the family's ``_dense`` helper swaps in
+    :class:`Int8Dense`); KV cache, decode schedules and sampling are
+    untouched. Covers GPT-2, T5 and BART/mBART."""
     import dataclasses
 
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bart import (
+        BartConfig,
+    )
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
         Gpt2Config,
     )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5Config,
+    )
 
     cfg = model.config
-    if not isinstance(cfg, Gpt2Config):
+    targets = {Gpt2Config: GPT2_QUANT_TARGETS, T5Config: T5_QUANT_TARGETS,
+               BartConfig: BART_QUANT_TARGETS}.get(type(cfg))
+    if targets is None:
         raise ValueError(
-            "int8 weight-only quantization currently covers the "
-            "GPT-2 family only (the decode-bound one); got "
+            "int8 weight-only quantization covers the generating "
+            "families (GPT-2, T5, BART/mBART); got "
             f"{type(cfg).__name__}")
     qcfg = dataclasses.replace(cfg, weight_quant="int8")
     qmodel = type(model)(qcfg)
-    qparams, stats = quantize_params(params)
+    qparams, stats = quantize_params(params, targets)
     return qmodel, qparams, stats
+
+
+# original (GPT-2-only) entry point; kept as an alias
+quantize_gpt2 = quantize_for_generation

@@ -81,6 +81,8 @@ class T5Config:
     # (KV cache) reloads dense like GPT-2's pipelined stack.
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0
+    # int8 weight-only dense kernels for generation (models/quant.py)
+    weight_quant: str = "none"           # none | int8
 
     @property
     def is_gated_act(self) -> bool:
@@ -143,6 +145,20 @@ class RMSNorm(nn.Module):
 
 
 
+def _t5_dense(cfg, features: int, std: float, name: str) -> nn.Module:
+    """The ONE construction of T5's bias-free dense — fp or int8
+    (models/quant.py) — shared by attention and FFN."""
+    if cfg.weight_quant == "int8":
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+            Int8Dense,
+        )
+        return Int8Dense(features, dtype=cfg.dtype, use_bias=False,
+                         name=name)
+    return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    kernel_init=nn.initializers.normal(std), name=name)
+
+
 class T5Attention(nn.Module):
     """Multi-head attention, T5 flavor: no bias, no sqrt(d) scaling,
     optional relative-position bias, optional incremental KV cache."""
@@ -151,14 +167,12 @@ class T5Attention(nn.Module):
     causal: bool = False
     has_rel_bias: bool = False
 
-    def _dense(self, features: int, name: str) -> nn.Dense:
+    def _dense(self, features: int, name: str) -> nn.Module:
         cfg = self.config
         # HF init: q scaled by (d_model * d_kv)^-0.5, k/v/o by d_model^-0.5;
         # the fine-tune path overwrites these with checkpoint weights anyway.
-        std = cfg.initializer_factor * cfg.d_model ** -0.5
-        return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype,
-                        kernel_init=nn.initializers.normal(std), name=name)
+        return _t5_dense(cfg, features,
+                         cfg.initializer_factor * cfg.d_model ** -0.5, name)
 
     def _rel_bias_embed(self) -> nn.Embed:
         """The ONE construction of the rel_bias embedding — xla mode
@@ -288,9 +302,7 @@ class T5FeedForward(nn.Module):
         std_out = cfg.initializer_factor * cfg.d_ff ** -0.5
 
         def dense(features, std, name):
-            return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
-                            param_dtype=cfg.param_dtype,
-                            kernel_init=nn.initializers.normal(std), name=name)
+            return _t5_dense(cfg, features, std, name)
 
         if cfg.is_gated_act:
             gate = cfg.act_fn(dense(cfg.d_ff, std_in, "wi_0")(x))

@@ -90,13 +90,13 @@ def predict(args) -> list[dict]:
     if getattr(args, "quantize", "none") == "int8":
         # int8 weight-only decode (models/quant.py): HBM-bound decode
         # reads 1/4 the kernel bytes; compute stays in the model dtype
-        if args.task != "causal-lm":
-            raise SystemExit("--quantize int8 covers --task causal-lm "
-                             "(GPT-2 family) only")
+        if args.task not in ("causal-lm", "seq2seq"):
+            raise SystemExit("--quantize int8 covers the generation tasks "
+                             "(--task causal-lm or seq2seq)")
         from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
-            quantize_gpt2,
+            quantize_for_generation,
         )
-        model, params, stats = quantize_gpt2(model, params)
+        model, params, stats = quantize_for_generation(model, params)
         print(f"int8: {stats['kernels_quantized']} kernels, "
               f"{stats['bytes_before']/1e6:.1f} -> "
               f"{stats['bytes_after']/1e6:.1f} MB", file=sys.stderr)

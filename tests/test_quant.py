@@ -18,7 +18,9 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
     generate_causal,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+    GPT2_QUANT_TARGETS,
     Int8Dense,
+    quantize_for_generation,
     quantize_gpt2,
     quantize_kernel,
     quantize_params,
@@ -120,7 +122,7 @@ def test_quantize_stats_bytes(gpt2_dir):
     """fp32 checkpoint → ~4x smaller dense kernels (int8 + a scale row)."""
     _, params, _, _ = auto_models.from_pretrained(gpt2_dir,
                                                   task="causal-lm")
-    _, stats = quantize_params(params)
+    _, stats = quantize_params(params, GPT2_QUANT_TARGETS)
     ratio = stats["bytes_before"] / stats["bytes_after"]
     assert 3.5 < ratio <= 4.0, ratio
 
@@ -143,3 +145,49 @@ def test_quantize_rejects_non_gpt2():
     params = init_params(model, cfg, seed=0)
     with pytest.raises(ValueError, match="GPT-2"):
         quantize_gpt2(model, params)
+
+
+@pytest.mark.slow
+def test_quantized_t5_and_bart_generate(tmp_path_factory):
+    """The encoder-decoder families quantize and decode too: logits stay
+    close to full precision and cached greedy generation runs."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        generate,
+    )
+
+    torch.manual_seed(0)
+    cases = []
+    t5_cfg = transformers.T5Config(
+        vocab_size=128, d_model=32, d_kv=8, d_ff=64, num_layers=2,
+        num_decoder_layers=2, num_heads=4, dropout_rate=0.0,
+        decoder_start_token_id=0)
+    d = str(tmp_path_factory.mktemp("t5q"))
+    transformers.T5ForConditionalGeneration(t5_cfg).eval().save_pretrained(d)
+    cases.append((d, 2 * (4 + 2) + 2 * (8 + 2)))  # enc: 2L x (attn4 + ffn2); dec adds cross-attn
+    bart_cfg = transformers.BartConfig(
+        vocab_size=128, d_model=32, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=64, decoder_ffn_dim=64, max_position_embeddings=64,
+        dropout=0.0, pad_token_id=1, bos_token_id=0, eos_token_id=2,
+        decoder_start_token_id=2)
+    d = str(tmp_path_factory.mktemp("bartq"))
+    transformers.BartForConditionalGeneration(bart_cfg).eval().save_pretrained(d)
+    cases.append((d, 2 * (4 + 2) + 2 * (8 + 2)))
+
+    for model_dir, expect_kernels in cases:
+        model, params, fam, _ = auto_models.from_pretrained(model_dir,
+                                                            task="seq2seq")
+        qmodel, qparams, stats = quantize_for_generation(model, params)
+        assert stats["kernels_quantized"] == expect_kernels, (
+            fam, stats["kernels_quantized"])
+        rng = np.random.RandomState(0)
+        src = jnp.asarray(rng.randint(3, 128, (2, 10)))
+        dec_in = jnp.asarray(rng.randint(3, 128, (2, 6)))
+        fp = np.asarray(model.apply({"params": params}, src, None, dec_in,
+                                    deterministic=True), np.float64)
+        q8 = np.asarray(qmodel.apply({"params": qparams}, src, None, dec_in,
+                                     deterministic=True), np.float64)
+        corr = np.corrcoef(fp.ravel(), q8.ravel())[0, 1]
+        assert corr > 0.999, (fam, corr)
+        out = np.asarray(generate(qmodel, qparams, src, max_new_tokens=4))
+        assert out.shape == (2, 4)
