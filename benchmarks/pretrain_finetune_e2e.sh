@@ -12,8 +12,12 @@
 #   A  MLM pretrain 6 epochs from scratch          -> $WORK/mlm_model
 #   B  seq-cls fine-tune 1 epoch FROM A            -> eval_results.txt
 #   C  seq-cls from scratch 1 epoch (control)      -> eval_results.txt
+#   D  LoRA r=8 fine-tune 1 epoch FROM A           -> eval_results.txt
+#      (frozen backbone + adapters/head at 10x lr — the PEFT lr
+#      convention; quality evidence for --lora_rank)
 # Expected: B beats C decisively and approaches/beats the 3-epoch
-# from-scratch 0.985 (EVAL_REALDATA.md) in 1/3 the epochs.
+# from-scratch 0.985 (EVAL_REALDATA.md) in 1/3 the epochs; D lands
+# near B with <1% of the optimizer state.
 set -euo pipefail
 
 WORK=${WORK:-/tmp/pt_ft_e2e}
@@ -52,6 +56,14 @@ python scripts/train.py $COMMON --task seq-cls --from_scratch true \
   --output_data_dir "$WORK/scratch_out" --model_dir "$WORK/scratch_model" \
   --checkpoint_dir "$WORK/scratch_ckpt"
 
+echo "=== D: LoRA r=8 fine-tune 1 epoch FROM the MLM export ==="
+python scripts/train.py $COMMON --task seq-cls \
+  --model_name_or_path "$WORK/mlm_model" --epochs 1 --learning_rate 3e-3 \
+  --lora_rank 8 \
+  --output_data_dir "$WORK/lora_out" --model_dir "$WORK/lora_model" \
+  --checkpoint_dir "$WORK/lora_ckpt"
+
 echo "=== results ==="
 echo "--- B (pretrained, 1 epoch):"; cat "$WORK/ft_out/eval_results.txt"
 echo "--- C (scratch, 1 epoch):"; cat "$WORK/scratch_out/eval_results.txt"
+echo "--- D (pretrained + LoRA r=8, 1 epoch):"; cat "$WORK/lora_out/eval_results.txt"

@@ -325,14 +325,11 @@ class TrainConfig:
             raise ValueError("lora_rank must be >= 0 (0 disables LoRA)")
         if self.lora_rank > 0 and self.lora_alpha <= 0:
             raise ValueError("lora_alpha must be positive")
-        if self.lora_rank > 0 and self.gradient_accumulation_steps > 1:
-            # optax.MultiSteps inside multi_transform would accumulate
-            # masked placeholder leaves; keep the combination closed off
-            # until that composition is tested
-            raise ValueError(
-                "lora_rank > 0 with gradient_accumulation_steps > 1 is "
-                "not supported yet (adapters are small — prefer a larger "
-                "per-chip batch instead)")
+        # lora_rank > 0 composes with gradient accumulation: the trainer
+        # wraps multi_transform AROUND the MultiSteps'd optimizer, so the
+        # accumulator only ever sees the trainable (adapter+head) subtree
+        # — MaskedNode placeholders carry no leaves and accumulate
+        # nothing (parity-tested in tests/test_lora.py)
         if self.num_experts and self.num_experts % self.ep:
             raise ValueError(
                 f"num_experts={self.num_experts} must divide over ep={self.ep}")

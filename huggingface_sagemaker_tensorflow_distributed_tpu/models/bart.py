@@ -109,14 +109,11 @@ def bart_config_from_hf(hf_config: dict, **overrides) -> BartConfig:
 
 
 def _dense(cfg, features: int, name: str) -> nn.Module:
-    if cfg.weight_quant == "int8":
-        from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
-            Int8Dense,
-        )
-        return Int8Dense(features, dtype=cfg.dtype, name=name)
-    return nn.Dense(features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                    kernel_init=nn.initializers.normal(cfg.init_std),
-                    name=name)
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+        make_dense,
+    )
+    return make_dense(cfg, features, nn.initializers.normal(cfg.init_std),
+                      name=name)
 
 
 def _ln(cfg, name: str) -> nn.LayerNorm:

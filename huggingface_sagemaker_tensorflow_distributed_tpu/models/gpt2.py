@@ -125,15 +125,12 @@ def gpt2_config_from_hf(hf_config: dict, **overrides) -> Gpt2Config:
 
 def _dense(cfg: Gpt2Config, features: int, name: str,
            std: Optional[float] = None) -> nn.Module:
-    if cfg.weight_quant == "int8":
-        from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
-            Int8Dense,
-        )
-        return Int8Dense(features, dtype=cfg.dtype, name=name)
-    return nn.Dense(
-        features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-        kernel_init=nn.initializers.normal(std or cfg.initializer_range),
-        name=name)
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+        make_dense,
+    )
+    return make_dense(
+        cfg, features,
+        nn.initializers.normal(std or cfg.initializer_range), name=name)
 
 
 def _layernorm(cfg: Gpt2Config, name: str) -> nn.LayerNorm:

@@ -69,6 +69,19 @@ class Int8Dense(nn.Module):
         return y
 
 
+def make_dense(cfg, features: int, kernel_init, *, use_bias: bool = True,
+               name: str | None = None) -> nn.Module:
+    """THE dense-construction chokepoint for the generating families:
+    fp (``nn.Dense``) or int8 (:class:`Int8Dense`) by ``cfg.weight_quant``
+    — so a new weight_quant mode lands here once, not per family."""
+    if getattr(cfg, "weight_quant", "none") == "int8":
+        return Int8Dense(features, dtype=cfg.dtype, use_bias=use_bias,
+                         name=name)
+    return nn.Dense(features, use_bias=use_bias, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype, kernel_init=kernel_init,
+                    name=name)
+
+
 def quantize_kernel(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """Symmetric per-output-channel int8: scale = max|w|/127 per column,
     q = round(w/scale). Returns (q int8 [in, out], scale fp32 [out])."""

@@ -146,17 +146,13 @@ class RMSNorm(nn.Module):
 
 
 def _t5_dense(cfg, features: int, std: float, name: str) -> nn.Module:
-    """The ONE construction of T5's bias-free dense — fp or int8
-    (models/quant.py) — shared by attention and FFN."""
-    if cfg.weight_quant == "int8":
-        from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
-            Int8Dense,
-        )
-        return Int8Dense(features, dtype=cfg.dtype, use_bias=False,
-                         name=name)
-    return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
-                    param_dtype=cfg.param_dtype,
-                    kernel_init=nn.initializers.normal(std), name=name)
+    """T5's bias-free dense — fp or int8 via the shared chokepoint
+    (``models/quant.py::make_dense``) — used by attention and FFN."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+        make_dense,
+    )
+    return make_dense(cfg, features, nn.initializers.normal(std),
+                      use_bias=False, name=name)
 
 
 class T5Attention(nn.Module):

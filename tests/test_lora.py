@@ -205,10 +205,35 @@ def test_lora_adapter_sidecar_roundtrip(tmp_path, devices8):
         np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
 
 
-def test_lora_rejects_grad_accumulation():
-    with pytest.raises(ValueError, match="lora_rank"):
-        TrainConfig(task="seq-cls", lora_rank=4,
-                    gradient_accumulation_steps=2)
+@pytest.mark.slow
+def test_lora_grad_accumulation_matches_big_batch(devices8):
+    """LoRA + accumulation: accum=2 at global batch 8 must produce the
+    same final (base, adapter) state as one update at global batch 16 —
+    MultiSteps under multi_transform accumulates only the trainable
+    subtree (MaskedNode placeholders carry no leaves)."""
+    final = {}
+    for accum, gb in ((1, 16), (2, 8)):
+        mesh = build_mesh(MeshConfig(dp=-1), devices=devices8)
+        # dropout-free: per-micro-step rng draws would otherwise differ
+        # from the single-big-step draw (same as the non-LoRA accum test)
+        model_cfg = _cfg(hidden_dropout=0.0, attention_dropout=0.0)
+        model = BertForSequenceClassification(model_cfg, num_labels=2)
+        params = init_params(model, model_cfg, seed=0)
+        cfg = TrainConfig(task="seq-cls", dtype="float32",
+                          learning_rate=1e-2, scale_lr_by_world_size=False,
+                          log_every_steps=0, rng_impl="threefry",
+                          lora_rank=4, gradient_accumulation_steps=accum)
+        trainer = Trainer(cfg, model, params, mesh)
+        tok = WordHashTokenizer(vocab_size=256)
+        texts, labels = synthetic_text_classification(64, seed=7)
+        ds = ArrayDataset.from_texts(tok, texts, labels, max_length=SEQ)
+        for batch in ShardedBatcher(ds, gb, mesh, shuffle=False,
+                                    seed=0).global_arrays(0):
+            trainer.state, _ = trainer._train_step(trainer.state, batch)
+        final[accum] = jax.device_get(trainer.state.params)
+    for x, y in zip(jax.tree.leaves(final[1]), jax.tree.leaves(final[2])):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x),
+                                   atol=1e-5, rtol=1e-4)
 
 
 @pytest.mark.slow
@@ -295,3 +320,47 @@ def test_lora_trains_on_tp_mesh(devices8):
     ref = losses(MeshConfig(dp=-1))
     tp = losses(MeshConfig(dp=2, tp=2, fsdp=2))
     np.testing.assert_allclose(ref, tp, rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_lora_checkpoint_resume_roundtrip(tmp_path, devices8):
+    """The split {"model","lora"} state (and the multi_transform
+    opt_state with its masked placeholders) round-trips through the
+    Orbax checkpointer into a FRESH trainer built from a different
+    seed — the preemption story holds under LoRA."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.checkpoint import (
+        Checkpointer,
+    )
+
+    def make(seed):
+        mesh = build_mesh(MeshConfig(dp=-1), devices=devices8)
+        model_cfg = _cfg()
+        model = BertForSequenceClassification(model_cfg, num_labels=2)
+        params = init_params(model, model_cfg, seed=seed)
+        cfg = TrainConfig(task="seq-cls", dtype="float32",
+                          learning_rate=2e-2, scale_lr_by_world_size=False,
+                          log_every_steps=0, rng_impl="threefry",
+                          lora_rank=4, checkpoint_dir=str(tmp_path / "ck"))
+        trainer = Trainer(cfg, model, params, mesh)
+        tok = WordHashTokenizer(vocab_size=256)
+        texts, labels = synthetic_text_classification(32, seed=0)
+        ds = ArrayDataset.from_texts(tok, texts, labels, max_length=SEQ)
+        return cfg, trainer, ShardedBatcher(ds, 8, mesh, shuffle=False,
+                                            seed=0)
+
+    cfg, trainer, batcher = make(seed=0)
+    for batch in batcher.global_arrays(0):
+        trainer.state, _ = trainer._train_step(trainer.state, batch)
+    ckpt = Checkpointer(cfg.checkpoint_dir)
+    ckpt.save(trainer.state, epoch=1)
+    ckpt.wait_until_finished()
+
+    _, trainer2, _ = make(seed=9)
+    restored, epoch, _ = Checkpointer(cfg.checkpoint_dir).restore(
+        trainer2.state)
+    assert epoch == 1
+    assert set(restored.params.keys()) == {"model", "lora"}
+    for x, y in zip(jax.tree.leaves(jax.device_get(trainer.state)),
+                    jax.tree.leaves(jax.device_get(restored))):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    ckpt.close()
