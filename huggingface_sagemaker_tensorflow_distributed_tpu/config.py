@@ -177,6 +177,14 @@ class TrainConfig:
     # the unfused path to fp32 roundoff, tests/test_vocab_ce.py).
     fused_vocab_ce: bool = False
 
+    # --- QA doc-stride (HF run_qa semantics): contexts longer than the
+    #     room left by the question become overlapping windows instead of
+    #     being truncated — at training (independent rows) AND at the
+    #     --eval_qa_samples EM/F1 eval (best-scoring span across each
+    #     example's windows). 0 = truncate (reference-era behavior);
+    #     HF's conventional value is 128. ---
+    qa_doc_stride: int = 0
+
     # --- LoRA parameter-efficient fine-tuning (models/lora.py;
     #     beyond-parity — the reference trains every weight,
     #     train.py:117). rank 0 = off. With rank r > 0 the base model is
@@ -321,6 +329,8 @@ class TrainConfig:
             raise ValueError("num_experts >= 0, expert_top_k >= 1, moe_every >= 1")
         if self.ep > 1 and self.num_experts == 0:
             raise ValueError("ep > 1 requires num_experts > 0 (MoE model)")
+        if self.qa_doc_stride < 0:
+            raise ValueError("qa_doc_stride must be >= 0 (0 disables)")
         if self.lora_rank < 0:
             raise ValueError("lora_rank must be >= 0 (0 disables LoRA)")
         if self.lora_rank > 0 and self.lora_alpha <= 0:

@@ -333,11 +333,18 @@ class ArrayDataset:
 
     @classmethod
     def from_qa(cls, tokenizer, questions, contexts, start_chars, answer_texts,
-                max_length: int = 512) -> "ArrayDataset":
-        """SQuAD-style spans → start/end token positions."""
-        enc = tokenizer.encode_qa(questions, contexts, start_chars,
-                                  answer_texts, max_length=max_length)
-        return cls(dict(enc))
+                max_length: int = 512, doc_stride: int = 0) -> "ArrayDataset":
+        """SQuAD-style spans → start/end token positions. ``doc_stride``
+        > 0 trains on overlapping context windows (HF run_qa) instead of
+        truncating long contexts — each window is an independent row,
+        labeled iff it contains the full answer."""
+        enc = dict(tokenizer.encode_qa(questions, contexts, start_chars,
+                                       answer_texts, max_length=max_length,
+                                       doc_stride=doc_stride))
+        # feature→example map is an eval-side concern; training rows are
+        # independent and the loss must not see the extra column
+        enc.pop("example_ids", None)
+        return cls(enc)
 
     @classmethod
     def from_seq2seq(cls, tokenizer, sources, targets,

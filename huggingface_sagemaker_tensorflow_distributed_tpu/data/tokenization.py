@@ -31,6 +31,106 @@ from typing import Sequence
 import numpy as np
 
 
+# --- QA feature assembly shared by the in-repo tokenizers ------------------
+# (WordHash here and WordPiece in data/wordpiece.py build the identical
+# [CLS] question [SEP] context-window [SEP] layout; the HF wrapper uses
+# the fast tokenizer's own overflow machinery instead.)
+
+def _qa_windows(n_q: int, n_ctx: int, max_length: int, doc_stride: int):
+    """(window_start, window_len) pairs over the context tokens.
+    stride 0 → one truncated window (the pre-stride behavior); stride>0 →
+    overlapping windows covering the whole context. ``doc_stride`` is the
+    OVERLAP between consecutive windows — the HF fast-tokenizer ``stride``
+    parameter's meaning, so one config value windows identically across
+    all tokenizer tiers; a stride ≥ the window size clamps to step 1 so
+    coverage never gaps."""
+    room = max_length - n_q - 3
+    if room <= 0:
+        yield 0, 0
+        return
+    if doc_stride <= 0 or n_ctx <= room:
+        yield 0, min(n_ctx, room)
+        return
+    step = max(room - doc_stride, 1)
+    w = 0
+    while True:
+        yield w, min(room, n_ctx - w)
+        if w + room >= n_ctx:
+            return
+        w += step
+
+
+def _qa_feature(example_id: int, q_ids, win_ids, win_spans, max_length: int,
+                labeled: bool, a_start: int, a_end: int,
+                cls_id: int, sep_id: int) -> dict:
+    """One feature row: ids/segments/context char-offsets/labels for a
+    single context window. The label is the token span iff the window
+    contains the FULL answer (HF run_qa convention); otherwise (0, 0) =
+    CLS, the unanswerable-in-this-window marker."""
+    ids = [cls_id] + list(q_ids) + [sep_id] + list(win_ids) + [sep_id]
+    segs = [0] * (len(q_ids) + 2) + [1] * (len(win_ids) + 1)
+    ctx_offset = len(q_ids) + 2
+    tok_start = tok_end = None
+    first_start = last_end = 0
+    ctx_positions = []
+    for t, (s, e) in enumerate(win_spans):
+        pos = ctx_offset + t
+        if pos >= max_length or e == s:
+            continue
+        ctx_positions.append((pos, s, e))
+        if labeled and s < a_end and e > a_start:
+            if tok_start is None:
+                tok_start = pos
+                first_start = s
+            tok_end = pos
+            last_end = e
+    # label iff the window contains the FULL answer: head covered
+    # (first overlapping token starts at/before the answer) AND tail
+    # covered — a window cutting either side trains toward CLS, not a
+    # partial span (HF run_qa convention)
+    if (tok_start is None or first_start > a_start or last_end < a_end
+            or tok_end >= max_length):
+        tok_start = tok_end = 0
+    return {"example_id": example_id, "ids": ids[:max_length],
+            "segs": segs[:max_length], "tok_start": tok_start,
+            "tok_end": tok_end, "ctx_positions": ctx_positions}
+
+
+def _qa_assemble(rows, max_length: int, pad_id: int, return_offsets: bool,
+                 token_type: bool) -> dict:
+    """Stack feature rows into the encode_qa array contract (+
+    ``example_ids``, the feature→input map for doc-stride aggregation)."""
+    n = len(rows)
+    input_ids = np.full((n, max_length), pad_id, np.int32)
+    attention_mask = np.zeros((n, max_length), np.int32)
+    token_type_ids = np.zeros((n, max_length), np.int32)
+    start_positions = np.zeros(n, np.int32)
+    end_positions = np.zeros(n, np.int32)
+    example_ids = np.zeros(n, np.int32)
+    offset_starts = np.full((n, max_length), -1, np.int32)
+    offset_ends = np.full((n, max_length), -1, np.int32)
+    for r, row in enumerate(rows):
+        ids = row["ids"]
+        input_ids[r, : len(ids)] = ids
+        attention_mask[r, : len(ids)] = 1
+        token_type_ids[r, : len(row["segs"])] = row["segs"]
+        start_positions[r] = row["tok_start"]
+        end_positions[r] = row["tok_end"]
+        example_ids[r] = row["example_id"]
+        for pos, s, e in row["ctx_positions"]:
+            offset_starts[r, pos] = s
+            offset_ends[r, pos] = e
+    res = {"input_ids": input_ids, "attention_mask": attention_mask,
+           "start_positions": start_positions,
+           "end_positions": end_positions, "example_ids": example_ids}
+    if token_type:
+        res["token_type_ids"] = token_type_ids
+    if return_offsets:
+        res["offset_starts"] = offset_starts
+        res["offset_ends"] = offset_ends
+    return res
+
+
 class WordHashTokenizer:
     """Deterministic hashing tokenizer (offline fallback).
 
@@ -136,7 +236,7 @@ class WordHashTokenizer:
 
     def encode_qa(self, questions, contexts, start_chars=None,
                   answer_texts=None, max_length: int | None = None,
-                  return_offsets: bool = False):
+                  return_offsets: bool = False, doc_stride: int = 0):
         """Question+context pairs → ids with answer span token positions.
 
         Char-offset → token-index mapping via the same regex the word
@@ -147,55 +247,33 @@ class WordHashTokenizer:
         answer-text decoding input, eval-side only so the extra columns
         never reach the model). ``start_chars``/``answer_texts`` may be
         None (inference: no labels to build).
+
+        ``doc_stride > 0``: contexts longer than the room left by the
+        question become MULTIPLE overlapping windows (HF run_qa
+        semantics) instead of being truncated; the result rows are
+        features, with ``example_ids`` mapping each feature back to its
+        input — aggregate with ``utils.metrics.best_windowed_answers``.
         """
         max_length = max_length or self.model_max_length
-        n = len(questions)
-        input_ids = np.full((n, max_length), self.pad_token_id, np.int32)
-        attention_mask = np.zeros((n, max_length), np.int32)
-        token_type_ids = np.zeros((n, max_length), np.int32)
-        start_positions = np.zeros(n, np.int32)
-        end_positions = np.zeros(n, np.int32)
-        offset_starts = np.full((n, max_length), -1, np.int32)
-        offset_ends = np.full((n, max_length), -1, np.int32)
-        for r in range(n):
+        rows = []
+        for r in range(len(questions)):
             q = questions[r].lower() if self.lowercase else questions[r]
             c = contexts[r].lower() if self.lowercase else contexts[r]
             q_ids = [self._word_id(w) for w in re.findall(r"\w+|[^\w\s]", q)]
-            ctx_spans = [(m.group(0), m.start(), m.end())
-                         for m in re.finditer(r"\w+|[^\w\s]", c)]
-            c_ids = [self._word_id(w) for w, _, _ in ctx_spans]
-            ids = [self.cls_token_id] + q_ids + [self.sep_token_id] + c_ids + [self.sep_token_id]
-            segs = [0] * (len(q_ids) + 2) + [1] * (len(c_ids) + 1)
-            ctx_offset = len(q_ids) + 2  # token index of first context token
-            tok_start = tok_end = None
-            if start_chars is not None:
-                a_start = start_chars[r]
-                a_end = a_start + len(answer_texts[r])
-                for t, (_, s, e) in enumerate(ctx_spans):
-                    if s < a_end and e > a_start:  # overlap
-                        if tok_start is None:
-                            tok_start = ctx_offset + t
-                        tok_end = ctx_offset + t
-            ids, segs = ids[:max_length], segs[:max_length]
-            input_ids[r, : len(ids)] = ids
-            attention_mask[r, : len(ids)] = 1
-            token_type_ids[r, : len(segs)] = segs
-            if tok_start is not None and tok_end < max_length:
-                start_positions[r] = tok_start
-                end_positions[r] = tok_end
-            for t, (_, s, e) in enumerate(ctx_spans):
-                pos = ctx_offset + t
-                if pos >= max_length:
-                    break
-                offset_starts[r, pos] = s
-                offset_ends[r, pos] = e
-        res = {"input_ids": input_ids, "attention_mask": attention_mask,
-               "token_type_ids": token_type_ids,
-               "start_positions": start_positions, "end_positions": end_positions}
-        if return_offsets:
-            res["offset_starts"] = offset_starts
-            res["offset_ends"] = offset_ends
-        return res
+            ctx_spans = [(m.start(), m.end()) for m in
+                         re.finditer(r"\w+|[^\w\s]", c)]
+            c_ids = [self._word_id(c[s:e]) for s, e in ctx_spans]
+            labeled = start_chars is not None
+            a_start = start_chars[r] if labeled else 0
+            a_end = a_start + (len(answer_texts[r]) if labeled else 0)
+            for w0, nw in _qa_windows(len(q_ids), len(c_ids), max_length,
+                                      doc_stride):
+                rows.append(_qa_feature(
+                    r, q_ids, c_ids[w0:w0 + nw], ctx_spans[w0:w0 + nw],
+                    max_length, labeled, a_start, a_end,
+                    self.cls_token_id, self.sep_token_id))
+        return _qa_assemble(rows, max_length, self.pad_token_id,
+                            return_offsets, token_type=True)
 
     def save_pretrained(self, output_dir: str) -> None:
         os.makedirs(output_dir, exist_ok=True)
@@ -284,28 +362,39 @@ class HFTokenizer:
 
     def encode_qa(self, questions, contexts, start_chars=None,
                   answer_texts=None, max_length: int | None = None,
-                  return_offsets: bool = False):
+                  return_offsets: bool = False, doc_stride: int = 0):
         """Question+context → ids + answer token span via offset mapping.
         ``return_offsets`` adds ``offset_starts``/``offset_ends`` (char
         offsets into the context per CONTEXT token, -1 elsewhere) for
         answer-text decoding at eval. ``start_chars``/``answer_texts``
-        may be None (inference: no labels to build)."""
+        may be None (inference: no labels to build). ``doc_stride > 0``
+        uses the fast tokenizer's own overflow machinery (one feature per
+        context window; ``example_ids`` maps features → inputs)."""
         max_length = max_length or self.model_max_length
-        out = self._tok(questions, contexts, truncation="only_second",
-                        padding="max_length", max_length=max_length,
-                        return_offsets_mapping=True, return_tensors="np")
-        n = len(questions)
+        kw = {}
+        if doc_stride > 0:
+            kw = {"return_overflowing_tokens": True, "stride": doc_stride}
+        out = self._tok(list(questions), list(contexts),
+                        truncation="only_second", padding="max_length",
+                        max_length=max_length,
+                        return_offsets_mapping=True, return_tensors="np",
+                        **kw)
+        n = out["input_ids"].shape[0]          # features (== inputs if stride 0)
+        example_ids = (out["overflow_to_sample_mapping"].astype(np.int32)
+                       if doc_stride > 0 else np.arange(n, dtype=np.int32))
         start_positions = np.zeros(n, np.int32)
         end_positions = np.zeros(n, np.int32)
         offset_starts = np.full((n, max_length), -1, np.int32)
         offset_ends = np.full((n, max_length), -1, np.int32)
         offsets = out["offset_mapping"]
         for r in range(n):
+            ex = int(example_ids[r])
             labeled = start_chars is not None
-            a_start = start_chars[r] if labeled else 0
-            a_end = a_start + (len(answer_texts[r]) if labeled else 0)
+            a_start = start_chars[ex] if labeled else 0
+            a_end = a_start + (len(answer_texts[ex]) if labeled else 0)
             seq_ids = out.sequence_ids(r)
             tok_start = tok_end = None
+            first_start = 0
             for t, (s, e) in enumerate(offsets[r]):
                 if seq_ids[t] != 1 or e == s:
                     continue
@@ -314,16 +403,20 @@ class HFTokenizer:
                 if labeled and s < a_end and e > a_start:
                     if tok_start is None:
                         tok_start = t
+                        first_start = s
                     tok_end = t
-            # only label spans that contain the FULL answer; partially
-            # truncated answers fall back to (0,0)/CLS like the WordHash
-            # path and HF's run_qa convention
-            if tok_start is not None and offsets[r][tok_end][1] >= a_end:
+            # only label spans that contain the FULL answer — head AND
+            # tail; a window starting mid-answer (possible with
+            # doc_stride overflow) or truncating its tail falls back to
+            # (0,0)/CLS like the in-repo tiers and HF's run_qa
+            if (tok_start is not None and first_start <= a_start
+                    and offsets[r][tok_end][1] >= a_end):
                 start_positions[r] = tok_start
                 end_positions[r] = tok_end
         res = {"input_ids": out["input_ids"].astype(np.int32),
                "attention_mask": out["attention_mask"].astype(np.int32),
-               "start_positions": start_positions, "end_positions": end_positions}
+               "start_positions": start_positions,
+               "end_positions": end_positions, "example_ids": example_ids}
         if "token_type_ids" in out:
             res["token_type_ids"] = out["token_type_ids"].astype(np.int32)
         if return_offsets:

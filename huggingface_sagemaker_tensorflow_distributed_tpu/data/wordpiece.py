@@ -336,68 +336,72 @@ class WordPieceTokenizer:
 
     def encode_qa(self, questions, contexts, start_chars=None,
                   answer_texts=None, max_length: int | None = None,
-                  return_offsets: bool = False):
+                  return_offsets: bool = False, doc_stride: int = 0):
         """Question+context pairs → ids + answer token spans via the
         code-point offsets the core emits (HF offset_mapping semantics,
         truncation="only_second"). ``return_offsets`` adds
         ``offset_starts``/``offset_ends`` (char offsets into the context
         per CONTEXT token, -1 elsewhere) for answer-text decoding.
-        ``start_chars``/``answer_texts`` may be None (inference)."""
+        ``start_chars``/``answer_texts`` may be None (inference).
+        ``doc_stride > 0``: overlapping context windows instead of
+        truncation, with ``example_ids`` mapping features → inputs
+        (shared assembly with the WordHash tier, data/tokenization.py)."""
+        from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
+            _qa_assemble,
+            _qa_feature,
+            _qa_windows,
+        )
+
         max_length = max_length or self.model_max_length
         n = len(questions)
         q_ids, _, _, _, q_cnt = self._tokenize_batch(list(questions), max_length)
-        c_ids, _, c_starts, c_ends, c_cnt = self._tokenize_batch(
-            list(contexts), max_length)
+        if doc_stride <= 0:
+            c_ids, _, c_starts, c_ends, c_cnt = self._tokenize_batch(
+                list(contexts), max_length)
+        else:
+            # with stride the windows must see the WHOLE context, not a
+            # max_length-truncated one. Tokenize in row chunks with a
+            # per-chunk width bounded by the chunk's longest context in
+            # CHARS (a wordpiece is >= 1 char), so the buffers stay
+            # ~chunk x actual-need instead of n x 8192 for the split
+            CHUNK, HARD_CAP = 128, 8192
+            parts = []
+            for lo in range(0, n, CHUNK):
+                chunk = list(contexts[lo:lo + CHUNK])
+                cap = max(max_length,
+                          min(HARD_CAP, max(len(c) for c in chunk)))
+                parts.append(self._tokenize_batch(chunk, cap))
+            widest = max(p[0].shape[1] for p in parts)
 
-        input_ids = np.full((n, max_length), self.pad_token_id, np.int32)
-        attention_mask = np.zeros((n, max_length), np.int32)
-        token_type_ids = np.zeros((n, max_length), np.int32)
-        start_positions = np.zeros(n, np.int32)
-        end_positions = np.zeros(n, np.int32)
-        offset_starts = np.full((n, max_length), -1, np.int32)
-        offset_ends = np.full((n, max_length), -1, np.int32)
+            def pad_to(a, fill):
+                out = np.full((a.shape[0], widest), fill, a.dtype)
+                out[:, :a.shape[1]] = a
+                return out
+
+            c_ids = np.concatenate([pad_to(p[0], self.pad_token_id)
+                                    for p in parts])
+            c_starts = np.concatenate([pad_to(p[2], 0) for p in parts])
+            c_ends = np.concatenate([pad_to(p[3], 0) for p in parts])
+            c_cnt = np.concatenate([p[4] for p in parts])
+
+        rows = []
         for r in range(n):
             # only_second truncation: question keeps its tokens (capped so
             # CLS/q/SEP/SEP still fit), context gets the remaining room
             nq = min(int(q_cnt[r]), max_length - 3)
-            room = max_length - nq - 3
-            nc = min(int(c_cnt[r]), max(room, 0))
-            ids = ([self.cls_token_id] + list(q_ids[r, :nq]) + [self.sep_token_id]
-                   + list(c_ids[r, :nc]) + [self.sep_token_id])
-            seg = [0] * (nq + 2) + [1] * (nc + 1)
-            input_ids[r, :len(ids)] = ids
-            attention_mask[r, :len(ids)] = 1
-            token_type_ids[r, :len(seg)] = seg
-            ctx_offset = nq + 2
+            nc = int(c_cnt[r])
+            spans = [(int(c_starts[r, t]), int(c_ends[r, t]))
+                     for t in range(nc)]
             labeled = start_chars is not None
             a_start = start_chars[r] if labeled else 0
             a_end = a_start + (len(answer_texts[r]) if labeled else 0)
-            tok_start = tok_end = None
-            last_end = 0
-            for t in range(nc):
-                s, e = int(c_starts[r, t]), int(c_ends[r, t])
-                if e == s:
-                    continue
-                offset_starts[r, ctx_offset + t] = s
-                offset_ends[r, ctx_offset + t] = e
-                if labeled and s < a_end and e > a_start:
-                    if tok_start is None:
-                        tok_start = ctx_offset + t
-                    tok_end = ctx_offset + t
-                    last_end = e
-            # label only spans containing the FULL answer (HF run_qa
-            # convention); truncated-away answers → (0, 0) = CLS
-            if tok_start is not None and last_end >= a_end:
-                start_positions[r] = tok_start
-                end_positions[r] = tok_end
-        res = {"input_ids": input_ids, "attention_mask": attention_mask,
-               "token_type_ids": token_type_ids,
-               "start_positions": start_positions,
-               "end_positions": end_positions}
-        if return_offsets:
-            res["offset_starts"] = offset_starts
-            res["offset_ends"] = offset_ends
-        return res
+            for w0, nw in _qa_windows(nq, nc, max_length, doc_stride):
+                rows.append(_qa_feature(
+                    r, list(q_ids[r, :nq]), list(c_ids[r, w0:w0 + nw]),
+                    spans[w0:w0 + nw], max_length, labeled, a_start, a_end,
+                    self.cls_token_id, self.sep_token_id))
+        return _qa_assemble(rows, max_length, self.pad_token_id,
+                            return_offsets, token_type=True)
 
     # -- persistence (HF vocab.txt layout: save_pretrained parity,
     #    reference scripts/train.py:183) -----------------------------------

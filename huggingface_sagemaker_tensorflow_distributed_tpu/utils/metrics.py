@@ -102,7 +102,8 @@ def squad_em_f1(predictions: Sequence[str], references: Sequence[str]) -> dict:
 def extract_answer_spans(start_logits, end_logits, offset_starts,
                          offset_ends, contexts: Sequence[str],
                          max_answer_len: int = 30,
-                         with_spans: bool = False):
+                         with_spans: bool = False,
+                         with_scores: bool = False):
     """Decode predicted answer texts from span logits (HF run_qa's n-best
     search collapsed to the argmax pair): best (s, e) with s ≤ e ≤
     s + max_answer_len over CONTEXT tokens only (offsets ≥ 0); a winning
@@ -112,7 +113,9 @@ def extract_answer_spans(start_logits, end_logits, offset_starts,
     -1 outside context tokens — the ``return_offsets=True`` output of the
     tokenizers' ``encode_qa``. With ``with_spans`` each element is
     ``(text, start_token, end_token)`` (tokens -1/-1 on a no-answer
-    decode) so callers can report indices CONSISTENT with the text."""
+    decode) so callers can report indices CONSISTENT with the text.
+    With ``with_scores`` the pair score (start+end logit; -inf for a
+    no-answer decode) is appended — the doc-stride aggregation key."""
     import numpy as np
 
     out = []
@@ -120,7 +123,7 @@ def extract_answer_spans(start_logits, end_logits, offset_starts,
     e_l = np.asarray(end_logits)
     for r in range(len(contexts)):
         idx = np.flatnonzero(np.asarray(offset_starts[r]) >= 0)
-        text, s_tok, e_tok = "", -1, -1
+        text, s_tok, e_tok, score = "", -1, -1, float("-inf")
         if len(idx):
             # pair-score matrix over context tokens, upper-triangular
             # within the answer-length window (seq ≤ 512 ⇒ tiny)
@@ -130,7 +133,29 @@ def extract_answer_spans(start_logits, end_logits, offset_starts,
             s_i, e_i = np.unravel_index(np.argmax(pair), pair.shape)
             if np.isfinite(pair[s_i, e_i]):
                 s_tok, e_tok = int(idx[s_i]), int(idx[e_i])
+                score = float(pair[s_i, e_i])
                 text = contexts[r][offset_starts[r][s_tok]:
                                    offset_ends[r][e_tok]]
-        out.append((text, s_tok, e_tok) if with_spans else text)
+        row = (text,)
+        if with_spans:
+            row += (s_tok, e_tok)
+        if with_scores:
+            row += (score,)
+        out.append(row if len(row) > 1 else text)
     return out
+
+
+def best_windowed_answers(texts: Sequence[str], scores: Sequence[float],
+                          example_ids: Sequence[int],
+                          n_examples: int) -> list[str]:
+    """Doc-stride aggregation (HF run_qa semantics, argmax collapsed):
+    each example's answer is the highest-scoring span across its windows;
+    an example whose every window decodes no-answer gets ""."""
+    best = [""] * n_examples
+    best_score = [float("-inf")] * n_examples
+    for text, score, ex in zip(texts, scores, example_ids):
+        ex = int(ex)
+        if score > best_score[ex]:
+            best_score[ex] = score
+            best[ex] = text
+    return best

@@ -120,7 +120,8 @@ def predict(args) -> list[dict]:
         # char offsets, so the answer decodes by slicing the ORIGINAL
         # context (exact surface text) with the joint span search
         enc = dict(tokenizer.encode_qa(texts, contexts, max_length=max_len,
-                                       return_offsets=True))
+                                       return_offsets=True,
+                                       doc_stride=args.doc_stride))
         # encode_qa pads to max_length; trim every column to the longest
         # real row (the 'longest' contract of _encode) so the jitted
         # width tracks the batch
@@ -128,6 +129,7 @@ def predict(args) -> list[dict]:
         enc = {k: v[:, :width] if getattr(v, "ndim", 1) == 2 else v
                for k, v in enc.items()}
         qa_offsets = (enc["offset_starts"], enc["offset_ends"])
+        qa_example_ids = enc.get("example_ids")
     else:
         enc = _encode(tokenizer, texts, contexts, max_len)
     ids = jnp.asarray(enc["input_ids"])
@@ -196,10 +198,21 @@ def predict(args) -> list[dict]:
             from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import (
                 extract_answer_spans,
             )
+            ex_ids = (qa_example_ids if qa_example_ids is not None
+                      else np.arange(len(texts)))
+            feat_ctx = [contexts[int(ex)] for ex in ex_ids]
             spans = extract_answer_spans(start, end, qa_offsets[0],
-                                         qa_offsets[1], contexts,
-                                         with_spans=True)
-            for text, (answer, s_tok, e_tok) in zip(texts, spans):
+                                         qa_offsets[1], feat_ctx,
+                                         with_spans=True, with_scores=True)
+            # doc-stride: keep each input's highest-scoring window (token
+            # indices are relative to THAT window's feature row)
+            best = {}
+            for (answer, s_tok, e_tok, score), ex in zip(spans, ex_ids):
+                ex = int(ex)
+                if ex not in best or score > best[ex][3]:
+                    best[ex] = (answer, s_tok, e_tok, score)
+            for r, text in enumerate(texts):
+                answer, s_tok, e_tok, _ = best[r]
                 results.append({"text": text, "start": s_tok,
                                 "end": e_tok, "answer": answer})
         else:
@@ -262,6 +275,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--input_file", default=None,
                     help="jsonl with {'text': ..., 'context'?: ...}")
     ap.add_argument("--num_labels", type=int, default=2)
+    ap.add_argument("--doc_stride", type=int, default=0,
+                    help="QA: window long contexts with this token stride "
+                         "instead of truncating (HF run_qa; 0 = off)")
     ap.add_argument("--quantize", choices=["none", "int8"], default="none",
                     help="int8 weight-only dense kernels for causal-lm "
                          "generation (HBM-bound decode speedup)")

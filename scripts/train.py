@@ -172,7 +172,8 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
     if config.task == "qa":
         questions, contexts, starts, answers = load_qa(config.dataset, split, **kw)
         return ArrayDataset.from_qa(tokenizer, questions, contexts, starts,
-                                    answers, max_len)
+                                    answers, max_len,
+                                    doc_stride=config.qa_doc_stride)
     if config.task == "seq2seq" and config.span_corruption:
         try:
             texts, _ = load_text_classification(config.dataset, split, **kw)
@@ -357,6 +358,7 @@ def main(argv=None) -> dict:
                 import numpy as np
 
                 from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import (
+                    best_windowed_answers,
                     extract_answer_spans,
                     squad_em_f1,
                 )
@@ -366,14 +368,20 @@ def main(argv=None) -> dict:
                     max_samples=config.eval_qa_samples, seed=config.seed)
                 enc = tokenizer.encode_qa(questions, contexts, starts,
                                           answers, max_length=max_len,
-                                          return_offsets=True)
-                preds: list = []
+                                          return_offsets=True,
+                                          doc_stride=config.qa_doc_stride)
+                # with doc-stride each input yields several window
+                # features; predictions aggregate per example below
+                ex_ids = enc["example_ids"]
+                feat_ctx = np.asarray(contexts)[ex_ids]
+                texts_scores: list = []
                 bs = global_eval_batch
+                n_feat = enc["input_ids"].shape[0]
                 # hoisted: export_params re-merges LoRA adapters on every
                 # read — do it once, not once per eval batch
                 eval_params = trainer.export_params
-                for lo in range(0, len(questions), bs):
-                    sl = slice(lo, min(lo + bs, len(questions)))
+                for lo in range(0, n_feat, bs):
+                    sl = slice(lo, min(lo + bs, n_feat))
                     s_log, e_log = model.apply(
                         {"params": eval_params},
                         jnp.asarray(enc["input_ids"][sl]),
@@ -381,9 +389,13 @@ def main(argv=None) -> dict:
                         token_type_ids=jnp.asarray(enc["token_type_ids"][sl])
                         if "token_type_ids" in enc else None,
                         deterministic=True)
-                    preds.extend(extract_answer_spans(
+                    texts_scores.extend(extract_answer_spans(
                         s_log, e_log, enc["offset_starts"][sl],
-                        enc["offset_ends"][sl], contexts[sl]))
+                        enc["offset_ends"][sl], feat_ctx[sl],
+                        with_scores=True))
+                preds = best_windowed_answers(
+                    [t for t, _ in texts_scores],
+                    [sc for _, sc in texts_scores], ex_ids, len(questions))
                 em_f1 = squad_em_f1(preds, list(answers))
                 eval_results["eval_exact_match"] = em_f1["exact_match"]
                 eval_results["eval_f1"] = em_f1["f1"]

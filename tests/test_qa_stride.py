@@ -1,0 +1,184 @@
+"""QA doc-stride windowing (HF run_qa semantics) across all three
+tokenizer tiers, plus the best-window aggregation.
+
+The reference's data path truncates everything to 512 (reference
+``scripts/train.py:81``); with ``doc_stride > 0`` long contexts become
+overlapping windows so an answer past the truncation boundary is still
+trainable and findable — each feature carries ``example_ids`` back to
+its input, and eval keeps the highest-scoring span per example
+(``utils/metrics.py::best_windowed_answers``).
+"""
+
+import numpy as np
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
+    WordHashTokenizer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.utils.metrics import (
+    best_windowed_answers,
+    extract_answer_spans,
+)
+
+L = 32          # feature length: small enough to force windows
+
+
+def _long_ctx(n_words=100):
+    words = [f"w{i}" for i in range(n_words)]
+    words[77] = "needle"
+    ctx = " ".join(words)
+    return ctx, ctx.index("needle"), "needle"
+
+
+def _check_stride_encoding(tok, token_type=True):
+    """Shared contract: truncation loses the deep answer, striding finds
+    it; offsets decode it; example_ids map features to inputs."""
+    ctx, a_start, answer = _long_ctx()
+    q = ["which word"]
+
+    trunc = tok.encode_qa(q, [ctx], [a_start], [answer], max_length=L)
+    assert int(trunc["start_positions"][0]) == 0       # truncated away
+    assert trunc["input_ids"].shape[0] == 1
+
+    enc = tok.encode_qa(q, [ctx], [a_start], [answer], max_length=L,
+                        return_offsets=True, doc_stride=8)
+    n_feat = enc["input_ids"].shape[0]
+    assert n_feat > 1
+    assert np.all(enc["example_ids"] == 0)
+    labeled = np.flatnonzero(enc["start_positions"] > 0)
+    assert len(labeled) >= 1                           # some window has it
+    for r in labeled:
+        s = int(enc["start_positions"][r])
+        e = int(enc["end_positions"][r])
+        assert ctx[enc["offset_starts"][r][s]:
+                   enc["offset_ends"][r][e]] == answer
+    # every context token is covered by at least one window: the union
+    # of char offsets across features spans the whole context
+    covered = set()
+    for r in range(n_feat):
+        for s, e in zip(enc["offset_starts"][r], enc["offset_ends"][r]):
+            if s >= 0:
+                covered.add((int(s), int(e)))
+    n_ctx_tokens = len(ctx.split())
+    assert len(covered) == n_ctx_tokens
+    return enc
+
+
+def test_wordhash_doc_stride():
+    _check_stride_encoding(WordHashTokenizer(vocab_size=512))
+
+
+def test_wordpiece_doc_stride():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.wordpiece import (
+        WordPieceTokenizer,
+    )
+
+    vocab = {w: i for i, w in enumerate(
+        ["[PAD]", "[CLS]", "[SEP]", "[UNK]", "[MASK]", "which", "word",
+         "needle"] + [f"w{i}" for i in range(100)])}
+    _check_stride_encoding(WordPieceTokenizer(vocab))
+
+
+def test_hf_tokenizer_doc_stride(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
+        HFTokenizer,
+    )
+
+    vocab_path = tmp_path / "vocab.txt"
+    vocab_path.write_text("\n".join(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "which", "word",
+         "needle"] + [f"w{i}" for i in range(100)]) + "\n")
+    tok = HFTokenizer(transformers.BertTokenizerFast(
+        vocab_file=str(vocab_path), do_lower_case=True))
+    _check_stride_encoding(tok)
+
+
+def test_multi_example_ids_roundtrip():
+    """Two inputs of very different lengths: example_ids partitions the
+    features correctly and short contexts still get exactly one."""
+    tok = WordHashTokenizer(vocab_size=512)
+    long_ctx, a_start, answer = _long_ctx()
+    enc = tok.encode_qa(["q one", "q two"], [long_ctx, "tiny context"],
+                        [a_start, 0], [answer, "tiny"], max_length=L,
+                        doc_stride=8)
+    ex = enc["example_ids"]
+    assert np.sum(ex == 0) > 1 and np.sum(ex == 1) == 1
+    # the short example's answer survives at its usual position
+    short_row = int(np.flatnonzero(ex == 1)[0])
+    assert int(enc["start_positions"][short_row]) > 0
+
+
+def test_best_windowed_answers_picks_max_score():
+    texts = ["", "alpha", "beta", "gamma"]
+    scores = [float("-inf"), 1.0, 3.0, 2.0]
+    ex_ids = [0, 0, 0, 1]
+    assert best_windowed_answers(texts, scores, ex_ids, 2) == ["beta",
+                                                               "gamma"]
+    # an example whose windows all decode no-answer stays ""
+    assert best_windowed_answers([""], [float("-inf")], [0], 1) == [""]
+
+
+def test_extract_answer_spans_with_scores():
+    # 1 row, 3 context tokens at positions 2..4 with char offsets
+    s_log = np.array([[0.0, 0.0, 5.0, 0.0, 0.0]])
+    e_log = np.array([[0.0, 0.0, 0.0, 4.0, 0.0]])
+    off_s = np.array([[-1, -1, 0, 4, 9]])
+    off_e = np.array([[-1, -1, 3, 8, 12]])
+    ctx = ["abc defg hij"]
+    (text, score), = extract_answer_spans(s_log, e_log, off_s, off_e, ctx,
+                                          with_scores=True)
+    assert text == "abc defg" and score == pytest.approx(9.0)
+    (text2, s_tok, e_tok, score2), = extract_answer_spans(
+        s_log, e_log, off_s, off_e, ctx, with_spans=True, with_scores=True)
+    assert (text2, s_tok, e_tok) == ("abc defg", 2, 3)
+    assert score2 == pytest.approx(9.0)
+
+
+def test_doc_stride_is_overlap_and_clamps():
+    """doc_stride is the OVERLAP between windows (the HF fast-tokenizer
+    meaning): consecutive windows share exactly `stride` tokens; a
+    stride >= the window size clamps to step 1 and coverage never gaps."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
+        _qa_windows,
+    )
+
+    wins = list(_qa_windows(2, 100, 32, 8))   # room = 27, step = 19
+    assert wins[0] == (0, 27) and wins[1][0] == 19
+    # consecutive windows overlap by exactly doc_stride tokens
+    assert wins[0][0] + wins[0][1] - wins[1][0] == 8
+    # full coverage, no gaps
+    covered = set()
+    for w0, nw in wins:
+        covered.update(range(w0, w0 + nw))
+    assert covered == set(range(100))
+
+    # stride >= room: step clamps to 1 instead of gapping/looping
+    wins = list(_qa_windows(2, 40, 32, 64))
+    covered = set()
+    for w0, nw in wins:
+        assert nw > 0
+        covered.update(range(w0, w0 + nw))
+    assert covered == set(range(40))
+
+
+def test_window_cutting_answer_head_is_unlabeled():
+    """A window that begins mid-answer must label CLS, not the answer's
+    tail (HF run_qa full-containment convention, both sides)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.tokenization import (
+        _qa_feature,
+    )
+
+    # answer = chars 10..25, three tokens; window holds only the last two
+    win_spans = [(15, 20), (21, 25), (26, 30)]
+    row = _qa_feature(0, [7, 7], win_spans=win_spans,
+                      win_ids=[5, 5, 5], max_length=32, labeled=True,
+                      a_start=10, a_end=25, cls_id=1, sep_id=2)
+    assert row["tok_start"] == row["tok_end"] == 0
+    # same window with the head INCLUDED is labeled
+    row2 = _qa_feature(0, [7, 7], win_ids=[5, 5, 5, 5],
+                       win_spans=[(10, 14)] + win_spans, max_length=32,
+                       labeled=True, a_start=10, a_end=25, cls_id=1,
+                       sep_id=2)
+    # three tokens cover chars 10..25 → positions 4..6 after [CLS] q q [SEP]
+    assert row2["tok_start"] == 4 and row2["tok_end"] == 6

@@ -215,29 +215,41 @@ def test_encode_qa_offsets_slice_to_answer_wordpiece():
 
 
 def test_encode_qa_offsets_cover_truncation_boundary():
-    """A context token that lands on the LAST position after truncation
-    can still be the labeled gold span — its offset must be recorded, or
-    a model predicting the gold span exactly would decode to ''."""
+    """A context token on the LAST context position after truncation can
+    still be the labeled gold span — its offset must be recorded, or a
+    model predicting the gold span exactly would decode to ''. The
+    layout reserves the final [SEP] (HF only_second truncation), so the
+    last context slot is max_length-2."""
     tok = WordHashTokenizer(vocab_size=512)
     ctx = " ".join(f"w{i}" for i in range(20))
     # 2-token question → ctx_offset=4; answer placed so its token sits at
-    # position max_length-1
+    # position max_length-2 (the last context slot before the final SEP)
     L = 12
-    answer_idx = L - 1 - 4  # context token index landing on position L-1
+    answer_idx = L - 2 - 4
     words = ctx.split()
     a_start = ctx.index(words[answer_idx])
     enc = tok.encode_qa(["which one"], [ctx], [a_start], [words[answer_idx]],
                         max_length=L, return_offsets=True)
     s, e = int(enc["start_positions"][0]), int(enc["end_positions"][0])
-    assert s == e == L - 1
+    assert s == e == L - 2
     assert enc["offset_starts"][0][s] >= 0, "offset missing at boundary"
     assert ctx[enc["offset_starts"][0][s]:enc["offset_ends"][0][e]] == words[answer_idx]
+    # the slot after it is the final [SEP], present even under truncation
+    assert int(enc["input_ids"][0][L - 1]) == tok.sep_token_id
+    # a token truncated past the boundary cannot be labeled
+    a2 = ctx.index(words[answer_idx + 1])
+    enc2 = tok.encode_qa(["which one"], [ctx], [a2], [words[answer_idx + 1]],
+                         max_length=L)
+    assert int(enc2["start_positions"][0]) == 0
 
 
-def test_qa_eval_reports_em_f1(tmp_path, devices8):
+@pytest.mark.parametrize("doc_stride", [0, 8])
+def test_qa_eval_reports_em_f1(tmp_path, devices8, doc_stride):
     """scripts/train.py --task qa --eval_qa_samples N lands
     eval_exact_match / eval_f1 in eval_results.txt (reference analogue:
-    the metric emission at train.py:170)."""
+    the metric emission at train.py:170). With --qa_doc_stride the
+    training rows are windowed features and the eval aggregates the
+    best-scoring span per example across its windows."""
     import transformers
 
     from scripts.train import main as train_main
@@ -254,6 +266,7 @@ def test_qa_eval_reports_em_f1(tmp_path, devices8):
         "--train_batch_size", "2", "--dtype", "float32",
         "--max_seq_length", str(SEQ), "--max_train_samples", "256",
         "--max_eval_samples", "64", "--eval_qa_samples", "32",
+        "--qa_doc_stride", str(doc_stride),
         "--learning_rate", "1e-3", "--scale_lr_by_world_size", "false",
         "--output_data_dir", out, "--model_dir", str(tmp_path / "model"),
     ])
