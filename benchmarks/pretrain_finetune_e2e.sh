@@ -14,10 +14,15 @@
 #   C  seq-cls from scratch 1 epoch (control)      -> eval_results.txt
 #   D  LoRA r=8 fine-tune 1 epoch FROM A           -> eval_results.txt
 #      (frozen backbone + adapters/head at 10x lr — the PEFT lr
-#      convention; quality evidence for --lora_rank)
-# Expected: B beats C decisively and approaches/beats the 3-epoch
-# from-scratch 0.985 (EVAL_REALDATA.md) in 1/3 the epochs; D lands
-# near B with <1% of the optimizer state.
+#      convention; exercises the LoRA path end to end incl. the
+#      adapter sidecar export)
+# Expected: B beats C under the 1-epoch budget; D stays near chance ON
+# THIS CORPUS — it is constructed to defeat frozen-feature probes (the
+# label depends on clause ORDER, and a linear probe on the frozen
+# backbone's CLS features measures only 0.553), so parameter-efficient
+# tuning needs a backbone that already encodes the task, which a 1.8M
+# -param 6-epoch MLM pretrain does not provide. See EVAL_REALDATA.md
+# ("LoRA under a tiny pretraining budget").
 set -euo pipefail
 
 WORK=${WORK:-/tmp/pt_ft_e2e}
