@@ -114,7 +114,8 @@ def train_flops_per_sample(seq_len: int, hidden_size: int = 768,
 
 
 def build_harness(model_kwargs: dict, per_chip_batch: int, seq_len: int = 512,
-                  remat: bool = False, bucket_multiple: int = 0,
+                  remat: bool = False, remat_policy: str = "full",
+                  bucket_multiple: int = 0,
                   min_len: int = 300, max_len: int = 600, batches: int = 14,
                   opt_state_bf16: bool = False, lora_rank: int = 0,
                   lora_targets: str = "attention"):
@@ -161,7 +162,7 @@ def build_harness(model_kwargs: dict, per_chip_batch: int, seq_len: int = 512,
         max_position_embeddings=512,
         attention_impl=config.resolve_attention_impl(
             jax.devices()[0].platform),
-        remat=remat,
+        remat=remat, remat_policy=remat_policy,
         **model_kwargs)
     model = BertForSequenceClassification(model_cfg, num_labels=2)
     params = init_params(model, model_cfg, seed=0)
@@ -227,19 +228,23 @@ def _on_tpu() -> bool:
 
 
 def bench_headline(per_chip_batch: int | None = None,
-                   opt_state_bf16: bool = False) -> None:
+                   opt_state_bf16: bool = False,
+                   remat_policy: str | None = None) -> None:
     # batch 8 off-TPU keeps the CPU smoke run tractable
     if per_chip_batch is None:
         per_chip_batch = 48 if _on_tpu() else 8
     history = run_finetune({}, per_chip_batch=per_chip_batch,
-                           opt_state_bf16=opt_state_bf16)
+                           opt_state_bf16=opt_state_bf16,
+                           remat=remat_policy is not None,
+                           remat_policy=remat_policy or "full")
     emit("bert_base_finetune_samples_per_sec_per_chip",
          history["train_samples_per_second_per_chip"],
          V100_BASELINE_SAMPLES_PER_SEC,
          flops_per_sample=train_flops_per_sample(512),
          detail={"per_chip_batch": per_chip_batch,
                  "optimizer_state_dtype":
-                     "bfloat16" if opt_state_bf16 else "float32"})
+                     "bfloat16" if opt_state_bf16 else "float32",
+                 "remat_policy": remat_policy or "off"})
 
 
 def _bert_large_flops_per_sample() -> float:
@@ -423,7 +428,8 @@ def _run_child(args: argparse.Namespace) -> None:
         bench_bert_large()
     else:
         bench_headline(per_chip_batch=args.batch,
-                       opt_state_bf16=args.opt_state_bf16)
+                       opt_state_bf16=args.opt_state_bf16,
+                       remat_policy=args.remat_policy)
 
 
 def main() -> None:
@@ -444,6 +450,10 @@ def main() -> None:
                         dest="opt_state_bf16",
                         help="bf16 Adam m/v storage (halved optimizer HBM; "
                              "headline mode)")
+    parser.add_argument("--remat-policy", dest="remat_policy", default=None,
+                        choices=["full", "dots", "dots_no_batch"],
+                        help="enable encoder remat with this checkpoint "
+                             "policy (headline mode; default: remat off)")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)  # internal: run measured body
     args = parser.parse_args()
@@ -456,11 +466,12 @@ def main() -> None:
                               ("--lora", args.lora)] if on]
     if len(picked) > 1:
         parser.error(f"pick one mode, got {' and '.join(picked)}")
-    if (args.batch is not None or args.opt_state_bf16) and picked:
+    if (args.batch is not None or args.opt_state_bf16
+            or args.remat_policy) and picked:
         # headline-only knobs: other modes hardcode their configuration,
         # so dropping these silently would mislabel the measurement
-        parser.error("--batch/--opt-state-bf16 apply to the headline mode "
-                     f"only, not {picked[0]}")
+        parser.error("--batch/--opt-state-bf16/--remat-policy apply to "
+                     f"the headline mode only, not {picked[0]}")
 
     if getattr(args, "_child"):
         _run_child(args)

@@ -39,6 +39,12 @@ run headline_b64_bf16opt python bench.py --batch 64 --opt-state-bf16
 run headline_b80_bf16opt python bench.py --batch 80 --opt-state-bf16
 run headline_b96_bf16opt python bench.py --batch 96 --opt-state-bf16
 
+# 3b. remat-policy probe: "dots" saves the matmuls and recomputes only
+#     elementwise ops — HBM headroom for a bigger batch without full
+#     recompute cost (the second >=0.45-MFU lever)
+run headline_b80_dots_bf16opt python bench.py --batch 80 --opt-state-bf16 --remat-policy dots
+run headline_b96_dots_bf16opt python bench.py --batch 96 --opt-state-bf16 --remat-policy dots
+
 # 4. the BENCH_EXTRA backlog (VERDICT #1c)
 run buckets    python bench.py --buckets
 run causal_lm  python bench.py --causal-lm

@@ -172,6 +172,10 @@ class TrainConfig:
     # per-chip batch >= 16 and never loses, so it is the TPU default.
     attention_impl: str = "auto"   # auto | xla | flash (pallas) | ring
     remat: bool = False            # rematerialize encoder layers (FLOPs for HBM)
+    # what remat saves at layer boundaries: "full" recomputes everything,
+    # "dots" saves matmul outputs and recomputes only elementwise ops,
+    # "dots_no_batch" also drops batch-dim matmul results (models/layers.py)
+    remat_policy: str = "full"     # full | dots | dots_no_batch
     # Fused LM-head + CE (ops/pallas_vocab_ce.py): the [B,S,V] logits
     # never materialize in HBM. causal-lm only; opt-in (numerics match
     # the unfused path to fp32 roundoff, tests/test_vocab_ce.py).
@@ -329,6 +333,8 @@ class TrainConfig:
             raise ValueError("num_experts >= 0, expert_top_k >= 1, moe_every >= 1")
         if self.ep > 1 and self.num_experts == 0:
             raise ValueError("ep > 1 requires num_experts > 0 (MoE model)")
+        if self.remat_policy not in ("full", "dots", "dots_no_batch"):
+            raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
         if self.qa_doc_stride < 0:
             raise ValueError("qa_doc_stride must be >= 0 (0 disables)")
         if self.lora_rank < 0:

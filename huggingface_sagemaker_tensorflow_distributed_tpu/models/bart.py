@@ -31,7 +31,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import ACT2FN
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    ACT2FN,
+    remat_policy,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import xla_attention
 
 NEG_INF = -1e9
@@ -66,6 +69,7 @@ class BartConfig:
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"
     remat: bool = False
+    remat_policy: str = "full"           # full | dots | dots_no_batch
     # mBART variant: pre-LN blocks + a final LayerNorm per stack
     normalize_before: bool = False
     stack_final_ln: bool = False
@@ -285,13 +289,17 @@ class BartStack(nn.Module):
             if self.is_decoder:
                 layer_cls = BartDecoderLayer
                 if cfg.remat:
-                    layer_cls = nn.remat(BartDecoderLayer, static_argnums=(5, 6))
+                    layer_cls = nn.remat(
+                        BartDecoderLayer, static_argnums=(5, 6),
+                        policy=remat_policy(cfg.remat_policy))
                 x = layer_cls(cfg, name=f"layer_{i}")(
                     x, attn_mask, enc_hidden, enc_mask, deterministic, decode)
             else:
                 layer_cls = BartEncoderLayer
                 if cfg.remat:
-                    layer_cls = nn.remat(BartEncoderLayer, static_argnums=(3,))
+                    layer_cls = nn.remat(
+                        BartEncoderLayer, static_argnums=(3,),
+                        policy=remat_policy(cfg.remat_policy))
                 x = layer_cls(cfg, name=f"layer_{i}")(
                     x, attn_mask, deterministic)
         if cfg.stack_final_ln:

@@ -76,6 +76,7 @@ class DebertaV2Config:
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"                  # disentangled → xla only
     remat: bool = False
+    remat_policy: str = "full"           # full | dots | dots_no_batch
 
     @property
     def pos_ebd_size(self) -> int:
@@ -333,7 +334,11 @@ class DebertaBackbone(nn.Module):
         initial = x
         layer_cls = DebertaLayer
         if cfg.remat:
-            layer_cls = nn.remat(DebertaLayer, static_argnums=(4,))
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+                remat_policy,
+            )
+            layer_cls = nn.remat(DebertaLayer, static_argnums=(4,),
+                                 policy=remat_policy(cfg.remat_policy))
         for i in range(cfg.num_layers):
             x = layer_cls(cfg, name=f"layer_{i}")(x, qk_mask, rel_embeddings,
                                                   deterministic)

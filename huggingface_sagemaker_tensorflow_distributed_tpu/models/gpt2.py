@@ -32,6 +32,7 @@ from jax import lax
 from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
     ACT2FN,
     is_moe_layer,
+    remat_policy,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
     dot_product_attention,
@@ -79,6 +80,7 @@ class Gpt2Config:
     param_dtype: Any = jnp.float32
     attention_impl: str = "xla"
     remat: bool = False
+    remat_policy: str = "full"            # full | dots | dots_no_batch
     # Mixture-of-Experts (models/moe.py, shared with the encoder
     # families): every moe_every-th block's MLP becomes a token-routed
     # expert bank (Mixtral-style decoder MoE). 0 = dense everywhere.
@@ -323,7 +325,8 @@ class Gpt2Model(nn.Module):
         else:
             block_cls = Gpt2Block
             if cfg.remat:
-                block_cls = nn.remat(Gpt2Block, static_argnums=(3, 4))
+                block_cls = nn.remat(Gpt2Block, static_argnums=(3, 4),
+                                     policy=remat_policy(cfg.remat_policy))
             for i in range(cfg.num_layers):
                 x = block_cls(cfg, name=f"h_{i}", layer_index=i)(
                     x, additive_mask, deterministic, decode)

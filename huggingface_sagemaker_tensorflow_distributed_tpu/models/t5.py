@@ -33,6 +33,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    remat_policy,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
     relative_position_bias,
     relative_position_bucket,  # bucket math shared with the ring kernel
@@ -69,6 +72,7 @@ class T5Config:
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
     remat: bool = False
+    remat_policy: str = "full"           # full | dots | dots_no_batch
     # "xla" (default) or "ring": with a seq mesh axis the ENCODER
     # self-attention runs sequence-parallel ring attention, re-tiling the
     # relative-position bias per ring step from global positions (the
@@ -364,7 +368,8 @@ class T5Stack(nn.Module):
         block_cls = T5Block
         if cfg.remat:
             # bound module is arg 0: deterministic=6, decode=7
-            block_cls = nn.remat(T5Block, static_argnums=(6, 7))
+            block_cls = nn.remat(T5Block, static_argnums=(6, 7),
+                                 policy=remat_policy(cfg.remat_policy))
         position_bias = None
         for i in range(n_layers):
             hidden, position_bias = block_cls(

@@ -255,6 +255,7 @@ def main(argv=None) -> dict:
         from_scratch=config.from_scratch,
         attention_impl=attention_impl,
         remat=config.remat,
+        remat_policy=config.remat_policy,
         **moe_overrides,
     )
     if config.num_experts:

@@ -1,0 +1,134 @@
+"""Rematerialization policies (models/layers.py::remat_policy).
+
+``remat`` trades recompute FLOPs for HBM; ``remat_policy`` controls
+WHAT is recomputed ("full" = save nothing; "dots" saves matmul outputs
+and recomputes only elementwise ops; "dots_no_batch" also drops
+batch-dim matmul results). All of them are numerics-preserving by
+construction — these tests pin that: loss AND gradients must be
+bit-comparable to the no-remat baseline on every policy and family
+entry point.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.bert import (
+    BertForSequenceClassification,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    EncoderConfig,
+    remat_policy,
+)
+
+SEQ = 16
+
+
+def _loss_and_grads(remat, policy="full"):
+    cfg = EncoderConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=4, intermediate_size=64,
+                        max_position_embeddings=SEQ, hidden_dropout=0.0,
+                        attention_dropout=0.0, remat=remat,
+                        remat_policy=policy)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    params = init_params(model, cfg, seed=0)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 128, (4, SEQ)))
+    labels = jnp.asarray(rng.randint(0, 2, (4,)))
+
+    def loss(p):
+        logits = model.apply({"params": p}, ids, deterministic=True)
+        import optax
+        return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels))
+
+    val, grads = jax.jit(jax.value_and_grad(loss))(params)
+    return float(val), jax.device_get(grads)
+
+
+@pytest.mark.parametrize("policy", ["full", "dots", "dots_no_batch"])
+def test_remat_policies_match_no_remat(policy):
+    base_val, base_grads = _loss_and_grads(remat=False)
+    val, grads = _loss_and_grads(remat=True, policy=policy)
+    assert val == pytest.approx(base_val, rel=1e-6)
+    for a, b in zip(jax.tree.leaves(base_grads), jax.tree.leaves(grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-5)
+
+
+def test_unknown_policy_raises():
+    with pytest.raises(ValueError, match="remat_policy"):
+        remat_policy("bogus")
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import (
+        TrainConfig,
+    )
+    with pytest.raises(ValueError, match="remat_policy"):
+        TrainConfig(remat_policy="bogus")
+
+
+def test_gpt2_remat_policy_runs():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+
+    cfg = Gpt2Config(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=4, intermediate_size=64,
+                     max_position_embeddings=SEQ, hidden_dropout=0.0,
+                     embd_dropout=0.0, attention_dropout=0.0,
+                     remat=True, remat_policy="dots")
+    model = Gpt2LMHeadModel(cfg)
+    params = init_params(model, cfg, seed=0)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, SEQ)))
+
+    def loss(p):
+        return jnp.sum(model.apply({"params": p}, ids,
+                                   deterministic=True) ** 2)
+
+    val = jax.jit(jax.value_and_grad(loss))(params)[0]
+    assert np.isfinite(float(val))
+
+
+def test_remat_policy_override_reaches_every_family(tmp_path):
+    """scripts/train.py passes remat_policy into every family's config
+    builder — each from_hf constructor must accept it (DeBERTa was the
+    one with its own config class that initially did not)."""
+    import transformers
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import (
+        auto as auto_models,
+    )
+
+    cases = [
+        ("transformers.BertConfig", dict(vocab_size=128, hidden_size=32,
+                                         num_hidden_layers=1,
+                                         num_attention_heads=2,
+                                         intermediate_size=64)),
+        ("transformers.DebertaV2Config", dict(vocab_size=128, hidden_size=32,
+                                              num_hidden_layers=1,
+                                              num_attention_heads=2,
+                                              intermediate_size=64)),
+        ("transformers.GPT2Config", dict(vocab_size=128, n_embd=32,
+                                         n_layer=1, n_head=2, n_inner=64)),
+        ("transformers.T5Config", dict(vocab_size=128, d_model=32, d_kv=16,
+                                       d_ff=64, num_layers=1, num_heads=2)),
+        ("transformers.BartConfig", dict(vocab_size=128, d_model=32,
+                                         encoder_layers=1, decoder_layers=1,
+                                         encoder_attention_heads=2,
+                                         decoder_attention_heads=2,
+                                         encoder_ffn_dim=64,
+                                         decoder_ffn_dim=64)),
+    ]
+    tasks = {"GPT2Config": "causal-lm", "T5Config": "seq2seq",
+             "BartConfig": "seq2seq"}
+    for name, kw in cases:
+        cls = getattr(transformers, name.split(".")[1])
+        d = str(tmp_path / name.split(".")[1])
+        cls(**kw).save_pretrained(d)
+        task = tasks.get(name.split(".")[1], "seq-cls")
+        _, _, _, cfg = auto_models.from_pretrained(
+            d, task=task, from_scratch=True,
+            remat=True, remat_policy="dots")
+        assert cfg.remat_policy == "dots", name
