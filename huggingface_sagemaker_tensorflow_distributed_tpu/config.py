@@ -129,6 +129,11 @@ class TrainConfig:
     warmup_ratio: float = 0.0
     weight_decay: float = 0.0
     max_grad_norm: float = 0.0     # 0 disables clipping (reference has none)
+    # uniform label smoothing for seq2seq fine-tuning (T5/BART
+    # convention, HF --label_smoothing_factor; train-time only — eval
+    # loss stays plain CE). Unfused path only: the fused vocab-CE kernel
+    # computes integer-label CE and does not emit the mean-logits term.
+    label_smoothing: float = 0.0
     # micro-batches averaged per optimizer update (1 = off): grows the
     # effective batch beyond HBM limits (e.g. BERT-large past bs 8/chip)
     gradient_accumulation_steps: int = 1
@@ -333,6 +338,18 @@ class TrainConfig:
             raise ValueError("num_experts >= 0, expert_top_k >= 1, moe_every >= 1")
         if self.ep > 1 and self.num_experts == 0:
             raise ValueError("ep > 1 requires num_experts > 0 (MoE model)")
+        if not 0.0 <= self.label_smoothing < 1.0:
+            raise ValueError("label_smoothing must be in [0, 1)")
+        if self.label_smoothing > 0 and self.task != "seq2seq":
+            raise ValueError(
+                "label_smoothing is implemented for task='seq2seq' (the "
+                "T5/BART fine-tuning convention); other tasks would "
+                "silently ignore it")
+        if self.label_smoothing > 0 and self.fused_vocab_ce:
+            raise ValueError(
+                "label_smoothing does not combine with --fused_vocab_ce "
+                "(the fused kernel computes integer-label CE without the "
+                "mean-logits term smoothing needs); drop one")
         if self.remat_policy not in ("full", "dots", "dots_no_batch"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
         if self.qa_doc_stride < 0:

@@ -38,6 +38,7 @@ import numpy as np
 from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
     ACT2FN,
     MlmHead,
+    remat_policy,
 )
 
 NEG_INF = -1e9
@@ -334,9 +335,6 @@ class DebertaBackbone(nn.Module):
         initial = x
         layer_cls = DebertaLayer
         if cfg.remat:
-            from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
-                remat_policy,
-            )
             layer_cls = nn.remat(DebertaLayer, static_argnums=(4,),
                                  policy=remat_policy(cfg.remat_policy))
         for i in range(cfg.num_layers):

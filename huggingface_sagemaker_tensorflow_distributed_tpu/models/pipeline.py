@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    remat_policy,
     EncoderConfig,
     EncoderLayer,
 )
@@ -393,7 +394,8 @@ class PipelinedEncoder(nn.Module):
             return x
 
         if cfg.remat:
-            stage_fn = jax.checkpoint(stage_fn)
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=remat_policy(cfg.remat_policy))
 
         return gpipe_schedule(
             stage_fn, staged, hidden, (attn_mask,), pp=pp,
@@ -527,7 +529,8 @@ class PipelinedT5Stack(nn.Module):
             return x
 
         if cfg.remat:
-            stage_fn = jax.checkpoint(stage_fn)
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=remat_policy(cfg.remat_policy))
 
         hidden = gpipe_schedule(
             stage_fn, staged, hidden, riders, pp=pp,
@@ -634,7 +637,8 @@ class PipelinedBartStack(nn.Module):
             return x
 
         if cfg.remat:
-            stage_fn = jax.checkpoint(stage_fn)
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=remat_policy(cfg.remat_policy))
 
         hidden = gpipe_schedule(
             stage_fn, staged, hidden, riders, pp=pp,
@@ -708,7 +712,8 @@ class PipelinedGpt2Stack(nn.Module):
             return x
 
         if cfg.remat:
-            stage_fn = jax.checkpoint(stage_fn)
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=remat_policy(cfg.remat_policy))
 
         return gpipe_schedule(
             stage_fn, staged, hidden, (attn_mask,), pp=pp,

@@ -134,10 +134,18 @@ def qa_loss(apply_fn, params, batch, rngs, train: bool):
     return _masked_sums(0.5 * (s_ce + e_ce), 0.5 * (s_ok + e_ok), valid)
 
 
-def seq2seq_loss(apply_fn, params, batch, rngs, train: bool):
+def seq2seq_loss(apply_fn, params, batch, rngs, train: bool,
+                 epsilon: float = 0.0):
     """Teacher-forced LM cross-entropy over non-pad target tokens
     (labels == -100 ignored, HF convention); covers the T5/CNN-DM
-    breadth config. Metric is next-token accuracy."""
+    breadth config. Metric is next-token accuracy.
+
+    ``epsilon`` > 0 adds uniform label smoothing at TRAIN time (T5/BART
+    fine-tuning convention, HF ``--label_smoothing_factor``):
+    q = (1-eps)*onehot + eps/V decomposes into
+    (1-eps)*CE + eps*(logsumexp - mean(logits)) — computed from the
+    logits directly, no [*, V] one-hot ever materialized. Eval keeps
+    the plain CE so eval_loss stays comparable across settings."""
     logits = apply_fn({"params": params}, batch["input_ids"],
                       batch["attention_mask"], batch["decoder_input_ids"],
                       batch.get("decoder_attention_mask"),
@@ -148,8 +156,17 @@ def seq2seq_loss(apply_fn, params, batch, rngs, train: bool):
         token_valid = token_valid & (batch["valid"][:, None] > 0)
     safe_labels = jnp.maximum(labels, 0)
     per_tok = softmax_cross_entropy_with_integer_labels(logits, safe_labels)
+    if epsilon > 0 and train:
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1)
+        uniform = lse - jnp.mean(logits.astype(jnp.float32), axis=-1)
+        per_tok = (1.0 - epsilon) * per_tok + epsilon * uniform
     correct = jnp.argmax(logits, -1) == safe_labels
     return _masked_sums(per_tok, correct, token_valid)
+
+
+def make_smoothed_seq2seq_loss(epsilon: float):
+    return functools.partial(seq2seq_loss, epsilon=epsilon)
 
 
 def causal_lm_loss(apply_fn, params, batch, rngs, train: bool):
@@ -420,6 +437,9 @@ class Trainer:
         if self.task not in TASK_LOSSES:
             raise ValueError(f"no loss for task {self.task!r}")
         self.loss_fn = TASK_LOSSES[self.task]
+        if getattr(config, "label_smoothing", 0.0) > 0:
+            # config validation restricts the knob to task='seq2seq'
+            self.loss_fn = make_smoothed_seq2seq_loss(config.label_smoothing)
         if getattr(config, "fused_vocab_ce", False):
             if self.task == "causal-lm" and hasattr(model,
                                                     "hidden_and_embedding"):

@@ -289,3 +289,53 @@ def test_rouge_l():
     assert 0.0 < out["rougeL_f1"] < 1.0
     with pytest.raises(ValueError):
         rouge_l(["a"], ["a", "b"])
+
+
+def test_label_smoothing_matches_explicit_onehot():
+    """The (1-eps)*CE + eps*(lse - mean logits) decomposition must equal
+    the explicit smoothed-one-hot cross-entropy, eps=0 must equal the
+    plain loss, and eval (train=False) must ignore smoothing."""
+    import jax
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        make_smoothed_seq2seq_loss,
+        seq2seq_loss,
+    )
+
+    B, S, V = 2, 5, 7
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(B, S, V), jnp.float32)
+    labels = rng.randint(0, V, (B, S))
+    labels[0, -2:] = -100                       # pad positions ignored
+    batch = {"input_ids": jnp.zeros((B, S), jnp.int32),
+             "attention_mask": jnp.ones((B, S), jnp.int32),
+             "decoder_input_ids": jnp.zeros((B, S), jnp.int32),
+             "labels": jnp.asarray(labels)}
+
+    def apply_fn(variables, *a, **kw):
+        return logits
+
+    eps = 0.1
+    loss_fn = make_smoothed_seq2seq_loss(eps)
+    smoothed, _ = loss_fn(apply_fn, None, batch, {}, True)
+
+    # explicit reference: q = (1-eps)*onehot + eps/V
+    logp = jax.nn.log_softmax(logits, -1)
+    safe = np.maximum(labels, 0)
+    q = ((1 - eps) * jax.nn.one_hot(safe, V)
+         + eps / V * jnp.ones((B, S, V)))
+    per_tok = -jnp.sum(q * logp, -1)
+    valid = jnp.asarray(labels != -100, jnp.float32)
+    want = float(jnp.sum(per_tok * valid) / jnp.sum(valid))
+    assert float(smoothed) == pytest.approx(want, rel=1e-5)
+
+    plain, _ = seq2seq_loss(apply_fn, None, batch, {}, True)
+    zero, _ = make_smoothed_seq2seq_loss(0.0)(apply_fn, None, batch, {},
+                                              True)
+    assert float(zero) == pytest.approx(float(plain), rel=1e-6)
+    # eval ignores smoothing entirely
+    ev, _ = loss_fn(apply_fn, None, batch, {}, False)
+    assert float(ev) == pytest.approx(float(plain), rel=1e-6)
+    # smoothing strictly increases the training loss on confident logits
+    assert float(smoothed) > float(plain)
