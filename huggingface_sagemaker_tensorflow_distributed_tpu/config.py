@@ -222,6 +222,14 @@ class TrainConfig:
     # --- control flags (reference train.py:44-45, typed correctly here) ---
     do_train: bool = True
     do_eval: bool = True
+    # per-epoch eval during fit (Keras validation_data shape): eval
+    # metrics land in the training history as eval_loss/eval_accuracy
+    eval_each_epoch: bool = False
+    # HF load_best_model_at_end: snapshot the best epoch's params (by
+    # --best_metric) to host and export THOSE instead of the final ones;
+    # implies per-epoch eval
+    keep_best: bool = False
+    best_metric: str = "eval_loss"    # eval_loss | eval_accuracy
 
     # --- checkpoint / resume (reference commented these out, train.py:136-137) ---
     checkpoint_dir: Optional[str] = None
@@ -350,6 +358,15 @@ class TrainConfig:
                 "label_smoothing does not combine with --fused_vocab_ce "
                 "(the fused kernel computes integer-label CE without the "
                 "mean-logits term smoothing needs); drop one")
+        if self.best_metric not in ("eval_loss", "eval_accuracy"):
+            raise ValueError(
+                f"unknown best_metric {self.best_metric!r} "
+                "(eval_loss | eval_accuracy)")
+        if self.keep_best and not self.do_eval:
+            raise ValueError("keep_best needs do_eval=true (it selects "
+                             "by eval metric)")
+        if self.keep_best:
+            self.eval_each_epoch = True
         if self.remat_policy not in ("full", "dots", "dots_no_batch"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
         if self.qa_doc_stride < 0:

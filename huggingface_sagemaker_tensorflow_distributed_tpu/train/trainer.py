@@ -51,6 +51,17 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.utils.timing import StepMe
 logger = get_logger(__name__)
 
 
+def _host_snapshot(tree):
+    """Fetch a (possibly cross-process sharded) pytree to host memory —
+    the collective allgather runs on EVERY host before any fetch, same
+    discipline as models/auto.py::save_pretrained."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        tree = multihost_utils.process_allgather(tree, tiled=True)
+    return jax.device_get(tree)
+
+
 class TrainState(flax.struct.PyTreeNode):
     step: jnp.ndarray
     params: Any
@@ -529,6 +540,11 @@ class Trainer:
         # rbg = TPU hardware RNG for dropout keys (config.rng_impl docs)
         self._base_rng = jax.random.key(config.seed, impl=config.rng_impl)
         self._divergence_fn = None  # built lazily, compiled once
+        # --keep_best (HF load_best_model_at_end): host snapshot of the
+        # best epoch's params + the watched metric's best value
+        self._best_params = None
+        self._best_metric: Optional[float] = None
+        self.best_epoch: Optional[int] = None
 
         # Batch shardings are inherited from the arrays the batcher
         # device_puts (batch dim over data axes; token dims over ``seq``
@@ -621,23 +637,26 @@ class Trainer:
 
     @property
     def export_params(self):
-        """Deployable model params: with LoRA active, the base weights
-        with adapters merged in (what ``save_pretrained``/``generate``
-        should see); otherwise ``state.params`` unchanged."""
+        """Deployable model params: the best epoch's host snapshot when
+        ``--keep_best`` found one, else the live state; with LoRA
+        active, the base weights with adapters merged in (what
+        ``save_pretrained``/``generate`` should see)."""
+        params = (self._best_params if self._best_params is not None
+                  else self.state.params)
         if self._lora_scaling is None:
-            return self.state.params
+            return params
         from huggingface_sagemaker_tensorflow_distributed_tpu.models.lora import (
             merge_lora,
         )
 
-        return merge_lora(self.state.params["model"],
-                          self.state.params["lora"], self._lora_scaling)
+        return merge_lora(params["model"], params["lora"],
+                          self._lora_scaling)
 
     # -- host-side loops ----------------------------------------------------
 
     def fit(self, train_batcher, epochs: Optional[int] = None,
             checkpointer=None, start_epoch: int = 0,
-            start_step_in_epoch: int = 0) -> dict:
+            start_step_in_epoch: int = 0, eval_batcher=None) -> dict:
         """Epoch loop — `model.fit` parity (reference train.py:145-153).
 
         Returns a Keras-style history dict: per-epoch mean loss/accuracy
@@ -648,6 +667,14 @@ class Trainer:
         epoch end, so batch prep overlaps the async-dispatched step.
         Mid-epoch resume (``start_step_in_epoch``) continues the epoch's
         permutation from the next unseen batch.
+
+        With ``eval_batcher`` (``--eval_each_epoch``/``--keep_best``),
+        every epoch ends with an eval pass whose metrics land in the
+        history (``eval_loss``/``eval_accuracy`` lists, Keras
+        ``validation_data`` shape); ``--keep_best`` additionally
+        snapshots the epoch's params to host whenever the watched
+        metric (``--best_metric``) improves, and ``export_params``
+        serves that snapshot — HF ``load_best_model_at_end``.
         """
         cfg = self.config
         epochs = cfg.epochs if epochs is None else epochs
@@ -730,12 +757,53 @@ class Trainer:
                 logger.info("epoch %d done: loss %.4f acc %.4f", epoch,
                             history["loss"][-1],
                             history["sparse_categorical_accuracy"][-1])
+                if eval_batcher is not None:
+                    res = self.evaluate(eval_batcher)
+                    history.setdefault("eval_loss", []).append(
+                        res["eval_loss"])
+                    history.setdefault("eval_accuracy", []).append(
+                        res["eval_accuracy"])
+                    logger.info("epoch %d eval: loss %.4f acc %.4f", epoch,
+                                res["eval_loss"], res["eval_accuracy"])
+                    if getattr(cfg, "keep_best", False):
+                        metric = res[cfg.best_metric]
+                        if self._best_metric is None:
+                            better = True
+                        elif cfg.best_metric.endswith("accuracy"):
+                            better = metric > self._best_metric
+                        else:
+                            better = metric < self._best_metric
+                        if better:
+                            self._best_metric = metric
+                            self.best_epoch = epoch
+                            # host snapshot: device HBM holds ONE live
+                            # state; the best params live in host RAM
+                            self._best_params = _host_snapshot(
+                                self.state.params)
+                            logger.info(
+                                "epoch %d is the new best (%s %.4f)",
+                                epoch, cfg.best_metric, metric)
                 if checkpointer is not None:
                     if cfg.check_divergence:
                         self.check_replica_divergence()
                     checkpointer.save(self.state, epoch=epoch + 1)
             if profiling:  # epoch shorter than the profiled step range
                 jax.profiler.stop_trace()
+            if (getattr(cfg, "keep_best", False)
+                    and self._best_params is not None):
+                # load_best_model_at_end, literally: everything after fit
+                # (final eval, ROUGE/QA passes, export, adapter sidecar)
+                # sees the best epoch's weights. Optimizer state is NOT
+                # rewound — training is over; resuming from a checkpoint
+                # uses the checkpointed state, not this restore.
+                self.state = TrainState(
+                    step=self.state.step,
+                    params=jax.device_put(self._best_params,
+                                          self.state_shardings.params),
+                    opt_state=self.state.opt_state)
+                logger.info("restored best epoch %d params into the live "
+                            "state (%s %.4f)", self.best_epoch,
+                            cfg.best_metric, self._best_metric)
             meter.end_window()
 
         history["train_runtime"] = sw.elapsed

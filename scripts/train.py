@@ -318,14 +318,27 @@ def main(argv=None) -> dict:
                 trainer.state, start_epoch, start_step_in_epoch = restored
                 logger.info("resuming from epoch %d (step-in-epoch %d)",
                             start_epoch, start_step_in_epoch)
+                if config.keep_best:
+                    logger.warning(
+                        "--keep_best across a resume: the best-model "
+                        "snapshot lives in host RAM, not the checkpoint "
+                        "— selection restarts at this epoch and earlier "
+                        "epochs can no longer win")
 
     results: dict = {}
     try:
         if config.do_train:
             logger.info("*** Train ***")
-            history = trainer.fit(train_batcher, checkpointer=checkpointer,
-                                  start_epoch=start_epoch,
-                                  start_step_in_epoch=start_step_in_epoch)
+            history = trainer.fit(
+                train_batcher, checkpointer=checkpointer,
+                start_epoch=start_epoch,
+                start_step_in_epoch=start_step_in_epoch,
+                eval_batcher=eval_batcher if config.eval_each_epoch
+                else None)
+            if trainer.best_epoch is not None:
+                logger.info("exporting best epoch %d (%s = %.4f)",
+                            trainer.best_epoch, config.best_metric,
+                            trainer._best_metric)
             trainer.write_train_results(history)
             results["train"] = history
 

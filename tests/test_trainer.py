@@ -252,3 +252,54 @@ def test_cosine_schedule_builds(devices8):
     cfg = TrainConfig(dtype="float32", warmup_ratio=0.1, lr_schedule="cosine")
     tx, lr = build_optimizer(cfg, world_size=1, total_steps=100)
     assert lr == cfg.learning_rate
+
+
+def test_eval_each_epoch_and_keep_best(devices8, monkeypatch):
+    """--eval_each_epoch lands eval_loss/eval_accuracy per epoch in the
+    history; --keep_best snapshots the best epoch's params and
+    export_params serves THAT snapshot, not the final state (HF
+    load_best_model_at_end). Scripted eval metrics force the best epoch
+    to be the middle one."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        Trainer,
+    )
+
+    mesh = build_mesh(MeshConfig(dp=-1), devices=devices8)
+    cfg = EncoderConfig(vocab_size=512, hidden_size=32, num_layers=2,
+                        num_heads=2, intermediate_size=64,
+                        max_position_embeddings=SEQ)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    tcfg = TrainConfig(task="seq-cls", dtype="float32", learning_rate=1e-3,
+                       scale_lr_by_world_size=False, log_every_steps=0,
+                       rng_impl="threefry", epochs=3, keep_best=True)
+    assert tcfg.eval_each_epoch          # keep_best implies it
+    trainer = Trainer(tcfg, model, init_params(model, cfg, seed=0), mesh)
+
+    scripted = iter([0.5, 0.2, 0.9])
+    captured = {}
+
+    def fake_evaluate(batcher):
+        loss = next(scripted)
+        captured[loss] = jax.device_get(trainer.state.params)
+        return {"eval_loss": loss, "eval_accuracy": 1.0 - loss}
+
+    monkeypatch.setattr(trainer, "evaluate", fake_evaluate)
+    data = _data(n=64, seed=3)
+    hist = trainer.fit(ShardedBatcher(data, 16, mesh, shuffle=True, seed=0),
+                       eval_batcher=object())
+    assert hist["eval_loss"] == [0.5, 0.2, 0.9]
+    assert hist["eval_accuracy"] == [0.5, 0.8, pytest.approx(0.1)]
+    assert trainer.best_epoch == 1
+    # the epoch-1 snapshot differs from the last epoch's weights...
+    best, last = captured[0.2], captured[0.9]
+    assert any(not np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(jax.tree.leaves(best), jax.tree.leaves(last)))
+    # ...and fit() restored it into the LIVE state (load_best_model_at
+    # _end), so the final eval, export and task-metric passes all see
+    # the best model
+    for a, b in zip(jax.tree.leaves(best),
+                    jax.tree.leaves(jax.device_get(trainer.state.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(best),
+                    jax.tree.leaves(jax.device_get(trainer.export_params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
