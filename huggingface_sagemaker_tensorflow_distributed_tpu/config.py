@@ -230,6 +230,10 @@ class TrainConfig:
     # implies per-epoch eval
     keep_best: bool = False
     best_metric: str = "eval_loss"    # eval_loss | eval_accuracy
+    # stop when --best_metric hasn't improved for N consecutive epochs
+    # (0 = off; implies per-epoch eval). Composes with --keep_best: the
+    # exported model is the best epoch's, not the stopping epoch's.
+    early_stopping_patience: int = 0
 
     # --- checkpoint / resume (reference commented these out, train.py:136-137) ---
     checkpoint_dir: Optional[str] = None
@@ -362,6 +366,10 @@ class TrainConfig:
             raise ValueError(
                 f"unknown best_metric {self.best_metric!r} "
                 "(eval_loss | eval_accuracy)")
+        if self.early_stopping_patience < 0:
+            raise ValueError("early_stopping_patience must be >= 0")
+        if self.early_stopping_patience > 0:
+            self.eval_each_epoch = True
         if self.keep_best and not self.do_eval:
             raise ValueError("keep_best needs do_eval=true (it selects "
                              "by eval metric)")

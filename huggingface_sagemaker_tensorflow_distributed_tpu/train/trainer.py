@@ -635,14 +635,56 @@ class Trainer:
         _, sums = self.loss_fn(self.model.apply, params, batch, {}, False)
         return sums
 
+    def _best_snapshot(self):
+        """Host snapshot of everything --keep_best must preserve. Full
+        fine-tune: the whole param tree. LoRA: only what can change —
+        the adapter subtree plus the trainable head leaves; the frozen
+        base is identical every epoch and stays on device (a multi-GB
+        base would otherwise be allgathered+copied per improvement)."""
+        if self._lora_scaling is None:
+            return _host_snapshot(self.state.params)
+        import re as _re
+
+        from flax.traverse_util import flatten_dict
+
+        rx = (_re.compile(self.config.lora_train_heads)
+              if self.config.lora_train_heads else None)
+        heads = {p: l for p, l in
+                 flatten_dict(self.state.params["model"]).items()
+                 if rx is not None and rx.search("/".join(map(str, p)))}
+        return {"lora": _host_snapshot(self.state.params["lora"]),
+                "heads": _host_snapshot(heads)}
+
+    def _restore_best_into_state(self):
+        """load_best_model_at_end: put the best snapshot back into the
+        live state (sharded), then release the host copy — the live
+        state IS the best model from here on."""
+        from flax.traverse_util import flatten_dict, unflatten_dict
+
+        if self._lora_scaling is None:
+            params = jax.device_put(self._best_params,
+                                    self.state_shardings.params)
+        else:
+            flat = dict(flatten_dict(self.state.params["model"]))
+            head_shard = flatten_dict(self.state_shardings.params["model"])
+            for p, leaf in self._best_params["heads"].items():
+                flat[p] = jax.device_put(leaf, head_shard[p])
+            params = {
+                "model": unflatten_dict(flat),
+                "lora": jax.device_put(self._best_params["lora"],
+                                       self.state_shardings.params["lora"]),
+            }
+        self.state = TrainState(step=self.state.step, params=params,
+                                opt_state=self.state.opt_state)
+        self._best_params = None
+
     @property
     def export_params(self):
-        """Deployable model params: the best epoch's host snapshot when
-        ``--keep_best`` found one, else the live state; with LoRA
-        active, the base weights with adapters merged in (what
-        ``save_pretrained``/``generate`` should see)."""
-        params = (self._best_params if self._best_params is not None
-                  else self.state.params)
+        """Deployable model params (with LoRA: base + adapters merged —
+        what ``save_pretrained``/``generate`` should see). After a
+        ``--keep_best`` fit the live state already holds the best
+        epoch's weights (``_restore_best_into_state``)."""
+        params = self.state.params
         if self._lora_scaling is None:
             return params
         from huggingface_sagemaker_tensorflow_distributed_tpu.models.lora import (
@@ -696,6 +738,7 @@ class Trainer:
             meter.begin_window()
             return fetched
 
+        epochs_since_best = 0
         with Stopwatch() as sw:
             for epoch in range(start_epoch, epochs):
                 start_step = start_step_in_epoch if epoch == start_epoch else 0
@@ -757,6 +800,7 @@ class Trainer:
                 logger.info("epoch %d done: loss %.4f acc %.4f", epoch,
                             history["loss"][-1],
                             history["sparse_categorical_accuracy"][-1])
+                stop_early = False
                 if eval_batcher is not None:
                     res = self.evaluate(eval_batcher)
                     history.setdefault("eval_loss", []).append(
@@ -765,7 +809,9 @@ class Trainer:
                         res["eval_accuracy"])
                     logger.info("epoch %d eval: loss %.4f acc %.4f", epoch,
                                 res["eval_loss"], res["eval_accuracy"])
-                    if getattr(cfg, "keep_best", False):
+                    track_best = (cfg.keep_best
+                                  or cfg.early_stopping_patience > 0)
+                    if track_best:
                         metric = res[cfg.best_metric]
                         if self._best_metric is None:
                             better = True
@@ -776,31 +822,38 @@ class Trainer:
                         if better:
                             self._best_metric = metric
                             self.best_epoch = epoch
-                            # host snapshot: device HBM holds ONE live
-                            # state; the best params live in host RAM
-                            self._best_params = _host_snapshot(
-                                self.state.params)
+                            epochs_since_best = 0
+                            if cfg.keep_best:
+                                # host snapshot: device HBM holds ONE
+                                # live state; best params go to host RAM
+                                self._best_params = self._best_snapshot()
                             logger.info(
                                 "epoch %d is the new best (%s %.4f)",
                                 epoch, cfg.best_metric, metric)
+                        else:
+                            epochs_since_best += 1
+                            patience = cfg.early_stopping_patience
+                            if patience and epochs_since_best >= patience:
+                                logger.info(
+                                    "early stop at epoch %d: no %s "
+                                    "improvement for %d epochs", epoch,
+                                    cfg.best_metric, patience)
+                                stop_early = True
                 if checkpointer is not None:
                     if cfg.check_divergence:
                         self.check_replica_divergence()
                     checkpointer.save(self.state, epoch=epoch + 1)
+                if stop_early:
+                    break
             if profiling:  # epoch shorter than the profiled step range
                 jax.profiler.stop_trace()
-            if (getattr(cfg, "keep_best", False)
-                    and self._best_params is not None):
+            if cfg.keep_best and self._best_params is not None:
                 # load_best_model_at_end, literally: everything after fit
                 # (final eval, ROUGE/QA passes, export, adapter sidecar)
                 # sees the best epoch's weights. Optimizer state is NOT
                 # rewound — training is over; resuming from a checkpoint
                 # uses the checkpointed state, not this restore.
-                self.state = TrainState(
-                    step=self.state.step,
-                    params=jax.device_put(self._best_params,
-                                          self.state_shardings.params),
-                    opt_state=self.state.opt_state)
+                self._restore_best_into_state()
                 logger.info("restored best epoch %d params into the live "
                             "state (%s %.4f)", self.best_epoch,
                             cfg.best_metric, self._best_metric)

@@ -318,12 +318,13 @@ def main(argv=None) -> dict:
                 trainer.state, start_epoch, start_step_in_epoch = restored
                 logger.info("resuming from epoch %d (step-in-epoch %d)",
                             start_epoch, start_step_in_epoch)
-                if config.keep_best:
+                if config.keep_best or config.early_stopping_patience:
                     logger.warning(
-                        "--keep_best across a resume: the best-model "
-                        "snapshot lives in host RAM, not the checkpoint "
-                        "— selection restarts at this epoch and earlier "
-                        "epochs can no longer win")
+                        "--keep_best/--early_stopping_patience across a "
+                        "resume: best-metric and patience tracking live "
+                        "in host RAM, not the checkpoint — both restart "
+                        "at this epoch (earlier epochs can no longer "
+                        "win, and the patience budget is fresh)")
 
     results: dict = {}
     try:
@@ -335,7 +336,7 @@ def main(argv=None) -> dict:
                 start_step_in_epoch=start_step_in_epoch,
                 eval_batcher=eval_batcher if config.eval_each_epoch
                 else None)
-            if trainer.best_epoch is not None:
+            if config.keep_best and trainer.best_epoch is not None:
                 logger.info("exporting best epoch %d (%s = %.4f)",
                             trainer.best_epoch, config.best_metric,
                             trainer._best_metric)

@@ -364,3 +364,55 @@ def test_lora_checkpoint_resume_roundtrip(tmp_path, devices8):
                     jax.tree.leaves(jax.device_get(restored))):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
     ckpt.close()
+
+
+@pytest.mark.slow
+def test_lora_keep_best_restores_adapters_and_heads(devices8, monkeypatch):
+    """--keep_best under LoRA snapshots only what can change (adapters +
+    trainable heads, NOT the frozen multi-size base) and restores the
+    best epoch's values into the live state at fit end."""
+    mesh = build_mesh(MeshConfig(dp=-1), devices=devices8)
+    model_cfg = _cfg()
+    model = BertForSequenceClassification(model_cfg, num_labels=2)
+    params = init_params(model, model_cfg, seed=0)
+    cfg = TrainConfig(task="seq-cls", dtype="float32", learning_rate=2e-2,
+                      scale_lr_by_world_size=False, log_every_steps=0,
+                      rng_impl="threefry", epochs=3, lora_rank=4,
+                      keep_best=True)
+    trainer = Trainer(cfg, model, params, mesh)
+
+    scripted = iter([0.5, 0.2, 0.9])
+    captured = {}
+
+    def fake_evaluate(batcher):
+        loss = next(scripted)
+        captured[loss] = jax.device_get(trainer.state.params)
+        return {"eval_loss": loss, "eval_accuracy": 1.0 - loss}
+
+    monkeypatch.setattr(trainer, "evaluate", fake_evaluate)
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, labels = synthetic_text_classification(64, seed=0)
+    ds = ArrayDataset.from_texts(tok, texts, labels, max_length=SEQ)
+    trainer.fit(ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0),
+                eval_batcher=object())
+    assert trainer.best_epoch == 1
+    # the snapshot covers adapters + head leaves only
+    best = captured[0.2]
+    live = jax.device_get(trainer.state.params)
+    for k, v in flatten_dict(best["lora"]).items():
+        np.testing.assert_array_equal(
+            np.asarray(v), np.asarray(flatten_dict(live["lora"])[k]))
+    import re
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.lora import (
+        HEAD_REGEX_DEFAULT,
+    )
+
+    rx = re.compile(HEAD_REGEX_DEFAULT)
+    live_model = flatten_dict(live["model"])
+    for k, v in flatten_dict(best["model"]).items():
+        if rx.search("/".join(k)):
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(live_model[k]))
+    # the snapshot itself was released after the restore
+    assert trainer._best_params is None

@@ -303,3 +303,35 @@ def test_eval_each_epoch_and_keep_best(devices8, monkeypatch):
     for a, b in zip(jax.tree.leaves(best),
                     jax.tree.leaves(jax.device_get(trainer.export_params))):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_early_stopping_patience(devices8, monkeypatch):
+    """Training stops after `patience` epochs without improvement on the
+    watched metric; with --keep_best the best snapshot still wins."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        Trainer,
+    )
+
+    mesh = build_mesh(MeshConfig(dp=-1), devices=devices8)
+    cfg = EncoderConfig(vocab_size=512, hidden_size=32, num_layers=2,
+                        num_heads=2, intermediate_size=64,
+                        max_position_embeddings=SEQ)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    tcfg = TrainConfig(task="seq-cls", dtype="float32", learning_rate=1e-3,
+                       scale_lr_by_world_size=False, log_every_steps=0,
+                       rng_impl="threefry", epochs=10, keep_best=True,
+                       early_stopping_patience=2)
+    trainer = Trainer(tcfg, model, init_params(model, cfg, seed=0), mesh)
+
+    scripted = iter([0.5, 0.2, 0.4, 0.3, 0.9, 0.9, 0.9, 0.9, 0.9, 0.9])
+    monkeypatch.setattr(
+        trainer, "evaluate",
+        lambda b: (lambda l: {"eval_loss": l, "eval_accuracy": 1 - l})(
+            next(scripted)))
+    data = _data(n=64, seed=3)
+    hist = trainer.fit(ShardedBatcher(data, 16, mesh, shuffle=True, seed=0),
+                       eval_batcher=object())
+    # best at epoch 1 (0.2); epochs 2 and 3 don't improve → stop after 3
+    assert hist["eval_loss"] == [0.5, 0.2, 0.4, 0.3]
+    assert trainer.best_epoch == 1
+    assert len(hist["loss"]) == 4
