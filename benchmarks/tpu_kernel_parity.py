@@ -167,6 +167,32 @@ def vocab_ce_parity() -> None:
         for name, a, b in zip(("dh", "dw"), gf, gx):
             check(f"vocab-ce {name} ({label})", a, b, atol=1e-5)
 
+        # smoothed variant (eps=0.1): the running logit-sum + smoothed
+        # target paths in the kernel, vs the explicit decomposition
+        eps = 0.1
+
+        def fl_s(h, w):
+            per_tok, _ = fused_vocab_cross_entropy(h, w, labels,
+                                                   label_smoothing=eps)
+            return jnp.mean(per_tok)
+
+        def xl_s(h, w):
+            logits = h.astype(jnp.float32) @ w.astype(jnp.float32).T
+            per_tok = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            per_tok = ((1 - eps) * per_tok
+                       + eps * (lse - jnp.mean(logits, axis=-1)))
+            return jnp.mean(per_tok)
+
+        check(f"vocab-ce smoothed loss ({label})",
+              jax.jit(fl_s)(hidden, weight), jax.jit(xl_s)(hidden, weight),
+              atol=1e-4)
+        gf = jax.jit(jax.grad(fl_s, argnums=(0, 1)))(hidden, weight)
+        gx = jax.jit(jax.grad(xl_s, argnums=(0, 1)))(hidden, weight)
+        for name, a, b in zip(("dh", "dw"), gf, gx):
+            check(f"vocab-ce smoothed {name} ({label})", a, b, atol=1e-5)
+
 
 def main() -> None:
     dev = jax.devices()[0]

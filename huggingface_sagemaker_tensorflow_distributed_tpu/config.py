@@ -131,8 +131,8 @@ class TrainConfig:
     max_grad_norm: float = 0.0     # 0 disables clipping (reference has none)
     # uniform label smoothing for seq2seq fine-tuning (T5/BART
     # convention, HF --label_smoothing_factor; train-time only — eval
-    # loss stays plain CE). Unfused path only: the fused vocab-CE kernel
-    # computes integer-label CE and does not emit the mean-logits term.
+    # loss stays plain CE). Composes with --fused_vocab_ce: the kernel
+    # carries a running logit-sum next to its online-softmax stats.
     label_smoothing: float = 0.0
     # micro-batches averaged per optimizer update (1 = off): grows the
     # effective batch beyond HBM limits (e.g. BERT-large past bs 8/chip)
@@ -357,11 +357,6 @@ class TrainConfig:
                 "label_smoothing is implemented for task='seq2seq' (the "
                 "T5/BART fine-tuning convention); other tasks would "
                 "silently ignore it")
-        if self.label_smoothing > 0 and self.fused_vocab_ce:
-            raise ValueError(
-                "label_smoothing does not combine with --fused_vocab_ce "
-                "(the fused kernel computes integer-label CE without the "
-                "mean-logits term smoothing needs); drop one")
         if self.best_metric not in ("eval_loss", "eval_accuracy"):
             raise ValueError(
                 f"unknown best_metric {self.best_metric!r} "
@@ -373,6 +368,9 @@ class TrainConfig:
         if self.keep_best and not self.do_eval:
             raise ValueError("keep_best needs do_eval=true (it selects "
                              "by eval metric)")
+        if self.early_stopping_patience > 0 and not self.do_eval:
+            raise ValueError("early_stopping_patience needs do_eval=true "
+                             "(it watches an eval metric)")
         if self.keep_best:
             self.eval_each_epoch = True
         if self.remat_policy not in ("full", "dots", "dots_no_batch"):

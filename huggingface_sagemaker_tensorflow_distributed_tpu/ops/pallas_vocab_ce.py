@@ -46,10 +46,15 @@ def _vocab_ids(iv, block_v, block_n):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(h_ref, w_ref, label_ref, loss_ref, lse_ref, pred_ref,
-                m_ref, l_ref, ll_ref, ix_ref, *, vocab_size, block_n, block_v):
+                m_ref, l_ref, ll_ref, ix_ref, zs_ref=None, *, vocab_size,
+                block_n, block_v, epsilon=0.0):
     """Grid (num_n, num_v), v innermost: online softmax stats over vocab
     blocks for one token block. Tracks running max ``m``, sum-exp ``l``,
-    the label's logit ``ll`` and the argmax id ``ix`` in VMEM scratch."""
+    the label's logit ``ll`` and the argmax id ``ix`` in VMEM scratch.
+    With ``epsilon`` > 0 (uniform label smoothing) a running logit SUM
+    ``zs`` rides along and the emitted loss becomes
+    ``lse - (1-eps)*z_label - eps*mean(z)`` — the smoothed CE, still
+    with no [N, V] materialization."""
     iv = pl.program_id(1)
     num_v = pl.num_programs(1)
 
@@ -59,6 +64,8 @@ def _fwd_kernel(h_ref, w_ref, label_ref, loss_ref, lse_ref, pred_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         ll_ref[...] = jnp.full_like(ll_ref, _NEG_INF)
         ix_ref[...] = jnp.zeros_like(ix_ref)
+        if epsilon > 0:
+            zs_ref[...] = jnp.zeros_like(zs_ref)
 
     h = h_ref[...]                                        # [BN, H]
     w = w_ref[...]                                        # [BV, H]
@@ -87,19 +94,29 @@ def _fwd_kernel(h_ref, w_ref, label_ref, loss_ref, lse_ref, pred_ref,
                                            keepdims=True)
     m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+    if epsilon > 0:
+        zs_blk = jnp.sum(jnp.where(vids < vocab_size, s, 0.0), axis=-1,
+                         keepdims=True)
+        zs_ref[...] = zs_ref[...] + jnp.broadcast_to(zs_blk, zs_ref.shape)
 
     @pl.when(iv == num_v - 1)
     def _finish():
         lse = m_ref[:, :1] + jnp.log(l_ref[:, :1])        # [BN, 1]
         lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
-        loss_ref[...] = jnp.broadcast_to(lse - ll_ref[:, :1], loss_ref.shape)
+        if epsilon > 0:
+            target = ((1.0 - epsilon) * ll_ref[:, :1]
+                      + epsilon * zs_ref[:, :1] / vocab_size)
+        else:
+            target = ll_ref[:, :1]
+        loss_ref[...] = jnp.broadcast_to(lse - target, loss_ref.shape)
         pred_ref[...] = ix_ref[...]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("vocab_size", "block_n", "block_v", "interpret"))
+    jax.jit, static_argnames=("vocab_size", "block_n", "block_v",
+                              "interpret", "epsilon"))
 def _fused_ce_fwd_call(hidden, weight, labels, vocab_size, block_n, block_v,
-                       interpret):
+                       interpret, epsilon=0.0):
     n_tok, h_dim = hidden.shape
     v_pad = weight.shape[0]
     grid = (n_tok // block_n, v_pad // block_v)
@@ -107,9 +124,18 @@ def _fused_ce_fwd_call(hidden, weight, labels, vocab_size, block_n, block_v,
     # labels ride in lane-broadcast [N, 128] form (TPU row-vector layout)
     lab = jnp.broadcast_to(labels.astype(jnp.int32)[:, None], (n_tok, 128))
 
+    scratch = [
+        pltpu.VMEM((block_n, 128), jnp.float32),   # running max
+        pltpu.VMEM((block_n, 128), jnp.float32),   # running sum-exp
+        pltpu.VMEM((block_n, 128), jnp.float32),   # label logit
+        pltpu.VMEM((block_n, 128), jnp.int32),     # argmax id
+    ]
+    if epsilon > 0:
+        scratch.append(pltpu.VMEM((block_n, 128), jnp.float32))  # logit sum
     outs = pl.pallas_call(
         functools.partial(_fwd_kernel, vocab_size=vocab_size,
-                          block_n=block_n, block_v=block_v),
+                          block_n=block_n, block_v=block_v,
+                          epsilon=epsilon),
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_n, h_dim), lambda j, i: (j, 0)),
@@ -126,12 +152,7 @@ def _fused_ce_fwd_call(hidden, weight, labels, vocab_size, block_n, block_v,
             jax.ShapeDtypeStruct((n_tok, 128), jnp.float32),   # lse
             jax.ShapeDtypeStruct((n_tok, 128), jnp.int32),     # pred
         ],
-        scratch_shapes=[
-            pltpu.VMEM((block_n, 128), jnp.float32),   # running max
-            pltpu.VMEM((block_n, 128), jnp.float32),   # running sum-exp
-            pltpu.VMEM((block_n, 128), jnp.float32),   # label logit
-            pltpu.VMEM((block_n, 128), jnp.int32),     # argmax id
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(hidden, weight, lab)
     loss, lse, pred = outs
@@ -143,8 +164,9 @@ def _fused_ce_fwd_call(hidden, weight, labels, vocab_size, block_n, block_v,
 # ---------------------------------------------------------------------------
 
 def _dh_kernel(h_ref, w_ref, label_ref, lse_ref, g_ref, dh_ref, dh_acc,
-               *, vocab_size, block_n, block_v):
-    """Grid (num_n, num_v): dH = Σ_v g ∘ (softmax − onehot) · W."""
+               *, vocab_size, block_n, block_v, epsilon=0.0):
+    """Grid (num_n, num_v): dH = Σ_v g ∘ (softmax − target) · W, where
+    target is the (possibly smoothed) label distribution."""
     iv = pl.program_id(1)
     num_v = pl.num_programs(1)
 
@@ -161,7 +183,13 @@ def _dh_kernel(h_ref, w_ref, label_ref, lse_ref, g_ref, dh_ref, dh_acc,
     s = jnp.where(vids < vocab_size, s, _NEG_INF)
     p = jnp.exp(s - lse_ref[...][:, :1])                  # [BN, BV]
     onehot = (vids == label_ref[...][:, :1]).astype(jnp.float32)
-    ds = (p - onehot) * g_ref[...][:, :1]                 # [BN, BV]
+    if epsilon > 0:
+        target = ((1.0 - epsilon) * onehot
+                  + epsilon / vocab_size
+                  * (vids < vocab_size).astype(jnp.float32))
+    else:
+        target = onehot
+    ds = (p - target) * g_ref[...][:, :1]                 # [BN, BV]
     dh_acc[...] += jax.lax.dot_general(
         ds.astype(w.dtype), w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)               # [BN, H]
@@ -172,8 +200,8 @@ def _dh_kernel(h_ref, w_ref, label_ref, lse_ref, g_ref, dh_ref, dh_acc,
 
 
 def _dw_kernel(h_ref, w_ref, label_ref, lse_ref, g_ref, dw_ref, dw_acc,
-               *, vocab_size, block_n, block_v):
-    """Grid (num_v, num_n), n innermost: dW = Σ_n (g ∘ (softmax − onehot))ᵀ · H."""
+               *, vocab_size, block_n, block_v, epsilon=0.0):
+    """Grid (num_v, num_n), n innermost: dW = Σ_n (g ∘ (softmax − target))ᵀ · H."""
     i_n = pl.program_id(1)
     num_n = pl.num_programs(1)
 
@@ -191,7 +219,13 @@ def _dw_kernel(h_ref, w_ref, label_ref, lse_ref, g_ref, dw_ref, dw_acc,
     s = jnp.where(vids < vocab_size, s, _NEG_INF)
     p = jnp.exp(s - lse_ref[...][:, :1])
     onehot = (vids == label_ref[...][:, :1]).astype(jnp.float32)
-    ds = (p - onehot) * g_ref[...][:, :1]                 # [BN, BV]
+    if epsilon > 0:
+        target = ((1.0 - epsilon) * onehot
+                  + epsilon / vocab_size
+                  * (vids < vocab_size).astype(jnp.float32))
+    else:
+        target = onehot
+    ds = (p - target) * g_ref[...][:, :1]                 # [BN, BV]
     # contract over tokens: [BV, BN] · [BN, H] without explicit transpose
     dw_acc[...] += jax.lax.dot_general(
         ds.astype(h.dtype), h, (((0,), (0,)), ((), ())),
@@ -203,9 +237,10 @@ def _dw_kernel(h_ref, w_ref, label_ref, lse_ref, g_ref, dw_ref, dw_acc,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("vocab_size", "block_n", "block_v", "interpret"))
+    jax.jit, static_argnames=("vocab_size", "block_n", "block_v",
+                              "interpret", "epsilon"))
 def _fused_ce_bwd_call(hidden, weight, labels, lse, g, vocab_size,
-                       block_n, block_v, interpret):
+                       block_n, block_v, interpret, epsilon=0.0):
     n_tok, h_dim = hidden.shape
     v_pad = weight.shape[0]
     num_n = n_tok // block_n
@@ -215,7 +250,8 @@ def _fused_ce_bwd_call(hidden, weight, labels, lse, g, vocab_size,
     lse_b = jnp.broadcast_to(lse[:, None], (n_tok, 128))
     g_b = jnp.broadcast_to(g.astype(jnp.float32)[:, None], (n_tok, 128))
 
-    kw = dict(vocab_size=vocab_size, block_n=block_n, block_v=block_v)
+    kw = dict(vocab_size=vocab_size, block_n=block_n, block_v=block_v,
+              epsilon=epsilon)
     row = lambda j, i: (j, 0)                     # noqa: E731
     dh = pl.pallas_call(
         functools.partial(_dh_kernel, **kw),
@@ -259,7 +295,8 @@ def _fused_ce_bwd_call(hidden, weight, labels, lse, g, vocab_size,
 
 def fused_vocab_cross_entropy(hidden, weight, labels, block_n: int = 256,
                               block_v: int = 512,
-                              interpret: bool | None = None):
+                              interpret: bool | None = None,
+                              label_smoothing: float = 0.0):
     """Per-token CE loss + argmax prediction of ``logits = hidden·weightᵀ``
     without materialising the logits.
 
@@ -298,38 +335,48 @@ def fused_vocab_cross_entropy(hidden, weight, labels, block_n: int = 256,
             or h_dim % 128):
         logits = (hidden.astype(jnp.float32)
                   @ weight.astype(jnp.float32).T)
-        return (softmax_cross_entropy_with_integer_labels(logits, labels),
-                jnp.argmax(logits, -1).astype(jnp.int32))
+        per_tok = softmax_cross_entropy_with_integer_labels(logits, labels)
+        if label_smoothing > 0:
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            uniform = lse - jnp.mean(logits, axis=-1)
+            per_tok = ((1.0 - label_smoothing) * per_tok
+                       + label_smoothing * uniform)
+        return per_tok, jnp.argmax(logits, -1).astype(jnp.int32)
     v_pad = -(-vocab_size // block_v) * block_v
     if v_pad != vocab_size:
         weight = jnp.pad(weight, ((0, v_pad - vocab_size), (0, 0)))
     return _fused_ce_vjp(hidden, weight, labels, vocab_size, block_n,
-                         block_v, interpret)
+                         block_v, interpret, float(label_smoothing))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _fused_ce_vjp(hidden, weight, labels, vocab_size, block_n, block_v,
-                  interpret):
+                  interpret, epsilon):
     loss, _, pred = _fused_ce_fwd_call(hidden, weight, labels, vocab_size,
-                                       block_n, block_v, interpret)
+                                       block_n, block_v, interpret,
+                                       epsilon=epsilon)
     return loss, pred
 
 
 def _fused_ce_vjp_fwd(hidden, weight, labels, vocab_size, block_n, block_v,
-                      interpret):
+                      interpret, epsilon):
     loss, lse, pred = _fused_ce_fwd_call(hidden, weight, labels, vocab_size,
-                                         block_n, block_v, interpret)
+                                         block_n, block_v, interpret,
+                                         epsilon=epsilon)
     return (loss, pred), (hidden, weight, labels, lse)
 
 
-def _fused_ce_vjp_bwd(vocab_size, block_n, block_v, interpret, res, g):
+def _fused_ce_vjp_bwd(vocab_size, block_n, block_v, interpret, epsilon,
+                      res, g):
     hidden, weight, labels, lse = res
     g_loss, _ = g                                 # pred cotangent is float0
     # dw matches the (possibly vocab-padded) weight this vjp received;
     # the outer jnp.pad's transpose rule slices padding back off. Pad
-    # rows get zero grad by construction (logit -inf ⇒ p = 0, onehot = 0).
+    # rows get zero grad by construction (logit -inf ⇒ p = 0, and the
+    # smoothed target's uniform mass is masked to real vocab rows).
     dh, dw = _fused_ce_bwd_call(hidden, weight, labels, lse, g_loss,
-                                vocab_size, block_n, block_v, interpret)
+                                vocab_size, block_n, block_v, interpret,
+                                epsilon=epsilon)
     return dh, dw, None
 
 

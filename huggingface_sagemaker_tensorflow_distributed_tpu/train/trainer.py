@@ -213,7 +213,8 @@ def rtd_loss(apply_fn, params, batch, rngs, train: bool):
 
 
 def _make_sharded_fused_ce(block_n: int, block_v: int,
-                           interpret: bool | None):
+                           interpret: bool | None,
+                           label_smoothing: float = 0.0):
     """The shard_mapped blocked-vocab CE call the fused losses share:
     ``ce(hidden [B,T,H], weight [V,H], labels [B,T]) → (per_tok, pred)``,
     per-dp-shard through the Pallas kernel, weight cotangent psummed by
@@ -232,7 +233,8 @@ def _make_sharded_fused_ce(block_n: int, block_v: int,
         n = h.shape[0] * h.shape[1]
         per_tok, pred = fused_vocab_cross_entropy(
             h.reshape(n, h.shape[2]), w, lab.reshape(n),
-            block_n=block_n, block_v=block_v, interpret=interpret)
+            block_n=block_n, block_v=block_v, interpret=interpret,
+            label_smoothing=label_smoothing)
         return per_tok.reshape(lab.shape), pred.reshape(lab.shape)
 
     mesh = maybe_current_mesh()
@@ -289,13 +291,17 @@ def make_fused_causal_lm_loss(model, block_n: int = 256, block_v: int = 512,
 
 
 def make_fused_seq2seq_loss(model, block_n: int = 256, block_v: int = 512,
-                            interpret: bool | None = None):
+                            interpret: bool | None = None,
+                            label_smoothing: float = 0.0):
     """``seq2seq_loss`` without the [B, T, V] logits: the encoder-decoder
     model exposes ``seq2seq_hidden_and_embedding`` (pre-head decoder
     hidden + LM weight — T5 tied/untied and BART) and the blocked-vocab
     Pallas kernel computes CE + argmax on chip, shard_mapped per dp
     shard like the causal path. No label shifting: seq2seq labels align
-    with decoder positions (teacher forcing is in decoder_input_ids)."""
+    with decoder positions (teacher forcing is in decoder_input_ids).
+    ``label_smoothing`` rides into the kernel as a static epsilon (a
+    running logit-sum joins the online-softmax stats) at TRAIN time;
+    eval uses the plain-CE variant."""
 
     def loss(apply_fn, params, batch, rngs, train: bool):
         # apply_fn, not model.apply — see make_fused_causal_lm_loss
@@ -309,7 +315,9 @@ def make_fused_seq2seq_loss(model, block_n: int = 256, block_v: int = 512,
         if "valid" in batch:
             token_valid = token_valid & (batch["valid"][:, None] > 0)
         safe_labels = jnp.maximum(labels, 0)
-        ce = _make_sharded_fused_ce(block_n, block_v, interpret)
+        eps = label_smoothing if train else 0.0
+        ce = _make_sharded_fused_ce(block_n, block_v, interpret,
+                                    label_smoothing=eps)
         per_tok, pred = ce(hidden, weight, safe_labels)
         correct = pred == safe_labels
         return _masked_sums(per_tok, correct, token_valid)
@@ -462,7 +470,8 @@ class Trainer:
                                             0.25))
             elif self.task == "seq2seq" and hasattr(
                     model, "seq2seq_hidden_and_embedding"):
-                self.loss_fn = make_fused_seq2seq_loss(model)
+                self.loss_fn = make_fused_seq2seq_loss(
+                    model, label_smoothing=config.label_smoothing)
             else:
                 raise ValueError(
                     "fused_vocab_ce requires task='causal-lm' with a model "
@@ -738,6 +747,12 @@ class Trainer:
             meter.begin_window()
             return fetched
 
+        if eval_batcher is None and (cfg.keep_best
+                                     or cfg.early_stopping_patience > 0):
+            logger.warning(
+                "keep_best/early_stopping_patience are set but fit() got "
+                "no eval_batcher — both are inert this run (pass "
+                "eval_batcher=..., as scripts/train.py does)")
         epochs_since_best = 0
         with Stopwatch() as sw:
             for epoch in range(start_epoch, epochs):

@@ -254,6 +254,7 @@ def test_cosine_schedule_builds(devices8):
     assert lr == cfg.learning_rate
 
 
+@pytest.mark.slow
 def test_eval_each_epoch_and_keep_best(devices8, monkeypatch):
     """--eval_each_epoch lands eval_loss/eval_accuracy per epoch in the
     history; --keep_best snapshots the best epoch's params and
@@ -305,6 +306,7 @@ def test_eval_each_epoch_and_keep_best(devices8, monkeypatch):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_early_stopping_patience(devices8, monkeypatch):
     """Training stops after `patience` epochs without improvement on the
     watched metric; with --keep_best the best snapshot still wins."""

@@ -369,3 +369,112 @@ def test_fused_seq2seq_composes_with_pipelined_t5(devices8):
         return losses
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-5)
+
+
+def test_fused_label_smoothing_matches_unfused():
+    """Smoothed fused CE: loss and both gradients must match the explicit
+    (1-eps)*CE + eps*(lse - mean logits) computed from full logits —
+    including with vocab padding (mean over REAL vocab only)."""
+    eps = 0.1
+    for vocab in (512, 1000):              # aligned and padded vocab
+        hidden, weight, labels = _rand(256, 128, vocab, seed=2)
+        valid = jnp.asarray((np.arange(256) % 3 != 0).astype(np.float32))
+
+        def smooth_unfused(h, w):
+            logits = h.astype(jnp.float32) @ w.astype(jnp.float32).T
+            per_tok, _ = _unfused(h, w, labels)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            uniform = lse - jnp.mean(logits, axis=-1)
+            per_tok = (1 - eps) * per_tok + eps * uniform
+            return jnp.sum(per_tok * valid) / jnp.sum(valid)
+
+        def smooth_fused(h, w):
+            per_tok, _ = fused_vocab_cross_entropy(
+                h, w, labels, block_n=128, block_v=256, interpret=True,
+                label_smoothing=eps)
+            return jnp.sum(per_tok * valid) / jnp.sum(valid)
+
+        lu = float(smooth_unfused(hidden, weight))
+        lf = float(smooth_fused(hidden, weight))
+        assert lf == pytest.approx(lu, rel=1e-5), vocab
+        (gh_f, gw_f) = jax.grad(smooth_fused, argnums=(0, 1))(hidden, weight)
+        (gh_u, gw_u) = jax.grad(smooth_unfused, argnums=(0, 1))(hidden,
+                                                                weight)
+        np.testing.assert_allclose(np.asarray(gh_f), np.asarray(gh_u),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_u),
+                                   rtol=1e-4, atol=1e-5)
+        # eps=0 keeps the original path bit-for-bit
+        plain_f, _ = fused_vocab_cross_entropy(
+            hidden, weight, labels, block_n=128, block_v=256,
+            interpret=True, label_smoothing=0.0)
+        plain_ref, _ = fused_vocab_cross_entropy(
+            hidden, weight, labels, block_n=128, block_v=256,
+            interpret=True)
+        np.testing.assert_array_equal(np.asarray(plain_f),
+                                      np.asarray(plain_ref))
+
+
+@pytest.mark.slow
+def test_fused_seq2seq_label_smoothing_training_parity(devices8):
+    """--fused_vocab_ce + --label_smoothing: the fused T5 training loss
+    must equal the unfused smoothed loss on a dp8 mesh, and eval must
+    drop the smoothing on both paths."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_summarization,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5Config,
+        T5ForConditionalGeneration,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        make_fused_seq2seq_loss,
+    )
+
+    tok = WordHashTokenizer(vocab_size=256)
+    sources, targets = synthetic_summarization(16, seed=4)
+    ds = ArrayDataset.from_seq2seq(tok, sources, targets,
+                                   max_source_length=24,
+                                   max_target_length=16)
+
+    def first_loss(fused, train=True):
+        mesh = build_mesh(MeshConfig(dp=-1), devices=devices8)
+        mcfg = T5Config(vocab_size=256, d_model=128, num_layers=2,
+                        num_decoder_layers=2, num_heads=4, d_ff=256,
+                        d_kv=32, dropout_rate=0.0)
+        model = T5ForConditionalGeneration(mcfg)
+        params = init_params(model, mcfg, seed=0)
+        cfg = TrainConfig(task="seq2seq", dtype="float32",
+                          learning_rate=1e-3, scale_lr_by_world_size=False,
+                          log_every_steps=0, rng_impl="threefry",
+                          label_smoothing=0.1, fused_vocab_ce=fused)
+        trainer = Trainer(cfg, model, params, mesh)
+        if fused:
+            trainer.loss_fn = make_fused_seq2seq_loss(
+                model, interpret=True, label_smoothing=0.1)
+        batch = next(ShardedBatcher(ds, 16, mesh, shuffle=False,
+                                    seed=0).global_arrays(0))
+        if train:
+            _, m = trainer._train_step(trainer.state, batch)
+            return float(jax.device_get(m["loss"]))
+        sums = trainer._eval_step(trainer.state.params, batch)
+        s = jax.device_get(sums)
+        return float(s["loss_sum"] / s["count"])
+
+    np.testing.assert_allclose(first_loss(True), first_loss(False),
+                               rtol=2e-5)
+    # eval drops smoothing on both paths: fused-eval == unfused-eval
+    np.testing.assert_allclose(first_loss(True, train=False),
+                               first_loss(False, train=False), rtol=2e-5)
