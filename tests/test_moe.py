@@ -436,6 +436,7 @@ def test_round_major_priority_is_not_causal():
                               jax.device_get(y2[:, :-1]))
 
 
+@pytest.mark.slow
 def test_gpt2_moe_residual_flow_init():
     """The expert output projection follows GPT-2's 1/sqrt(2*n_layer)
     residual-flow init (like attn c_proj and dense mlp fc_out), and the

@@ -48,6 +48,7 @@ def _loss_and_grads(remat, policy="full"):
     return float(val), jax.device_get(grads)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("policy", ["full", "dots", "dots_no_batch"])
 def test_remat_policies_match_no_remat(policy):
     base_val, base_grads = _loss_and_grads(remat=False)
@@ -91,6 +92,7 @@ def test_gpt2_remat_policy_runs():
     assert np.isfinite(float(val))
 
 
+@pytest.mark.slow
 def test_remat_policy_override_reaches_every_family(tmp_path):
     """scripts/train.py passes remat_policy into every family's config
     builder — each from_hf constructor must accept it (DeBERTa was the

@@ -371,6 +371,7 @@ def test_fused_seq2seq_composes_with_pipelined_t5(devices8):
     np.testing.assert_allclose(run(True), run(False), rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_fused_label_smoothing_matches_unfused():
     """Smoothed fused CE: loss and both gradients must match the explicit
     (1-eps)*CE + eps*(lse - mean logits) computed from full logits —
