@@ -35,6 +35,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.models import (
     distilbert,
     electra,
     gpt2,
+    llama,
     roberta,
     t5,
 )
@@ -69,6 +70,7 @@ MODEL_REGISTRY: dict[tuple[str, str], Any] = {
     ("albert", "qa"): albert.AlbertForQuestionAnswering,
     ("t5", "seq2seq"): t5.T5ForConditionalGeneration,
     ("gpt2", "causal-lm"): gpt2.Gpt2LMHeadModel,
+    ("llama", "causal-lm"): llama.LlamaForCausalLM,
     ("bert", "mlm"): bert.BertForMaskedLM,
     ("roberta", "mlm"): roberta.RobertaForMaskedLM,
     ("distilbert", "mlm"): distilbert.DistilBertForMaskedLM,
@@ -91,6 +93,7 @@ CONFIG_BUILDERS = {
     "albert": albert.albert_config_from_hf,
     "t5": t5.t5_config_from_hf,
     "gpt2": gpt2.gpt2_config_from_hf,
+    "llama": llama.llama_config_from_hf,
     "deberta-v2": deberta.deberta_config_from_hf,
     "bart": bart.bart_config_from_hf,
     # mBART hardcodes pre-LN + per-stack final LN in its modeling class
@@ -227,6 +230,21 @@ _HF_CONFIG_EXPORTERS = {
         "pad_token_id": c.pad_token_id,
         "initializer_range": c.initializer_range,
     },
+    "llama": lambda c: {
+        "model_type": "llama", "architectures": ["LlamaForCausalLM"],
+        "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.num_layers,
+        "num_attention_heads": c.num_heads,
+        "num_key_value_heads": c.num_kv_heads,
+        "intermediate_size": c.intermediate_size,
+        "max_position_embeddings": c.max_position_embeddings,
+        "rope_theta": c.rope_theta, "rms_norm_eps": c.rms_norm_eps,
+        "hidden_act": c.hidden_act,
+        "tie_word_embeddings": c.tie_word_embeddings,
+        "bos_token_id": c.bos_token_id, "eos_token_id": c.eos_token_id,
+        "pad_token_id": c.pad_token_id,
+        "initializer_range": c.initializer_range,
+    },
     "bart": _bart_hf_config,
     "mbart": lambda c: {**_bart_hf_config(c), "model_type": "mbart",
                         "architectures": ["MBartForConditionalGeneration"]},
@@ -340,10 +358,11 @@ def from_pretrained(
             "layout is supported — silently loading would leave a random "
             "head (HF's own non-legacy forward is broken in transformers "
             "4.57: tie_weights clobbers lm_head.dense)")
-    if family == "gpt2" and task != "causal-lm":
+    if family in ("gpt2", "llama") and task != "causal-lm":
         raise ValueError(
-            f"{model_name_or_path!r} is a GPT-2 (decoder-only) checkpoint; "
-            f"it only supports task='causal-lm', got task={task!r}")
+            f"{model_name_or_path!r} is a {family} (decoder-only) "
+            f"checkpoint; it only supports task='causal-lm', got "
+            f"task={task!r}")
     if family in ("bert", "albert") and task != "seq-cls":
         # HF Bert/Albert QA/token-cls models are built with
         # add_pooling_layer=False; only the seq-cls head uses the pooler.

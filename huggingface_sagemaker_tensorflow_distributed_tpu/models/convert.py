@@ -265,6 +265,22 @@ _GPT2_RULES = [
     (r"^lm_head$", r"backbone/wte"),
 ]
 
+_LLAMA_RULES = [
+    (r"^model\.embed_tokens$", r"backbone/embed_tokens"),
+    (r"^model\.layers\.(\d+)\.self_attn\.(q|k|v|o)_proj$",
+     r"backbone/layers_\1/self_attn/\2_proj"),
+    (r"^model\.layers\.(\d+)\.mlp\.(gate|up|down)_proj$",
+     r"backbone/layers_\1/mlp/\2_proj"),
+    (r"^model\.layers\.(\d+)\.input_layernorm$",
+     r"backbone/layers_\1/input_ln"),
+    (r"^model\.layers\.(\d+)\.post_attention_layernorm$",
+     r"backbone/layers_\1/post_attn_ln"),
+    (r"^model\.norm$", r"backbone/final_ln"),
+    (r"^lm_head$", r"lm_head"),
+    # rotary inv_freq buffers (older HF exports) are derived, not
+    # parameters — they match no rule and are skipped by hf_to_params
+]
+
 RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_RULES,
     "roberta": _ROBERTA_RULES,
@@ -273,6 +289,7 @@ RULES_BY_FAMILY: dict[str, list] = {
     "albert": _ALBERT_RULES,
     "t5": _T5_RULES,
     "gpt2": _GPT2_RULES,
+    "llama": _LLAMA_RULES,
     "deberta-v2": _DEBERTA_V2_RULES,
     "bart": _BART_RULES,
     "mbart": _MBART_RULES,
@@ -313,7 +330,8 @@ def translate_key(torch_key: str, family: str) -> str | None:
             is_embed = "word_embeddings" in base or "position_embeddings" in base \
                 or "token_type_embeddings" in base or "rel_bias" in base \
                 or "rel_embeddings" in base or base == "shared" \
-                or leaf_name in ("wte", "wpe", "embed_positions")
+                or leaf_name in ("wte", "wpe", "embed_positions",
+                                 "embed_tokens")
             is_ln = leaf_name.endswith("_ln") or leaf_name.startswith("ln_") \
                 or leaf_name == "ln" or "layernorm" in leaf_name.lower()
             if kind == "weight":
@@ -603,6 +621,19 @@ _MBART_REVERSE = _BART_REVERSE + [
     (r"^(encoder|decoder)/final_ln$", "model.{}.layer_norm"),
 ]
 
+_LLAMA_REVERSE = [
+    (r"^backbone/embed_tokens$", "model.embed_tokens"),
+    (r"^backbone/layers_(\d+)/self_attn/(q|k|v|o)_proj$",
+     "model.layers.{}.self_attn.{}_proj"),
+    (r"^backbone/layers_(\d+)/mlp/(gate|up|down)_proj$",
+     "model.layers.{}.mlp.{}_proj"),
+    (r"^backbone/layers_(\d+)/input_ln$", "model.layers.{}.input_layernorm"),
+    (r"^backbone/layers_(\d+)/post_attn_ln$",
+     "model.layers.{}.post_attention_layernorm"),
+    (r"^backbone/final_ln$", "model.norm"),
+    (r"^lm_head$", "lm_head"),
+]
+
 REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "bert": _BERT_REVERSE,
     "roberta": _ROBERTA_REVERSE,
@@ -611,6 +642,7 @@ REVERSE_RULES_BY_FAMILY: dict[str, list] = {
     "albert": _ALBERT_REVERSE,
     "t5": _T5_REVERSE,
     "gpt2": _GPT2_REVERSE,
+    "llama": _LLAMA_REVERSE,
     "deberta-v2": _DEBERTA_V2_REVERSE,
     "bart": _BART_REVERSE,
     "mbart": _MBART_REVERSE,

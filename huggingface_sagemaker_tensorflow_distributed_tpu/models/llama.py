@@ -1,0 +1,340 @@
+"""Llama-family decoder: RoPE + GQA + SwiGLU + RMSNorm.
+
+Beyond-parity model family: the reference fine-tunes the BERT-era HF
+zoo (reference ``scripts/train.py:117``); this adds the modern
+decoder-only lineage (Llama/Llama-2/3 layout, which TinyLlama, Mistral
+-without-sliding-window, Qwen-sans-bias and friends share) with HF
+``LlamaForCausalLM`` checkpoint parity — and it composes with the
+framework's existing machinery for free: the causal-lm task loss,
+``generate_causal`` (prefill + KV cache), LoRA (bias-free ``*_proj``
+kernels), int8 weight-only decode, fused vocab-CE
+(``hidden_and_embedding``), and the Megatron sharding rules
+(``q|k|v_proj`` column-, ``o_proj|down_proj`` row-parallel).
+
+Architecture (HF parity):
+- token embeddings only (positions live in RoPE), no dropout;
+- pre-norm blocks: ``x + attn(rms(x))`` then ``x + mlp(rms(x))``;
+- rotary position embeddings in HF's rotate-half layout, applied to
+  q/k after head split;
+- grouped-query attention: ``num_kv_heads <= num_heads`` k/v heads,
+  cached PRE-repeat (the GQA memory win), repeated to full heads for
+  the attention kernel (Pallas flash on TPU);
+- SwiGLU MLP ``down(silu(gate(x)) * up(x))``, all projections bias-free;
+- RMSNorm (fp32 statistics island) with HF's epsilon placement;
+- untied ``lm_head`` by default (``tie_word_embeddings`` supported —
+  TinyLlama/Gemma-style).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+    ACT2FN,
+    remat_policy,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+    dot_product_attention,
+    make_attention_mask,
+)
+
+NEG_INF = -1e9
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32                   # num_hidden_layers
+    num_heads: int = 32                    # num_attention_heads
+    num_kv_heads: int = 32                 # num_key_value_heads (GQA)
+    intermediate_size: int = 11008
+    max_position_embeddings: int = 2048
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    hidden_act: str = "silu"
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    bos_token_id: int = 1
+    eos_token_id: int = 2
+    pad_token_id: int = 0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "xla"
+    remat: bool = False
+    remat_policy: str = "full"             # full | dots | dots_no_batch
+    # int8 weight-only dense kernels for generation (models/quant.py)
+    weight_quant: str = "none"             # none | int8
+
+
+def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
+    # silently-wrong-logits guards (repo convention: raise on unsupported
+    # layouts rather than load-and-diverge, cf. the DeBERTa legacy-head
+    # check in models/auto.py)
+    scaling = hf_config.get("rope_scaling")
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        raise ValueError(
+            "rope_scaling (Llama-3.1+ long-context frequency scaling) is "
+            f"not implemented: {scaling!r}; loading would silently use "
+            "unscaled RoPE frequencies and diverge from HF")
+    if hf_config.get("attention_bias") or hf_config.get("mlp_bias"):
+        raise ValueError(
+            "attention_bias/mlp_bias=true (Qwen-style biased projections "
+            "under model_type 'llama') is not supported: the modules are "
+            "bias-free and the checkpoint's biases would be silently "
+            "dropped")
+    kw = dict(
+        vocab_size=hf_config["vocab_size"],
+        hidden_size=hf_config["hidden_size"],
+        num_layers=hf_config["num_hidden_layers"],
+        num_heads=hf_config["num_attention_heads"],
+        num_kv_heads=hf_config.get("num_key_value_heads",
+                                   hf_config["num_attention_heads"]),
+        intermediate_size=hf_config["intermediate_size"],
+        max_position_embeddings=hf_config.get("max_position_embeddings",
+                                              2048),
+        rope_theta=hf_config.get("rope_theta", 10000.0),
+        rms_norm_eps=hf_config.get("rms_norm_eps", 1e-5),
+        hidden_act=hf_config.get("hidden_act", "silu"),
+        initializer_range=hf_config.get("initializer_range", 0.02),
+        tie_word_embeddings=hf_config.get("tie_word_embeddings", False),
+        bos_token_id=hf_config.get("bos_token_id", 1),
+        eos_token_id=hf_config.get("eos_token_id", 2),
+        pad_token_id=(hf_config["pad_token_id"]
+                      if hf_config.get("pad_token_id") is not None
+                      else hf_config.get("eos_token_id", 2)),
+    )
+    kw.update(overrides)
+    kw.pop("use_pooler", None)             # encoder-family knob
+    return LlamaConfig(**kw)
+
+
+def _dense(cfg: LlamaConfig, features: int, name: str) -> nn.Module:
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+        make_dense,
+    )
+
+    return make_dense(cfg, features,
+                      nn.initializers.normal(cfg.initializer_range),
+                      use_bias=False, name=name)
+
+
+class LlamaRMSNorm(nn.Module):
+    """HF ``LlamaRMSNorm``: fp32 mean-square island, scale applied in the
+    compute dtype (the weight multiplies AFTER the cast, HF order)."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        scale = self.param("scale", nn.initializers.ones,
+                           (x.shape[-1],), cfg.param_dtype)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        x32 = x32 * lax.rsqrt(var + cfg.rms_norm_eps)
+        return (x32.astype(cfg.dtype) * scale.astype(cfg.dtype))
+
+
+def apply_rope(x, position_ids, theta: float):
+    """HF rotate-half RoPE on [B, H, S, D] with [B, S] positions."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = position_ids.astype(jnp.float32)[:, :, None] * inv_freq  # [B,S,D/2]
+    cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)[:, None]    # [B,1,S,D]
+    sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)[:, None]
+    x1, x2 = x[..., : d // 2], x[..., d // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x.astype(jnp.float32) * cos
+            + rotated.astype(jnp.float32) * sin).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    """GQA self-attention with RoPE and an optional incremental KV cache
+    (cached pre-repeat: [B, H_kv, max_len, D])."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, position_ids=None,
+                 deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        B, S, _ = hidden.shape
+
+        def split(x, n_heads):
+            return x.reshape(B, S, n_heads, head_dim).transpose(0, 2, 1, 3)
+
+        q = split(_dense(cfg, cfg.num_heads * head_dim, "q_proj")(hidden),
+                  cfg.num_heads)
+        k = split(_dense(cfg, cfg.num_kv_heads * head_dim, "k_proj")(hidden),
+                  cfg.num_kv_heads)
+        v = split(_dense(cfg, cfg.num_kv_heads * head_dim, "v_proj")(hidden),
+                  cfg.num_kv_heads)
+
+        q = apply_rope(q, position_ids, cfg.rope_theta)
+        k = apply_rope(k, position_ids, cfg.rope_theta)
+
+        causal = True
+        if decode:
+            is_init = self.has_variable("cache", "cached_key")
+            cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                     k.shape, k.dtype)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                     v.shape, v.dtype)
+            cache_index = self.variable("cache", "cache_index",
+                                        lambda: jnp.array(0, jnp.int32))
+            if is_init:
+                cur = cache_index.value
+                max_len = cached_k.value.shape[2]
+                q_len = q.shape[2]
+                k = lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
+                v = lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
+                cached_k.value, cached_v.value = k, v
+                cache_index.value = cur + q_len
+                valid = jnp.arange(max_len)[None, :] <= (
+                    cur + jnp.arange(q_len)[:, None])
+                step_mask = jnp.where(valid, 0.0, NEG_INF)[None, None]
+                attn_mask = (step_mask if attn_mask is None
+                             else attn_mask + step_mask)
+                causal = False                 # the step mask IS causality
+
+        if cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+
+        ctx = dot_product_attention(q, k, v, mask=attn_mask,
+                                    impl=cfg.attention_impl, causal=causal)
+        b, h, s, d = ctx.shape
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        return _dense(cfg, cfg.hidden_size, "o_proj")(ctx)
+
+
+class LlamaMlp(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        act = ACT2FN[cfg.hidden_act]
+        gate = _dense(cfg, cfg.intermediate_size, "gate_proj")(x)
+        up = _dense(cfg, cfg.intermediate_size, "up_proj")(x)
+        return _dense(cfg, cfg.hidden_size, "down_proj")(act(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, position_ids=None,
+                 deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        attn = LlamaAttention(cfg, name="self_attn")(
+            LlamaRMSNorm(cfg, name="input_ln")(hidden), attn_mask,
+            position_ids, deterministic, decode)
+        hidden = hidden + attn
+        mlp = LlamaMlp(cfg, name="mlp")(
+            LlamaRMSNorm(cfg, name="post_attn_ln")(hidden))
+        return hidden + mlp
+
+
+class LlamaModel(nn.Module):
+    """Backbone: embeddings + blocks + final RMSNorm. Returns
+    (hidden, lm weight [V, H]) so the head can fuse with vocab-CE."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, position_ids=None,
+                 deterministic: bool = True, decode: bool = False):
+        cfg = self.config
+        B, S = input_ids.shape
+
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size,
+            embedding_init=nn.initializers.normal(cfg.initializer_range),
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="embed_tokens")
+
+        if position_ids is None:
+            offset = 0
+            if decode:
+                is_init = self.has_variable("cache", "position_index")
+                idx = self.variable("cache", "position_index",
+                                    lambda: jnp.array(0, jnp.int32))
+                if is_init:
+                    offset = idx.value
+                    idx.value = offset + S
+            position_ids = offset + jnp.arange(S)[None, :]
+            position_ids = jnp.broadcast_to(position_ids, (B, S))
+
+        additive_mask = (make_attention_mask(attention_mask)
+                        if attention_mask is not None else None)
+
+        x = embed(input_ids)
+        block_cls = LlamaBlock
+        if cfg.remat:
+            block_cls = nn.remat(LlamaBlock, static_argnums=(4, 5),
+                                 policy=remat_policy(cfg.remat_policy))
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"layers_{i}")(
+                x, additive_mask, position_ids, deterministic, decode)
+        x = LlamaRMSNorm(cfg, name="final_ln")(x)
+        return x, embed.embedding
+
+
+class LlamaForCausalLM(nn.Module):
+    """HF ``LlamaForCausalLM`` parity. Same call signature as
+    ``Gpt2LMHeadModel`` so the causal-lm task loss, ``generate_causal``
+    and ``predict.py`` drive it unchanged; ``hidden_and_embedding``
+    feeds the fused vocab-CE kernel (tied or untied head)."""
+
+    config: LlamaConfig
+
+    def setup(self):
+        cfg = self.config
+        self.backbone = LlamaModel(cfg)
+        if not cfg.tie_word_embeddings:
+            # plain fp Dense on purpose: the output projection stays full
+            # precision under int8 weight-only decode (models/quant.py
+            # excludes LM heads — quantization error there lands directly
+            # on the logits)
+            self.lm_head = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.initializers.normal(cfg.initializer_range),
+                name="lm_head")
+
+    def _head_weight(self, tied_weight):
+        if self.config.tie_word_embeddings:
+            return tied_weight
+        # nn.Dense kernel is [H, V]; the fused-CE contract wants [V, H]
+        return self.variables["params"]["lm_head"]["kernel"].T
+
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 position_ids=None, deterministic: bool = True,
+                 decode: bool = False):
+        # token_type_ids accepted for trainer-signature parity
+        hidden, tied = self.backbone(input_ids, attention_mask,
+                                     position_ids, deterministic, decode)
+        if self.config.tie_word_embeddings:
+            logits = jnp.einsum("bsh,vh->bsv", hidden,
+                                tied.astype(self.config.dtype))
+        else:
+            logits = self.lm_head(hidden)
+        return logits.astype(jnp.float32)
+
+    def hidden_and_embedding(self, input_ids, attention_mask=None,
+                             token_type_ids=None, position_ids=None,
+                             deterministic: bool = True):
+        """(hidden [B, S, H], lm weight [V, H]) — the fused-CE path."""
+        hidden, tied = self.backbone(input_ids, attention_mask,
+                                     position_ids, deterministic, False)
+        return hidden, self._head_weight(tied)

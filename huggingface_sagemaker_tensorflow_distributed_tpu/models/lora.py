@@ -39,9 +39,10 @@ from flax.traverse_util import flatten_dict, unflatten_dict
 # path). Both naming schemes appear in the zoo: per-layer modules end in
 # ".../<name>/kernel", pipelined stacked params in ".../<name>_kernel".
 TARGET_PRESETS = {
-    "attention": r"(query|key|value|qkv|attention_out|attn_out)(/kernel|_kernel)$",
-    "mlp": r"(intermediate|ffn_out|fc_in|fc_out|wi|wi_0|wi_1|wo|fc1|fc2)"
-           r"(/kernel|_kernel)$",
+    "attention": r"(query|key|value|qkv|attention_out|attn_out"
+                 r"|q_proj|k_proj|v_proj|o_proj)(/kernel|_kernel)$",
+    "mlp": r"(intermediate|ffn_out|fc_in|fc_out|wi|wi_0|wi_1|wo|fc1|fc2"
+           r"|gate_proj|up_proj|down_proj)(/kernel|_kernel)$",
     "all": r"(/kernel|_kernel)$",
 }
 

@@ -39,6 +39,8 @@ from flax.traverse_util import flatten_dict, unflatten_dict
 GPT2_QUANT_TARGETS = r"(qkv|attn_out|fc_in|fc_out)/kernel$"
 T5_QUANT_TARGETS = r"(query|key|value|attention_out|wi|wi_0|wi_1|wo)/kernel$"
 BART_QUANT_TARGETS = r"(query|key|value|attention_out|fc1|fc2)/kernel$"
+LLAMA_QUANT_TARGETS = (
+    r"(q_proj|k_proj|v_proj|o_proj|gate_proj|up_proj|down_proj)/kernel$")
 
 
 class Int8Dense(nn.Module):
@@ -124,7 +126,7 @@ def quantize_for_generation(model, params) -> tuple[Any, Any, dict]:
     generation. The returned model is the same architecture with
     ``weight_quant='int8'`` (the family's ``_dense`` helper swaps in
     :class:`Int8Dense`); KV cache, decode schedules and sampling are
-    untouched. Covers GPT-2, T5 and BART/mBART."""
+    untouched. Covers GPT-2, Llama, T5 and BART/mBART."""
     import dataclasses
 
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.bart import (
@@ -133,17 +135,21 @@ def quantize_for_generation(model, params) -> tuple[Any, Any, dict]:
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
         Gpt2Config,
     )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaConfig,
+    )
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
         T5Config,
     )
 
     cfg = model.config
     targets = {Gpt2Config: GPT2_QUANT_TARGETS, T5Config: T5_QUANT_TARGETS,
-               BartConfig: BART_QUANT_TARGETS}.get(type(cfg))
+               BartConfig: BART_QUANT_TARGETS,
+               LlamaConfig: LLAMA_QUANT_TARGETS}.get(type(cfg))
     if targets is None:
         raise ValueError(
             "int8 weight-only quantization covers the generating "
-            "families (GPT-2, T5, BART/mBART); got "
+            "families (GPT-2, Llama, T5, BART/mBART); got "
             f"{type(cfg).__name__}")
     qcfg = dataclasses.replace(cfg, weight_quant="int8")
     qmodel = type(model)(qcfg)

@@ -73,8 +73,8 @@ _PARAM_RULES: Sequence[tuple[str, tuple]] = (
     (r"(query|key|value|q_proj|k_proj|v_proj|qkv).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
     (r"(attention_out|out_proj|o_proj|attn_out).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),
     # FFN
-    (r"(intermediate|wi|fc1|ffn_in|lin1).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
-    (r"(ffn_out|wo|fc2|lin2).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),
+    (r"(intermediate|wi|fc1|ffn_in|lin1|gate_proj|up_proj).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
+    (r"(ffn_out|wo|fc2|lin2|down_proj).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),
     # embeddings: (vocab, hidden)
     (r"embedding$", (AXIS_FSDP, None)),
     # classifier / pooler / lm heads: shard the big dim over fsdp

@@ -1,0 +1,254 @@
+"""Llama-family decoder (models/llama.py): HF torch parity (RoPE, GQA,
+SwiGLU, RMSNorm), cached decode, export roundtrip, training, and
+composition with the framework machinery (fused CE, LoRA, int8)."""
+
+import numpy as np
+import pytest
+import torch
+import transformers
+import jax
+import jax.numpy as jnp
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+    generate_causal,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+
+TOL = 3e-4
+
+
+@pytest.fixture(scope="module", params=["gqa", "mha"])
+def llama_dir(request, tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2 if request.param == "gqa" else 4,
+        intermediate_size=64, max_position_embeddings=64,
+        rms_norm_eps=1e-5, bos_token_id=1, eos_token_id=2, pad_token_id=0,
+        tie_word_embeddings=False, attention_dropout=0.0)
+    d = str(tmp_path_factory.mktemp(f"llama_{request.param}"))
+    transformers.LlamaForCausalLM(cfg).eval().save_pretrained(d)
+    return d
+
+
+def _inputs(batch=3, seq=10, vocab=128, seed=0):
+    r = np.random.RandomState(seed)
+    ids = r.randint(3, vocab, (batch, seq))
+    mask = np.ones((batch, seq), np.int64)
+    return ids, mask
+
+
+def test_llama_lm_parity(llama_dir):
+    model, params, family, cfg = auto_models.from_pretrained(
+        llama_dir, task="causal-lm")
+    assert family == "llama"
+    m = transformers.LlamaForCausalLM.from_pretrained(llama_dir).eval()
+    ids, mask = _inputs()
+    with torch.no_grad():
+        t_out = m(input_ids=torch.tensor(ids),
+                  attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids),
+                        jnp.asarray(mask), deterministic=True)
+    np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                               atol=TOL, rtol=1e-3)
+
+
+@pytest.mark.slow
+def test_llama_incremental_decode_matches_full(llama_dir):
+    model, params, _, _ = auto_models.from_pretrained(llama_dir,
+                                                      task="causal-lm")
+    rng = np.random.RandomState(2)
+    ids = rng.randint(3, 128, (2, 6))
+    new = 5
+    got = np.asarray(generate_causal(model, params, ids,
+                                     max_new_tokens=new))
+    cur = ids.copy()
+    for _ in range(new):
+        logits = model.apply({"params": params}, jnp.asarray(cur),
+                             deterministic=True)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1))
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    want = cur[:, ids.shape[1]:]
+    for b in range(ids.shape[0]):
+        row = want[b]
+        eos = np.where(row == 2)[0]
+        upto = (eos[0] + 1) if len(eos) else new
+        np.testing.assert_array_equal(got[b, :upto], row[:upto])
+
+
+@pytest.mark.slow
+def test_llama_export_roundtrip(llama_dir, tmp_path):
+    model, params, family, cfg = auto_models.from_pretrained(
+        llama_dir, task="causal-lm")
+    out = str(tmp_path / "export")
+    auto_models.save_pretrained(out, params, family, cfg)
+    m1 = transformers.LlamaForCausalLM.from_pretrained(llama_dir).eval()
+    m2 = transformers.LlamaForCausalLM.from_pretrained(out).eval()
+    ids, _ = _inputs()
+    with torch.no_grad():
+        a = m1(input_ids=torch.tensor(ids)).logits.numpy()
+        b = m2(input_ids=torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(b, a, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_llama_trains_causal_lm(devices8):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+    mesh = build_mesh(MeshConfig(dp=-1), devices=devices8)
+    cfg = LlamaConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_position_embeddings=32)
+    model = LlamaForCausalLM(cfg)
+    params = init_params(model, cfg, seed=0)
+    tcfg = TrainConfig(task="causal-lm", dtype="float32", learning_rate=3e-3,
+                       scale_lr_by_world_size=False, log_every_steps=0,
+                       rng_impl="threefry", epochs=2)
+    trainer = Trainer(tcfg, model, params, mesh)
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(64, seed=0)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=32)
+    hist = trainer.fit(ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0))
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+@pytest.mark.slow
+def test_llama_fused_ce_matches_unfused(devices8):
+    """hidden_and_embedding drives the fused vocab-CE (untied lm_head):
+    fused and unfused first-step training losses must match."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        make_fused_causal_lm_loss,
+    )
+
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(16, seed=2)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=16)
+
+    def first_loss(fused):
+        mesh = build_mesh(MeshConfig(dp=-1), devices=devices8)
+        cfg = LlamaConfig(vocab_size=256, hidden_size=128, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=256,
+                          max_position_embeddings=16)
+        model = LlamaForCausalLM(cfg)
+        params = init_params(model, cfg, seed=0)
+        tcfg = TrainConfig(task="causal-lm", dtype="float32",
+                           learning_rate=1e-3, scale_lr_by_world_size=False,
+                           log_every_steps=0, rng_impl="threefry",
+                           fused_vocab_ce=fused)
+        trainer = Trainer(tcfg, model, params, mesh)
+        if fused:
+            trainer.loss_fn = make_fused_causal_lm_loss(model,
+                                                        interpret=True)
+        batch = next(ShardedBatcher(ds, 16, mesh, shuffle=False,
+                                    seed=0).global_arrays(0))
+        _, m = trainer._train_step(trainer.state, batch)
+        return float(jax.device_get(m["loss"]))
+
+    np.testing.assert_allclose(first_loss(True), first_loss(False),
+                               rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_llama_int8_and_lora_compose(llama_dir):
+    """int8 weight-only decode quantizes exactly the seven projections
+    per layer; LoRA's attention preset matches the q/k/v/o kernels."""
+    from flax.traverse_util import flatten_dict
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.lora import (
+        init_lora_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
+        quantize_for_generation,
+    )
+
+    model, params, _, _ = auto_models.from_pretrained(llama_dir,
+                                                      task="causal-lm")
+    qmodel, qparams, stats = quantize_for_generation(model, params)
+    assert stats["kernels_quantized"] == 3 * 7     # 3 layers x 7 projs
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(3, 128, (2, 12)))
+    fp = np.asarray(model.apply({"params": params}, ids,
+                                deterministic=True), np.float64)
+    q8 = np.asarray(qmodel.apply({"params": qparams}, ids,
+                                 deterministic=True), np.float64)
+    assert np.corrcoef(fp.ravel(), q8.ravel())[0, 1] > 0.999
+    out = np.asarray(generate_causal(qmodel, qparams, ids[:, :6],
+                                     max_new_tokens=4))
+    assert out.shape == (2, 4)
+
+    lora = init_lora_params(params, rank=4, targets="attention")
+    paths = {"/".join(p[:-1]) for p in flatten_dict(lora)}
+    assert len(paths) == 3 * 4                     # q/k/v/o per layer
+    assert all(p.endswith(("q_proj/kernel", "k_proj/kernel",
+                           "v_proj/kernel", "o_proj/kernel"))
+               for p in paths)
+
+
+@pytest.mark.slow
+def test_llama_generate_left_padded(llama_dir):
+    """A left-padded prompt generates the same continuation as the same
+    prompt without padding (generate_causal supplies mask-derived
+    positions; pads fully masked from the cache)."""
+    model, params, _, _ = auto_models.from_pretrained(llama_dir,
+                                                      task="causal-lm")
+    prompt = np.asarray([[5, 9, 17, 33]])
+    padded = np.asarray([[0, 0, 5, 9, 17, 33]])
+    pmask = np.asarray([[0, 0, 1, 1, 1, 1]])
+    a = np.asarray(generate_causal(model, params, prompt, max_new_tokens=4))
+    b = np.asarray(generate_causal(model, params, padded, pmask,
+                                   max_new_tokens=4))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_llama_rejects_unsupported_layouts():
+    """rope_scaling (3.1+ frequency scaling) and biased projections must
+    raise at load instead of silently diverging from HF."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        llama_config_from_hf,
+    )
+
+    base = dict(vocab_size=128, hidden_size=32, num_hidden_layers=1,
+                num_attention_heads=4, intermediate_size=64)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        llama_config_from_hf({**base, "rope_scaling":
+                              {"rope_type": "llama3", "factor": 8.0}})
+    # trivial/default scaling passes
+    llama_config_from_hf({**base, "rope_scaling": None})
+    llama_config_from_hf({**base,
+                          "rope_scaling": {"rope_type": "default"}})
+    with pytest.raises(ValueError, match="attention_bias"):
+        llama_config_from_hf({**base, "attention_bias": True})
