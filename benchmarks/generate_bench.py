@@ -3,7 +3,7 @@
 The reference's model objects carry ``generate`` via HF ``transformers``
 (SURVEY.md D7; the reference itself only fine-tunes,
 reference ``scripts/train.py:145``) — round 2 proved our decode paths
-token-exact against HF; this mode measures them. Three lines:
+token-exact against HF; this mode measures them, one line each:
 
 - ``gpt2_greedy``      GPT-2 (124M shape) prefill + jitted-scan greedy
                        continuation — the decoder-only path.
@@ -11,6 +11,10 @@ token-exact against HF; this mode measures them. Three lines:
                        (models/quant.py) — the HBM-bandwidth story:
                        decode re-reads all weights per token, so 1/4
                        the kernel bytes should show up as tokens/s.
+- ``llama_greedy``     TinyLlama-1.1B shape (22L/2048H/32q/4kv heads,
+                       GQA) prefill + cached greedy — the modern
+                       decoder family at a real size (2.2 GB bf16).
+- ``llama_greedy_int8`` same, int8 dense kernels.
 - ``bart_greedy``      BART-base encoder once + cached greedy decode —
                        the encoder-decoder path.
 - ``bart_beam4``       same, beam search at 4 beams (beams flattened
@@ -67,6 +71,10 @@ def bench_generate() -> None:
         Gpt2Config,
         Gpt2LMHeadModel,
     )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
 
     on_tpu = _on_tpu()
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
@@ -76,11 +84,19 @@ def bench_generate() -> None:
         batch, prompt_len, new_tokens = 16, 128, 128
         gpt2_cfg = Gpt2Config(dtype=dtype)                  # 124M
         bart_cfg = BartConfig(dtype=dtype)                  # base, 139M
+        llama_cfg = LlamaConfig(                            # TinyLlama-1.1B
+            vocab_size=32000, hidden_size=2048, num_layers=22,
+            num_heads=32, num_kv_heads=4, intermediate_size=5632,
+            max_position_embeddings=2048, dtype=dtype)
     else:
         batch, prompt_len, new_tokens = 4, 16, 16
         gpt2_cfg = Gpt2Config(vocab_size=512, hidden_size=64, num_layers=2,
                               num_heads=4, intermediate_size=128,
                               max_position_embeddings=256, dtype=dtype)
+        llama_cfg = LlamaConfig(vocab_size=512, hidden_size=64,
+                                num_layers=2, num_heads=4, num_kv_heads=2,
+                                intermediate_size=128,
+                                max_position_embeddings=256, dtype=dtype)
         bart_cfg = BartConfig(vocab_size=512, d_model=64, encoder_layers=2,
                               decoder_layers=2, encoder_attention_heads=4,
                               decoder_attention_heads=4, encoder_ffn_dim=128,
@@ -99,11 +115,25 @@ def bench_generate() -> None:
         new_tokens, batch)
 
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
-        quantize_gpt2,
+        quantize_for_generation,
     )
-    q_gpt2, q_params, _ = quantize_gpt2(gpt2, gpt2_params)
+    q_gpt2, q_params, _ = quantize_for_generation(gpt2, gpt2_params)
     results["gpt2_greedy_int8"] = _bench_one(
         lambda: generate_causal(q_gpt2, q_params, prompt,
+                                max_new_tokens=new_tokens),
+        new_tokens, batch)
+
+    llama = LlamaForCausalLM(llama_cfg)
+    llama_params = init_params(llama, llama_cfg, seed=0)
+    l_prompt = jnp.asarray(
+        rng.randint(3, llama_cfg.vocab_size, (batch, prompt_len)), jnp.int32)
+    results["llama_greedy"] = _bench_one(
+        lambda: generate_causal(llama, llama_params, l_prompt,
+                                max_new_tokens=new_tokens),
+        new_tokens, batch)
+    q_llama, ql_params, _ = quantize_for_generation(llama, llama_params)
+    results["llama_greedy_int8"] = _bench_one(
+        lambda: generate_causal(q_llama, ql_params, l_prompt,
                                 max_new_tokens=new_tokens),
         new_tokens, batch)
 

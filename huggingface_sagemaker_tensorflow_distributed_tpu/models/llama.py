@@ -142,13 +142,22 @@ class LlamaRMSNorm(nn.Module):
         return (x32.astype(cfg.dtype) * scale.astype(cfg.dtype))
 
 
-def apply_rope(x, position_ids, theta: float):
-    """HF rotate-half RoPE on [B, H, S, D] with [B, S] positions."""
-    d = x.shape[-1]
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = position_ids.astype(jnp.float32)[:, :, None] * inv_freq  # [B,S,D/2]
-    cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)[:, None]    # [B,1,S,D]
+def rope_tables(position_ids, head_dim: int, theta: float):
+    """(cos, sin) [B, 1, S, D] in HF's duplicated-half layout — computed
+    ONCE per forward (they depend only on positions) and threaded to
+    every layer, as HF's rotary module does."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    angles = position_ids.astype(jnp.float32)[:, :, None] * inv_freq
+    cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)[:, None]
     sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)[:, None]
+    return cos, sin
+
+
+def apply_rope(x, rope):
+    """HF rotate-half RoPE on [B, H, S, D] given precomputed tables."""
+    cos, sin = rope
+    d = x.shape[-1]
     x1, x2 = x[..., : d // 2], x[..., d // 2:]
     rotated = jnp.concatenate([-x2, x1], axis=-1)
     return (x.astype(jnp.float32) * cos
@@ -162,7 +171,7 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, attn_mask=None, position_ids=None,
+    def __call__(self, hidden, attn_mask=None, rope=None,
                  deterministic: bool = True, decode: bool = False):
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_heads
@@ -178,8 +187,8 @@ class LlamaAttention(nn.Module):
         v = split(_dense(cfg, cfg.num_kv_heads * head_dim, "v_proj")(hidden),
                   cfg.num_kv_heads)
 
-        q = apply_rope(q, position_ids, cfg.rope_theta)
-        k = apply_rope(k, position_ids, cfg.rope_theta)
+        q = apply_rope(q, rope)
+        k = apply_rope(k, rope)
 
         causal = True
         if decode:
@@ -233,12 +242,12 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, hidden, attn_mask=None, position_ids=None,
+    def __call__(self, hidden, attn_mask=None, rope=None,
                  deterministic: bool = True, decode: bool = False):
         cfg = self.config
         attn = LlamaAttention(cfg, name="self_attn")(
             LlamaRMSNorm(cfg, name="input_ln")(hidden), attn_mask,
-            position_ids, deterministic, decode)
+            rope, deterministic, decode)
         hidden = hidden + attn
         mlp = LlamaMlp(cfg, name="mlp")(
             LlamaRMSNorm(cfg, name="post_attn_ln")(hidden))
@@ -277,6 +286,8 @@ class LlamaModel(nn.Module):
 
         additive_mask = (make_attention_mask(attention_mask)
                         if attention_mask is not None else None)
+        rope = rope_tables(position_ids, cfg.hidden_size // cfg.num_heads,
+                           cfg.rope_theta)
 
         x = embed(input_ids)
         block_cls = LlamaBlock
@@ -285,7 +296,7 @@ class LlamaModel(nn.Module):
                                  policy=remat_policy(cfg.remat_policy))
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"layers_{i}")(
-                x, additive_mask, position_ids, deterministic, decode)
+                x, additive_mask, rope, deterministic, decode)
         x = LlamaRMSNorm(cfg, name="final_ln")(x)
         return x, embed.embedding
 

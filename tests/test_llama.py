@@ -252,3 +252,46 @@ def test_llama_rejects_unsupported_layouts():
                           "rope_scaling": {"rope_type": "default"}})
     with pytest.raises(ValueError, match="attention_bias"):
         llama_config_from_hf({**base, "attention_bias": True})
+
+
+@pytest.mark.slow
+def test_llama_trains_on_tp_mesh(devices8):
+    """dp2 x tp2 x fsdp2 reproduces the plain-dp loss sequence: the
+    Megatron rules cover the *_proj kernels (q/k/v/gate/up column-,
+    o/down row-parallel) and GQA survives head sharding at kv_heads=2
+    over tp=2."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+    def losses(mesh_cfg):
+        mesh = build_mesh(mesh_cfg, devices=devices8)
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=128,
+                          max_position_embeddings=32)
+        model = LlamaForCausalLM(cfg)
+        params = init_params(model, cfg, seed=0)
+        tcfg = TrainConfig(task="causal-lm", dtype="float32",
+                           learning_rate=1e-3, scale_lr_by_world_size=False,
+                           log_every_steps=0, rng_impl="threefry", epochs=2)
+        trainer = Trainer(tcfg, model, params, mesh)
+        tok = WordHashTokenizer(vocab_size=256)
+        texts, _ = synthetic_text_classification(32, seed=0)
+        ds = ArrayDataset.from_lm_texts(tok, texts, max_length=32)
+        return trainer.fit(ShardedBatcher(ds, 8, mesh, shuffle=False,
+                                          seed=0))["loss"]
+
+    np.testing.assert_allclose(losses(MeshConfig(dp=2, tp=2, fsdp=2)),
+                               losses(MeshConfig(dp=-1)), rtol=2e-5)
