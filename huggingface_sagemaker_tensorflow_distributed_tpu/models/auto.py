@@ -231,7 +231,10 @@ _HF_CONFIG_EXPORTERS = {
         "initializer_range": c.initializer_range,
     },
     "llama": lambda c: {
-        "model_type": "llama", "architectures": ["LlamaForCausalLM"],
+        "model_type": c.model_type,
+        "architectures": [{"llama": "LlamaForCausalLM",
+                           "mistral": "MistralForCausalLM",
+                           "qwen2": "Qwen2ForCausalLM"}[c.model_type]],
         "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
         "num_hidden_layers": c.num_layers,
         "num_attention_heads": c.num_heads,
@@ -244,6 +247,12 @@ _HF_CONFIG_EXPORTERS = {
         "bos_token_id": c.bos_token_id, "eos_token_id": c.eos_token_id,
         "pad_token_id": c.pad_token_id,
         "initializer_range": c.initializer_range,
+        **({"sliding_window": c.sliding_window} if c.model_type == "mistral"
+           else {}),
+        **({"sliding_window": c.sliding_window or 4096,
+            "use_sliding_window": c.sliding_window is not None,
+            "max_window_layers": c.sliding_window_start_layer}
+           if c.model_type == "qwen2" else {}),
     },
     "bart": _bart_hf_config,
     "mbart": lambda c: {**_bart_hf_config(c), "model_type": "mbart",
@@ -280,6 +289,11 @@ _MOE_CONFIG_KEYS = ("num_experts", "expert_top_k", "moe_every",
 _FAMILY_ALIASES = {
     "xlm-roberta": "roberta",   # XLM-R == RoBERTa with a bigger vocab
     "camembert": "roberta",
+    # same state-dict layout as Llama; the config builder reads the
+    # variant knobs (sliding_window, Qwen2's hardcoded qkv biases) off
+    # the original model_type
+    "mistral": "llama",
+    "qwen2": "llama",
 }
 
 

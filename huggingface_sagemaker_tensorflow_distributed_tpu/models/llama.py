@@ -1,10 +1,13 @@
-"""Llama-family decoder: RoPE + GQA + SwiGLU + RMSNorm.
+"""Llama-family decoder: RoPE + GQA + SwiGLU + RMSNorm
+(+ the Mistral and Qwen2 variants of the same layout).
 
 Beyond-parity model family: the reference fine-tunes the BERT-era HF
 zoo (reference ``scripts/train.py:117``); this adds the modern
-decoder-only lineage (Llama/Llama-2/3 layout, which TinyLlama, Mistral
--without-sliding-window, Qwen-sans-bias and friends share) with HF
-``LlamaForCausalLM`` checkpoint parity — and it composes with the
+decoder-only lineage — the Llama/Llama-2/3 layout, Mistral (sliding
+-window attention, banded mask from logical positions so padded
+prompts window correctly), and Qwen2 (hardcoded q/k/v biases,
+per-layer window policy via ``max_window_layers``) — with HF
+checkpoint parity — and it composes with the
 framework's existing machinery for free: the causal-lm task loss,
 ``generate_causal`` (prefill + KV cache), LoRA (bias-free ``*_proj``
 kernels), int8 weight-only decode, fused vocab-CE
@@ -71,6 +74,18 @@ class LlamaConfig:
     remat_policy: str = "full"             # full | dots | dots_no_batch
     # int8 weight-only dense kernels for generation (models/quant.py)
     weight_quant: str = "none"             # none | int8
+    # Mistral: attend only to the last N key positions (None = full
+    # causal). The banded mask rides the additive-mask path (XLA
+    # attention; flash covers pure-causal only).
+    sliding_window: Optional[int] = None
+    # first layer the window applies to (HF Qwen2 ``max_window_layers``
+    # semantics: layers below it use full attention; 0 = window all)
+    sliding_window_start_layer: int = 0
+    # Qwen2: biases on q/k/v projections only (o/mlp stay bias-free)
+    qkv_bias: bool = False
+    # which HF model_type this config round-trips as (llama | mistral |
+    # qwen2 — same state-dict layout, different config.json)
+    model_type: str = "llama"
 
 
 def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
@@ -83,13 +98,33 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
             "rope_scaling (Llama-3.1+ long-context frequency scaling) is "
             f"not implemented: {scaling!r}; loading would silently use "
             "unscaled RoPE frequencies and diverge from HF")
+    mt = hf_config.get("model_type", "llama")
+    window_start = 0
+    if mt == "qwen2":
+        # Qwen2's modeling class hardcodes q/k/v biases (not a config
+        # field); the o/mlp projections stay bias-free. Its window is
+        # PER-LAYER: layers >= max_window_layers slide, earlier ones use
+        # full attention (HF layer_types derivation).
+        qkv_bias = True
+        if hf_config.get("use_sliding_window"):
+            window = hf_config.get("sliding_window")
+            window_start = hf_config.get("max_window_layers", 28)
+        else:
+            window = None
+    else:
+        qkv_bias = False
+        window = (hf_config.get("sliding_window")
+                  if mt == "mistral" else None)
     if hf_config.get("attention_bias") or hf_config.get("mlp_bias"):
         raise ValueError(
-            "attention_bias/mlp_bias=true (Qwen-style biased projections "
-            "under model_type 'llama') is not supported: the modules are "
-            "bias-free and the checkpoint's biases would be silently "
-            "dropped")
+            "attention_bias/mlp_bias=true (biased projections under "
+            f"model_type {mt!r}) is not supported: the modules are "
+            "bias-free (Qwen2's hardcoded q/k/v biases ARE supported "
+            "via model_type 'qwen2') and the checkpoint's biases would "
+            "be silently dropped")
     kw = dict(
+        model_type=mt, sliding_window=window, qkv_bias=qkv_bias,
+        sliding_window_start_layer=window_start,
         vocab_size=hf_config["vocab_size"],
         hidden_size=hf_config["hidden_size"],
         num_layers=hf_config["num_hidden_layers"],
@@ -115,14 +150,15 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def _dense(cfg: LlamaConfig, features: int, name: str) -> nn.Module:
+def _dense(cfg: LlamaConfig, features: int, name: str,
+           use_bias: bool = False) -> nn.Module:
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.quant import (
         make_dense,
     )
 
     return make_dense(cfg, features,
                       nn.initializers.normal(cfg.initializer_range),
-                      use_bias=False, name=name)
+                      use_bias=use_bias, name=name)
 
 
 class LlamaRMSNorm(nn.Module):
@@ -166,13 +202,16 @@ def apply_rope(x, rope):
 
 class LlamaAttention(nn.Module):
     """GQA self-attention with RoPE and an optional incremental KV cache
-    (cached pre-repeat: [B, H_kv, max_len, D])."""
+    (cached pre-repeat: [B, H_kv, max_len, D]). ``use_window`` applies
+    the config's sliding window to THIS layer (per-layer policy)."""
 
     config: LlamaConfig
+    use_window: bool = False
 
     @nn.compact
     def __call__(self, hidden, attn_mask=None, rope=None,
-                 deterministic: bool = True, decode: bool = False):
+                 position_ids=None, deterministic: bool = True,
+                 decode: bool = False):
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_heads
         B, S, _ = hidden.shape
@@ -180,12 +219,13 @@ class LlamaAttention(nn.Module):
         def split(x, n_heads):
             return x.reshape(B, S, n_heads, head_dim).transpose(0, 2, 1, 3)
 
-        q = split(_dense(cfg, cfg.num_heads * head_dim, "q_proj")(hidden),
-                  cfg.num_heads)
-        k = split(_dense(cfg, cfg.num_kv_heads * head_dim, "k_proj")(hidden),
-                  cfg.num_kv_heads)
-        v = split(_dense(cfg, cfg.num_kv_heads * head_dim, "v_proj")(hidden),
-                  cfg.num_kv_heads)
+        qb = cfg.qkv_bias
+        q = split(_dense(cfg, cfg.num_heads * head_dim, "q_proj",
+                         use_bias=qb)(hidden), cfg.num_heads)
+        k = split(_dense(cfg, cfg.num_kv_heads * head_dim, "k_proj",
+                         use_bias=qb)(hidden), cfg.num_kv_heads)
+        v = split(_dense(cfg, cfg.num_kv_heads * head_dim, "v_proj",
+                         use_bias=qb)(hidden), cfg.num_kv_heads)
 
         q = apply_rope(q, rope)
         k = apply_rope(k, rope)
@@ -207,9 +247,27 @@ class LlamaAttention(nn.Module):
                 v = lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
                 cached_k.value, cached_v.value = k, v
                 cache_index.value = cur + q_len
-                valid = jnp.arange(max_len)[None, :] <= (
-                    cur + jnp.arange(q_len)[:, None])
+                key_pos = jnp.arange(max_len)[None, :]
+                qry_pos = cur + jnp.arange(q_len)[:, None]
+                valid = key_pos <= qry_pos
                 step_mask = jnp.where(valid, 0.0, NEG_INF)[None, None]
+                if cfg.sliding_window is not None and self.use_window:
+                    # window in LOGICAL coordinates: buffer slots are not
+                    # positions when the prompt is padded. Each valid
+                    # slot's logical position is its rank among valid
+                    # slots (the caller's buffer-validity mask), queries
+                    # carry theirs in position_ids.
+                    if attn_mask is not None:
+                        valid_k = (attn_mask[:, 0, 0, :] > NEG_INF / 2)
+                        key_logical = jnp.cumsum(
+                            valid_k.astype(jnp.int32), axis=-1) - 1
+                    else:
+                        key_logical = jnp.broadcast_to(
+                            jnp.arange(max_len), (B, max_len))
+                    in_win = (key_logical[:, None, None, :]
+                              > position_ids[:, None, :, None]
+                              - cfg.sliding_window)
+                    step_mask = step_mask + jnp.where(in_win, 0.0, NEG_INF)
                 attn_mask = (step_mask if attn_mask is None
                              else attn_mask + step_mask)
                 causal = False                 # the step mask IS causality
@@ -240,14 +298,19 @@ class LlamaMlp(nn.Module):
 
 class LlamaBlock(nn.Module):
     config: LlamaConfig
+    use_window: bool = False
 
     @nn.compact
-    def __call__(self, hidden, attn_mask=None, rope=None,
+    def __call__(self, hidden, masks=None, rope=None, position_ids=None,
                  deterministic: bool = True, decode: bool = False):
         cfg = self.config
-        attn = LlamaAttention(cfg, name="self_attn")(
+        plain, banded = masks if isinstance(masks, tuple) else (masks, None)
+        attn_mask = banded if (self.use_window and banded is not None) \
+            else plain
+        attn = LlamaAttention(cfg, use_window=self.use_window,
+                              name="self_attn")(
             LlamaRMSNorm(cfg, name="input_ln")(hidden), attn_mask,
-            rope, deterministic, decode)
+            rope, position_ids, deterministic, decode)
         hidden = hidden + attn
         mlp = LlamaMlp(cfg, name="mlp")(
             LlamaRMSNorm(cfg, name="post_attn_ln")(hidden))
@@ -286,17 +349,36 @@ class LlamaModel(nn.Module):
 
         additive_mask = (make_attention_mask(attention_mask)
                         if attention_mask is not None else None)
+        banded_mask = None
+        if cfg.sliding_window is not None and not decode:
+            # Mistral banding, built ONCE from absolute positions: key
+            # allowed iff 0 <= pos_q - pos_k < window. The general
+            # [B,1,S,S] mask routes attention onto the XLA path (flash
+            # covers pure-causal only); the decode path windows its
+            # cache mask inside LlamaAttention (logical coordinates).
+            # Windowed layers (i >= sliding_window_start_layer, the HF
+            # Qwen2 max_window_layers policy) get the banded mask;
+            # earlier layers keep full causal attention.
+            pq = position_ids[:, None, :, None]
+            pk = position_ids[:, None, None, :]
+            band = (pq - pk < cfg.sliding_window) & (pq >= pk)
+            band_mask = jnp.where(band, 0.0, NEG_INF)
+            banded_mask = (band_mask if additive_mask is None
+                           else additive_mask + band_mask)
         rope = rope_tables(position_ids, cfg.hidden_size // cfg.num_heads,
                            cfg.rope_theta)
 
         x = embed(input_ids)
         block_cls = LlamaBlock
         if cfg.remat:
-            block_cls = nn.remat(LlamaBlock, static_argnums=(4, 5),
+            block_cls = nn.remat(LlamaBlock, static_argnums=(5, 6),
                                  policy=remat_policy(cfg.remat_policy))
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"layers_{i}")(
-                x, additive_mask, rope, deterministic, decode)
+            windowed = (cfg.sliding_window is not None
+                        and i >= cfg.sliding_window_start_layer)
+            x = block_cls(cfg, use_window=windowed, name=f"layers_{i}")(
+                x, (additive_mask, banded_mask), rope, position_ids,
+                deterministic, decode)
         x = LlamaRMSNorm(cfg, name="final_ln")(x)
         return x, embed.embedding
 
