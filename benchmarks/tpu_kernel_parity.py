@@ -82,12 +82,15 @@ def flash_parity() -> None:
     mn[: B // 2, ..., -64:] = -1e9
     q, k, v, mask = (jnp.asarray(a, jnp.float32) for a in (qn, kn, vn, mn))
 
-    def ref64(causal):
+    def ref64(causal, window=None):
         """fp64 forward + analytic grads of sum(out^2) — the anchor."""
         s = np.einsum("bhqd,bhkd->bhqk", qn, kn) * scale + mn
         if causal:
             pos = np.arange(S)
-            s = s + np.where(pos[None, :] <= pos[:, None], 0.0, -1e30)
+            keep = pos[None, :] <= pos[:, None]
+            if window is not None:
+                keep &= pos[None, :] > pos[:, None] - window
+            s = s + np.where(keep, 0.0, -1e30)
         p = np.exp(s - s.max(-1, keepdims=True))
         p /= p.sum(-1, keepdims=True)
         out = np.einsum("bhqk,bhkd->bhqd", p, vn)
@@ -99,20 +102,32 @@ def flash_parity() -> None:
         dk_ = scale * np.einsum("bhqk,bhqd->bhkd", ds, qn)
         return out, dq_, dk_, dv_
 
-    for causal in (False, True):
-        tag = "causal" if causal else "full"
-        r_out, r_dq, r_dk, r_dv = ref64(causal)
-        full_mask = mask + make_causal_mask(S, S) if causal else mask
+    # (causal, window): full, causal, and the Mistral band — the banded
+    # kernels (tile-skip below the band) have their own Mosaic surface
+    for causal, window in ((False, None), (True, None), (True, 128)):
+        tag = ("windowed" if window else "causal") if causal else "full"
+        r_out, r_dq, r_dk, r_dv = ref64(causal, window)
+        full_mask = mask
+        if causal:
+            if window:
+                pos = jnp.arange(S)
+                keep = ((pos[None, :] <= pos[:, None])
+                        & (pos[None, :] > pos[:, None] - window))
+                full_mask = mask + jnp.where(keep, 0.0,
+                                             -1e9)[None, None]
+            else:
+                full_mask = mask + make_causal_mask(S, S)
 
         out_f = jax.jit(lambda q, k, v: flash_attention(
-            q, k, v, mask=mask, causal=causal))(q, k, v)
+            q, k, v, mask=mask, causal=causal, window=window))(q, k, v)
         out_x = jax.jit(lambda q, k, v: xla_attention(
             q, k, v, mask=full_mask))(q, k, v)
         check_anchored(f"flash fwd ({tag})", out_f, out_x, r_out)
 
         def loss_f(q, k, v):
             return jnp.sum(flash_attention(q, k, v, mask=mask,
-                                           causal=causal) ** 2)
+                                           causal=causal,
+                                           window=window) ** 2)
 
         def loss_x(q, k, v):
             return jnp.sum(xla_attention(q, k, v, mask=full_mask) ** 2)

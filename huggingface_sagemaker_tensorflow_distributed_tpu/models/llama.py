@@ -75,8 +75,10 @@ class LlamaConfig:
     # int8 weight-only dense kernels for generation (models/quant.py)
     weight_quant: str = "none"             # none | int8
     # Mistral: attend only to the last N key positions (None = full
-    # causal). The banded mask rides the additive-mask path (XLA
-    # attention; flash covers pure-causal only).
+    # causal). On the default-positions training path the window runs
+    # through the attention kernel (banded flash with tile-skipping on
+    # TPU); custom position_ids and ring attention use a general
+    # [B,1,S,S] banded mask instead.
     sliding_window: Optional[int] = None
     # first layer the window applies to (HF Qwen2 ``max_window_layers``
     # semantics: layers below it use full attention; 0 = window all)
@@ -207,6 +209,10 @@ class LlamaAttention(nn.Module):
 
     config: LlamaConfig
     use_window: bool = False
+    # window via the attention kernel (banded flash tile-skipping) vs a
+    # general additive mask: kernel banding indexes ROWS, which equals
+    # logical positions only for default (arange) position_ids
+    kernel_window: bool = False
 
     @nn.compact
     def __call__(self, hidden, attn_mask=None, rope=None,
@@ -277,8 +283,12 @@ class LlamaAttention(nn.Module):
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
 
+        window = (cfg.sliding_window
+                  if (self.use_window and self.kernel_window and not decode)
+                  else None)
         ctx = dot_product_attention(q, k, v, mask=attn_mask,
-                                    impl=cfg.attention_impl, causal=causal)
+                                    impl=cfg.attention_impl, causal=causal,
+                                    window=window)
         b, h, s, d = ctx.shape
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         return _dense(cfg, cfg.hidden_size, "o_proj")(ctx)
@@ -299,6 +309,7 @@ class LlamaMlp(nn.Module):
 class LlamaBlock(nn.Module):
     config: LlamaConfig
     use_window: bool = False
+    kernel_window: bool = False
 
     @nn.compact
     def __call__(self, hidden, masks=None, rope=None, position_ids=None,
@@ -308,6 +319,7 @@ class LlamaBlock(nn.Module):
         attn_mask = banded if (self.use_window and banded is not None) \
             else plain
         attn = LlamaAttention(cfg, use_window=self.use_window,
+                              kernel_window=self.kernel_window,
                               name="self_attn")(
             LlamaRMSNorm(cfg, name="input_ln")(hidden), attn_mask,
             rope, position_ids, deterministic, decode)
@@ -328,6 +340,7 @@ class LlamaModel(nn.Module):
                  deterministic: bool = True, decode: bool = False):
         cfg = self.config
         B, S = input_ids.shape
+        default_positions = position_ids is None
 
         embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size,
@@ -350,7 +363,13 @@ class LlamaModel(nn.Module):
         additive_mask = (make_attention_mask(attention_mask)
                         if attention_mask is not None else None)
         banded_mask = None
-        if cfg.sliding_window is not None and not decode:
+        # ring shards the seq axis and has no banded schedule — it gets
+        # the general banded mask (detected → XLA fallback) instead
+        kernel_window = (cfg.sliding_window is not None and not decode
+                         and default_positions
+                         and cfg.attention_impl != "ring")
+        if (cfg.sliding_window is not None and not decode
+                and not kernel_window):
             # Mistral banding, built ONCE from absolute positions: key
             # allowed iff 0 <= pos_q - pos_k < window. The general
             # [B,1,S,S] mask routes attention onto the XLA path (flash
@@ -376,7 +395,9 @@ class LlamaModel(nn.Module):
         for i in range(cfg.num_layers):
             windowed = (cfg.sliding_window is not None
                         and i >= cfg.sliding_window_start_layer)
-            x = block_cls(cfg, use_window=windowed, name=f"layers_{i}")(
+            x = block_cls(cfg, use_window=windowed,
+                          kernel_window=kernel_window,
+                          name=f"layers_{i}")(
                 x, (additive_mask, banded_mask), rope, position_ids,
                 deterministic, decode)
         x = LlamaRMSNorm(cfg, name="final_ln")(x)

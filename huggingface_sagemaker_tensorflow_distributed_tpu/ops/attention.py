@@ -41,23 +41,40 @@ def xla_attention(q, k, v, mask=None, scale=None):
 
 
 def dot_product_attention(q, k, v, mask=None, scale=None, impl: str = "xla",
-                          causal: bool = False):
+                          causal: bool = False, window: int | None = None):
     """Dispatch on implementation tier. ``impl='flash'`` requires TPU;
     ``impl='ring'`` requires an ambient mesh with a ``seq`` axis
     (``parallel.mesh.use_mesh`` / Trainer sets it). ``causal`` applies
     autoregressive masking in whichever tier is fastest for it (the
-    flash kernel skips above-diagonal tiles entirely)."""
+    flash kernel skips above-diagonal tiles entirely). ``window``
+    (requires ``causal``) restricts each query to the last N positions
+    — Mistral's sliding window; the flash kernel also skips tiles
+    entirely BELOW the band, so long-sequence banded attention costs
+    O(S·window) instead of O(S²)."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is an autoregressive construct)")
     if impl == "flash":
         from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_attention import (
             flash_attention,
         )
-        return flash_attention(q, k, v, mask=mask, scale=scale, causal=causal)
+        return flash_attention(q, k, v, mask=mask, scale=scale,
+                               causal=causal, window=window)
     if impl == "ring":
+        if window is not None:
+            # ring attention shards the seq axis; banding it needs
+            # window-aware ring scheduling — not implemented
+            raise ValueError("sliding window is not supported with "
+                             "impl='ring'")
         from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.ring_attention import (
             ring_attention_or_fallback,
         )
         return ring_attention_or_fallback(q, k, v, mask=mask, scale=scale,
                                           causal=causal)
+    if window is not None:
+        band = make_banded_causal_mask(q.shape[2], window, k.shape[2])
+        mask = band if mask is None else mask + band
+        causal = False                        # the band includes causality
     if impl != "xla":
         raise ValueError(f"unknown attention impl {impl!r} (xla | flash | ring)")
     if causal:
@@ -82,6 +99,19 @@ def make_causal_mask(q_len: int, kv_len: int | None = None, dtype=jnp.float32, n
     i = jnp.arange(q_len)[:, None]
     j = jnp.arange(kv_len)[None, :]
     return jnp.where(j <= i, 0.0, neg).astype(dtype)[None, None, :, :]
+
+
+def make_banded_causal_mask(q_len: int, window: int,
+                            kv_len: int | None = None, dtype=jnp.float32,
+                            neg=-1e9):
+    """Causal + sliding window: key allowed iff 0 <= q - k < window
+    (Mistral semantics) — THE band definition; every banded path
+    (dispatch fallback, flash fallback, model-level masks) uses this."""
+    kv_len = kv_len or q_len
+    i = jnp.arange(q_len)[:, None]
+    j = jnp.arange(kv_len)[None, :]
+    keep = (j <= i) & (j > i - window)
+    return jnp.where(keep, 0.0, neg).astype(dtype)[None, None, :, :]
 
 
 def relative_position_bucket(relative_position, bidirectional: bool,

@@ -29,19 +29,33 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30
 
 
-def _causal_mask_block(iq, ik, block_q, block_k):
-    """Additive fp32 mask for the (iq, ik) tile of a causal attention."""
+def _causal_mask_block(iq, ik, block_q, block_k, window=None):
+    """Additive fp32 mask for the (iq, ik) tile of a causal attention;
+    ``window`` additionally bands it (key within the last N positions —
+    Mistral sliding window)."""
     q_pos = iq * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = ik * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(k_pos <= q_pos, 0.0, _NEG_INF).astype(jnp.float32)
+    keep = k_pos <= q_pos
+    if window is not None:
+        keep &= k_pos > q_pos - window
+    return jnp.where(keep, 0.0, _NEG_INF).astype(jnp.float32)
 
 
-def _tile_runs(causal, iq, ik, block_q, block_k):
+def _tile_runs(causal, iq, ik, block_q, block_k, window=None):
     """Whether the (iq, ik) tile contributes: causal tiles strictly above
-    the diagonal are skipped entirely (shared by fwd / dQ / dKV kernels)."""
-    return (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+    the diagonal are skipped entirely, and with a sliding ``window``
+    tiles entirely BELOW the band too — O(S·window) work at long S
+    (shared by fwd / dQ / dKV kernels)."""
+    if not causal:
+        return True
+    run = ik * block_k <= iq * block_q + block_q - 1
+    if window is not None:
+        # tile overlaps the band iff its newest key can still be seen by
+        # its oldest query: k_max >= q_min - window + 1
+        run &= (ik + 1) * block_k - 1 >= iq * block_q - window + 1
+    return run
 
 
 # ---------------------------------------------------------------------------
@@ -49,7 +63,8 @@ def _tile_runs(causal, iq, ik, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k):
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
+                window=None):
     """Grid (B, H, num_q, num_kv); kv is innermost so the online-softmax
     state in VMEM scratch carries across kv steps of one q block.
     ``lse_ref`` is None on the inference-only path (no residual needed)."""
@@ -64,7 +79,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 
     iq = pl.program_id(2)
     # with causal masking, tiles strictly above the diagonal contribute 0
-    run = _tile_runs(causal, iq, ik, block_q, block_k)
+    run = _tile_runs(causal, iq, ik, block_q, block_k, window)
 
     @pl.when(run)
     def _step():
@@ -76,7 +91,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         if mask_ref is not None:
             s = s + mask_ref[0].astype(jnp.float32)       # [1, BK] broadcast
         if causal:
-            s = s + _causal_mask_block(iq, ik, block_q, block_k)
+            s = s + _causal_mask_block(iq, ik, block_q, block_k, window)
 
         m_prev = m_ref[:, :1]                             # [BQ, 1]
         l_prev = l_ref[:, :1]
@@ -111,9 +126,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "block_q", "block_k", "causal", "interpret",
-                     "want_lse"))
+                     "want_lse", "window"))
 def _flash_fwd_call(q, k, v, mask, scale, block_q, block_k, causal, interpret,
-                    want_lse=True):
+                    want_lse=True, window=None):
     batch, heads, q_len, head_dim = q.shape
     kv_len = k.shape[2]
     grid = (batch, heads, q_len // block_q, kv_len // block_k)
@@ -144,7 +159,8 @@ def _flash_fwd_call(q, k, v, mask, scale, block_q, block_k, causal, interpret,
             q_, k_, v_, o_, acc_, mx_, l_ = refs
             m_ = lse_ = None
         _fwd_kernel(q_, k_, v_, m_, o_, lse_, acc_, mx_, l_, scale=scale,
-                    causal=causal, block_q=block_q, block_k=block_k)
+                    causal=causal, block_q=block_q, block_k=block_k,
+                    window=window)
 
     out_specs = [
         pl.BlockSpec((1, 1, block_q, head_dim), lambda b, h, j, i: (b, h, j, 0)),
@@ -177,7 +193,8 @@ def _flash_fwd_call(q, k, v, mask, scale, block_q, block_k, causal, interpret,
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
-               dq_ref, dq_acc, delta_ref, *, scale, causal, block_q, block_k):
+               dq_ref, dq_acc, delta_ref, *, scale, causal, block_q, block_k,
+               window=None):
     """Grid (B, H, num_q, num_kv); accumulates dQ for one q block across
     kv blocks.  dS = P ∘ (dO·Vᵀ − Δ), dQ = scale · dS·K.
     Δ_i = Σ_d dO_id·O_id is computed HERE (once per q block, into VMEM
@@ -194,7 +211,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
         delta_ref[...] = jnp.broadcast_to(d, delta_ref.shape)
 
     iq = pl.program_id(2)
-    run = _tile_runs(causal, iq, ik, block_q, block_k)
+    run = _tile_runs(causal, iq, ik, block_q, block_k, window)
 
     @pl.when(run)
     def _step():
@@ -206,7 +223,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
         if mask_ref is not None:
             s = s + mask_ref[0].astype(jnp.float32)
         if causal:
-            s = s + _causal_mask_block(iq, ik, block_q, block_k)
+            s = s + _causal_mask_block(iq, ik, block_q, block_k, window)
         lse = lse_ref[0, 0][:, :1]                        # [BQ, 1]
         p = jnp.exp(s - lse)                              # [BQ, BK] fp32
 
@@ -228,7 +245,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
                 dk_ref, dv_ref, dmask_ref, dk_acc, dv_acc, dm_acc,
-                *, scale, causal, block_q, block_k):
+                *, scale, causal, block_q, block_k, window=None):
     """Grid (B, H, num_kv, num_q); accumulates dK/dV (and the padding-mask
     cotangent) for one kv block across q blocks.
     dV = Pᵀ·dO, dK = scale · dSᵀ·Q, dmask = Σ_q dS. Δ is recomputed
@@ -245,7 +262,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
             dm_acc[...] = jnp.zeros_like(dm_acc)
 
     ik = pl.program_id(2)
-    run = _tile_runs(causal, iq, ik, block_q, block_k)
+    run = _tile_runs(causal, iq, ik, block_q, block_k, window)
 
     @pl.when(run)
     def _step():
@@ -257,7 +274,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
         if mask_ref is not None:
             s = s + mask_ref[0].astype(jnp.float32)
         if causal:
-            s = s + _causal_mask_block(iq, ik, block_q, block_k)
+            s = s + _causal_mask_block(iq, ik, block_q, block_k, window)
         lse = lse_ref[0, 0][:, :1]
         p = jnp.exp(s - lse)                              # [BQ, BK]
 
@@ -291,9 +308,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, mask_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_q", "block_k", "causal", "interpret"))
+    static_argnames=("scale", "block_q", "block_k", "causal", "interpret",
+                     "window"))
 def _flash_bwd_call(q, k, v, mask, o, lse, do, scale, block_q, block_k,
-                    causal, interpret):
+                    causal, interpret, window=None):
     batch, heads, q_len, head_dim = q.shape
     kv_len = k.shape[2]
     num_q = q_len // block_q
@@ -314,7 +332,8 @@ def _flash_bwd_call(q, k, v, mask, o, lse, do, scale, block_q, block_k,
         base_specs.append(
             pl.BlockSpec((1, 1, block_k), lambda b, h, j, i: (b, 0, i)))
 
-    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+              window=window)
 
     def dq_kernel(*refs):
         if has_mask:
@@ -396,7 +415,8 @@ def _flash_bwd_call(q, k, v, mask, o, lse, do, scale, block_q, block_k,
 
 def flash_attention(q, k, v, mask=None, scale=None, block_q: int = 512,
                     block_k: int = 512, causal: bool = False,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    window: int | None = None):
     """Flash attention. q,k,v: [B, H, S, D]; mask additive, broadcastable
     to [B, 1, 1, S] (padding masks; [B,H,Q,K] masks fall back to XLA).
 
@@ -408,6 +428,9 @@ def flash_attention(q, k, v, mask=None, scale=None, block_q: int = 512,
     """
     from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import xla_attention
 
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is an autoregressive construct)")
     head_dim = q.shape[-1]
     scale = scale if scale is not None else head_dim ** -0.5
     q_len, kv_len = q.shape[2], k.shape[2]
@@ -417,34 +440,41 @@ def flash_attention(q, k, v, mask=None, scale=None, block_q: int = 512,
     if q_len % block_q != 0 or kv_len % block_k != 0 or general_mask:
         if causal:
             from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+                make_banded_causal_mask,
                 make_causal_mask,
             )
-            cm = make_causal_mask(q_len, kv_len)
+            cm = (make_banded_causal_mask(q_len, window, kv_len)
+                  if window is not None else make_causal_mask(q_len, kv_len))
             mask = cm if mask is None else mask + cm
         return xla_attention(q, k, v, mask=mask, scale=scale)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    return _flash_vjp(q, k, v, mask, scale, block_q, block_k, causal, interpret)
+    return _flash_vjp(q, k, v, mask, scale, block_q, block_k, causal,
+                      interpret, window)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_vjp(q, k, v, mask, scale, block_q, block_k, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_vjp(q, k, v, mask, scale, block_q, block_k, causal, interpret,
+               window):
     # inference-only path: skip the LSE residual output entirely
     out, _ = _flash_fwd_call(q, k, v, mask, scale, block_q, block_k, causal,
-                             interpret, want_lse=False)
+                             interpret, want_lse=False, window=window)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, mask, scale, block_q, block_k, causal, interpret):
+def _flash_vjp_fwd(q, k, v, mask, scale, block_q, block_k, causal, interpret,
+                   window):
     out, lse = _flash_fwd_call(q, k, v, mask, scale, block_q, block_k, causal,
-                               interpret)
+                               interpret, window=window)
     return out, (q, k, v, mask, out, lse)
 
 
-def _flash_vjp_bwd(scale, block_q, block_k, causal, interpret, res, g):
+def _flash_vjp_bwd(scale, block_q, block_k, causal, interpret, window,
+                   res, g):
     q, k, v, mask, out, lse = res
     dq, dk, dv, dmask = _flash_bwd_call(
-        q, k, v, mask, out, lse, g, scale, block_q, block_k, causal, interpret)
+        q, k, v, mask, out, lse, g, scale, block_q, block_k, causal,
+        interpret, window)
     return dq, dk, dv, dmask
 
 
