@@ -52,6 +52,9 @@ Extra modes (each also prints one JSON line per run):
   --lora               BERT-large + LoRA r=8: the frozen base carries no
                        Adam m/v or grad tree, buying per-chip batch 32
                        (full fine-tuning's HBM sweet spot is 8-16).
+  --banded             banded-flash microbench: sliding-window vs full
+                       causal fwd+bwd at seq 8192 (the O(S*window)
+                       tile-skip claim, measured).
 
 Results across rounds are recorded in BENCH_EXTRA.md.
 """
@@ -365,6 +368,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
         return ["gpt2_finetune_fused_ce_samples_per_sec_per_chip"]
     if args.mlm:
         return ["bert_base_mlm_fused_ce_samples_per_sec_per_chip"]
+    if args.banded:
+        return ["flash_banded_fwd_bwd_ms"]
     if args.lora:
         return ["bert_large_lora_r8_samples_per_sec_per_chip"]
     if args.model == "bert-large":
@@ -423,6 +428,9 @@ def _run_child(args: argparse.Namespace) -> None:
     elif args.mlm:
         from benchmarks.mlm_bench import bench_mlm
         bench_mlm()
+    elif args.banded:
+        from benchmarks.banded_bench import bench_banded
+        bench_banded()
     elif args.lora:
         bench_lora()
     elif args.model == "bert-large":
@@ -445,6 +453,9 @@ def main() -> None:
     parser.add_argument("--lora", action="store_true",
                         help="BERT-large + LoRA r=8: adapter-only "
                              "optimizer state buys batch 32 on one chip")
+    parser.add_argument("--banded", action="store_true",
+                        help="banded-flash microbench (sliding window vs "
+                             "full causal at seq 8192)")
     parser.add_argument("--batch", type=int, default=None,
                         help="per-chip batch override (headline mode)")
     parser.add_argument("--opt-state-bf16", action="store_true",
@@ -464,7 +475,8 @@ def main() -> None:
                               ("--generate", args.generate),
                               ("--causal-lm", args.causal_lm),
                               ("--mlm", args.mlm),
-                              ("--lora", args.lora)] if on]
+                              ("--lora", args.lora),
+                              ("--banded", args.banded)] if on]
     if len(picked) > 1:
         parser.error(f"pick one mode, got {' and '.join(picked)}")
     if (args.batch is not None or args.opt_state_bf16

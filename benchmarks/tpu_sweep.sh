@@ -52,6 +52,7 @@ run mlm        python bench.py --mlm
 run generate   python bench.py --generate
 run bert_large python bench.py --model bert-large
 run bert_large_lora python bench.py --lora
+run banded python bench.py --banded
 
 # 5. scaling instrument (collective fraction from a real trace)
 run mesh python bench.py --mesh
