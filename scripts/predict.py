@@ -87,6 +87,23 @@ def predict(args) -> list[dict]:
         args.model_dir, task=args.task, num_labels=args.num_labels)
     tokenizer = load_tokenizer(args.model_dir, vocab_size=config.vocab_size)
 
+    if getattr(args, "adapter", None):
+        # LoRA sidecar deployment: merge adapter.safetensors onto the
+        # base checkpoint at load (the alternative to shipping the
+        # merged export scripts/train.py writes)
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.lora import (
+            load_adapters,
+            lora_scaling,
+            merge_lora,
+        )
+
+        lora, meta = load_adapters(args.adapter)
+        params = merge_lora(params, lora,
+                            lora_scaling(meta["lora_rank"],
+                                         meta["lora_alpha"]))
+        print(f"adapter: r={meta['lora_rank']} alpha={meta['lora_alpha']} "
+              f"targets={meta['lora_targets']} merged", file=sys.stderr)
+
     if getattr(args, "quantize", "none") == "int8":
         # int8 weight-only decode (models/quant.py): HBM-bound decode
         # reads 1/4 the kernel bytes; compute stays in the model dtype
@@ -275,6 +292,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--input_file", default=None,
                     help="jsonl with {'text': ..., 'context'?: ...}")
     ap.add_argument("--num_labels", type=int, default=2)
+    ap.add_argument("--adapter", default=None,
+                    help="LoRA adapter dir (adapter.safetensors + "
+                         "adapter_config.json) merged onto the base "
+                         "checkpoint at load")
     ap.add_argument("--doc_stride", type=int, default=0,
                     help="QA: window long contexts with this token stride "
                          "instead of truncating (HF run_qa; 0 = off)")

@@ -122,3 +122,51 @@ def test_predict_rtd(tmp_path):
                  "--text", "a plain sentence"])
     assert len(rows[0]["tokens"]) == len(rows[0]["replaced_prob"])
     assert all(0.0 <= p <= 1.0 for p in rows[0]["replaced_prob"])
+
+
+def test_predict_with_lora_adapter(tmp_path):
+    """--adapter merges a LoRA sidecar onto the base checkpoint at load:
+    predictions equal the merged-export model's exactly."""
+    import numpy as np
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.lora import (
+        init_lora_params,
+        lora_scaling,
+        merge_lora,
+        save_adapters,
+    )
+
+    cfg = EncoderConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                        num_heads=4, intermediate_size=64,
+                        max_position_embeddings=32)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    params = init_params(model, cfg)
+    base_dir = str(tmp_path / "base")
+    auto_models.save_pretrained(base_dir, params, "bert", cfg)
+
+    # nonzero adapters so the merge visibly changes the logits
+    import jax
+    import jax.numpy as jnp
+
+    lora = init_lora_params(params, rank=4, targets="attention", seed=3)
+    lora = jax.tree.map(
+        lambda x: jnp.asarray(
+            np.random.RandomState(0).normal(0, 0.1, x.shape), x.dtype),
+        lora)
+    adapter_dir = str(tmp_path / "adapter")
+    save_adapters(adapter_dir, lora, rank=4, alpha=16.0,
+                  targets="attention")
+    merged_dir = str(tmp_path / "merged")
+    auto_models.save_pretrained(
+        merged_dir, merge_lora(params, lora, lora_scaling(4, 16.0)),
+        "bert", cfg)
+
+    out_adapter = _run(["--model_dir", base_dir, "--adapter", adapter_dir,
+                        "--task", "seq-cls", "--text", "a fine day"])
+    out_merged = _run(["--model_dir", merged_dir, "--task", "seq-cls",
+                       "--text", "a fine day"])
+    out_base = _run(["--model_dir", base_dir, "--task", "seq-cls",
+                     "--text", "a fine day"])
+    np.testing.assert_allclose(out_adapter[0]["probs"],
+                               out_merged[0]["probs"], atol=1e-6)
+    assert not np.allclose(out_adapter[0]["probs"], out_base[0]["probs"])
