@@ -55,6 +55,9 @@ Extra modes (each also prints one JSON line per run):
   --banded             banded-flash microbench: sliding-window vs full
                        causal fwd+bwd at seq 8192 (the O(S*window)
                        tile-skip claim, measured).
+  --llama-train        TinyLlama-1.1B causal-LM training on one chip
+                       (bf16 Adam + remat dots + fused vocab-CE +
+                       flash), samples/s + MFU.
 
 Results across rounds are recorded in BENCH_EXTRA.md.
 """
@@ -370,6 +373,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
         return ["bert_base_mlm_fused_ce_samples_per_sec_per_chip"]
     if args.banded:
         return ["flash_banded_fwd_bwd_ms"]
+    if args.llama_train:
+        return ["llama_1b_train_samples_per_sec_per_chip"]
     if args.lora:
         return ["bert_large_lora_r8_samples_per_sec_per_chip"]
     if args.model == "bert-large":
@@ -431,6 +436,9 @@ def _run_child(args: argparse.Namespace) -> None:
     elif args.banded:
         from benchmarks.banded_bench import bench_banded
         bench_banded()
+    elif args.llama_train:
+        from benchmarks.llama_train_bench import bench_llama_train
+        bench_llama_train()
     elif args.lora:
         bench_lora()
     elif args.model == "bert-large":
@@ -456,6 +464,10 @@ def main() -> None:
     parser.add_argument("--banded", action="store_true",
                         help="banded-flash microbench (sliding window vs "
                              "full causal at seq 8192)")
+    parser.add_argument("--llama-train", action="store_true",
+                        dest="llama_train",
+                        help="TinyLlama-1.1B training throughput "
+                             "(bf16 Adam + remat dots + fused CE)")
     parser.add_argument("--batch", type=int, default=None,
                         help="per-chip batch override (headline mode)")
     parser.add_argument("--opt-state-bf16", action="store_true",
@@ -476,7 +488,8 @@ def main() -> None:
                               ("--causal-lm", args.causal_lm),
                               ("--mlm", args.mlm),
                               ("--lora", args.lora),
-                              ("--banded", args.banded)] if on]
+                              ("--banded", args.banded),
+                              ("--llama-train", args.llama_train)] if on]
     if len(picked) > 1:
         parser.error(f"pick one mode, got {' and '.join(picked)}")
     if (args.batch is not None or args.opt_state_bf16

@@ -1,0 +1,136 @@
+"""Llama training throughput (bench.py --llama-train).
+
+TinyLlama-1.1B (22L/2048H, 32 query / 4 kv heads, SwiGLU 5632) causal-LM
+training on one chip through the real ``Trainer.fit`` loop — the modern
+-decoder counterpart to the BERT headline. The configuration is the
+framework's own HBM recipe for a 1.1B model on 16G: bf16 Adam moments
+(``optimizer_state_dtype``), ``remat dots`` (save matmul outputs,
+recompute elementwise), fused vocab-CE (no [B,S,V] logits at V=32000),
+packed-shape synthetic data at seq 1024. Off-TPU this shrinks to smoke
+size with the interpret-mode fused loss.
+
+Emits samples/s/chip, tokens/s/chip and MFU from an analytic Llama
+FLOPs model (matmul-only, 3x forward, remat recompute excluded — the
+same convention as the BERT headline).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def llama_train_flops_per_token(hidden: int, layers: int, heads: int,
+                                kv_heads: int, intermediate: int,
+                                vocab: int, seq_len: int) -> float:
+    """Analytic matmul FLOPs per TOKEN for one training step (3x fwd)."""
+    kv_ratio = kv_heads / heads
+    qkvo = 2 * hidden * hidden * (2 + 2 * kv_ratio)   # q,o full; k,v scaled
+    attn = 4 * seq_len * hidden                        # qk^T + pv
+    mlp = 6 * hidden * intermediate                    # gate, up, down
+    head = 2 * hidden * vocab
+    fwd = layers * (qkvo + attn + mlp) + head
+    return 3.0 * fwd
+
+
+def bench_llama_train() -> None:
+    import jax
+
+    from bench import _flops_detail, _on_tpu
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import (
+        TrainConfig,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import (
+        Trainer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
+        make_fused_causal_lm_loss,
+    )
+    import jax.numpy as jnp
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        per_chip_batch, seq_len, batches = 4, 1024, 8
+        cfg = LlamaConfig(                             # TinyLlama-1.1B
+            vocab_size=32000, hidden_size=2048, num_layers=22,
+            num_heads=32, num_kv_heads=4, intermediate_size=5632,
+            max_position_embeddings=seq_len, dtype=jnp.bfloat16,
+            attention_impl="flash", remat=True, remat_policy="dots")
+    else:
+        per_chip_batch, seq_len, batches = 2, 64, 4
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=256,
+                          max_position_embeddings=seq_len)
+
+    n_chips = len(jax.devices())
+    global_batch = per_chip_batch * n_chips
+    mesh = build_mesh(MeshConfig(dp=-1))
+    tconfig = TrainConfig(task="causal-lm",
+                          dtype="bfloat16" if on_tpu else "float32",
+                          train_batch_size=per_chip_batch,
+                          max_seq_length=seq_len, log_every_steps=0,
+                          optimizer_state_dtype="bfloat16" if on_tpu
+                          else "float32",
+                          remat=on_tpu, remat_policy="dots" if on_tpu
+                          else "full",
+                          fused_vocab_ce=True)
+    model = LlamaForCausalLM(cfg)
+    params = init_params(model, cfg, seed=0)
+    trainer = Trainer(tconfig, model, params, mesh)
+    if not on_tpu:
+        trainer.loss_fn = make_fused_causal_lm_loss(model, interpret=True)
+
+    tok = WordHashTokenizer(vocab_size=cfg.vocab_size)
+    texts, _ = synthetic_text_classification(
+        global_batch * batches, seed=0, min_len=600, max_len=900)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=seq_len)
+    history = trainer.fit(ShardedBatcher(ds, global_batch, mesh,
+                                         shuffle=False, seed=0), epochs=2)
+
+    sps = history["train_samples_per_second_per_chip"]
+    flops_per_sample = seq_len * llama_train_flops_per_token(
+        cfg.hidden_size, cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+        cfg.intermediate_size, cfg.vocab_size, seq_len)
+    line = {
+        "metric": "llama_1b_train_samples_per_sec_per_chip",
+        "value": round(sps, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": 0.0,    # no reference decoder-training anchor
+        "tokens_per_sec_per_chip": round(sps * seq_len, 1),
+    }
+    if on_tpu:
+        line.update(_flops_detail(sps, flops_per_sample))
+    line["detail"] = {
+        "per_chip_batch": per_chip_batch, "seq_len": seq_len,
+        "recipe": "bf16-adam + remat dots + fused vocab-CE + flash",
+        "model_scale": "TinyLlama-1.1B" if on_tpu else "smoke",
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    bench_llama_train()
