@@ -234,7 +234,8 @@ _HF_CONFIG_EXPORTERS = {
         "model_type": c.model_type,
         "architectures": [{"llama": "LlamaForCausalLM",
                            "mistral": "MistralForCausalLM",
-                           "qwen2": "Qwen2ForCausalLM"}[c.model_type]],
+                           "qwen2": "Qwen2ForCausalLM",
+                           "gemma": "GemmaForCausalLM"}[c.model_type]],
         "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
         "num_hidden_layers": c.num_layers,
         "num_attention_heads": c.num_heads,
@@ -253,6 +254,11 @@ _HF_CONFIG_EXPORTERS = {
             "use_sliding_window": c.sliding_window is not None,
             "max_window_layers": c.sliding_window_start_layer}
            if c.model_type == "qwen2" else {}),
+        **({"head_dim": c.resolved_head_dim,
+            "hidden_activation": c.hidden_act}
+           if c.model_type == "gemma" else {}),
+        **({"head_dim": c.head_dim} if c.head_dim is not None
+           and c.model_type != "gemma" else {}),
     },
     "bart": _bart_hf_config,
     "mbart": lambda c: {**_bart_hf_config(c), "model_type": "mbart",
@@ -294,6 +300,7 @@ _FAMILY_ALIASES = {
     # the original model_type
     "mistral": "llama",
     "qwen2": "llama",
+    "gemma": "llama",
 }
 
 

@@ -31,6 +31,8 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
 ACT2FN: dict[str, Callable] = {
     "gelu": lambda x: jax.nn.gelu(x, approximate=False),  # HF "gelu" is erf-exact
     "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+    # HF's name for the same tanh approximation (Gemma's default)
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
     "relu": jax.nn.relu,
     "silu": jax.nn.silu,
     "tanh": jnp.tanh,

@@ -85,6 +85,18 @@ class LlamaConfig:
     sliding_window_start_layer: int = 0
     # Qwen2: biases on q/k/v projections only (o/mlp stay bias-free)
     qkv_bias: bool = False
+    # Gemma: q/k/v head size independent of hidden_size/num_heads
+    # (None = hidden_size // num_heads, the Llama/Mistral/Qwen2 case)
+    head_dim: Optional[int] = None
+    # Gemma RMSNorm: scale applied as (1 + weight) in fp32 BEFORE the
+    # cast back to the compute dtype (HF GemmaRMSNorm order)
+    rms_unit_offset: bool = False
+    # Gemma: embeddings multiplied by sqrt(hidden_size)
+    embed_scale: bool = False
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
     # which HF model_type this config round-trips as (llama | mistral |
     # qwen2 — same state-dict layout, different config.json)
     model_type: str = "llama"
@@ -102,6 +114,12 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
             "unscaled RoPE frequencies and diverge from HF")
     mt = hf_config.get("model_type", "llama")
     window_start = 0
+    extra = {}
+    if mt == "gemma":
+        extra = dict(
+            rms_unit_offset=True,
+            embed_scale=True,
+        )
     if mt == "qwen2":
         # Qwen2's modeling class hardcodes q/k/v biases (not a config
         # field); the o/mlp projections stay bias-free. Its window is
@@ -126,7 +144,7 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
             "be silently dropped")
     kw = dict(
         model_type=mt, sliding_window=window, qkv_bias=qkv_bias,
-        sliding_window_start_layer=window_start,
+        sliding_window_start_layer=window_start, **extra,
         vocab_size=hf_config["vocab_size"],
         hidden_size=hf_config["hidden_size"],
         num_layers=hf_config["num_hidden_layers"],
@@ -138,9 +156,20 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
                                               2048),
         rope_theta=hf_config.get("rope_theta", 10000.0),
         rms_norm_eps=hf_config.get("rms_norm_eps", 1e-5),
-        hidden_act=hf_config.get("hidden_act", "silu"),
+        # HF's GemmaMLP substitutes gelu_pytorch_tanh whenever
+        # hidden_activation is absent/null (the legacy 'gelu' configs of
+        # the original release included) — honour that, not hidden_act
+        hidden_act=(hf_config.get("hidden_activation")
+                    or ("gelu_pytorch_tanh" if mt == "gemma"
+                        else hf_config.get("hidden_act", "silu"))),
+        # HF reads head_dim generically (Mistral-Nemo, Llama-3.x and
+        # Qwen2 derivatives serialize non-default values too)
+        head_dim=hf_config.get("head_dim"),
         initializer_range=hf_config.get("initializer_range", 0.02),
-        tie_word_embeddings=hf_config.get("tie_word_embeddings", False),
+        # Gemma's CLASS default is tied (unlike Llama's), so an absent
+        # key means tied there
+        tie_word_embeddings=hf_config.get("tie_word_embeddings",
+                                          mt == "gemma"),
         bos_token_id=hf_config.get("bos_token_id", 1),
         eos_token_id=hf_config.get("eos_token_id", 2),
         pad_token_id=(hf_config["pad_token_id"]
@@ -172,11 +201,16 @@ class LlamaRMSNorm(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        scale = self.param("scale", nn.initializers.ones,
-                           (x.shape[-1],), cfg.param_dtype)
+        init = (nn.initializers.zeros if cfg.rms_unit_offset
+                else nn.initializers.ones)
+        scale = self.param("scale", init, (x.shape[-1],), cfg.param_dtype)
         x32 = x.astype(jnp.float32)
         var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
         x32 = x32 * lax.rsqrt(var + cfg.rms_norm_eps)
+        if cfg.rms_unit_offset:
+            # Gemma order: (1 + w) multiplied in fp32, THEN cast down
+            return (x32 * (1.0 + scale.astype(jnp.float32))).astype(
+                cfg.dtype)
         return (x32.astype(cfg.dtype) * scale.astype(cfg.dtype))
 
 
@@ -219,7 +253,7 @@ class LlamaAttention(nn.Module):
                  position_ids=None, deterministic: bool = True,
                  decode: bool = False):
         cfg = self.config
-        head_dim = cfg.hidden_size // cfg.num_heads
+        head_dim = cfg.resolved_head_dim
         B, S, _ = hidden.shape
 
         def split(x, n_heads):
@@ -384,10 +418,14 @@ class LlamaModel(nn.Module):
             band_mask = jnp.where(band, 0.0, NEG_INF)
             banded_mask = (band_mask if additive_mask is None
                            else additive_mask + band_mask)
-        rope = rope_tables(position_ids, cfg.hidden_size // cfg.num_heads,
+        rope = rope_tables(position_ids, cfg.resolved_head_dim,
                            cfg.rope_theta)
 
         x = embed(input_ids)
+        if cfg.embed_scale:
+            # Gemma: normalizer in the embedding dtype (HF computes the
+            # sqrt as a tensor of that dtype)
+            x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
         block_cls = LlamaBlock
         if cfg.remat:
             block_cls = nn.remat(LlamaBlock, static_argnums=(5, 6),
