@@ -429,3 +429,37 @@ def test_local_two_host_moe_expert_parallel_job(tmp_path):
         result = json.load(f)
     assert len(result["losses"]) == 2
     assert all(np.isfinite(l) for l in result["losses"])
+
+
+@pytest.mark.slow
+def test_local_two_host_llama_causal_lm_job(tmp_path):
+    """The modern-decoder family through the full multi-process path:
+    2 simulated hosts fine-tune a tiny Llama (GQA) causal-lm with the
+    fused vocab-CE loss — rendezvous, sharded data, allreduce, export."""
+    import transformers
+    cfg_dir = str(tmp_path / "cfg")
+    transformers.LlamaConfig(
+        vocab_size=256, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=64, max_position_embeddings=64,
+        tie_word_embeddings=False).save_pretrained(cfg_dir)
+    job = TPUJob(entry_point="scripts/train.py", source_dir=os.getcwd(),
+                 slice_spec="cpu-8", num_hosts=2,
+                 hyperparameters={
+                     "model_name_or_path": cfg_dir, "from_scratch": True,
+                     "task": "causal-lm", "dataset": "synthetic",
+                     "epochs": 1, "train_batch_size": 2,
+                     "dtype": "float32", "max_seq_length": 32,
+                     "max_train_samples": 32, "max_eval_samples": 16,
+                     "learning_rate": 1e-3,
+                     "scale_lr_by_world_size": False,
+                 },
+                 job_root=str(tmp_path / "jobs"), coordinator_port=8499,
+                 env={"PYTHONPATH": os.getcwd()})
+    handle = job.fit(wait=True)
+    assert handle.returncodes == [0, 0]
+    assert os.path.exists(os.path.join(handle.model_dir,
+                                       "model.safetensors"))
+    import json as _json
+    with open(os.path.join(handle.model_dir, "config.json")) as f:
+        assert _json.load(f)["model_type"] == "llama"
