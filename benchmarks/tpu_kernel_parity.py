@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 FAILED = []
+SUBSET = False  # --subset: ~2-min spot-check embedded in bench.py headline
 
 
 def check(name: str, got, want, atol: float, rtol: float = 1e-3) -> None:
@@ -47,12 +48,17 @@ def check(name: str, got, want, atol: float, rtol: float = 1e-3) -> None:
         FAILED.append(name)
 
 
-def check_anchored(name: str, flash, xla, ref64, floor: float = 1e-6) -> None:
+def check_anchored(name: str, flash, xla, ref64, floor: float = 1e-6,
+                   ceiling: float = 1e-2) -> None:
     """PASS iff the Pallas result is as close to the float64 anchor as
-    the XLA path is (within 2x + a floor for near-exact cases)."""
+    the XLA path is (within 2x + a floor for near-exact cases) AND under
+    an absolute ceiling — the bare 2x ratio alone would let a systematic
+    defect shared with a drifting XLA error pass; the ceiling is a few
+    times the worst error measured in r4 (full ~5e-5, causal ~1e-3 from
+    the -1e30 mask arithmetic)."""
     ef = float(np.max(np.abs(np.asarray(flash, np.float64) - ref64)))
     ex = float(np.max(np.abs(np.asarray(xla, np.float64) - ref64)))
-    ok = ef <= 2.0 * ex + floor
+    ok = (ef <= 2.0 * ex + floor) and (ef <= ceiling)
     print(f"{'PASS' if ok else 'FAIL'} {name}: flash_vs_fp64={ef:.3e} "
           f"xla_vs_fp64={ex:.3e} ratio={ef / max(ex, 1e-12):.2f}")
     if not ok:
@@ -103,9 +109,16 @@ def flash_parity() -> None:
         return out, dq_, dk_, dv_
 
     # (causal, window): full, causal, and the Mistral band — the banded
-    # kernels (tile-skip below the band) have their own Mosaic surface
-    for causal, window in ((False, None), (True, None), (True, 128)):
+    # kernels (tile-skip below the band) have their own Mosaic surface.
+    # Subset mode keeps only the causal case (the headline config):
+    # fwd + 3 grads, the four checks with the most Mosaic surface.
+    cases = ((True, None),) if SUBSET else (
+        (False, None), (True, None), (True, 128))
+    for causal, window in cases:
         tag = ("windowed" if window else "causal") if causal else "full"
+        # absolute ceilings: a few times the r4-measured errors (full
+        # ~5e-5, causal/windowed ~1e-3 from -1e30 mask arithmetic)
+        ceiling = 1e-2 if causal else 1e-3
         r_out, r_dq, r_dk, r_dv = ref64(causal, window)
         full_mask = mask
         if causal:
@@ -122,7 +135,8 @@ def flash_parity() -> None:
             q, k, v, mask=mask, causal=causal, window=window))(q, k, v)
         out_x = jax.jit(lambda q, k, v: xla_attention(
             q, k, v, mask=full_mask))(q, k, v)
-        check_anchored(f"flash fwd ({tag})", out_f, out_x, r_out)
+        check_anchored(f"flash fwd ({tag})", out_f, out_x, r_out,
+                       ceiling=ceiling)
 
         def loss_f(q, k, v):
             return jnp.sum(flash_attention(q, k, v, mask=mask,
@@ -136,7 +150,8 @@ def flash_parity() -> None:
         gx = jax.jit(jax.grad(loss_x, argnums=(0, 1, 2)))(q, k, v)
         for name, a, b, r in zip(("dq", "dk", "dv"), gf, gx,
                                  (r_dq, r_dk, r_dv)):
-            check_anchored(f"flash bwd {name} ({tag})", a, b, r)
+            check_anchored(f"flash bwd {name} ({tag})", a, b, r,
+                           ceiling=ceiling)
 
 
 def vocab_ce_parity() -> None:
@@ -146,9 +161,11 @@ def vocab_ce_parity() -> None:
         fused_vocab_cross_entropy,
     )
 
-    for label, (n_tok, h_dim, vocab) in (
-            ("gpt2-vocab", (2048, 768, 50257)),
-            ("mlm-bias-aug", (2048, 896, 30522))):
+    shapes = (("gpt2-vocab", (2048, 768, 50257)),
+              ("mlm-bias-aug", (2048, 896, 30522)))
+    if SUBSET:
+        shapes = shapes[:1]
+    for label, (n_tok, h_dim, vocab) in shapes:
         rng = np.random.RandomState(1)
         hidden = jnp.asarray(rng.randn(n_tok, h_dim), jnp.float32) * 0.1
         weight = jnp.asarray(rng.randn(vocab, h_dim), jnp.float32) * 0.05
@@ -182,6 +199,8 @@ def vocab_ce_parity() -> None:
         for name, a, b in zip(("dh", "dw"), gf, gx):
             check(f"vocab-ce {name} ({label})", a, b, atol=1e-5)
 
+        if SUBSET:
+            continue
         # smoothed variant (eps=0.1): the running logit-sum + smoothed
         # target paths in the kernel, vs the explicit decomposition
         eps = 0.1
@@ -210,6 +229,8 @@ def vocab_ce_parity() -> None:
 
 
 def main() -> None:
+    global SUBSET
+    SUBSET = "--subset" in sys.argv[1:]
     dev = jax.devices()[0]
     print(f"backend: {dev.platform} ({dev.device_kind})")
     on_tpu = dev.platform == "tpu"

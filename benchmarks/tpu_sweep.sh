@@ -12,10 +12,33 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=/tmp/tpu_sweep
 mkdir -p "$OUT"
+# Resume is keyed to HEAD: banked numbers belong to the code that
+# produced them. A sweep at a new rev archives the old logs instead of
+# silently re-reporting stale measurements as fresh.
+REV=$(git rev-parse HEAD 2>/dev/null || echo unknown)
+# uncommitted edits are code the banked numbers never saw
+git diff --quiet 2>/dev/null || REV="$REV-dirty-$(git diff | sha1sum | cut -c1-8)"
+if [ -f "$OUT/sweep_rev" ] && [ "$(cat "$OUT/sweep_rev")" != "$REV" ]; then
+  old="$OUT.$(cat "$OUT/sweep_rev" | cut -c1-12)"
+  echo "HEAD moved since last sweep — archiving old logs to $old"
+  rm -rf "$old"; mv "$OUT" "$old"; mkdir -p "$OUT"
+fi
+echo "$REV" > "$OUT/sweep_rev"
 WORST=0
 run() {  # run <name> <cmd...>  — tee output, never abort the sweep,
-         # but remember the worst rc so the sweep's exit code is honest
+         # but remember the worst rc so the sweep's exit code is honest.
+         # A step whose log already holds a real number is skipped, so a
+         # re-run after a mid-sweep tunnel drop resumes where it died.
   local name=$1; shift
+  if { grep -q '"value": [0-9]' "$OUT/$name.log" 2>/dev/null \
+       || grep -q 'ALL PASS' "$OUT/$name.log" 2>/dev/null; } \
+     && ! grep -q '"kernel_parity": {"error"' "$OUT/$name.log" 2>/dev/null \
+     && ! grep -q '"fail": [1-9]' "$OUT/$name.log" 2>/dev/null; then
+    # banked = a real number AND (for the headline) healthy folded-in
+    # kernel parity — a parity timeout/FAIL must retry at this rev
+    echo "=== $name: already banked, skipping" | tee -a "$OUT/sweep.log"
+    return
+  fi
   echo "=== $name: $*" | tee -a "$OUT/sweep.log"
   "$@" 2>&1 | tee "$OUT/$name.log" | tail -3
   local rc=${PIPESTATUS[0]}
