@@ -284,7 +284,7 @@ _HF_CONFIG_EXPORTERS = {
 # families whose Encoder stack supports per-layer MoE FFNs / pipelining
 # (T5 has its own blocks; ALBERT shares one layer across the stack)
 _MOE_FAMILIES = ("bert", "roberta", "distilbert", "electra", "gpt2")
-_PIPELINE_FAMILIES = _MOE_FAMILIES + ("t5", "bart", "mbart")
+_PIPELINE_FAMILIES = _MOE_FAMILIES + ("t5", "bart", "mbart", "llama")
 
 _MOE_CONFIG_KEYS = ("num_experts", "expert_top_k", "moe_every",
                     "expert_capacity_factor", "router_aux_coef")
@@ -424,6 +424,18 @@ def from_pretrained(
                 bb["pipelined_h"] = stack_layer_params(
                     layers, config.num_layers, GPT2_LAYER_LEAVES, "h_{}")
                 loaded = {**loaded, "backbone": bb}
+            elif family == "llama":
+                from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                    llama_layer_leaves,
+                )
+
+                bb = dict(bb)
+                layers = {k: bb.pop(k) for k in list(bb)
+                          if k.startswith("layers_")}
+                bb["pipelined_layers"] = stack_layer_params(
+                    layers, config.num_layers,
+                    llama_layer_leaves(config.qkv_bias), "layers_{}")
+                loaded = {**loaded, "backbone": bb}
             elif family in ("t5", "bart", "mbart"):
                 from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
                     convert_encdec_stacks,
@@ -521,6 +533,16 @@ def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderCo
             bb.update(unstack_layer_params(
                 bb.pop("pipelined_h"), config.num_layers,
                 GPT2_LAYER_LEAVES, "h_{}"))
+            params = {**params, "backbone": bb}
+        elif "pipelined_layers" in bb:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                llama_layer_leaves,
+            )
+
+            bb = dict(bb)
+            bb.update(unstack_layer_params(
+                bb.pop("pipelined_layers"), config.num_layers,
+                llama_layer_leaves(config.qkv_bias), "layers_{}"))
             params = {**params, "backbone": bb}
         elif family in ("t5", "bart", "mbart"):
             from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (

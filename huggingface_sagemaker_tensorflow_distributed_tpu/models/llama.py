@@ -93,6 +93,10 @@ class LlamaConfig:
     rms_unit_offset: bool = False
     # Gemma: embeddings multiplied by sqrt(hidden_size)
     embed_scale: bool = False
+    # GPipe pipeline parallelism over the block stack (models/pipeline.py;
+    # training/scoring path — generation reloads dense)
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0         # 0 → = pipeline_stages
 
     @property
     def resolved_head_dim(self) -> int:
@@ -426,6 +430,41 @@ class LlamaModel(nn.Module):
             # Gemma: normalizer in the embedding dtype (HF computes the
             # sqrt as a tensor of that dtype)
             x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+        if cfg.pipeline_stages:
+            if decode:
+                raise ValueError(
+                    "pipeline_stages and incremental decode cannot "
+                    "combine: the KV cache is stage-local state. Export "
+                    "the pipelined checkpoint and reload it dense "
+                    "(pipeline_stages=0) for generation")
+            if cfg.sliding_window is not None:
+                raise ValueError(
+                    "pipeline_stages cannot combine with sliding_window "
+                    "(Mistral/Qwen2): the per-layer window policy makes "
+                    "stages heterogeneous, which the vmap-over-stages "
+                    "GPipe schedule cannot express")
+            if not default_positions:
+                raise ValueError(
+                    "pipeline_stages requires default position_ids: the "
+                    "pipelined stack closes over batch-invariant RoPE "
+                    "tables computed from arange positions")
+            if cfg.weight_quant != "none":
+                raise ValueError(
+                    "pipeline_stages and weight_quant cannot combine "
+                    "(int8 weight-only kernels are a decode-path "
+                    "feature; the pipelined stack is training-only)")
+            if cfg.attention_impl == "ring":
+                raise ValueError(
+                    "pipeline_stages cannot combine with attention_impl="
+                    "'ring' (sequence parallelism): scale long sequences "
+                    "with sp OR pipeline with pp, not both")
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+                PipelinedLlamaStack,
+            )
+            x = PipelinedLlamaStack(cfg, name="pipelined_layers")(
+                x, additive_mask, deterministic)
+            x = LlamaRMSNorm(cfg, name="final_ln")(x)
+            return x, embed.embedding
         block_cls = LlamaBlock
         if cfg.remat:
             block_cls = nn.remat(LlamaBlock, static_argnums=(5, 6),

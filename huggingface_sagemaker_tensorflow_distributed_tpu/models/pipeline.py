@@ -75,6 +75,24 @@ def _stacked_name(path: tuple) -> str:
     return "_".join(path[-2:])
 
 
+def llama_layer_leaves(qkv_bias: bool) -> tuple:
+    """Per-layer leaf paths of ``LlamaBlock`` (bias-free except Qwen2's
+    hardcoded q/k/v biases; RMS scales only — no LN biases)."""
+    leaves = [("input_ln", "scale")]
+    for proj in ("q_proj", "k_proj", "v_proj"):
+        leaves.append(("self_attn", proj, "kernel"))
+        if qkv_bias:
+            leaves.append(("self_attn", proj, "bias"))
+    leaves += [
+        ("self_attn", "o_proj", "kernel"),
+        ("post_attn_ln", "scale"),
+        ("mlp", "gate_proj", "kernel"),
+        ("mlp", "up_proj", "kernel"),
+        ("mlp", "down_proj", "kernel"),
+    ]
+    return tuple(leaves)
+
+
 def full_stacked_name(path: tuple) -> str:
     """T5 needs the FULL path joined: self_attn and cross_attn share
     query/key/value/attention_out leaf names, so the two-component name
@@ -401,6 +419,106 @@ class PipelinedEncoder(nn.Module):
             stage_fn, staged, hidden, (attn_mask,), pp=pp,
             microbatches=cfg.pipeline_microbatches,
             deterministic=deterministic, base_key=base_key)
+
+
+class PipelinedLlamaStack(nn.Module):
+    """The Llama-family block stack under the GPipe schedule — pipeline
+    parallelism for the modern decoder lineage (training/scoring path;
+    generation's KV cache is stage-local state, enforced loudly by
+    ``LlamaModel``). Two structural simplifications relative to the
+    other pipelined families:
+
+    - Llama has NO dropout anywhere, so the schedule always runs its
+      deterministic branch (no per-stage rng plumbing);
+    - RoPE tables depend only on positions, and the pipelined path is
+      the default-positions training path (``LlamaModel`` rejects custom
+      ``position_ids`` under pp), so the [1, 1, S, D] cos/sin tables are
+      microbatch-invariant — computed once outside the schedule and
+      closed over by every stage, broadcasting against each microbatch
+      (exactly how ``PipelinedT5Stack`` treats its relative-position
+      bias).
+
+    Sliding-window variants (Mistral/Qwen2) are rejected by
+    ``LlamaModel`` under pp: the per-layer window policy
+    (``sliding_window_start_layer``) makes stages heterogeneous, which
+    the vmap-over-stages formulation cannot express.
+    """
+
+    config: Any  # LlamaConfig (annotated loosely to avoid a cycle)
+
+    def _declare_stacked(self, leaves) -> dict:
+        cfg = self.config
+        L, H, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+        inner = cfg.num_heads * cfg.resolved_head_dim
+        kv_inner = cfg.num_kv_heads * cfg.resolved_head_dim
+        kernel = nn.initializers.normal(cfg.initializer_range)
+        # Gemma RMSNorm stores (scale - 1): zeros init (models/llama.py)
+        ln_init = (nn.initializers.zeros if cfg.rms_unit_offset
+                   else nn.initializers.ones)
+        out = {}
+        for path in leaves:
+            name = _stacked_name(path)
+            if path[-1] == "scale":
+                shape, init = (L, H), ln_init
+            elif path[-1] == "bias":
+                width = inner if path[-2] == "q_proj" else kv_inner
+                shape, init = (L, width), nn.initializers.zeros
+            elif path[-2] == "q_proj":
+                shape, init = (L, H, inner), kernel
+            elif path[-2] in ("k_proj", "v_proj"):
+                shape, init = (L, H, kv_inner), kernel
+            elif path[-2] == "o_proj":
+                shape, init = (L, inner, H), kernel
+            elif path[-2] in ("gate_proj", "up_proj"):
+                shape, init = (L, H, F), kernel
+            else:  # down_proj
+                shape, init = (L, F, H), kernel
+            out[name] = self.param(name, init, shape, cfg.param_dtype)
+        return out
+
+    @nn.compact
+    def __call__(self, hidden, attn_mask=None, deterministic: bool = True):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+            LlamaBlock,
+            rope_tables,
+        )
+
+        cfg = self.config
+        pp = cfg.pipeline_stages
+        lps = _check_pipeline_shape(pp, cfg.num_layers)
+        leaves = llama_layer_leaves(cfg.qkv_bias)
+        B, S, _ = hidden.shape
+
+        flat = self._declare_stacked(leaves)
+        staged = jax.tree.map(
+            lambda a: a.reshape(pp, lps, *a.shape[1:]), flat)
+
+        if attn_mask is None:
+            attn_mask = jnp.zeros((B, 1, 1, S), jnp.float32)
+        attn_mask = jnp.broadcast_to(attn_mask, (B, 1, 1, S))
+
+        # microbatch-invariant: default positions are arange for every
+        # row, so the [1, 1, S, D] tables broadcast over each microbatch
+        rope = rope_tables(jnp.arange(S)[None, :], cfg.resolved_head_dim,
+                           cfg.rope_theta)
+        block = LlamaBlock(cfg)
+
+        def stage_fn(p_stage, x, m, key):
+            del key  # Llama has no dropout; schedule runs deterministic
+            for i in range(lps):
+                p_i = _layer_tree(p_stage, i, leaves)
+                x = block.apply({"params": p_i}, x, (m, None), rope, None,
+                                True, False)
+            return x
+
+        if cfg.remat:
+            stage_fn = jax.checkpoint(
+                stage_fn, policy=remat_policy(cfg.remat_policy))
+
+        return gpipe_schedule(
+            stage_fn, staged, hidden, (attn_mask,), pp=pp,
+            microbatches=cfg.pipeline_microbatches,
+            deterministic=True, base_key=None)
 
 
 class PipelinedT5Stack(nn.Module):

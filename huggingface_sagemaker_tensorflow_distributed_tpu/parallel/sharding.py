@@ -62,6 +62,12 @@ _PARAM_RULES: Sequence[tuple[str, tuple]] = (
     (r"pipelined_h/(qkv|fc_in)_kernel$", (AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR)),
     (r"pipelined_h/(attn_out|fc_out)_kernel$", (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
     (r"pipelined_h/", (AXIS_PIPE,)),
+    # pipelined Llama stack: same contract, bias-free *_proj naming
+    (r"pipelined_layers/(q_proj|k_proj|v_proj|gate_proj|up_proj)_kernel$",
+     (AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR)),
+    (r"pipelined_layers/(o_proj|down_proj)_kernel$",
+     (AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP)),
+    (r"pipelined_layers/", (AXIS_PIPE,)),
     # pipelined T5/BART stacks (flat ``pipelined_<path>`` leaf names
     # inside encoder/decoder): stacked [L, ...], stage dim over pipe
     (r"pipelined_.*(query|key|value|wi|wi_0|wi_1|fc1)_kernel$",

@@ -53,6 +53,12 @@ _SLOW_TESTS = {
     "test_pipeline_parallel.py::test_dropout_runs_under_pipeline",
     "test_pipeline_parallel.py::test_non_dividing_microbatches_degrade_to_gcd",
     "test_pipeline_parallel.py::test_hf_checkpoint_loads_into_pipelined_model",
+    "test_pipeline_parallel.py::test_llama_pipelined_matches_dense_forward",
+    "test_pipeline_parallel.py::test_llama_qwen2_bias_pipelined_matches_dense_forward",
+    "test_pipeline_parallel.py::test_llama_pipelined_grads_match_dense",
+    "test_pipeline_parallel.py::test_llama_hf_checkpoint_roundtrips_through_pipelined",
+    "test_pipeline_parallel.py::test_llama_pipelined_decode_raises",
+    "test_pipeline_parallel.py::test_llama_pp_mesh_training_matches_single_device",
     "test_moe.py::test_ep_with_tp_matches_single_device",
     "test_moe.py::test_ep_sharded_matches_single_device",
     "test_moe.py::test_aux_loss_reaches_training_loss",

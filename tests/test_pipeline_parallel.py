@@ -758,3 +758,202 @@ def test_t5_pipelined_rejects_ring_attention():
     model = T5ForConditionalGeneration(cfg)
     with pytest.raises(ValueError, match="ring"):
         init_params(model, cfg)
+
+
+# --- Llama family (models/pipeline.py::PipelinedLlamaStack) -----------------
+
+
+def _llama_cfg(pp=0, **kw):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import LlamaConfig
+
+    base = dict(vocab_size=256, hidden_size=32, num_layers=L, num_heads=4,
+                num_kv_heads=2, intermediate_size=64,
+                max_position_embeddings=SEQ, pipeline_stages=pp)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+def _llama_pair(**kw):
+    """(dense model+params, pipelined model+params, SAME weights)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+        llama_layer_leaves,
+    )
+
+    dense_cfg = _llama_cfg(pp=0, **kw)
+    dense = LlamaForCausalLM(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+
+    pp_cfg = _llama_cfg(pp=2, pipeline_microbatches=4, **kw)
+    piped = LlamaForCausalLM(pp_cfg)
+    pp_params = init_params(piped, pp_cfg)
+    bb = dense_params["backbone"]
+    leaves = llama_layer_leaves(dense_cfg.qkv_bias)
+    pp_params["backbone"]["pipelined_layers"] = jax.tree.map(
+        jnp.asarray,
+        stack_layer_params({k: bb[k] for k in bb if k.startswith("layers_")},
+                           L, leaves, "layers_{}"))
+    for key in ("embed_tokens", "final_ln"):
+        pp_params["backbone"][key] = bb[key]
+    if "lm_head" in dense_params:
+        pp_params["lm_head"] = dense_params["lm_head"]
+    return dense, dense_params, piped, pp_params
+
+
+def test_llama_pipelined_matches_dense_forward():
+    dense, dense_params, piped, pp_params = _llama_pair()
+    ids, mask = _inputs()
+    out_dense = dense.apply({"params": dense_params}, ids, mask,
+                            deterministic=True)
+    out_pp = piped.apply({"params": pp_params}, ids, mask,
+                         deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=1e-5)
+
+
+def test_llama_qwen2_bias_pipelined_matches_dense_forward():
+    """qkv_bias=True (Qwen2) adds bias leaves to the stacked tree."""
+    dense, dense_params, piped, pp_params = _llama_pair(qkv_bias=True)
+    ids, mask = _inputs()
+    out_dense = dense.apply({"params": dense_params}, ids, mask,
+                            deterministic=True)
+    out_pp = piped.apply({"params": pp_params}, ids, mask,
+                         deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_pp), np.asarray(out_dense),
+                               atol=1e-5)
+
+
+def test_llama_pipelined_grads_match_dense():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+        llama_layer_leaves,
+    )
+
+    dense, dense_params, piped, pp_params = _llama_pair()
+    ids, mask = _inputs()
+
+    def loss_dense(p):
+        return jnp.mean(dense.apply({"params": p}, ids, mask,
+                                    deterministic=True) ** 2)
+
+    def loss_pp(p):
+        return jnp.mean(piped.apply({"params": p}, ids, mask,
+                                    deterministic=True) ** 2)
+
+    g_dense = jax.grad(loss_dense)(dense_params)
+    g_pp = jax.grad(loss_pp)(pp_params)
+    leaves = llama_layer_leaves(False)
+    g_layers = unstack_layer_params(
+        jax.tree.map(np.asarray, g_pp["backbone"]["pipelined_layers"]), L,
+        leaves, "layers_{}")
+    for i in range(L):
+        for sub, leaf in (("self_attn", "q_proj"), ("mlp", "down_proj")):
+            np.testing.assert_allclose(
+                g_layers[f"layers_{i}"][sub][leaf]["kernel"],
+                np.asarray(g_dense["backbone"][f"layers_{i}"][sub][leaf]["kernel"]),
+                atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(g_pp["backbone"]["embed_tokens"]["embedding"]),
+        np.asarray(g_dense["backbone"]["embed_tokens"]["embedding"]),
+        atol=2e-4)
+
+
+def test_llama_hf_checkpoint_roundtrips_through_pipelined(tmp_path):
+    """dense export → pipelined load (stacked weights match) → pipelined
+    export → dense load (weights survive the full cycle)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.pipeline import (
+        llama_layer_leaves,
+    )
+
+    dense_cfg = _llama_cfg()
+    dense = LlamaForCausalLM(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+    out = str(tmp_path / "llama-dense")
+    auto_models.save_pretrained(out, dense_params, "llama", dense_cfg)
+
+    model, params, fam, cfg = auto_models.from_pretrained(
+        out, task="causal-lm", pipeline_stages=2)
+    assert fam == "llama" and cfg.pipeline_stages == 2
+    bb = dense_params["backbone"]
+    leaves = llama_layer_leaves(False)
+    stacked = stack_layer_params(
+        {k: bb[k] for k in bb if k.startswith("layers_")}, L, leaves,
+        "layers_{}")
+    for name, arr in stacked.items():
+        np.testing.assert_allclose(
+            np.asarray(params["backbone"]["pipelined_layers"][name]), arr,
+            atol=1e-6)
+
+    out2 = str(tmp_path / "llama-pp-export")
+    auto_models.save_pretrained(out2, params, "llama", cfg)
+    _, dense2, _, cfg2 = auto_models.from_pretrained(out2, task="causal-lm")
+    assert cfg2.pipeline_stages == 0
+    np.testing.assert_allclose(
+        np.asarray(dense2["backbone"]["layers_0"]["self_attn"]["q_proj"]["kernel"]),
+        np.asarray(bb["layers_0"]["self_attn"]["q_proj"]["kernel"]), atol=1e-6)
+
+
+def test_llama_pipelined_invalid_combos_raise():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+    )
+
+    for kw, msg in ((dict(sliding_window=8), "sliding_window"),
+                    (dict(attention_impl="ring"), "ring"),
+                    (dict(weight_quant="int8"), "weight_quant")):
+        cfg = _llama_cfg(pp=2, **kw)
+        model = LlamaForCausalLM(cfg)
+        with pytest.raises(ValueError, match=msg):
+            init_params(model, cfg)
+
+
+def test_llama_pipelined_decode_raises():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+    )
+
+    cfg = _llama_cfg(pp=2)
+    model = LlamaForCausalLM(cfg)
+    params = init_params(model, cfg)
+    ids, mask = _inputs(batch=2)
+    with pytest.raises(ValueError, match="decode"):
+        model.apply({"params": params}, ids, mask, decode=True,
+                    mutable=["cache"])
+
+
+def test_llama_pp_mesh_training_matches_single_device(devices8):
+    """dp2×pp2×tp2 causal-lm training = single-device pipelined training."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaForCausalLM,
+    )
+
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(32, seed=3)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=SEQ)
+
+    def run(mesh_cfg, devices):
+        mesh = build_mesh(mesh_cfg, devices=devices)
+        cfg = TrainConfig(task="causal-lm", dtype="float32",
+                          learning_rate=1e-3, scale_lr_by_world_size=False,
+                          log_every_steps=0, rng_impl="threefry")
+        model_cfg = _llama_cfg(pp=2)
+        model = LlamaForCausalLM(model_cfg)
+        params = init_params(model, model_cfg)
+        trainer = Trainer(cfg, model, params, mesh)
+        batcher = ShardedBatcher(ds, 8, mesh, shuffle=False)
+        losses = []
+        for step, batch in enumerate(batcher.global_arrays(0)):
+            if step >= 4:
+                break
+            trainer.state, m = trainer._train_step(trainer.state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    single = run(MeshConfig(), devices8[:1])
+    sharded = run(MeshConfig(dp=2, pp=2, tp=2), devices8)
+    np.testing.assert_allclose(sharded, single, atol=3e-5)
