@@ -235,7 +235,8 @@ _HF_CONFIG_EXPORTERS = {
         "architectures": [{"llama": "LlamaForCausalLM",
                            "mistral": "MistralForCausalLM",
                            "qwen2": "Qwen2ForCausalLM",
-                           "gemma": "GemmaForCausalLM"}[c.model_type]],
+                           "gemma": "GemmaForCausalLM",
+                           "mixtral": "MixtralForCausalLM"}[c.model_type]],
         "vocab_size": c.vocab_size, "hidden_size": c.hidden_size,
         "num_hidden_layers": c.num_layers,
         "num_attention_heads": c.num_heads,
@@ -250,6 +251,15 @@ _HF_CONFIG_EXPORTERS = {
         "initializer_range": c.initializer_range,
         **({"sliding_window": c.sliding_window} if c.model_type == "mistral"
            else {}),
+        **({"sliding_window": c.sliding_window,
+            "num_local_experts": c.num_experts,
+            "num_experts_per_tok": c.expert_top_k,
+            "router_aux_loss_coef": c.router_aux_coef,
+            # framework knobs HF Mixtral has no fields for (extra keys
+            # are legal in config.json; the builder reads them back)
+            "moe_every": c.moe_every,
+            "expert_capacity_factor": c.expert_capacity_factor}
+           if c.model_type == "mixtral" else {}),
         **({"sliding_window": c.sliding_window or 4096,
             "use_sliding_window": c.sliding_window is not None,
             "max_window_layers": c.sliding_window_start_layer}
@@ -283,8 +293,8 @@ _HF_CONFIG_EXPORTERS = {
 
 # families whose Encoder stack supports per-layer MoE FFNs / pipelining
 # (T5 has its own blocks; ALBERT shares one layer across the stack)
-_MOE_FAMILIES = ("bert", "roberta", "distilbert", "electra", "gpt2")
-_PIPELINE_FAMILIES = _MOE_FAMILIES + ("t5", "bart", "mbart", "llama")
+_MOE_FAMILIES = ("bert", "roberta", "distilbert", "electra", "gpt2", "llama")
+_PIPELINE_FAMILIES = _MOE_FAMILIES + ("t5", "bart", "mbart")
 
 _MOE_CONFIG_KEYS = ("num_experts", "expert_top_k", "moe_every",
                     "expert_capacity_factor", "router_aux_coef")
@@ -301,6 +311,9 @@ _FAMILY_ALIASES = {
     "mistral": "llama",
     "qwen2": "llama",
     "gemma": "llama",
+    # Mixtral = Mistral attention + a SwiGLU expert bank per layer; the
+    # config builder reads the MoE shape off the original model_type
+    "mixtral": "llama",
 }
 
 
@@ -556,10 +569,13 @@ def save_pretrained(output_dir: str, params: Any, family: str, config: EncoderCo
     save_file(state, os.path.join(output_dir, "model.safetensors"),
               metadata={"format": "pt"})
     cfg_dict = _HF_CONFIG_EXPORTERS[family](config)
-    if getattr(config, "num_experts", 0):
+    if getattr(config, "num_experts", 0) and family != "llama":
         # expert/router weights have no HF-layout counterpart: persist
         # them in a sidecar under native paths, and record the MoE shape
-        # in config.json so from_pretrained rebuilds the expert bank
+        # in config.json so from_pretrained rebuilds the expert bank.
+        # (Mixtral/llama is the exception: HF DOES define an expert
+        # layout, so params_to_hf exports the bank into
+        # model.safetensors directly — no sidecar.)
         moe_state = {k: np.ascontiguousarray(v)
                      for k, v in _flatten_params(params).items()
                      if "/moe/" in k}

@@ -344,10 +344,64 @@ def translate_key(torch_key: str, family: str) -> str | None:
     return None
 
 
+_MIXTRAL_GATE_RE = re.compile(
+    r"^model\.layers\.(\d+)\.block_sparse_moe\.gate\.weight$")
+_MIXTRAL_EXPERT_RE = re.compile(
+    r"^model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)"
+    r"\.w([123])\.weight$")
+
+
+def _fold_mixtral_moe(state_dict: dict, nested: dict) -> None:
+    """HF Mixtral MoE weights → the expert-stacked tree of
+    ``MixtralMoeBlock`` (models/moe.py): per-expert ``w{1,2,3}.weight``
+    Linears [out, in] stack into [E, in, out]; the fp32 router
+    ``gate.weight`` [E, H] transposes to our [H, E]."""
+    experts: dict = {}
+    for key, value in state_dict.items():
+        m = _MIXTRAL_GATE_RE.match(key)
+        if m:
+            moe = nested.setdefault("backbone", {}).setdefault(
+                f"layers_{m.group(1)}", {}).setdefault("moe", {})
+            moe["router"] = np.asarray(value).T
+            continue
+        m = _MIXTRAL_EXPERT_RE.match(key)
+        if m:
+            layer, j, w = int(m.group(1)), int(m.group(2)), m.group(3)
+            experts.setdefault((layer, w), {})[j] = np.asarray(value)
+    for (layer, w), by_j in experts.items():
+        stacked = np.stack([by_j[j].T for j in range(len(by_j))], axis=0)
+        moe = nested.setdefault("backbone", {}).setdefault(
+            f"layers_{layer}", {}).setdefault("moe", {})
+        moe[f"w{w}"] = stacked
+
+
+_MIXTRAL_PARAM_RE = re.compile(
+    r"^backbone/layers_(\d+)/moe/(router|w[123])$")
+
+
+def _mixtral_moe_to_hf(flat: dict) -> dict[str, np.ndarray]:
+    """Inverse of :func:`_fold_mixtral_moe` — consumes the matching
+    entries from ``flat`` and returns their HF-layout keys."""
+    out: dict[str, np.ndarray] = {}
+    for path in [p for p in flat if _MIXTRAL_PARAM_RE.match(p)]:
+        m = _MIXTRAL_PARAM_RE.match(path)
+        layer, name = m.group(1), m.group(2)
+        value = flat.pop(path)
+        prefix = f"model.layers.{layer}.block_sparse_moe"
+        if name == "router":
+            out[f"{prefix}.gate.weight"] = value.T
+        else:
+            for j in range(value.shape[0]):
+                out[f"{prefix}.experts.{j}.{name}.weight"] = value[j].T
+    return out
+
+
 def hf_to_params(state_dict: dict[str, np.ndarray], family: str) -> dict:
     """Flat torch state dict → nested Flax param dict (unvalidated)."""
     nested: dict = {}
     for torch_key, value in state_dict.items():
+        if family == "llama" and "block_sparse_moe" in torch_key:
+            continue                       # folded below, expert-stacked
         path = translate_key(torch_key, family)
         if path is None:
             logger.info("convert: skipping unmapped key %s", torch_key)
@@ -362,6 +416,8 @@ def hf_to_params(state_dict: dict[str, np.ndarray], family: str) -> dict:
         for p in parts[:-1]:
             node = node.setdefault(p, {})
         node[parts[-1]] = np.asarray(value)
+    if family == "llama":
+        _fold_mixtral_moe(state_dict, nested)
     return nested
 
 
@@ -666,6 +722,8 @@ def params_to_hf(params: Any, family: str) -> dict[str, np.ndarray]:
     flatten(params, ())
 
     out: dict[str, np.ndarray] = {}
+    if family == "llama":
+        out.update(_mixtral_moe_to_hf(flat))   # pops the moe entries
     for path, value in flat.items():
         base, leaf = path.rsplit("/", 1)
         torch_stem = None

@@ -97,6 +97,16 @@ class LlamaConfig:
     # training/scoring path — generation reloads dense)
     pipeline_stages: int = 0
     pipeline_microbatches: int = 0         # 0 → = pipeline_stages
+    # Mixtral: every ``moe_every``-th block's MLP becomes a token-routed
+    # SwiGLU expert bank (models/moe.py::MixtralMoeBlock) sharded over
+    # the ``expert`` mesh axis. HF Mixtral is MoE at EVERY layer
+    # (moe_every=1, the default here); Switch-style sparse placement is
+    # moe_every=2. Router/capacity semantics match the encoder MoE.
+    num_experts: int = 0                   # num_local_experts
+    expert_top_k: int = 2                  # num_experts_per_tok
+    moe_every: int = 1
+    expert_capacity_factor: float = 1.25
+    router_aux_coef: float = 0.02          # router_aux_loss_coef
 
     @property
     def resolved_head_dim(self) -> int:
@@ -137,8 +147,23 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
             window = None
     else:
         qkv_bias = False
+        # Mixtral is a Mistral derivative: same optional sliding window
         window = (hf_config.get("sliding_window")
-                  if mt == "mistral" else None)
+                  if mt in ("mistral", "mixtral") else None)
+    if mt == "mixtral":
+        extra = dict(
+            num_experts=hf_config["num_local_experts"],
+            expert_top_k=hf_config.get("num_experts_per_tok", 2),
+            # HF Mixtral: MoE at every layer; our exports persist a
+            # sparser placement (+ the capacity factor, a framework
+            # knob HF has no field for) as extra config.json keys
+            moe_every=hf_config.get("moe_every", 1),
+            # HF MixtralConfig default (0.001), NOT our field default:
+            # a missing key must not silently 20x the aux penalty
+            router_aux_coef=hf_config.get("router_aux_loss_coef", 0.001),
+            expert_capacity_factor=hf_config.get("expert_capacity_factor",
+                                                 1.25),
+        )
     if hf_config.get("attention_bias") or hf_config.get("mlp_bias"):
         raise ValueError(
             "attention_bias/mlp_bias=true (biased projections under "
@@ -182,6 +207,23 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
     )
     kw.update(overrides)
     kw.pop("use_pooler", None)             # encoder-family knob
+    if kw.get("num_experts") and kw["model_type"] != "mixtral":
+        # MoE-upcycling a dense checkpoint (num_experts override): the
+        # only HF layout that can carry the expert bank is Mixtral's, so
+        # the config must round-trip as model_type 'mixtral' — otherwise
+        # save_pretrained would write block_sparse_moe.* weights next to
+        # a config.json that rebuilds a DENSE model, and the trained
+        # experts would silently vanish on reload. Llama and Mistral are
+        # layout-compatible (Mixtral IS Mistral attention + experts);
+        # Qwen2/Gemma variants have knobs Mixtral's layout can't express.
+        if kw["model_type"] in ("llama", "mistral"):
+            kw["model_type"] = "mixtral"
+        else:
+            raise ValueError(
+                f"num_experts > 0 is not supported for model_type "
+                f"{kw['model_type']!r}: the MoE export layout is HF "
+                "Mixtral's, which cannot express qkv biases / Gemma "
+                "norm semantics — upcycle a llama or mistral checkpoint")
     return LlamaConfig(**kw)
 
 
@@ -348,10 +390,15 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
     use_window: bool = False
     kernel_window: bool = False
+    layer_index: int = 0
 
     @nn.compact
     def __call__(self, hidden, masks=None, rope=None, position_ids=None,
                  deterministic: bool = True, decode: bool = False):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.layers import (
+            is_moe_layer,
+        )
+
         cfg = self.config
         plain, banded = masks if isinstance(masks, tuple) else (masks, None)
         attn_mask = banded if (self.use_window and banded is not None) \
@@ -362,8 +409,15 @@ class LlamaBlock(nn.Module):
             LlamaRMSNorm(cfg, name="input_ln")(hidden), attn_mask,
             rope, position_ids, deterministic, decode)
         hidden = hidden + attn
-        mlp = LlamaMlp(cfg, name="mlp")(
-            LlamaRMSNorm(cfg, name="post_attn_ln")(hidden))
+        normed = LlamaRMSNorm(cfg, name="post_attn_ln")(hidden)
+        if cfg.num_experts and is_moe_layer(cfg, self.layer_index):
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.moe import (
+                MixtralMoeBlock,
+            )
+
+            mlp = MixtralMoeBlock(cfg, name="moe")(normed, deterministic)
+        else:
+            mlp = LlamaMlp(cfg, name="mlp")(normed)
         return hidden + mlp
 
 
@@ -437,6 +491,9 @@ class LlamaModel(nn.Module):
                     "combine: the KV cache is stage-local state. Export "
                     "the pipelined checkpoint and reload it dense "
                     "(pipeline_stages=0) for generation")
+            if cfg.num_experts:
+                raise ValueError("pipeline_stages and num_experts cannot "
+                                 "combine (pipelined MoE is not supported)")
             if cfg.sliding_window is not None:
                 raise ValueError(
                     "pipeline_stages cannot combine with sliding_window "
@@ -473,7 +530,7 @@ class LlamaModel(nn.Module):
             windowed = (cfg.sliding_window is not None
                         and i >= cfg.sliding_window_start_layer)
             x = block_cls(cfg, use_window=windowed,
-                          kernel_window=kernel_window,
+                          kernel_window=kernel_window, layer_index=i,
                           name=f"layers_{i}")(
                 x, (additive_mask, banded_mask), rope, position_ids,
                 deterministic, decode)

@@ -50,6 +50,106 @@ def _constrain(x, *spec):
     return constrain_if_mesh(x, *spec)
 
 
+def topk_dispatch(probs, k: int, C: int, causal: bool):
+    """Greedy top-k routing → capacity-slot dispatch, shared by every
+    MoE flavor (Switch/GShard encoder FFN and Mixtral SwiGLU).
+
+    Returns ``(combine [B,S,E,C] fp32, top1_mask [B,S,E])`` where
+    ``combine`` carries each kept token→slot assignment weighted by its
+    gate, normalized per token over its total selected top-k mass
+    (Mixtral/HF convention — capacity-dropped choices keep zero
+    dispatch and the token rides the residual). Slot priority is
+    round-major (GShard) or position-major (``causal=True``, see
+    ``MoeFeedForward`` docstring for why causal LMs need it).
+    """
+    B, S, E = probs.shape
+    remaining = probs
+    masks, gates = [], []
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                   # [B,S]
+        mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)       # [B,S,E]
+        gates.append(jnp.sum(remaining * mask, axis=-1))       # [B,S]
+        remaining = remaining * (1.0 - mask)
+        masks.append(mask)
+    top1_mask = masks[0]
+
+    if causal:
+        # position-major: slot = #assignments to the chosen expert
+        # from strictly-earlier tokens (any round). Rounds of one
+        # token hit distinct experts, so slots stay collision-free,
+        # and nothing about token i depends on tokens j > i.
+        total = sum(masks)                                     # [B,S,E]
+        prefix = jnp.cumsum(total, axis=1) - total
+        slot_pos = [prefix] * k
+    else:
+        # round-major (GShard): all round-r slots precede round-r+1
+        slot_pos = []
+        counts = jnp.zeros((B, E), jnp.float32)
+        for mask in masks:
+            slot_pos.append(
+                jnp.cumsum(mask, axis=1) - 1.0 + counts[:, None, :])
+            counts = counts + jnp.sum(mask, axis=1)
+
+    combine = jnp.zeros((B, S, E, C), jnp.float32)
+    gate_total = jnp.zeros((B, S), jnp.float32)
+    for mask, gate, pos in zip(masks, gates, slot_pos):
+        slot = jnp.sum(pos * mask, axis=-1)                    # [B,S]
+        kept = (slot < C) & (gate > 0.0)
+        slot_oh = jax.nn.one_hot(jnp.where(kept, slot, 0).astype(jnp.int32),
+                                 C, dtype=jnp.float32)         # [B,S,C]
+        disp = (mask[..., None] * slot_oh[:, :, None, :]
+                * kept[:, :, None, None].astype(jnp.float32))  # [B,S,E,C]
+        combine = combine + gate[:, :, None, None] * disp
+        gate_total = gate_total + gate
+
+    denom = jnp.where(gate_total > 0.0, gate_total, 1.0)
+    return combine / denom[:, :, None, None], top1_mask
+
+
+def _route_and_dispatch(module: nn.Module, hidden, cfg, causal: bool):
+    """The scaffolding every MoE flavor shares: fp32 router + softmax,
+    :func:`topk_dispatch`, the Switch aux-loss sow, and the token→expert
+    all-to-all (dispatch einsum + expert-major sharding constraint).
+    Returns ``(expert_in [E,B,C,H], combine [B,S,E,C] fp32,
+    non_expert_axes)``; the caller runs its expert FFN on ``expert_in``
+    and combines with ``combine``. One implementation so router
+    precision, the aux formula, and the sharding constraints cannot
+    drift between the encoder MoE and Mixtral."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+        AXIS_EXPERT,
+        data_axis_names,
+    )
+
+    E, k = cfg.num_experts, cfg.expert_top_k
+    _, S, H = hidden.shape
+    C = expert_capacity(cfg, S)
+
+    router = module.param(
+        "router", nn.initializers.normal(cfg.initializer_range), (H, E),
+        jnp.float32)
+    # fp32 router: logits/softmax precision decides routing stability
+    logits = jnp.einsum("bsh,he->bse", hidden.astype(jnp.float32), router)
+    probs = jax.nn.softmax(logits, axis=-1)                    # [B,S,E]
+
+    combine, top1_mask = topk_dispatch(probs, k, C, causal)
+    dispatch = (combine > 0.0).astype(cfg.dtype)               # [B,S,E,C]
+
+    # Switch load-balance loss (top-1 fractions × mean probs)
+    frac = jnp.mean(top1_mask, axis=(0, 1))                    # [E]
+    mean_prob = jnp.mean(probs, axis=(0, 1))                   # [E]
+    aux = cfg.router_aux_coef * E * jnp.sum(frac * mean_prob)
+    module.sow("losses", "moe_aux", aux)
+
+    # [E,B,C,H]: E sharded over ``expert``, B over the other data
+    # axes — the resharding from token-major is the all-to-all
+    non_expert_axes = tuple(a for a in data_axis_names()
+                            if a != AXIS_EXPERT)
+    expert_in = jnp.einsum("bsec,bsh->ebch", dispatch,
+                           hidden.astype(cfg.dtype))
+    expert_in = _constrain(expert_in, AXIS_EXPERT, non_expert_axes)
+    return expert_in, combine, non_expert_axes
+
+
 class MoeFeedForward(nn.Module):
     """Drop-in replacement for ``FeedForward`` on MoE layers.
 
@@ -85,87 +185,15 @@ class MoeFeedForward(nn.Module):
     def __call__(self, hidden, deterministic: bool = True):
         from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
             AXIS_EXPERT,
-            AXIS_FSDP,
-            AXIS_TENSOR,
-            data_axis_names,
         )
 
         cfg = self.config
-        E, k = cfg.num_experts, cfg.expert_top_k
-        B, S, H = hidden.shape
+        E = cfg.num_experts
+        _, _, H = hidden.shape
         F = cfg.intermediate_size
-        C = expert_capacity(cfg, S)
-        batch_axes = data_axis_names()
 
-        router = self.param(
-            "router", nn.initializers.normal(cfg.initializer_range), (H, E),
-            jnp.float32)
-        # fp32 router: logits/softmax precision decides routing stability
-        logits = jnp.einsum("bsh,he->bse", hidden.astype(jnp.float32), router)
-        probs = jax.nn.softmax(logits, axis=-1)                    # [B,S,E]
-
-        # --- top-k greedy choice collection ----------------------------
-        remaining = probs
-        masks, gates = [], []
-        for _ in range(k):
-            idx = jnp.argmax(remaining, axis=-1)                   # [B,S]
-            mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)       # [B,S,E]
-            gates.append(jnp.sum(remaining * mask, axis=-1))       # [B,S]
-            remaining = remaining * (1.0 - mask)
-            masks.append(mask)
-        top1_mask = masks[0]
-
-        # --- capacity-slot assignment ----------------------------------
-        if self.causal:
-            # position-major: slot = #assignments to the chosen expert
-            # from strictly-earlier tokens (any round). Rounds of one
-            # token hit distinct experts, so slots stay collision-free,
-            # and nothing about token i depends on tokens j > i.
-            total = sum(masks)                                     # [B,S,E]
-            prefix = jnp.cumsum(total, axis=1) - total
-            slot_pos = [prefix] * k
-        else:
-            # round-major (GShard): all round-r slots precede round-r+1
-            slot_pos = []
-            counts = jnp.zeros((B, E), jnp.float32)
-            for mask in masks:
-                slot_pos.append(
-                    jnp.cumsum(mask, axis=1) - 1.0 + counts[:, None, :])
-                counts = counts + jnp.sum(mask, axis=1)
-
-        combine = jnp.zeros((B, S, E, C), jnp.float32)
-        gate_total = jnp.zeros((B, S), jnp.float32)
-        for mask, gate, pos in zip(masks, gates, slot_pos):
-            slot = jnp.sum(pos * mask, axis=-1)                    # [B,S]
-            kept = (slot < C) & (gate > 0.0)
-            slot_oh = jax.nn.one_hot(jnp.where(kept, slot, 0).astype(jnp.int32),
-                                     C, dtype=jnp.float32)         # [B,S,C]
-            disp = (mask[..., None] * slot_oh[:, :, None, :]
-                    * kept[:, :, None, None].astype(jnp.float32))  # [B,S,E,C]
-            combine = combine + gate[:, :, None, None] * disp
-            gate_total = gate_total + gate
-
-        # normalize each token's gates over its total selected top-k mass
-        # (Mixtral/HF convention); capacity-dropped choices simply keep
-        # their zero dispatch, and a token with every choice dropped
-        # contributes 0 and rides the residual connection
-        denom = jnp.where(gate_total > 0.0, gate_total, 1.0)
-        combine = combine / denom[:, :, None, None]
-        dispatch = (combine > 0.0).astype(cfg.dtype)               # [B,S,E,C]
-
-        # --- Switch load-balance loss (top-1 fractions × mean probs) ---
-        frac = jnp.mean(top1_mask, axis=(0, 1))                    # [E]
-        mean_prob = jnp.mean(probs, axis=(0, 1))                   # [E]
-        aux = cfg.router_aux_coef * E * jnp.sum(frac * mean_prob)
-        self.sow("losses", "moe_aux", aux)
-
-        # --- dispatch → expert FFN → combine ---------------------------
-        x = hidden.astype(cfg.dtype)
-        # [E,B,C,H]: E sharded over ``expert``, B over the other data
-        # axes — the resharding from token-major is the all-to-all
-        non_expert_axes = tuple(a for a in batch_axes if a != AXIS_EXPERT)
-        expert_in = jnp.einsum("bsec,bsh->ebch", dispatch, x)
-        expert_in = _constrain(expert_in, AXIS_EXPERT, non_expert_axes)
+        expert_in, combine, non_expert_axes = _route_and_dispatch(
+            self, hidden, cfg, self.causal)
 
         wi = self.param("wi", nn.initializers.normal(cfg.initializer_range),
                         (E, H, F), cfg.param_dtype)
@@ -183,3 +211,55 @@ class MoeFeedForward(nn.Module):
         y = jnp.einsum("bsec,ebch->bsh", combine.astype(cfg.dtype), out)
         y = nn.Dropout(cfg.hidden_dropout)(y, deterministic=deterministic)
         return y
+
+
+class MixtralMoeBlock(nn.Module):
+    """Mixtral-style sparse MoE for the Llama family: SwiGLU experts
+    (``w2(silu(w1 x) * w3 x)``, HF ``MixtralBlockSparseTop2MLP`` naming)
+    behind the same dense-dispatch top-k router as ``MoeFeedForward``.
+
+    HF parity notes (``MixtralSparseMoeBlock``):
+    - the router (``gate``) computes in fp32 and gates are the full
+      softmax renormalized over the selected top-k (HF's
+      ``routing_weights /= routing_weights.sum``) — exactly what
+      ``topk_dispatch`` produces;
+    - HF processes every routed token; this block keeps the framework's
+      static expert capacity (GShard semantics), so over-capacity tokens
+      ride the residual during training — at parity-test capacity
+      (factor >= E/k) the two are numerically identical;
+    - slot priority is always position-major (``causal=True``): this is
+      a causal-LM family, and round-major priority leaks future-token
+      information through the capacity drop pattern (see
+      ``MoeFeedForward`` docstring).
+
+    No dropout (the Llama family has none). The Switch aux loss sows
+    into ``losses`` like the encoder MoE.
+    """
+
+    config: object  # LlamaConfig (annotated loosely to avoid a cycle)
+
+    @nn.compact
+    def __call__(self, hidden, deterministic: bool = True):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+            AXIS_EXPERT,
+        )
+
+        cfg = self.config
+        E = cfg.num_experts
+        _, _, H = hidden.shape
+        F = cfg.intermediate_size
+
+        expert_in, combine, non_expert_axes = _route_and_dispatch(
+            self, hidden, cfg, causal=True)
+
+        init = nn.initializers.normal(cfg.initializer_range)
+        w1 = self.param("w1", init, (E, H, F), cfg.param_dtype)    # gate
+        w3 = self.param("w3", init, (E, H, F), cfg.param_dtype)    # up
+        w2 = self.param("w2", init, (E, F, H), cfg.param_dtype)    # down
+        act = ACT2FN[cfg.hidden_act]
+        g = act(jnp.einsum("ebch,ehf->ebcf", expert_in, w1.astype(cfg.dtype)))
+        u = jnp.einsum("ebch,ehf->ebcf", expert_in, w3.astype(cfg.dtype))
+        out = jnp.einsum("ebcf,efh->ebch", g * u, w2.astype(cfg.dtype))
+        out = _constrain(out, AXIS_EXPERT, non_expert_axes)
+
+        return jnp.einsum("bsec,ebch->bsh", combine.astype(cfg.dtype), out)

@@ -49,6 +49,9 @@ _PARAM_RULES: Sequence[tuple[str, tuple]] = (
     # hidden dims Megatron-style; router stays replicated (tiny, fp32)
     (r"moe/wi$", (AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR)),
     (r"moe/wo$", (AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)),
+    # Mixtral SwiGLU experts [E, in, out]: w1/w3 column-, w2 row-parallel
+    (r"moe/w[13]$", (AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR)),
+    (r"moe/w2$", (AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP)),
     (r"moe/router$", ()),
     # pipelined encoder: layer-stacked params [L, ...] — stage dim over
     # ``pipe``, then the Megatron layout on the per-layer dims. MUST

@@ -1,0 +1,260 @@
+"""Mixtral — MoE in the Llama family (models/moe.py::MixtralMoeBlock).
+
+HF torch parity (router softmax + top-2 renormalized gates + SwiGLU
+experts), checkpoint round-trip through the HF expert layout (no
+sidecar: Mixtral is the one MoE family HF defines a layout for),
+dp×ep mesh training equivalence, and the capacity/composition rules.
+
+Parity caveat: HF routes every token; our dispatch uses static GShard
+capacity. At ``expert_capacity_factor >= num_experts / top_k`` the
+capacity can never bind (a token contributes at most one assignment
+per expert), so the two are numerically identical — parity tests load
+with that override; training defaults keep the bounded capacity.
+"""
+
+import numpy as np
+import pytest
+import torch
+import transformers
+import jax
+import jax.numpy as jnp
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+)
+
+TOL = 3e-4
+NO_DROP = 4.0          # capacity factor at which dispatch never drops
+
+
+@pytest.fixture(scope="module")
+def mixtral_dir(tmp_path_factory):
+    torch.manual_seed(0)
+    cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=64, max_position_embeddings=64,
+        num_local_experts=4, num_experts_per_tok=2,
+        sliding_window=None, rms_norm_eps=1e-5,
+        bos_token_id=1, eos_token_id=2, pad_token_id=0,
+        tie_word_embeddings=False, attention_dropout=0.0)
+    d = str(tmp_path_factory.mktemp("mixtral"))
+    transformers.MixtralForCausalLM(cfg).eval().save_pretrained(d)
+    return d
+
+
+def _inputs(batch=3, seq=10, vocab=128, seed=0):
+    r = np.random.RandomState(seed)
+    ids = r.randint(3, vocab, (batch, seq))
+    mask = np.ones((batch, seq), np.int64)
+    return ids, mask
+
+
+def test_mixtral_lm_parity(mixtral_dir):
+    model, params, family, cfg = auto_models.from_pretrained(
+        mixtral_dir, task="causal-lm", expert_capacity_factor=NO_DROP)
+    assert family == "llama" and cfg.model_type == "mixtral"
+    assert cfg.num_experts == 4 and cfg.expert_top_k == 2
+    assert cfg.moe_every == 1
+    m = transformers.MixtralForCausalLM.from_pretrained(mixtral_dir).eval()
+    ids, mask = _inputs()
+    with torch.no_grad():
+        t_out = m(input_ids=torch.tensor(ids),
+                  attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids),
+                        jnp.asarray(mask), deterministic=True)
+    np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                               atol=TOL, rtol=1e-3)
+
+
+@pytest.mark.slow
+def test_mixtral_export_roundtrip(mixtral_dir, tmp_path):
+    """HF → ours → HF: the expert bank survives both conversion
+    directions and transformers reloads our export bit-compatibly."""
+    model, params, family, cfg = auto_models.from_pretrained(
+        mixtral_dir, task="causal-lm", expert_capacity_factor=NO_DROP)
+    out = str(tmp_path / "export")
+    auto_models.save_pretrained(out, params, family, cfg)
+    import os
+    assert not os.path.exists(os.path.join(out, "moe.safetensors"))
+
+    m1 = transformers.MixtralForCausalLM.from_pretrained(mixtral_dir).eval()
+    m2 = transformers.MixtralForCausalLM.from_pretrained(out).eval()
+    ids, _ = _inputs()
+    with torch.no_grad():
+        a = m1(input_ids=torch.tensor(ids)).logits.numpy()
+        b = m2(input_ids=torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(b, a, atol=1e-5)
+
+    # and back through OUR loader: the folded tree matches the original
+    _, params2, _, cfg2 = auto_models.from_pretrained(
+        out, task="causal-lm")
+    assert cfg2.num_experts == 4 and cfg2.model_type == "mixtral"
+    moe1 = params["backbone"]["layers_0"]["moe"]
+    moe2 = params2["backbone"]["layers_0"]["moe"]
+    for key in ("router", "w1", "w2", "w3"):
+        np.testing.assert_allclose(np.asarray(moe2[key]),
+                                   np.asarray(moe1[key]), atol=1e-6)
+
+
+def test_upcycle_dense_llama_roundtrips_as_mixtral(tmp_path):
+    """MoE-upcycling a dense Llama checkpoint (num_experts override)
+    coerces model_type to 'mixtral' so the expert bank survives export →
+    reload (HF Mixtral is the only layout that can carry it); Qwen2 and
+    Gemma variants are rejected (their knobs don't fit the layout)."""
+    dense_cfg = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                            num_heads=2, num_kv_heads=2,
+                            intermediate_size=32,
+                            max_position_embeddings=32)
+    dense_params = init_params(LlamaForCausalLM(dense_cfg), dense_cfg)
+    src = str(tmp_path / "dense")
+    auto_models.save_pretrained(src, dense_params, "llama", dense_cfg)
+
+    model, params, _, cfg = auto_models.from_pretrained(
+        src, task="causal-lm", num_experts=2)
+    assert cfg.model_type == "mixtral" and cfg.num_experts == 2
+    out = str(tmp_path / "upcycled")
+    auto_models.save_pretrained(out, params, "llama", cfg)
+    _, params2, _, cfg2 = auto_models.from_pretrained(out, task="causal-lm")
+    assert cfg2.num_experts == 2
+    moe1 = params["backbone"]["layers_0"]["moe"]
+    moe2 = params2["backbone"]["layers_0"]["moe"]
+    for key in ("router", "w1", "w2", "w3"):
+        np.testing.assert_allclose(np.asarray(moe2[key]),
+                                   np.asarray(moe1[key]), atol=1e-6)
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        llama_config_from_hf,
+    )
+
+    with pytest.raises(ValueError, match="Mixtral"):
+        llama_config_from_hf({"model_type": "qwen2", "vocab_size": 64,
+                              "hidden_size": 16, "num_hidden_layers": 2,
+                              "num_attention_heads": 2,
+                              "intermediate_size": 32}, num_experts=2)
+
+
+def test_mixtral_param_structure_and_moe_every():
+    """moe_every=2 places expert banks Switch-style (2nd, 4th, ...)
+    while dense MLPs keep the other layers."""
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=4,
+                      num_heads=2, num_kv_heads=2, intermediate_size=32,
+                      max_position_embeddings=32, num_experts=2,
+                      moe_every=2, model_type="mixtral")
+    params = init_params(LlamaForCausalLM(cfg), cfg)
+    bb = params["backbone"]
+    assert "moe" not in bb["layers_0"] and "mlp" in bb["layers_0"]
+    assert "moe" in bb["layers_1"] and "mlp" not in bb["layers_1"]
+    moe = bb["layers_1"]["moe"]
+    assert moe["w1"].shape == (2, 16, 32)
+    assert moe["w2"].shape == (2, 32, 16)
+    assert moe["w3"].shape == (2, 16, 32)
+    assert moe["router"].shape == (16, 2)
+    assert moe["router"].dtype == jnp.float32
+
+
+def test_mixtral_aux_loss_sowed():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                      num_heads=2, num_kv_heads=2, intermediate_size=32,
+                      max_position_embeddings=32, num_experts=2,
+                      model_type="mixtral")
+    model = LlamaForCausalLM(cfg)
+    params = init_params(model, cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(3, 64, (2, 8)))
+    _, aux = model.apply({"params": params}, ids, deterministic=True,
+                         mutable=["losses"])
+    flat = jax.tree.leaves(aux["losses"])
+    assert len(flat) == 2                  # one sow per MoE layer
+    assert all(float(v) >= 0.0 for v in flat)
+
+
+def test_mixtral_pp_rejected():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                      num_heads=2, num_kv_heads=2, intermediate_size=32,
+                      max_position_embeddings=32, num_experts=2,
+                      model_type="mixtral", pipeline_stages=2)
+    with pytest.raises(ValueError, match="num_experts"):
+        init_params(LlamaForCausalLM(cfg), cfg)
+
+
+@pytest.mark.slow
+def test_mixtral_incremental_decode_matches_full(mixtral_dir):
+    """Prefill+cached decode = full-forward argmax (no capacity drops at
+    the parity factor, so routing is identical across the two paths)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        generate_causal,
+    )
+
+    model, params, _, _ = auto_models.from_pretrained(
+        mixtral_dir, task="causal-lm", expert_capacity_factor=NO_DROP)
+    rng = np.random.RandomState(2)
+    ids = rng.randint(3, 128, (2, 6))
+    new = 4
+    got = np.asarray(generate_causal(model, params, ids,
+                                     max_new_tokens=new))
+    cur = ids.copy()
+    for _ in range(new):
+        logits = model.apply({"params": params}, jnp.asarray(cur),
+                             deterministic=True)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1))
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    want = cur[:, ids.shape[1]:]
+    for b in range(ids.shape[0]):
+        row = want[b]
+        eos = np.where(row == 2)[0]
+        upto = (eos[0] + 1) if len(eos) else new
+        np.testing.assert_array_equal(got[b, :upto], row[:upto])
+
+
+@pytest.mark.slow
+def test_mixtral_dp_ep_training_matches_single_device(devices8):
+    """dp2×ep2×tp2 Mixtral training = single-device training: routing is
+    per batch row, so sharding the batch/experts reshards the einsums
+    (all-to-alls) without changing the math."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        ShardedBatcher,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.train import Trainer
+
+    tok = WordHashTokenizer(vocab_size=256)
+    texts, _ = synthetic_text_classification(32, seed=3)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=16)
+
+    def run(mesh_cfg, devices):
+        mesh = build_mesh(mesh_cfg, devices=devices)
+        cfg = TrainConfig(task="causal-lm", dtype="float32",
+                          learning_rate=1e-3, scale_lr_by_world_size=False,
+                          log_every_steps=0, rng_impl="threefry")
+        model_cfg = LlamaConfig(
+            vocab_size=256, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, intermediate_size=64,
+            max_position_embeddings=16, num_experts=2,
+            model_type="mixtral")
+        model = LlamaForCausalLM(model_cfg)
+        params = init_params(model, model_cfg)
+        trainer = Trainer(cfg, model, params, mesh)
+        batcher = ShardedBatcher(ds, 8, mesh, shuffle=False)
+        losses = []
+        for step, batch in enumerate(batcher.global_arrays(0)):
+            if step >= 4:
+                break
+            trainer.state, m = trainer._train_step(trainer.state, batch)
+            losses.append(float(jax.device_get(m["loss"])))
+        return losses
+
+    single = run(MeshConfig(), devices8[:1])
+    sharded = run(MeshConfig(dp=2, ep=2, tp=2), devices8)
+    np.testing.assert_allclose(sharded, single, atol=3e-5)
