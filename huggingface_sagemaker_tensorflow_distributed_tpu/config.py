@@ -377,6 +377,16 @@ class TrainConfig:
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
         if self.qa_doc_stride < 0:
             raise ValueError("qa_doc_stride must be >= 0 (0 disables)")
+        if 0 < self.max_seq_length - 3 <= self.qa_doc_stride:
+            # stride is the OVERLAP between windows: when it meets or
+            # exceeds the best-case window room (empty question), every
+            # example degenerates to 1-token steps — up to one feature
+            # per context token, a quiet memory/time blowup
+            raise ValueError(
+                f"qa_doc_stride={self.qa_doc_stride} >= "
+                f"max_seq_length-3={self.max_seq_length - 3} (the maximum "
+                "context window room): windows would step 1 token at a "
+                "time; lower --qa_doc_stride or raise --max_seq_length")
         if self.lora_rank < 0:
             raise ValueError("lora_rank must be >= 0 (0 disables LoRA)")
         if self.lora_rank > 0 and self.lora_alpha <= 0:

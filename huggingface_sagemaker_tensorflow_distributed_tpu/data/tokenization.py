@@ -36,6 +36,9 @@ import numpy as np
 # [CLS] question [SEP] context-window [SEP] layout; the HF wrapper uses
 # the fast tokenizer's own overflow machinery instead.)
 
+_WARNED_STRIDE_CLAMP = False
+
+
 def _qa_windows(n_q: int, n_ctx: int, max_length: int, doc_stride: int):
     """(window_start, window_len) pairs over the context tokens.
     stride 0 → one truncated window (the pre-stride behavior); stride>0 →
@@ -51,7 +54,23 @@ def _qa_windows(n_q: int, n_ctx: int, max_length: int, doc_stride: int):
     if doc_stride <= 0 or n_ctx <= room:
         yield 0, min(n_ctx, room)
         return
-    step = max(room - doc_stride, 1)
+    step = room - doc_stride
+    if step < 1:
+        # config validation rejects stride >= max_length-3, but a long
+        # QUESTION can still shrink this example's room below the
+        # stride — make the 1-token-step degeneration visible instead
+        # of quietly emitting up to n_ctx features
+        global _WARNED_STRIDE_CLAMP
+        if not _WARNED_STRIDE_CLAMP:
+            _WARNED_STRIDE_CLAMP = True
+            import logging
+            logging.getLogger(__name__).warning(
+                "qa doc_stride %d >= window room %d (long question): "
+                "stepping 1 token per window, up to %d features for this "
+                "example — consider a smaller --qa_doc_stride or larger "
+                "--max_seq_length (warning once)",
+                doc_stride, room, n_ctx)
+        step = 1
     w = 0
     while True:
         yield w, min(room, n_ctx - w)

@@ -366,11 +366,28 @@ class WordPieceTokenizer:
             # ~chunk x actual-need instead of n x 8192 for the split
             CHUNK, HARD_CAP = 128, 8192
             parts = []
+            warned_cap = False
             for lo in range(0, n, CHUNK):
                 chunk = list(contexts[lo:lo + CHUNK])
                 cap = max(max_length,
                           min(HARD_CAP, max(len(c) for c in chunk)))
-                parts.append(self._tokenize_batch(chunk, cap))
+                part = self._tokenize_batch(chunk, cap)
+                # stride mode promises windows covering the WHOLE
+                # context; a row that FILLS a HARD_CAP-wide buffer was
+                # (in all but the exact-fit edge case) truncated there —
+                # answers past the cap become unlabeled and unfindable,
+                # so make it loud. (A char-capped buffer can't truncate:
+                # a wordpiece is >= 1 char, so tokens <= chars <= cap.)
+                if (cap == HARD_CAP and not warned_cap
+                        and int(np.max(part[4])) >= cap):
+                    warned_cap = True
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "doc-stride tokenization: a context filled the "
+                        "%d-token buffer cap and was TRUNCATED — answers "
+                        "past the cap are unreachable (warning once per "
+                        "call)", HARD_CAP)
+                parts.append(part)
             widest = max(p[0].shape[1] for p in parts)
 
             def pad_to(a, fill):

@@ -115,6 +115,27 @@ class LlamaConfig:
     # qwen2 — same state-dict layout, different config.json)
     model_type: str = "llama"
 
+    def __post_init__(self):
+        if self.num_experts and self.model_type != "mixtral":
+            # The only HF layout that can carry the expert bank is
+            # Mixtral's: with any other model_type, save_pretrained
+            # would write block_sparse_moe.* weights next to a
+            # config.json that rebuilds a DENSE model, and the trained
+            # experts would silently vanish on reload. Coerce the
+            # layout-compatible variants (Mixtral IS Mistral attention +
+            # experts); reject the ones whose knobs Mixtral's layout
+            # cannot express. Enforced HERE so directly-constructed
+            # configs get the same round-trip safety as from_pretrained.
+            if self.model_type in ("llama", "mistral"):
+                object.__setattr__(self, "model_type", "mixtral")
+            else:
+                raise ValueError(
+                    f"num_experts > 0 is not supported for model_type "
+                    f"{self.model_type!r}: the MoE export layout is HF "
+                    "Mixtral's, which cannot express qkv biases / Gemma "
+                    "norm semantics — upcycle a llama or mistral "
+                    "checkpoint")
+
 
 def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
     # silently-wrong-logits guards (repo convention: raise on unsupported
@@ -207,23 +228,10 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
     )
     kw.update(overrides)
     kw.pop("use_pooler", None)             # encoder-family knob
-    if kw.get("num_experts") and kw["model_type"] != "mixtral":
-        # MoE-upcycling a dense checkpoint (num_experts override): the
-        # only HF layout that can carry the expert bank is Mixtral's, so
-        # the config must round-trip as model_type 'mixtral' — otherwise
-        # save_pretrained would write block_sparse_moe.* weights next to
-        # a config.json that rebuilds a DENSE model, and the trained
-        # experts would silently vanish on reload. Llama and Mistral are
-        # layout-compatible (Mixtral IS Mistral attention + experts);
-        # Qwen2/Gemma variants have knobs Mixtral's layout can't express.
-        if kw["model_type"] in ("llama", "mistral"):
-            kw["model_type"] = "mixtral"
-        else:
-            raise ValueError(
-                f"num_experts > 0 is not supported for model_type "
-                f"{kw['model_type']!r}: the MoE export layout is HF "
-                "Mixtral's, which cannot express qkv biases / Gemma "
-                "norm semantics — upcycle a llama or mistral checkpoint")
+    # MoE-upcycling (num_experts override on a dense checkpoint):
+    # LlamaConfig.__post_init__ coerces the model_type to 'mixtral' (or
+    # rejects variants Mixtral's layout can't express) so the expert
+    # bank survives the export round-trip.
     return LlamaConfig(**kw)
 
 
@@ -443,6 +451,19 @@ class LlamaModel(nn.Module):
         if position_ids is None:
             offset = 0
             if decode:
+                if cfg.sliding_window is not None and attention_mask is not None:
+                    # windowed decode banding runs in LOGICAL coordinates
+                    # (key positions from the mask cumsum); defaulted
+                    # query positions would be buffer-slot offsets, which
+                    # diverge on padded prompts — silently mis-windowing.
+                    # generate_causal always passes mask-derived
+                    # positions; require the same of any caller.
+                    raise ValueError(
+                        "decode with sliding_window and an attention_mask "
+                        "requires explicit position_ids (logical query "
+                        "positions, e.g. mask.cumsum(-1) - 1 at each "
+                        "step): defaulted buffer-slot positions would "
+                        "mis-window padded prompts")
                 is_init = self.has_variable("cache", "position_index")
                 idx = self.variable("cache", "position_index",
                                     lambda: jnp.array(0, jnp.int32))

@@ -463,3 +463,122 @@ def test_local_two_host_llama_causal_lm_job(tmp_path):
     import json as _json
     with open(os.path.join(handle.model_dir, "config.json")) as f:
         assert _json.load(f)["model_type"] == "llama"
+
+
+def _stub_gcloud_multiworker(stub_dir, n_workers=2):
+    """A stub ``gcloud`` that fans the --command= payload out to
+    ``n_workers`` local shells (TPU_WORKER_ID set like the real tpu-vm
+    ssh does per host) and exits with the first nonzero worker rc —
+    matching real gcloud's any-worker-fails behavior."""
+    gcloud = stub_dir / "gcloud"
+    gcloud.write_text(textwrap.dedent(f"""\
+        #!/bin/bash
+        for a in "$@"; do
+          case "$a" in --command=*) CMD="${{a#--command=}}";; esac
+        done
+        [ -z "$CMD" ] && {{ echo "no --command passed" >&2; exit 9; }}
+        rc=0
+        for w in $(seq 0 {n_workers - 1}); do
+          TPU_WORKER_ID=$w bash -c "$CMD" || {{ r=$?; [ $rc -eq 0 ] && rc=$r; }}
+        done
+        exit $rc
+    """))
+    gcloud.chmod(0o755)
+
+
+def test_tpu_vm_worker_subset_failure_raises_and_keeps_artifacts(
+        tmp_path, monkeypatch):
+    """One of two workers dies mid-job (nonzero ssh rc on a worker
+    subset): wait() must raise with the failure code, and the surviving
+    worker's artifacts plus the gcloud log must still be collected."""
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    _stub_gcloud_multiworker(stub_dir)
+    monkeypatch.setenv("PATH", f"{stub_dir}:{os.environ['PATH']}")
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "entry.py").write_text(textwrap.dedent("""
+        import json, os, sys
+        w = os.environ["TPU_WORKER_ID"]
+        out = os.environ["TPU_OUTPUT_DATA_DIR"]
+        os.makedirs(out, exist_ok=True)
+        with open(os.path.join(out, f"ran_w{w}.json"), "w") as f:
+            json.dump({"worker": w}, f)
+        if w == "1":
+            print("worker 1 dying mid-job", file=sys.stderr)
+            sys.exit(7)
+    """))
+
+    job = TPUJob(entry_point="entry.py", source_dir=str(src),
+                 slice_spec="v5e-16", hyperparameters={},
+                 job_root=str(tmp_path / "jobs"))
+    backend = TPUVMBackend(tpu_name="stub-slice", zone="us-x1-a",
+                           execute=True)
+    job_dir = str(tmp_path / "jobs" / "jfail")
+    os.makedirs(job_dir, exist_ok=True)
+    handle = backend.launch(job, "jfail", job_dir)
+    with pytest.raises(RuntimeError, match="failed with codes"):
+        handle.wait(timeout=60)
+    assert handle.returncodes == [7]
+    # partial artifact collection: BOTH workers' outputs exist (worker 1
+    # wrote before dying), and the gcloud log captured its last words
+    assert os.path.exists(os.path.join(handle.output_data_dir,
+                                       "ran_w0.json"))
+    assert os.path.exists(os.path.join(handle.output_data_dir,
+                                       "ran_w1.json"))
+    with open(os.path.join(job_dir, "gcloud.log")) as f:
+        assert "worker 1 dying mid-job" in f.read()
+
+
+def test_tpu_vm_all_workers_fail_first_rc_wins(tmp_path, monkeypatch):
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    _stub_gcloud_multiworker(stub_dir)
+    monkeypatch.setenv("PATH", f"{stub_dir}:{os.environ['PATH']}")
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "entry.py").write_text(
+        "import os, sys; sys.exit(3 if os.environ['TPU_WORKER_ID'] == '0'"
+        " else 5)\n")
+    job = TPUJob(entry_point="entry.py", source_dir=str(src),
+                 slice_spec="v5e-16", hyperparameters={},
+                 job_root=str(tmp_path / "jobs"))
+    backend = TPUVMBackend(tpu_name="stub-slice", zone="us-x1-a",
+                           execute=True)
+    job_dir = str(tmp_path / "jobs" / "jall")
+    os.makedirs(job_dir, exist_ok=True)
+    handle = backend.launch(job, "jall", job_dir)
+    with pytest.raises(RuntimeError, match="failed with codes"):
+        handle.wait(timeout=60)
+    assert handle.returncodes == [3]
+
+
+def test_tpu_vm_hung_worker_times_out_and_terminates(tmp_path, monkeypatch):
+    """A worker that never returns (dead VM, wedged ssh): wait(timeout)
+    must terminate the gcloud process and raise instead of blocking."""
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    _stub_gcloud_multiworker(stub_dir)
+    monkeypatch.setenv("PATH", f"{stub_dir}:{os.environ['PATH']}")
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "entry.py").write_text(
+        "import os, time\n"
+        "time.sleep(120 if os.environ['TPU_WORKER_ID'] == '1' else 0)\n")
+    job = TPUJob(entry_point="entry.py", source_dir=str(src),
+                 slice_spec="v5e-16", hyperparameters={},
+                 job_root=str(tmp_path / "jobs"))
+    backend = TPUVMBackend(tpu_name="stub-slice", zone="us-x1-a",
+                           execute=True)
+    job_dir = str(tmp_path / "jobs" / "jhang")
+    os.makedirs(job_dir, exist_ok=True)
+    import time as _time
+    t0 = _time.time()
+    handle = backend.launch(job, "jhang", job_dir)
+    with pytest.raises(subprocess.TimeoutExpired):
+        handle.wait(timeout=3)
+    assert _time.time() - t0 < 60
+    # the stub gcloud (and its hung child) must be dead after terminate
+    handle.procs[0].wait(timeout=10)
+    assert handle.procs[0].poll() is not None

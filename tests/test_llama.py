@@ -540,3 +540,25 @@ def test_independent_head_dim_outside_gemma(tmp_path):
                         jnp.asarray(mask), deterministic=True)
     np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
                                atol=TOL, rtol=1e-3)
+
+
+def test_windowed_decode_requires_position_ids_with_mask():
+    """decode + sliding_window + attention_mask without position_ids is
+    a coordinate-system mix (logical keys vs buffer-slot queries) — the
+    model refuses instead of silently mis-windowing padded prompts;
+    generate_causal always supplies mask-derived positions."""
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                      num_heads=2, num_kv_heads=2, intermediate_size=32,
+                      max_position_embeddings=32, sliding_window=4,
+                      model_type="mistral")
+    model = LlamaForCausalLM(cfg)
+    params = auto_models.init_params(model, cfg)
+    ids = jnp.ones((2, 8), jnp.int32)
+    mask = jnp.ones((2, 8), jnp.int32)
+    with pytest.raises(ValueError, match="position_ids"):
+        model.apply({"params": params}, ids, mask, decode=True,
+                    mutable=["cache"])
+    # unpadded decode (no mask) keeps working: slots == logical positions
+    out, _ = model.apply({"params": params}, ids, decode=True,
+                         mutable=["cache"])
+    assert np.all(np.isfinite(np.asarray(out)))

@@ -135,6 +135,13 @@ def test_upcycle_dense_llama_roundtrips_as_mixtral(tmp_path):
                               "hidden_size": 16, "num_hidden_layers": 2,
                               "num_attention_heads": 2,
                               "intermediate_size": 32}, num_experts=2)
+    # DIRECT construction gets the same round-trip safety (the coercion
+    # lives in LlamaConfig.__post_init__, not just the HF builder)
+    assert LlamaConfig(num_experts=2).model_type == "mixtral"
+    assert LlamaConfig(num_experts=2,
+                       model_type="mistral").model_type == "mixtral"
+    with pytest.raises(ValueError, match="Mixtral"):
+        LlamaConfig(num_experts=2, model_type="gemma")
 
 
 def test_mixtral_param_structure_and_moe_every():
