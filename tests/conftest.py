@@ -148,6 +148,19 @@ _SLOW_TESTS = {
     "test_moe.py::test_moe_params_sharded_over_expert_axis",
     "test_predict.py::test_predict_causal_lm",
     "test_predict.py::test_predict_rtd",
+    # r5 re-tier (VERDICT r4 weak #6): everything ≥3s on an idle 1-core
+    # box moves out of the gate (measured via --durations this round)
+    "test_deberta.py::test_deberta_embedding_size_and_token_types_parity",
+    "test_pallas_attention.py::test_flash_sliding_window_matches_banded_xla",
+    "test_pipeline_parallel.py::test_bart_pipelined_decode_raises",
+    "test_remat.py::test_gpt2_remat_policy_runs",
+    "test_pipeline_parallel.py::test_t5_pipelined_decode_raises",
+    "test_mixtral.py::test_mixtral_lm_parity",
+    "test_mixtral.py::test_upcycle_dense_llama_roundtrips_as_mixtral",
+    "test_convert.py::test_roundtrip_identity",   # all params
+    "test_predict.py::test_predict_with_lora_adapter",
+    "test_llama.py::test_windowed_decode_requires_position_ids_with_mask",
+    "test_gpt2.py::test_gpt2_parity_with_left_padding",
 }
 
 
