@@ -267,6 +267,8 @@ _HF_CONFIG_EXPORTERS = {
         **({"head_dim": c.resolved_head_dim,
             "hidden_activation": c.hidden_act}
            if c.model_type == "gemma" else {}),
+        **({"rope_scaling": c.rope_scaling_dict} if c.rope_scaling
+           else {}),
         **({"head_dim": c.head_dim} if c.head_dim is not None
            and c.model_type != "gemma" else {}),
     },

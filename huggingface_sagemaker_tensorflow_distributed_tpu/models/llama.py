@@ -93,6 +93,11 @@ class LlamaConfig:
     rms_unit_offset: bool = False
     # Gemma: embeddings multiplied by sqrt(hidden_size)
     embed_scale: bool = False
+    # Llama-3.1+ long-context RoPE frequency scaling. Stored as a sorted
+    # item tuple (NOT the HF dict) so the frozen config stays hashable;
+    # ``rope_scaling_dict`` rebuilds the mapping. Supported rope_types:
+    # "llama3" (NTK-by-parts smoothing) and "linear" (inv_freq/factor).
+    rope_scaling: Optional[tuple] = None
     # GPipe pipeline parallelism over the block stack (models/pipeline.py;
     # training/scoring path — generation reloads dense)
     pipeline_stages: int = 0
@@ -111,6 +116,10 @@ class LlamaConfig:
     @property
     def resolved_head_dim(self) -> int:
         return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def rope_scaling_dict(self) -> Optional[dict]:
+        return dict(self.rope_scaling) if self.rope_scaling else None
     # which HF model_type this config round-trips as (llama | mistral |
     # qwen2 — same state-dict layout, different config.json)
     model_type: str = "llama"
@@ -142,11 +151,29 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
     # layouts rather than load-and-diverge, cf. the DeBERTa legacy-head
     # check in models/auto.py)
     scaling = hf_config.get("rope_scaling")
-    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
-        raise ValueError(
-            "rope_scaling (Llama-3.1+ long-context frequency scaling) is "
-            f"not implemented: {scaling!r}; loading would silently use "
-            "unscaled RoPE frequencies and diverge from HF")
+    rope_scaling = None
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type"))
+        if rope_type == "default":
+            pass
+        elif rope_type in ("linear", "llama3"):
+            required = (("factor",) if rope_type == "linear" else
+                        ("factor", "low_freq_factor", "high_freq_factor",
+                         "original_max_position_embeddings"))
+            missing = [k for k in required if k not in scaling]
+            if missing:
+                # fail at load time with names, not as a KeyError mid-jit
+                raise ValueError(
+                    f"rope_scaling type {rope_type!r} is missing required "
+                    f"keys {missing}: {scaling!r}")
+            rope_scaling = tuple(sorted(scaling.items()))
+        else:
+            # yarn/dynamic-NTK etc.: loading would silently use wrong
+            # RoPE frequencies and diverge from HF
+            raise ValueError(
+                f"rope_scaling type {rope_type!r} is not implemented "
+                "(supported: default, linear, llama3 — the Llama-3.1+ "
+                f"long-context scaling): {scaling!r}")
     mt = hf_config.get("model_type", "llama")
     window_start = 0
     extra = {}
@@ -194,7 +221,8 @@ def llama_config_from_hf(hf_config: dict, **overrides) -> LlamaConfig:
             "be silently dropped")
     kw = dict(
         model_type=mt, sliding_window=window, qkv_bias=qkv_bias,
-        sliding_window_start_layer=window_start, **extra,
+        sliding_window_start_layer=window_start, rope_scaling=rope_scaling,
+        **extra,
         vocab_size=hf_config["vocab_size"],
         hidden_size=hf_config["hidden_size"],
         num_layers=hf_config["num_hidden_layers"],
@@ -268,12 +296,45 @@ class LlamaRMSNorm(nn.Module):
         return (x32.astype(cfg.dtype) * scale.astype(cfg.dtype))
 
 
-def rope_tables(position_ids, head_dim: int, theta: float):
+def _scaled_inv_freq(inv_freq, scaling: Optional[dict]):
+    """Apply HF rope_scaling to the base inverse frequencies.
+
+    - "linear": inv_freq / factor (position interpolation);
+    - "llama3": NTK-by-parts (HF ``_compute_llama3_parameters``) — long
+      wavelengths (past the original context) are interpolated by
+      ``factor``, short ones kept, the band between ``low_freq_factor``
+      and ``high_freq_factor`` smoothly blended.
+
+    Both types have attention_factor 1.0 in HF, so cos/sin need no
+    post-scaling. Unsupported types are rejected at config build.
+    """
+    if not scaling:
+        return inv_freq
+    rope_type = scaling.get("rope_type", scaling.get("type"))
+    factor = scaling["factor"]
+    if rope_type == "linear":
+        return inv_freq / factor
+    low_f = scaling["low_freq_factor"]
+    high_f = scaling["high_freq_factor"]
+    old_len = scaling["original_max_position_embeddings"]
+    wavelen = 2.0 * jnp.pi / inv_freq
+    scaled = jnp.where(wavelen > old_len / low_f, inv_freq / factor,
+                       inv_freq)
+    smooth = (old_len / wavelen - low_f) / (high_f - low_f)
+    smoothed = (1.0 - smooth) * scaled / factor + smooth * scaled
+    mid = (wavelen >= old_len / high_f) & (wavelen <= old_len / low_f)
+    return jnp.where(mid, smoothed, scaled)
+
+
+def rope_tables(position_ids, head_dim: int, theta: float,
+                scaling: Optional[dict] = None):
     """(cos, sin) [B, 1, S, D] in HF's duplicated-half layout — computed
     ONCE per forward (they depend only on positions) and threaded to
-    every layer, as HF's rotary module does."""
+    every layer, as HF's rotary module does. ``scaling`` is the HF
+    rope_scaling mapping (``LlamaConfig.rope_scaling_dict``)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
                                            dtype=jnp.float32) / head_dim))
+    inv_freq = _scaled_inv_freq(inv_freq, scaling)
     angles = position_ids.astype(jnp.float32)[:, :, None] * inv_freq
     cos = jnp.concatenate([jnp.cos(angles)] * 2, axis=-1)[:, None]
     sin = jnp.concatenate([jnp.sin(angles)] * 2, axis=-1)[:, None]
@@ -498,7 +559,7 @@ class LlamaModel(nn.Module):
             banded_mask = (band_mask if additive_mask is None
                            else additive_mask + band_mask)
         rope = rope_tables(position_ids, cfg.resolved_head_dim,
-                           cfg.rope_theta)
+                           cfg.rope_theta, cfg.rope_scaling_dict)
 
         x = embed(input_ids)
         if cfg.embed_scale:

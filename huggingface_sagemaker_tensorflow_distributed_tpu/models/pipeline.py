@@ -500,7 +500,7 @@ class PipelinedLlamaStack(nn.Module):
         # microbatch-invariant: default positions are arange for every
         # row, so the [1, 1, S, D] tables broadcast over each microbatch
         rope = rope_tables(jnp.arange(S)[None, :], cfg.resolved_head_dim,
-                           cfg.rope_theta)
+                           cfg.rope_theta, cfg.rope_scaling_dict)
         block = LlamaBlock(cfg)
 
         def stage_fn(p_stage, x, m, key):

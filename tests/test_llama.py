@@ -562,3 +562,56 @@ def test_windowed_decode_requires_position_ids_with_mask():
     out, _ = model.apply({"params": params}, ids, decode=True,
                          mutable=["cache"])
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+@pytest.mark.parametrize("scaling", [
+    {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+     "high_freq_factor": 4.0, "original_max_position_embeddings": 16},
+    {"rope_type": "linear", "factor": 4.0},
+], ids=["llama3", "linear"])
+def test_rope_scaling_parity(tmp_path, scaling):
+    """Llama-3.1-style rope_scaling (NTK-by-parts) and linear position
+    interpolation match HF logits — positions past the ORIGINAL context
+    included, which is where the scaled frequencies actually differ."""
+    torch.manual_seed(0)
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=64, max_position_embeddings=64,
+        rope_scaling=dict(scaling), rms_norm_eps=1e-5,
+        bos_token_id=1, eos_token_id=2, pad_token_id=0,
+        tie_word_embeddings=False, attention_dropout=0.0)
+    d = str(tmp_path / "scaled")
+    transformers.LlamaForCausalLM(cfg).eval().save_pretrained(d)
+
+    model, params, _, mcfg = auto_models.from_pretrained(d, task="causal-lm")
+    assert mcfg.rope_scaling_dict["factor"] == scaling["factor"]
+    ids, mask = _inputs(seq=32)    # past original_max_position_embeddings
+    m = transformers.LlamaForCausalLM.from_pretrained(d).eval()
+    with torch.no_grad():
+        t_out = m(input_ids=torch.tensor(ids),
+                  attention_mask=torch.tensor(mask))
+    j_out = model.apply({"params": params}, jnp.asarray(ids),
+                        jnp.asarray(mask), deterministic=True)
+    np.testing.assert_allclose(np.asarray(j_out), t_out.logits.numpy(),
+                               atol=TOL, rtol=1e-3)
+
+    # export round-trips the scaling config
+    out = str(tmp_path / "export")
+    auto_models.save_pretrained(out, params, "llama", mcfg)
+    _, _, _, cfg2 = auto_models.from_pretrained(out, task="causal-lm")
+    assert cfg2.rope_scaling_dict == mcfg.rope_scaling_dict
+
+
+def test_rope_scaling_unknown_type_rejected(tmp_path):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        llama_config_from_hf,
+    )
+
+    with pytest.raises(ValueError, match="yarn"):
+        llama_config_from_hf({"model_type": "llama", "vocab_size": 64,
+                              "hidden_size": 16, "num_hidden_layers": 1,
+                              "num_attention_heads": 2,
+                              "intermediate_size": 32,
+                              "rope_scaling": {"rope_type": "yarn",
+                                               "factor": 2.0}})
