@@ -161,6 +161,7 @@ _SLOW_TESTS = {
     "test_predict.py::test_predict_with_lora_adapter",
     "test_llama.py::test_windowed_decode_requires_position_ids_with_mask",
     "test_gpt2.py::test_gpt2_parity_with_left_padding",
+    "test_ring_attention.py::test_llama_train_step_with_ring_attention",
 }
 
 
