@@ -23,6 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -359,3 +360,223 @@ def beam_search_generate(model, params, input_ids, attention_mask=None,
                                    int(num_beams), int(max_new_tokens),
                                    jnp.float32(length_penalty))
     return (ids, scores) if return_scores else ids
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (draft + verify)
+# ---------------------------------------------------------------------------
+
+
+def _rewind_cache(cache, n):
+    """Decode cache with every write index set to ``n`` (traced scalar).
+
+    Stale K/V entries at slots >= n stay in the buffers, but the decode
+    step mask is built from SLOT indices (``key_pos <= qry_pos``), so
+    queries issued after the rewind can never attend to them, and the
+    next writes overwrite them in place — rewinding is O(1), no buffer
+    copy."""
+    def fix(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("cache_index", "position_index"):
+            return jnp.asarray(n, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "draft_model",
+                                             "max_new_tokens",
+                                             "speculate_k"))
+def _speculative_jit(model, params, draft_model, draft_params, input_ids,
+                     prompt_mask, max_new_tokens, speculate_k):
+    """Greedy speculative decode, exact target semantics (docstring of
+    :func:`generate_speculative`). All shapes static: the draft scan is
+    always ``k`` steps, the verify pass always ``k+1`` tokens, and the
+    while_loop carries a fixed-size output buffer with ``k+1`` slack so
+    the per-iteration window write never clamps.
+
+    ``prompt_mask`` supports RIGHT-padded prompts so callers can bucket
+    prompt lengths (one compilation per bucket, not per length): slot
+    indices (cache writes) run over the padded width, logical positions
+    (RoPE/wpe) come from the mask cumsum, and a ``valid`` kv-buffer mask
+    keeps pad and stale slots invisible to attention."""
+    cfg = model.config
+    k = speculate_k
+    B, P = input_ids.shape
+    pad = jnp.int32(cfg.pad_token_id)
+    total = P + max_new_tokens + k + 1      # cache room incl. overshoot
+
+    def alloc(m, p):
+        _, v = m.apply({"params": p}, jnp.ones((B, total), jnp.int32),
+                       decode=True, deterministic=True, mutable=["cache"])
+        return v["cache"]
+
+    t_cache, d_cache = alloc(model, params), alloc(draft_model, draft_params)
+
+    # kv-buffer validity over all slots; logical prefill positions
+    valid = jnp.concatenate(
+        [prompt_mask, jnp.zeros((B, max_new_tokens + k + 1), jnp.int32)],
+        axis=1)
+    n_real = jnp.sum(prompt_mask[0]).astype(jnp.int32)         # scalar, B=1
+    pos = jnp.clip(jnp.cumsum(prompt_mask, axis=1) - 1, 0).astype(jnp.int32)
+
+    logits, mut = model.apply(
+        {"params": params, "cache": t_cache}, input_ids, valid,
+        position_ids=pos, decode=True, deterministic=True,
+        mutable=["cache"])
+    t_cache = mut["cache"]
+    _, mut = draft_model.apply(
+        {"params": draft_params, "cache": d_cache}, input_ids, valid,
+        position_ids=pos, decode=True, deterministic=True,
+        mutable=["cache"])
+    d_cache = mut["cache"]
+
+    last_logits = lax.dynamic_index_in_dim(
+        logits[0].astype(jnp.float32), n_real - 1, axis=0, keepdims=False)
+    first = jnp.argmax(last_logits, -1).astype(jnp.int32)[None]  # [B]
+    out = jnp.full((B, max_new_tokens + k + 1), pad, jnp.int32)
+    out = out.at[:, 0].set(first)
+    state = (out, jnp.ones((), jnp.int32),                     # n_out
+             jnp.asarray(P, jnp.int32),                        # n_ctx: slots
+             n_real,                                           # n_pos: logical
+             first, t_cache, d_cache, valid,
+             (first[0] == cfg.eos_token_id))                   # finished
+
+    def cond(state):
+        n_out, finished = state[1], state[-1]
+        return (n_out < max_new_tokens) & ~finished
+
+    def body(state):
+        (out, n_out, n_ctx, n_pos, last, t_cache, d_cache, valid,
+         finished) = state
+
+        # 1. draft k greedy candidates autoregressively (its cache copy
+        #    is discarded — step 3 replays the verified window instead)
+        def dstep(carry, t):
+            tok, dc, vld = carry
+            vld = lax.dynamic_update_slice(
+                vld, jnp.ones((B, 1), jnp.int32), (0, n_ctx + t))
+            lg, m = draft_model.apply(
+                {"params": draft_params, "cache": dc}, tok[:, None], vld,
+                position_ids=jnp.full((B, 1), n_pos + t, jnp.int32),
+                decode=True, deterministic=True, mutable=["cache"])
+            nxt = jnp.argmax(lg[:, -1, :].astype(jnp.float32),
+                             -1).astype(jnp.int32)
+            return (nxt, m["cache"], vld), nxt
+
+        (_, _, _), drafts = lax.scan(dstep, (last, d_cache, valid),
+                                     jnp.arange(k))
+        drafts = drafts[:, 0]                                  # [k] (B=1)
+
+        # 2. ONE target pass over [last, d_0..d_{k-1}] verifies all k
+        #    candidates at the cost of a single decode step's HBM
+        #    traffic (weights dominate at batch 1)
+        verify_in = jnp.concatenate([last, drafts])[None]      # [1, k+1]
+        vwin = lax.dynamic_update_slice(
+            valid, jnp.ones((B, k + 1), jnp.int32), (0, n_ctx))
+        vpos = (n_pos + jnp.arange(k + 1, dtype=jnp.int32))[None]
+        lg, mut = model.apply(
+            {"params": params, "cache": t_cache}, verify_in, vwin,
+            position_ids=vpos, decode=True, deterministic=True,
+            mutable=["cache"])
+        t_pred = jnp.argmax(lg[0].astype(jnp.float32),
+                            -1).astype(jnp.int32)              # [k+1]
+
+        # longest matching prefix, then the target's own token as bonus
+        match = (drafts == t_pred[:k]).astype(jnp.int32)
+        n_acc = jnp.argmin(jnp.concatenate(
+            [match, jnp.zeros((1,), jnp.int32)]))              # first miss
+        bonus = t_pred[n_acc]
+        idx = jnp.arange(k + 1)
+        emit = jnp.where(idx < n_acc,
+                         jnp.concatenate([drafts, drafts[-1:]]), pad)
+        emit = emit.at[n_acc].set(bonus)
+        n_new = n_acc + 1
+
+        # EOS: pad everything after the first one, stop iterating
+        is_eos = (emit == cfg.eos_token_id) & (idx < n_new)
+        after = (jnp.cumsum(is_eos.astype(jnp.int32)) -
+                 is_eos.astype(jnp.int32)) > 0
+        emit = jnp.where(after, pad, emit)
+        finished = finished | jnp.any(is_eos)
+
+        out = lax.dynamic_update_slice(out, emit[None], (0, n_out))
+        new_ctx = n_ctx + n_new
+        # commit validity: accepted slots become 1, rejected stay 0
+        valid = lax.dynamic_update_slice(
+            valid, (idx < n_new).astype(jnp.int32)[None], (0, n_ctx))
+
+        # 3. commit caches: the target wrote the whole window — rewind
+        #    its index to the accepted length; the draft's scan copy is
+        #    replaced by ONE replay of the same window (idempotent
+        #    rewrites + the slot its scan never reached), then rewound
+        t_cache = _rewind_cache(mut["cache"], new_ctx)
+        _, mdr = draft_model.apply(
+            {"params": draft_params, "cache": d_cache}, verify_in, vwin,
+            position_ids=vpos, decode=True, deterministic=True,
+            mutable=["cache"])
+        d_cache = _rewind_cache(mdr["cache"], new_ctx)
+
+        return (out, n_out + n_new, new_ctx, n_pos + n_new, bonus[None],
+                t_cache, d_cache, valid, finished)
+
+    state = lax.while_loop(cond, body, state)
+    return state[0][:, :max_new_tokens]
+
+
+def generate_speculative(model, params, draft_model, draft_params,
+                         input_ids, attention_mask=None,
+                         max_new_tokens: int = 64,
+                         speculate_k: int = 4) -> jax.Array:
+    """Greedy speculative decoding: a small draft model proposes
+    ``speculate_k`` tokens autoregressively, the target model scores the
+    whole window in ONE decode pass, and the longest draft prefix that
+    matches the target's own greedy choices is accepted plus one bonus
+    token from the target. Output is EXACTLY ``generate_causal``'s
+    greedy continuation — the draft only changes how fast tokens land,
+    never which tokens (blockwise-parallel / assisted-generation
+    semantics with a greedy target).
+
+    TPU-first shape discipline: fixed-k draft scan, fixed (k+1)-token
+    verify, ``lax.while_loop`` over a static output buffer — one
+    compilation regardless of acceptance pattern. Decode at batch 1 is
+    HBM-bound on the target's weights, so verifying k+1 tokens costs
+    about the same as one, and acceptance rate × (k+1) is the speedup.
+
+    Batch 1 only (per-row acceptance divergence needs per-row cache
+    indices; the cache tracks one write index per layer). The prompt
+    may be RIGHT-padded with ``attention_mask`` marking real tokens —
+    this lets callers bucket prompt lengths so each bucket compiles
+    once instead of every distinct length retracing the two-model
+    while_loop. Works with any decoder following the slot-indexed
+    KV-cache convention (GPT-2, the whole Llama family incl. Mixtral).
+    """
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if input_ids.ndim == 1:
+        input_ids = input_ids[None]
+    if input_ids.shape[0] != 1:
+        raise ValueError(
+            f"generate_speculative is batch-1 (got batch "
+            f"{input_ids.shape[0]}): per-row acceptance divergence "
+            "would need per-row KV write indices")
+    if attention_mask is None:
+        attention_mask = jnp.ones_like(input_ids)
+    mask_np = np.asarray(attention_mask)
+    if (mask_np[:, :-1] < mask_np[:, 1:]).any():
+        raise ValueError(
+            "generate_speculative requires a RIGHT-padded prompt "
+            "(attention_mask must be non-increasing): real tokens "
+            "first, pads after")
+    if mask_np.sum() < 1:
+        raise ValueError("prompt must contain at least one real token")
+    if model.config.vocab_size != draft_model.config.vocab_size:
+        raise ValueError(
+            "draft and target must share a vocabulary (got "
+            f"{draft_model.config.vocab_size} vs "
+            f"{model.config.vocab_size})")
+    if speculate_k < 1:
+        raise ValueError("speculate_k must be >= 1")
+    return _speculative_jit(model, params, draft_model, draft_params,
+                            input_ids,
+                            jnp.asarray(attention_mask, jnp.int32),
+                            int(max_new_tokens), int(speculate_k))

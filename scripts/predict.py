@@ -162,6 +162,9 @@ def predict(args) -> list[dict]:
             generate_causal,
         )
 
+        if getattr(args, "draft_dir", None) and args.task != "causal-lm":
+            raise SystemExit("--draft_dir (speculative decoding) supports "
+                             "--task causal-lm only")
         if args.task == "seq2seq":
             if args.num_beams > 1:
                 out = beam_search_generate(model, params, ids, mask,
@@ -174,6 +177,37 @@ def predict(args) -> list[dict]:
                                temperature=args.temperature,
                                top_k=args.top_k, top_p=args.top_p,
                                seed=args.seed)
+        elif getattr(args, "draft_dir", None):
+            # speculative decoding: exact greedy output, the draft only
+            # buys speed — so it refuses knobs it would otherwise have
+            # to silently ignore
+            if args.temperature or args.top_k or args.top_p:
+                raise SystemExit(
+                    "--draft_dir is greedy-exact speculative decoding; "
+                    "it cannot combine with --temperature/--top_k/--top_p")
+            if args.num_beams > 1:
+                raise SystemExit("--draft_dir cannot combine with "
+                                 "--num_beams (speculative decode is "
+                                 "greedy)")
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+                generate_speculative,
+            )
+
+            draft_model, draft_params, _, _ = auto_models.from_pretrained(
+                args.draft_dir, task="causal-lm")
+            rows = []
+            for r in range(ids.shape[0]):   # batch-1 contract
+                # bucket the prompt width to a multiple of 32 so N rows
+                # compile at most N/32-ish distinct while_loop shapes,
+                # not one per prompt length (right-padded prompt mask)
+                n = int(np.asarray(mask[r]).sum())
+                width = min(ids.shape[1], ((n + 31) // 32) * 32)
+                rows.append(np.asarray(generate_speculative(
+                    model, params, draft_model, draft_params,
+                    ids[r:r + 1, :width], mask[r:r + 1, :width],
+                    max_new_tokens=args.max_new_tokens,
+                    speculate_k=args.speculate_k))[0])
+            out = np.stack(rows, axis=0)
         else:
             out = generate_causal(model, params, ids, mask,
                                   max_new_tokens=args.max_new_tokens,
@@ -299,6 +333,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--doc_stride", type=int, default=0,
                     help="QA: window long contexts with this token stride "
                          "instead of truncating (HF run_qa; 0 = off)")
+    ap.add_argument("--draft_dir", default=None,
+                    help="draft-model checkpoint dir for speculative "
+                         "decoding (causal-lm, greedy-exact: the draft "
+                         "changes speed, never tokens)")
+    ap.add_argument("--speculate_k", type=int, default=4,
+                    help="draft tokens per verify window (--draft_dir)")
     ap.add_argument("--quantize", choices=["none", "int8"], default="none",
                     help="int8 weight-only dense kernels for causal-lm "
                          "generation (HBM-bound decode speedup)")
