@@ -98,6 +98,12 @@ class LlamaConfig:
     # ``rope_scaling_dict`` rebuilds the mapping. Supported rope_types:
     # "llama3" (NTK-by-parts smoothing) and "linear" (inv_freq/factor).
     rope_scaling: Optional[tuple] = None
+    # Decode KV cache storage: "fp" keeps K/V in the param dtype; "int8"
+    # stores symmetric per-(head, slot) int8 with an fp32 scale — long
+    # -context decode is HBM-bound on the KV cache, so int8 halves the
+    # cache bytes read per step vs bf16 (dequant fuses into the read).
+    # Q/K/V math still runs in the compute dtype after dequant.
+    kv_cache_dtype: str = "fp"             # fp | int8
     # GPipe pipeline parallelism over the block stack (models/pipeline.py;
     # training/scoring path — generation reloads dense)
     pipeline_stages: int = 0
@@ -125,6 +131,10 @@ class LlamaConfig:
     model_type: str = "llama"
 
     def __post_init__(self):
+        if self.kv_cache_dtype not in ("fp", "int8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r} "
+                "(fp | int8)")
         if self.num_experts and self.model_type != "mixtral":
             # The only HF layout that can carry the expert bank is
             # Mixtral's: with any other model_type, save_pretrained
@@ -351,9 +361,21 @@ def apply_rope(x, rope):
             + rotated.astype(jnp.float32) * sin).astype(x.dtype)
 
 
+def kv_quantize(x):
+    """Symmetric per-(batch, head, slot) int8 quantization of a K or V
+    slice [B, H, S, D]: scale = amax/127 over the head dim, zero rows
+    keep scale 0 (dequant returns exact zeros). Returns (int8, fp32
+    scale [B, H, S, 1])."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x32), axis=-1, keepdims=True) / 127.0
+    q = jnp.where(scale > 0, x32 / jnp.where(scale > 0, scale, 1.0), 0.0)
+    return jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8), scale
+
+
 class LlamaAttention(nn.Module):
     """GQA self-attention with RoPE and an optional incremental KV cache
-    (cached pre-repeat: [B, H_kv, max_len, D]). ``use_window`` applies
+    (cached pre-repeat: [B, H_kv, max_len, D]; stored int8 + per-slot
+    scales under ``kv_cache_dtype='int8'``). ``use_window`` applies
     the config's sliding window to THIS layer (per-layer policy)."""
 
     config: LlamaConfig
@@ -387,20 +409,48 @@ class LlamaAttention(nn.Module):
 
         causal = True
         if decode:
+            int8_kv = cfg.kv_cache_dtype == "int8"
+            kv_store = jnp.int8 if int8_kv else k.dtype
             is_init = self.has_variable("cache", "cached_key")
             cached_k = self.variable("cache", "cached_key", jnp.zeros,
-                                     k.shape, k.dtype)
+                                     k.shape, kv_store)
             cached_v = self.variable("cache", "cached_value", jnp.zeros,
-                                     v.shape, v.dtype)
+                                     v.shape, kv_store)
+            if int8_kv:
+                scale_shape = k.shape[:3] + (1,)
+                k_scale = self.variable("cache", "cached_key_scale",
+                                        jnp.zeros, scale_shape, jnp.float32)
+                v_scale = self.variable("cache", "cached_value_scale",
+                                        jnp.zeros, scale_shape, jnp.float32)
             cache_index = self.variable("cache", "cache_index",
                                         lambda: jnp.array(0, jnp.int32))
             if is_init:
                 cur = cache_index.value
                 max_len = cached_k.value.shape[2]
                 q_len = q.shape[2]
-                k = lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
-                v = lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
-                cached_k.value, cached_v.value = k, v
+                if int8_kv:
+                    qk, sk = kv_quantize(k)
+                    qv, sv = kv_quantize(v)
+                    cached_k.value = lax.dynamic_update_slice(
+                        cached_k.value, qk, (0, 0, cur, 0))
+                    cached_v.value = lax.dynamic_update_slice(
+                        cached_v.value, qv, (0, 0, cur, 0))
+                    k_scale.value = lax.dynamic_update_slice(
+                        k_scale.value, sk, (0, 0, cur, 0))
+                    v_scale.value = lax.dynamic_update_slice(
+                        v_scale.value, sv, (0, 0, cur, 0))
+                    # dequant fuses into the cache read; math continues
+                    # in the compute dtype
+                    k = (cached_k.value.astype(jnp.float32)
+                         * k_scale.value).astype(cfg.dtype)
+                    v = (cached_v.value.astype(jnp.float32)
+                         * v_scale.value).astype(cfg.dtype)
+                else:
+                    k = lax.dynamic_update_slice(cached_k.value, k,
+                                                 (0, 0, cur, 0))
+                    v = lax.dynamic_update_slice(cached_v.value, v,
+                                                 (0, 0, cur, 0))
+                    cached_k.value, cached_v.value = k, v
                 cache_index.value = cur + q_len
                 key_pos = jnp.arange(max_len)[None, :]
                 qry_pos = cur + jnp.arange(q_len)[:, None]

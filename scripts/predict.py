@@ -83,8 +83,15 @@ def _encode(tokenizer, texts, contexts, max_length):
 
 
 def predict(args) -> list[dict]:
+    overrides = {}
+    if getattr(args, "kv_cache", "fp") != "fp":
+        if args.task != "causal-lm":
+            raise SystemExit("--kv_cache int8 is a decode-cache knob "
+                             "(Llama family); use --task causal-lm")
+        overrides["kv_cache_dtype"] = args.kv_cache
     model, params, family, config = auto_models.from_pretrained(
-        args.model_dir, task=args.task, num_labels=args.num_labels)
+        args.model_dir, task=args.task, num_labels=args.num_labels,
+        **overrides)
     tokenizer = load_tokenizer(args.model_dir, vocab_size=config.vocab_size)
 
     if getattr(args, "adapter", None):
@@ -333,6 +340,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--doc_stride", type=int, default=0,
                     help="QA: window long contexts with this token stride "
                          "instead of truncating (HF run_qa; 0 = off)")
+    ap.add_argument("--kv_cache", choices=["fp", "int8"], default="fp",
+                    help="decode KV cache storage (Llama family): int8 "
+                         "halves cache bytes read per step at long "
+                         "context")
     ap.add_argument("--draft_dir", default=None,
                     help="draft-model checkpoint dir for speculative "
                          "decoding (causal-lm, greedy-exact: the draft "

@@ -163,6 +163,8 @@ _SLOW_TESTS = {
     "test_gpt2.py::test_gpt2_parity_with_left_padding",
     "test_ring_attention.py::test_llama_train_step_with_ring_attention",
     "test_speculative.py",       # whole module: two-model while_loop compiles
+    "test_kv_cache.py::test_int8_kv_decode_matches_fp",
+    "test_kv_cache.py::test_int8_kv_composes_with_speculative",
 }
 
 

@@ -1,0 +1,123 @@
+"""int8 KV cache for Llama-family decode (models/llama.py).
+
+Long-context decode is HBM-bound on the KV cache; storing K/V as
+symmetric per-(head, slot) int8 + fp32 scales halves the bytes read per
+step vs bf16. Contract: quantization error is bounded by the symmetric
+-int8 step size, and greedy decode under the int8 cache stays
+token-identical to the fp cache on the tiny test models (logit gaps
+dwarf ~0.4% relative KV noise).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+    generate_causal,
+    generate_speculative,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    kv_quantize,
+)
+
+
+def _llama(seed=0, **kw):
+    base = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                num_kv_heads=2, intermediate_size=64,
+                max_position_embeddings=128)
+    base.update(kw)
+    cfg = LlamaConfig(**base)
+    model = LlamaForCausalLM(cfg)
+    return model, init_params(model, cfg, seed=seed)
+
+
+def test_kv_quantize_error_bound_and_zero_rows():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 4, 8, 16) * 3.0, jnp.float32)
+    q, scale = kv_quantize(x)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 4, 8, 1)
+    deq = np.asarray(q, np.float32) * np.asarray(scale)
+    # symmetric int8: error <= scale/2 per element
+    err = np.abs(deq - np.asarray(x))
+    assert (err <= np.asarray(scale) / 2 + 1e-7).all()
+    # all-zero rows quantize to exact zeros (scale 0, no NaN)
+    z = jnp.zeros((1, 1, 2, 16), jnp.float32)
+    qz, sz = kv_quantize(z)
+    assert np.asarray(qz).sum() == 0 and np.asarray(sz).sum() == 0
+    assert np.isfinite(np.asarray(sz)).all()
+
+
+@pytest.mark.parametrize("window", [None, 6], ids=["full", "mistral"])
+def test_int8_kv_decode_matches_fp(window):
+    """Greedy generation with the int8 cache == fp cache, including the
+    sliding-window decode path (logical-position banding reads the same
+    dequantized buffers)."""
+    kw = {}
+    if window is not None:
+        kw = dict(sliding_window=window, model_type="mistral")
+    _, params = _llama(seed=0, **kw)
+    model_fp = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64,
+        max_position_embeddings=128, **kw))
+    model_q = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=64,
+        max_position_embeddings=128, kv_cache_dtype="int8", **kw))
+    rng = np.random.RandomState(1)
+    ids = rng.randint(3, 128, (2, 9))
+    want = np.asarray(generate_causal(model_fp, params, ids,
+                                      max_new_tokens=12))
+    got = np.asarray(generate_causal(model_q, params, ids,
+                                     max_new_tokens=12))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_int8_kv_composes_with_speculative():
+    """The speculative cache rewind only touches write indices, so the
+    int8 scale buffers ride along — spec decode under int8 KV equals
+    plain greedy under int8 KV."""
+    cfg_q = LlamaConfig(vocab_size=128, hidden_size=32, num_layers=3,
+                        num_heads=4, num_kv_heads=2, intermediate_size=64,
+                        max_position_embeddings=128, kv_cache_dtype="int8")
+    target = LlamaForCausalLM(cfg_q)
+    _, t_params = _llama(seed=0, num_layers=3)
+    cfg_d = LlamaConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                        num_heads=4, num_kv_heads=2, intermediate_size=64,
+                        max_position_embeddings=128, kv_cache_dtype="int8")
+    draft = LlamaForCausalLM(cfg_d)
+    _, d_params = _llama(seed=1, num_layers=1)
+    rng = np.random.RandomState(2)
+    ids = rng.randint(3, 128, (1, 7))
+    want = np.asarray(generate_causal(target, t_params, ids,
+                                      max_new_tokens=10))
+    got = np.asarray(generate_speculative(target, t_params, draft, d_params,
+                                          ids, max_new_tokens=10,
+                                          speculate_k=3))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_unknown_kv_cache_dtype_rejected():
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        LlamaConfig(kv_cache_dtype="int4")
+
+
+def test_int8_kv_rejected_for_non_llama(tmp_path):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+
+    cfg = Gpt2Config(vocab_size=64, hidden_size=16, num_layers=1,
+                     num_heads=2, intermediate_size=32,
+                     max_position_embeddings=32)
+    params = init_params(Gpt2LMHeadModel(cfg), cfg)
+    d = str(tmp_path / "gpt2")
+    auto_models.save_pretrained(d, params, "gpt2", cfg)
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        auto_models.from_pretrained(d, task="causal-lm",
+                                    kv_cache_dtype="int8")
