@@ -404,6 +404,7 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
         return [f"generate_{m}_tokens_per_sec_per_chip"
                 for m in ("gpt2_greedy", "gpt2_greedy_int8",
                           "llama_greedy", "llama_greedy_int8",
+                          "llama_greedy_b1", "llama_self_spec_b1",
                           "bart_greedy", "bart_beam4")]
     if args.causal_lm:
         return ["gpt2_finetune_fused_ce_samples_per_sec_per_chip"]

@@ -15,6 +15,14 @@ token-exact against HF; this mode measures them, one line each:
                        GQA) prefill + cached greedy — the modern
                        decoder family at a real size (2.2 GB bf16).
 - ``llama_greedy_int8`` same, int8 dense kernels.
+- ``llama_greedy_b1``  same model at batch 1 — the baseline the
+                       speculative line compares against (speculative
+                       decode is batch-1).
+- ``llama_self_spec_b1`` batch-1 greedy via layer-skip self-speculation
+                       (draft = the model's own first ~1/5 layers,
+                       k=4; models/generate.py::self_draft). Random
+                       weights are the acceptance WORST CASE — real
+                       checkpoints only accept more per window.
 - ``bart_greedy``      BART-base encoder once + cached greedy decode —
                        the encoder-decoder path.
 - ``bart_beam4``       same, beam search at 4 beams (beams flattened
@@ -136,6 +144,29 @@ def bench_generate() -> None:
         lambda: generate_causal(q_llama, ql_params, l_prompt,
                                 max_new_tokens=new_tokens),
         new_tokens, batch)
+
+    # self-speculative decode is batch-1 (per-row acceptance divergence);
+    # measure it against a batch-1 greedy baseline so the comparison is
+    # apples-to-apples. Random weights give a WORST-CASE acceptance
+    # floor — real checkpoints accept more, never fewer, tokens/window.
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        generate_speculative,
+        self_draft,
+    )
+
+    draft_layers = max(1, llama_cfg.num_layers // 5)
+    draft, d_params = self_draft(llama, llama_params, draft_layers)
+    spec_prompt = l_prompt[:1]
+    results["llama_greedy_b1"] = _bench_one(
+        lambda: generate_causal(llama, llama_params, spec_prompt,
+                                max_new_tokens=new_tokens),
+        new_tokens, 1)
+    results["llama_self_spec_b1"] = _bench_one(
+        lambda: generate_speculative(llama, llama_params, draft, d_params,
+                                     spec_prompt,
+                                     max_new_tokens=new_tokens,
+                                     speculate_k=4),
+        new_tokens, 1)
 
     bart = BartForConditionalGeneration(bart_cfg)
     bart_params = init_params(bart, bart_cfg, seed=0)

@@ -580,3 +580,43 @@ def generate_speculative(model, params, draft_model, draft_params,
                             input_ids,
                             jnp.asarray(attention_mask, jnp.int32),
                             int(max_new_tokens), int(speculate_k))
+
+
+def self_draft(model, params, num_layers: int):
+    """(draft_model, draft_params): a layer-skip draft assembled from the
+    target's own FIRST ``num_layers`` blocks, sharing its embeddings,
+    final norm, and LM head — self-speculative decoding with no second
+    checkpoint (LayerSkip/early-exit lineage). Acceptance depends on how
+    much the skipped top layers refine token choices, but
+    :func:`generate_speculative` guarantees the output is still exactly
+    the target's greedy continuation regardless.
+
+    Works for the decoder families whose per-layer params live under
+    ``backbone/layers_{i}`` (Llama family) or ``backbone/h_{i}`` (GPT-2).
+    """
+    import dataclasses
+
+    cfg = model.config
+    if not 1 <= num_layers < cfg.num_layers:
+        raise ValueError(
+            f"self_draft num_layers must be in [1, {cfg.num_layers - 1}] "
+            f"(target has {cfg.num_layers}), got {num_layers}")
+    if getattr(cfg, "pipeline_stages", 0):
+        raise ValueError("self_draft needs the dense stack "
+                         "(pipeline_stages=0): decode reloads dense")
+    draft_cfg = dataclasses.replace(cfg, num_layers=num_layers)
+    draft_model = type(model)(draft_cfg)
+
+    def keep(key):
+        for prefix in ("layers_", "h_"):
+            if key.startswith(prefix):
+                return int(key[len(prefix):]) < num_layers
+        return True
+
+    backbone = params["backbone"]
+    kept = {key: val for key, val in backbone.items() if keep(key)}
+    if len(kept) == len(backbone):
+        raise ValueError(
+            "self_draft found no per-layer blocks to truncate (expected "
+            "backbone/layers_{i} or backbone/h_{i} params)")
+    return draft_model, {**params, "backbone": kept}

@@ -169,9 +169,12 @@ def predict(args) -> list[dict]:
             generate_causal,
         )
 
-        if getattr(args, "draft_dir", None) and args.task != "causal-lm":
-            raise SystemExit("--draft_dir (speculative decoding) supports "
-                             "--task causal-lm only")
+        if ((getattr(args, "draft_dir", None)
+             or getattr(args, "self_speculate_layers", 0))
+                and args.task != "causal-lm"):
+            raise SystemExit("--draft_dir/--self_speculate_layers "
+                             "(speculative decoding) support --task "
+                             "causal-lm only")
         if args.task == "seq2seq":
             if args.num_beams > 1:
                 out = beam_search_generate(model, params, ids, mask,
@@ -184,24 +187,39 @@ def predict(args) -> list[dict]:
                                temperature=args.temperature,
                                top_k=args.top_k, top_p=args.top_p,
                                seed=args.seed)
-        elif getattr(args, "draft_dir", None):
+        elif (getattr(args, "draft_dir", None)
+                or getattr(args, "self_speculate_layers", 0)):
             # speculative decoding: exact greedy output, the draft only
             # buys speed — so it refuses knobs it would otherwise have
             # to silently ignore
+            spec_flag = ("--draft_dir" if args.draft_dir
+                         else "--self_speculate_layers")
             if args.temperature or args.top_k or args.top_p:
                 raise SystemExit(
-                    "--draft_dir is greedy-exact speculative decoding; "
+                    f"{spec_flag} is greedy-exact speculative decoding; "
                     "it cannot combine with --temperature/--top_k/--top_p")
             if args.num_beams > 1:
-                raise SystemExit("--draft_dir cannot combine with "
+                raise SystemExit(f"{spec_flag} cannot combine with "
                                  "--num_beams (speculative decode is "
                                  "greedy)")
+            if args.self_speculate_layers < 0:
+                raise SystemExit("--self_speculate_layers must be >= 1")
             from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
                 generate_speculative,
+                self_draft,
             )
 
-            draft_model, draft_params, _, _ = auto_models.from_pretrained(
-                args.draft_dir, task="causal-lm")
+            if args.draft_dir and args.self_speculate_layers:
+                raise SystemExit("--draft_dir and --self_speculate_layers "
+                                 "are mutually exclusive")
+            if args.self_speculate_layers:
+                # layer-skip self-speculation: the draft is the target's
+                # own first N layers — no second checkpoint
+                draft_model, draft_params = self_draft(
+                    model, params, args.self_speculate_layers)
+            else:
+                draft_model, draft_params, _, _ = auto_models.from_pretrained(
+                    args.draft_dir, task="causal-lm")
             rows = []
             for r in range(ids.shape[0]):   # batch-1 contract
                 # bucket the prompt width to a multiple of 32 so N rows
@@ -349,7 +367,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "decoding (causal-lm, greedy-exact: the draft "
                          "changes speed, never tokens)")
     ap.add_argument("--speculate_k", type=int, default=4,
-                    help="draft tokens per verify window (--draft_dir)")
+                    help="draft tokens per verify window (--draft_dir / "
+                         "--self_speculate_layers)")
+    ap.add_argument("--self_speculate_layers", type=int, default=0,
+                    help="layer-skip self-speculation: draft = the "
+                         "target's own first N layers (no draft "
+                         "checkpoint; greedy-exact like --draft_dir)")
     ap.add_argument("--quantize", choices=["none", "int8"], default="none",
                     help="int8 weight-only dense kernels for causal-lm "
                          "generation (HBM-bound decode speedup)")

@@ -151,3 +151,38 @@ def test_speculative_left_padded_rejected():
                            np.ones((1, 5), np.int64)], axis=1)
     with pytest.raises(ValueError, match="RIGHT-padded"):
         generate_speculative(target, t_params, draft, d_params, ids, mask)
+
+
+def test_self_draft_matches_greedy():
+    """Layer-skip self-speculation: the draft is the target's own first
+    N layers — no second checkpoint — and the output is still exactly
+    the target's greedy continuation (for both param layouts)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        self_draft,
+    )
+
+    for build in (_llama, _gpt2):
+        target, t_params = build(3, seed=0)
+        draft, d_params = self_draft(target, t_params, 1)
+        assert draft.config.num_layers == 1
+        rng = np.random.RandomState(7)
+        ids = rng.randint(3, 128, (1, 6))
+        want = np.asarray(generate_causal(target, t_params, ids,
+                                          max_new_tokens=10))
+        got = np.asarray(generate_speculative(target, t_params, draft,
+                                              d_params, ids,
+                                              max_new_tokens=10,
+                                              speculate_k=3))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_self_draft_rejects_bad_layer_counts():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        self_draft,
+    )
+
+    target, t_params = _llama(3, seed=0)
+    with pytest.raises(ValueError, match="num_layers"):
+        self_draft(target, t_params, 0)
+    with pytest.raises(ValueError, match="num_layers"):
+        self_draft(target, t_params, 3)
