@@ -16,8 +16,9 @@ token-exact against HF; this mode measures them, one line each:
                        decoder family at a real size (2.2 GB bf16).
 - ``llama_greedy_int8`` same, int8 dense kernels.
 - ``llama_greedy_b1``  same model at batch 1 — the baseline the
-                       speculative line compares against (speculative
-                       decode is batch-1).
+                       speculative line compares against (batch 1 is
+                       the latency-bound single-stream case; the
+                       speculative API itself batches).
 - ``llama_self_spec_b1`` batch-1 greedy via layer-skip self-speculation
                        (draft = the model's own first ~1/5 layers,
                        k=4; models/generate.py::self_draft). Random
@@ -145,10 +146,12 @@ def bench_generate() -> None:
                                 max_new_tokens=new_tokens),
         new_tokens, batch)
 
-    # self-speculative decode is batch-1 (per-row acceptance divergence);
-    # measure it against a batch-1 greedy baseline so the comparison is
-    # apples-to-apples. Random weights give a WORST-CASE acceptance
-    # floor — real checkpoints accept more, never fewer, tokens/window.
+    # self-speculative decode measured DELIBERATELY at batch 1 (the
+    # classic latency-bound single-stream case; the API itself batches,
+    # rows advancing independently) against a batch-1 greedy baseline
+    # so the comparison is apples-to-apples. Random weights give a
+    # WORST-CASE acceptance floor — real checkpoints accept more,
+    # never fewer, tokens/window.
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
         generate_speculative,
         self_draft,

@@ -377,9 +377,15 @@ def _rewind_cache(cache, n):
     copy."""
     def fix(path, leaf):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        if name in ("cache_index", "position_index"):
-            return jnp.asarray(n, leaf.dtype)
-        return leaf
+        if name not in ("cache_index", "position_index"):
+            return leaf
+        arr = jnp.asarray(n, leaf.dtype)
+        if arr.ndim > jnp.ndim(leaf):
+            # per-row n onto a scalar leaf (the model-level
+            # position_index, unused when explicit position_ids are
+            # passed — which every rewinding caller does)
+            arr = jnp.max(arr)
+        return jnp.broadcast_to(arr, jnp.shape(leaf))
 
     return jax.tree_util.tree_map_with_path(fix, cache)
 
@@ -403,8 +409,9 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
     cfg = model.config
     k = speculate_k
     B, P = input_ids.shape
+    T = max_new_tokens
     pad = jnp.int32(cfg.pad_token_id)
-    total = P + max_new_tokens + k + 1      # cache room incl. overshoot
+    total = P + T + k + 1                   # cache room incl. overshoot
 
     def alloc(m, p):
         _, v = m.apply({"params": p}, jnp.ones((B, total), jnp.int32),
@@ -413,11 +420,14 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
 
     t_cache, d_cache = alloc(model, params), alloc(draft_model, draft_params)
 
+    def row_put(row, upd, c):
+        # row [total], upd [w], c scalar — one row's buffer write
+        return lax.dynamic_update_slice(row, upd, (c,))
+
     # kv-buffer validity over all slots; logical prefill positions
     valid = jnp.concatenate(
-        [prompt_mask, jnp.zeros((B, max_new_tokens + k + 1), jnp.int32)],
-        axis=1)
-    n_real = jnp.sum(prompt_mask[0]).astype(jnp.int32)         # scalar, B=1
+        [prompt_mask, jnp.zeros((B, T + k + 1), jnp.int32)], axis=1)
+    n_real = jnp.sum(prompt_mask, axis=1).astype(jnp.int32)    # [B]
     pos = jnp.clip(jnp.cumsum(prompt_mask, axis=1) - 1, 0).astype(jnp.int32)
 
     logits, mut = model.apply(
@@ -431,85 +441,94 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
         mutable=["cache"])
     d_cache = mut["cache"]
 
-    last_logits = lax.dynamic_index_in_dim(
-        logits[0].astype(jnp.float32), n_real - 1, axis=0, keepdims=False)
-    first = jnp.argmax(last_logits, -1).astype(jnp.int32)[None]  # [B]
-    out = jnp.full((B, max_new_tokens + k + 1), pad, jnp.int32)
+    last_logits = jnp.take_along_axis(
+        logits.astype(jnp.float32), (n_real - 1)[:, None, None],
+        axis=1)[:, 0]                                          # [B, V]
+    first = jnp.argmax(last_logits, -1).astype(jnp.int32)      # [B]
+    out = jnp.full((B, T + k + 1), pad, jnp.int32)
     out = out.at[:, 0].set(first)
-    state = (out, jnp.ones((), jnp.int32),                     # n_out
-             jnp.asarray(P, jnp.int32),                        # n_ctx: slots
+    state = (out, jnp.ones((B,), jnp.int32),                   # n_out
+             jnp.full((B,), P, jnp.int32),                     # n_ctx: slots
              n_real,                                           # n_pos: logical
              first, t_cache, d_cache, valid,
-             (first[0] == cfg.eos_token_id))                   # finished
+             first == cfg.eos_token_id)                        # finished [B]
 
     def cond(state):
         n_out, finished = state[1], state[-1]
-        return (n_out < max_new_tokens) & ~finished
+        return jnp.any((n_out < T) & ~finished)
 
     def body(state):
         (out, n_out, n_ctx, n_pos, last, t_cache, d_cache, valid,
          finished) = state
+        active = (n_out < T) & ~finished                       # [B]
 
         # 1. draft k greedy candidates autoregressively (its cache copy
         #    is discarded — step 3 replays the verified window instead)
         def dstep(carry, t):
             tok, dc, vld = carry
-            vld = lax.dynamic_update_slice(
-                vld, jnp.ones((B, 1), jnp.int32), (0, n_ctx + t))
+            vld = jax.vmap(row_put)(vld, jnp.ones((B, 1), jnp.int32),
+                                    n_ctx + t)
             lg, m = draft_model.apply(
                 {"params": draft_params, "cache": dc}, tok[:, None], vld,
-                position_ids=jnp.full((B, 1), n_pos + t, jnp.int32),
-                decode=True, deterministic=True, mutable=["cache"])
+                position_ids=(n_pos + t)[:, None], decode=True,
+                deterministic=True, mutable=["cache"])
             nxt = jnp.argmax(lg[:, -1, :].astype(jnp.float32),
                              -1).astype(jnp.int32)
             return (nxt, m["cache"], vld), nxt
 
         (_, _, _), drafts = lax.scan(dstep, (last, d_cache, valid),
                                      jnp.arange(k))
-        drafts = drafts[:, 0]                                  # [k] (B=1)
+        drafts = drafts.T                                      # [B, k]
 
         # 2. ONE target pass over [last, d_0..d_{k-1}] verifies all k
-        #    candidates at the cost of a single decode step's HBM
-        #    traffic (weights dominate at batch 1)
-        verify_in = jnp.concatenate([last, drafts])[None]      # [1, k+1]
-        vwin = lax.dynamic_update_slice(
-            valid, jnp.ones((B, k + 1), jnp.int32), (0, n_ctx))
-        vpos = (n_pos + jnp.arange(k + 1, dtype=jnp.int32))[None]
+        #    candidates per row at the cost of a single decode step's
+        #    HBM traffic (weights dominate at decode batch sizes)
+        verify_in = jnp.concatenate([last[:, None], drafts], axis=1)
+        vwin = jax.vmap(row_put)(valid, jnp.ones((B, k + 1), jnp.int32),
+                                 n_ctx)
+        vpos = n_pos[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
         lg, mut = model.apply(
             {"params": params, "cache": t_cache}, verify_in, vwin,
             position_ids=vpos, decode=True, deterministic=True,
             mutable=["cache"])
-        t_pred = jnp.argmax(lg[0].astype(jnp.float32),
-                            -1).astype(jnp.int32)              # [k+1]
+        t_pred = jnp.argmax(lg.astype(jnp.float32),
+                            -1).astype(jnp.int32)              # [B, k+1]
 
-        # longest matching prefix, then the target's own token as bonus
-        match = (drafts == t_pred[:k]).astype(jnp.int32)
+        # longest matching prefix per row, then the target's own token
+        # as bonus
+        match = (drafts == t_pred[:, :k]).astype(jnp.int32)    # [B, k]
         n_acc = jnp.argmin(jnp.concatenate(
-            [match, jnp.zeros((1,), jnp.int32)]))              # first miss
-        bonus = t_pred[n_acc]
-        idx = jnp.arange(k + 1)
-        emit = jnp.where(idx < n_acc,
-                         jnp.concatenate([drafts, drafts[-1:]]), pad)
-        emit = emit.at[n_acc].set(bonus)
-        n_new = n_acc + 1
+            [match, jnp.zeros((B, 1), jnp.int32)], axis=1),
+            axis=1)                                            # first miss
+        bonus = jnp.take_along_axis(t_pred, n_acc[:, None],
+                                    axis=1)[:, 0]              # [B]
+        idx = jnp.arange(k + 1)[None]                          # [1, k+1]
+        emit = jnp.where(idx < n_acc[:, None],
+                         jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+                         pad)
+        emit = jnp.where(idx == n_acc[:, None], bonus[:, None], emit)
+        n_new = jnp.where(active, n_acc + 1, 0)                # [B]
 
-        # EOS: pad everything after the first one, stop iterating
-        is_eos = (emit == cfg.eos_token_id) & (idx < n_new)
-        after = (jnp.cumsum(is_eos.astype(jnp.int32)) -
+        # EOS: pad everything after the first one; inactive rows emit
+        # only pads (their slots past n_out were never written, so the
+        # write below is a value no-op for them)
+        is_eos = (emit == cfg.eos_token_id) & (idx < n_new[:, None])
+        after = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1) -
                  is_eos.astype(jnp.int32)) > 0
-        emit = jnp.where(after, pad, emit)
-        finished = finished | jnp.any(is_eos)
+        emit = jnp.where(after | ~active[:, None], pad, emit)
+        finished = finished | jnp.any(is_eos, axis=1)
 
-        out = lax.dynamic_update_slice(out, emit[None], (0, n_out))
+        out = jax.vmap(row_put)(out, emit, jnp.minimum(n_out, T))
         new_ctx = n_ctx + n_new
         # commit validity: accepted slots become 1, rejected stay 0
-        valid = lax.dynamic_update_slice(
-            valid, (idx < n_new).astype(jnp.int32)[None], (0, n_ctx))
+        valid = jax.vmap(row_put)(
+            valid, (idx < n_new[:, None]).astype(jnp.int32), n_ctx)
 
         # 3. commit caches: the target wrote the whole window — rewind
-        #    its index to the accepted length; the draft's scan copy is
-        #    replaced by ONE replay of the same window (idempotent
-        #    rewrites + the slot its scan never reached), then rewound
+        #    its per-row indices to the accepted lengths; the draft's
+        #    scan copy is replaced by ONE replay of the same window
+        #    (idempotent rewrites + the slot its scan never reached),
+        #    then rewound
         t_cache = _rewind_cache(mut["cache"], new_ctx)
         _, mdr = draft_model.apply(
             {"params": draft_params, "cache": d_cache}, verify_in, vwin,
@@ -517,11 +536,12 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
             mutable=["cache"])
         d_cache = _rewind_cache(mdr["cache"], new_ctx)
 
-        return (out, n_out + n_new, new_ctx, n_pos + n_new, bonus[None],
+        last = jnp.where(active, bonus, last)
+        return (out, n_out + n_new, new_ctx, n_pos + n_new, last,
                 t_cache, d_cache, valid, finished)
 
     state = lax.while_loop(cond, body, state)
-    return state[0][:, :max_new_tokens]
+    return state[0][:, :T]
 
 
 def generate_speculative(model, params, draft_model, draft_params,
@@ -539,36 +559,32 @@ def generate_speculative(model, params, draft_model, draft_params,
 
     TPU-first shape discipline: fixed-k draft scan, fixed (k+1)-token
     verify, ``lax.while_loop`` over a static output buffer — one
-    compilation regardless of acceptance pattern. Decode at batch 1 is
-    HBM-bound on the target's weights, so verifying k+1 tokens costs
+    compilation regardless of acceptance pattern. Decode at small batch
+    is HBM-bound on the target's weights, so verifying k+1 tokens costs
     about the same as one, and acceptance rate × (k+1) is the speedup.
 
-    Batch 1 only (per-row acceptance divergence needs per-row cache
-    indices; the cache tracks one write index per layer). The prompt
-    may be RIGHT-padded with ``attention_mask`` marking real tokens —
-    this lets callers bucket prompt lengths so each bucket compiles
-    once instead of every distinct length retracing the two-model
-    while_loop. Works with any decoder following the slot-indexed
-    KV-cache convention (GPT-2, the whole Llama family incl. Mixtral).
+    Batched: rows accept different numbers of tokens per iteration and
+    advance independently — the KV caches keep PER-ROW write indices,
+    and each row's stale slots hide behind the slot-indexed step mask.
+    Prompts may be RIGHT-padded with ``attention_mask`` marking real
+    tokens — bucket prompt widths and each bucket compiles once instead
+    of every distinct length retracing the two-model while_loop. Works
+    with any decoder following the slot-indexed KV-cache convention
+    (GPT-2, the whole Llama family incl. Mixtral).
     """
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if input_ids.ndim == 1:
         input_ids = input_ids[None]
-    if input_ids.shape[0] != 1:
-        raise ValueError(
-            f"generate_speculative is batch-1 (got batch "
-            f"{input_ids.shape[0]}): per-row acceptance divergence "
-            "would need per-row KV write indices")
     if attention_mask is None:
         attention_mask = jnp.ones_like(input_ids)
     mask_np = np.asarray(attention_mask)
     if (mask_np[:, :-1] < mask_np[:, 1:]).any():
         raise ValueError(
-            "generate_speculative requires a RIGHT-padded prompt "
-            "(attention_mask must be non-increasing): real tokens "
-            "first, pads after")
-    if mask_np.sum() < 1:
-        raise ValueError("prompt must contain at least one real token")
+            "generate_speculative requires RIGHT-padded prompts "
+            "(attention_mask must be non-increasing per row): real "
+            "tokens first, pads after")
+    if (mask_np.sum(axis=1) < 1).any():
+        raise ValueError("every prompt row needs at least one real token")
     if model.config.vocab_size != draft_model.config.vocab_size:
         raise ValueError(
             "draft and target must share a vocabulary (got "

@@ -163,22 +163,29 @@ class Gpt2Attention(nn.Module):
 
         causal = True
         if decode:
+            B = q.shape[0]
             is_init = self.has_variable("cache", "cached_key")
             cached_k = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
             cached_v = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            # per-row write indices [B] — rows may sit at different
+            # depths under speculative decode (models/generate.py)
             cache_index = self.variable("cache", "cache_index",
-                                        lambda: jnp.array(0, jnp.int32))
+                                        lambda: jnp.zeros((B,), jnp.int32))
             if is_init:
-                cur = cache_index.value
+                cur = cache_index.value                       # [B]
                 max_len = cached_k.value.shape[2]
                 q_len = q.shape[2]
-                k = lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
-                v = lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
+
+                def row_write(buf, new, c):
+                    return lax.dynamic_update_slice(buf, new, (0, c, 0))
+
+                k = jax.vmap(row_write)(cached_k.value, k, cur)
+                v = jax.vmap(row_write)(cached_v.value, v, cur)
                 cached_k.value, cached_v.value = k, v
                 cache_index.value = cur + q_len
-                valid = jnp.arange(max_len)[None, :] <= (
-                    cur + jnp.arange(q_len)[:, None])
-                step_mask = jnp.where(valid, 0.0, NEG_INF)[None, None]
+                valid = jnp.arange(max_len)[None, None, :] <= (
+                    cur[:, None, None] + jnp.arange(q_len)[None, :, None])
+                step_mask = jnp.where(valid, 0.0, NEG_INF)[:, None]
                 attn_mask = step_mask if attn_mask is None else attn_mask + step_mask
                 causal = False   # the step mask already encodes causality
 

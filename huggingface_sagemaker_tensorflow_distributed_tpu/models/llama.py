@@ -422,23 +422,32 @@ class LlamaAttention(nn.Module):
                                         jnp.zeros, scale_shape, jnp.float32)
                 v_scale = self.variable("cache", "cached_value_scale",
                                         jnp.zeros, scale_shape, jnp.float32)
+            # PER-ROW write indices [B]: rows may sit at different
+            # depths (speculative decode accepts a different number of
+            # tokens per row) — writes are per-row dynamic_update_slices
+            # and the step mask broadcasts per row
             cache_index = self.variable("cache", "cache_index",
-                                        lambda: jnp.array(0, jnp.int32))
+                                        lambda: jnp.zeros((B,), jnp.int32))
             if is_init:
-                cur = cache_index.value
+                cur = cache_index.value                       # [B]
                 max_len = cached_k.value.shape[2]
                 q_len = q.shape[2]
+
+                def row_write(buf, new, c):
+                    # buf [H, S, D], new [H, q, D], c scalar
+                    return lax.dynamic_update_slice(buf, new, (0, c, 0))
+
                 if int8_kv:
                     qk, sk = kv_quantize(k)
                     qv, sv = kv_quantize(v)
-                    cached_k.value = lax.dynamic_update_slice(
-                        cached_k.value, qk, (0, 0, cur, 0))
-                    cached_v.value = lax.dynamic_update_slice(
-                        cached_v.value, qv, (0, 0, cur, 0))
-                    k_scale.value = lax.dynamic_update_slice(
-                        k_scale.value, sk, (0, 0, cur, 0))
-                    v_scale.value = lax.dynamic_update_slice(
-                        v_scale.value, sv, (0, 0, cur, 0))
+                    cached_k.value = jax.vmap(row_write)(cached_k.value,
+                                                         qk, cur)
+                    cached_v.value = jax.vmap(row_write)(cached_v.value,
+                                                         qv, cur)
+                    k_scale.value = jax.vmap(row_write)(k_scale.value,
+                                                        sk, cur)
+                    v_scale.value = jax.vmap(row_write)(v_scale.value,
+                                                        sv, cur)
                     # dequant fuses into the cache read; math continues
                     # in the compute dtype
                     k = (cached_k.value.astype(jnp.float32)
@@ -446,16 +455,15 @@ class LlamaAttention(nn.Module):
                     v = (cached_v.value.astype(jnp.float32)
                          * v_scale.value).astype(cfg.dtype)
                 else:
-                    k = lax.dynamic_update_slice(cached_k.value, k,
-                                                 (0, 0, cur, 0))
-                    v = lax.dynamic_update_slice(cached_v.value, v,
-                                                 (0, 0, cur, 0))
+                    k = jax.vmap(row_write)(cached_k.value, k, cur)
+                    v = jax.vmap(row_write)(cached_v.value, v, cur)
                     cached_k.value, cached_v.value = k, v
                 cache_index.value = cur + q_len
-                key_pos = jnp.arange(max_len)[None, :]
-                qry_pos = cur + jnp.arange(q_len)[:, None]
-                valid = key_pos <= qry_pos
-                step_mask = jnp.where(valid, 0.0, NEG_INF)[None, None]
+                key_pos = jnp.arange(max_len)[None, :]        # [1, S]
+                qry_pos = (cur[:, None, None]
+                           + jnp.arange(q_len)[None, :, None])  # [B, q, 1]
+                valid = key_pos[None] <= qry_pos              # [B, q, S]
+                step_mask = jnp.where(valid, 0.0, NEG_INF)[:, None]
                 if cfg.sliding_window is not None and self.use_window:
                     # window in LOGICAL coordinates: buffer slots are not
                     # positions when the prompt is padded. Each valid

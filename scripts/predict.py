@@ -220,18 +220,24 @@ def predict(args) -> list[dict]:
             else:
                 draft_model, draft_params, _, _ = auto_models.from_pretrained(
                     args.draft_dir, task="causal-lm")
-            rows = []
-            for r in range(ids.shape[0]):   # batch-1 contract
-                # bucket the prompt width to a multiple of 32 so N rows
-                # compile at most N/32-ish distinct while_loop shapes,
-                # not one per prompt length (right-padded prompt mask)
-                n = int(np.asarray(mask[r]).sum())
-                width = min(ids.shape[1], ((n + 31) // 32) * 32)
-                rows.append(np.asarray(generate_speculative(
+            # bucket prompt widths to multiples of 32 (right-padded
+            # masks), batch each bucket in ONE call: rows advance
+            # independently inside the batched while_loop, and each
+            # bucket width compiles once
+            ids_np, mask_np = np.asarray(ids), np.asarray(mask)
+            widths = [min(ids_np.shape[1],
+                          ((int(mask_np[r].sum()) + 31) // 32) * 32)
+                      for r in range(ids_np.shape[0])]
+            rows = [None] * ids_np.shape[0]
+            for w in sorted(set(widths)):
+                sel = [r for r, rw in enumerate(widths) if rw == w]
+                outs = np.asarray(generate_speculative(
                     model, params, draft_model, draft_params,
-                    ids[r:r + 1, :width], mask[r:r + 1, :width],
+                    ids_np[sel][:, :w], mask_np[sel][:, :w],
                     max_new_tokens=args.max_new_tokens,
-                    speculate_k=args.speculate_k))[0])
+                    speculate_k=args.speculate_k))
+                for i, r in enumerate(sel):
+                    rows[r] = outs[i]
             out = np.stack(rows, axis=0)
         else:
             out = generate_causal(model, params, ids, mask,
