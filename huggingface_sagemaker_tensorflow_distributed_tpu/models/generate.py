@@ -143,13 +143,23 @@ def _sample_next(logits, temperature, top_k, top_p, rng):
 
 
 @functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
-                                             "temperature", "top_k", "top_p"))
+                                             "temperature", "top_k", "top_p",
+                                             "prefill_chunk"))
 def _generate_causal_jit(model, params, input_ids, attention_mask,
-                         max_new_tokens, temperature, rng, top_k=0, top_p=0.0):
+                         max_new_tokens, temperature, rng, top_k=0, top_p=0.0,
+                         prefill_chunk=0):
     """Decoder-only generation: one prefill pass writes the prompt into
     the KV cache, then a jitted scan decodes token-by-token. Left-padded
     prompts are supported: positions come from the padding-mask cumsum
-    and padded cache slots stay masked for the whole decode."""
+    and padded cache slots stay masked for the whole decode.
+
+    ``prefill_chunk > 0`` splits the prefill into a ``lax.scan`` over
+    fixed-size chunks (the wrapper pads the prompt width to a multiple):
+    attention memory during prefill drops from O(P·total) to
+    O(chunk·total) per layer — the knob that makes long-prompt serving
+    fit, at the cost of re-reading the weights once per chunk. The
+    chunks write the same cache slots the single pass would, so the
+    decode that follows is bit-identical."""
     cfg = model.config
     B, P = input_ids.shape
     total = P + max_new_tokens
@@ -168,15 +178,43 @@ def _generate_causal_jit(model, params, input_ids, attention_mask,
 
     # prefill: logical positions from the mask (left-pad aware)
     pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1, 0).astype(jnp.int32)
-    logits, mutated = model.apply(
-        {"params": params, "cache": cache}, input_ids, valid,
-        position_ids=pos, decode=True, deterministic=True, mutable=["cache"])
-    cache = mutated["cache"]
-    # per-row last REAL token (right- and left-padded prompts both work):
-    # left-padded rows end at index P-1, right-padded at n_real-1
-    last_real = jnp.where(attention_mask[:, -1] > 0, P - 1, n_real - 1)
-    last_logits = jnp.take_along_axis(
-        logits, last_real[:, None, None], axis=1)[:, 0].astype(jnp.float32)
+    # per-row index of the last REAL token = last set mask bit (works
+    # for left-padded, right-padded, and chunk-padded-after-left-padded
+    # prompts alike)
+    last_real = P - 1 - jnp.argmax(attention_mask[:, ::-1], axis=1)
+    if prefill_chunk:
+        C = prefill_chunk
+
+        def chunk_step(carry, i):
+            cache, last_logits = carry
+            start = i * C
+            ids_c = lax.dynamic_slice(input_ids, (0, start), (B, C))
+            pos_c = lax.dynamic_slice(pos, (0, start), (B, C))
+            lg, mut = model.apply(
+                {"params": params, "cache": cache}, ids_c, valid,
+                position_ids=pos_c, decode=True, deterministic=True,
+                mutable=["cache"])
+            # bank the last-real logits when they fall in this chunk
+            rel = last_real - start                              # [B]
+            sel = jnp.take_along_axis(
+                lg.astype(jnp.float32),
+                jnp.clip(rel, 0, C - 1)[:, None, None], axis=1)[:, 0]
+            hit = (rel >= 0) & (rel < C)
+            last_logits = jnp.where(hit[:, None], sel, last_logits)
+            return (mut["cache"], last_logits), None
+
+        (cache, last_logits), _ = lax.scan(
+            chunk_step,
+            (cache, jnp.zeros((B, cfg.vocab_size), jnp.float32)),
+            jnp.arange(P // C))
+    else:
+        logits, mutated = model.apply(
+            {"params": params, "cache": cache}, input_ids, valid,
+            position_ids=pos, decode=True, deterministic=True,
+            mutable=["cache"])
+        cache = mutated["cache"]
+        last_logits = jnp.take_along_axis(
+            logits, last_real[:, None, None], axis=1)[:, 0].astype(jnp.float32)
     first, rng = _sample_next(last_logits, temperature, top_k, top_p, rng)
     finished = first == cfg.eos_token_id
 
@@ -203,20 +241,46 @@ def _generate_causal_jit(model, params, input_ids, attention_mask,
 
 def generate_causal(model, params, input_ids, attention_mask=None,
                     max_new_tokens: int = 64, temperature: float = 0.0,
-                    top_k: int = 0, top_p: float = 0.0, seed: int = 0) -> jax.Array:
+                    top_k: int = 0, top_p: float = 0.0, seed: int = 0,
+                    prefill_chunk: int = 0) -> jax.Array:
     """Decoder-only ``generate`` (GPT-2 family): greedy at
     ``temperature=0``, otherwise temperature/top-k/top-p sampling.
     Prompts may be left-padded (mark pads 0 in ``attention_mask``).
+    ``prefill_chunk`` splits long-prompt prefill into fixed-size chunks
+    (O(chunk·total) attention memory instead of O(P·total); the prompt
+    is right-padded to a chunk multiple internally — same tokens out).
     Returns [batch, max_new_tokens] continuation ids, ``pad_token_id``
     after EOS."""
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if attention_mask is None:
         attention_mask = jnp.ones_like(input_ids)
     attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    prefill_chunk = int(prefill_chunk)
+    if prefill_chunk < 0:
+        raise ValueError(f"prefill_chunk must be >= 0, got {prefill_chunk}")
+    if prefill_chunk >= input_ids.shape[1]:
+        # chunking a prompt that fits one chunk would only PAD it up —
+        # degenerate to the single-pass prefill
+        prefill_chunk = 0
+    if prefill_chunk:
+        P = input_ids.shape[1]
+        short = -P % prefill_chunk
+        if short:
+            # the appended slots are masked everywhere and never read
+            # back, so any IN-VOCAB id works — and it must be in-vocab:
+            # an out-of-range pad_token_id (tiny test configs) would
+            # embed as NaN (jnp.take fill mode) and NaN survives the
+            # additive mask through softmax
+            pad_id = min(int(model.config.pad_token_id),
+                         model.config.vocab_size - 1)
+            input_ids = jnp.pad(input_ids, ((0, 0), (0, short)),
+                                constant_values=pad_id)
+            attention_mask = jnp.pad(attention_mask, ((0, 0), (0, short)))
     return _generate_causal_jit(model, params, input_ids, attention_mask,
                                 int(max_new_tokens), float(temperature),
                                 jax.random.PRNGKey(seed), top_k=int(top_k),
-                                top_p=float(top_p))
+                                top_p=float(top_p),
+                                prefill_chunk=prefill_chunk)
 
 
 _NEG = jnp.float32(-1e9)

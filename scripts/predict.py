@@ -175,6 +175,14 @@ def predict(args) -> list[dict]:
             raise SystemExit("--draft_dir/--self_speculate_layers "
                              "(speculative decoding) support --task "
                              "causal-lm only")
+        if getattr(args, "prefill_chunk", 0):
+            if args.task != "causal-lm":
+                raise SystemExit("--prefill_chunk supports --task "
+                                 "causal-lm only")
+            if args.draft_dir or args.self_speculate_layers:
+                raise SystemExit("--prefill_chunk cannot combine with "
+                                 "speculative decoding (its prefill is "
+                                 "not chunked)")
         if args.task == "seq2seq":
             if args.num_beams > 1:
                 out = beam_search_generate(model, params, ids, mask,
@@ -244,7 +252,8 @@ def predict(args) -> list[dict]:
                                   max_new_tokens=args.max_new_tokens,
                                   temperature=args.temperature,
                                   top_k=args.top_k, top_p=args.top_p,
-                                  seed=args.seed)
+                                  seed=args.seed,
+                                  prefill_chunk=args.prefill_chunk)
         for text, row in zip(texts, np.asarray(out)):
             results.append({"text": text,
                             "generated": tokenizer.decode(row),
@@ -364,6 +373,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--doc_stride", type=int, default=0,
                     help="QA: window long contexts with this token stride "
                          "instead of truncating (HF run_qa; 0 = off)")
+    ap.add_argument("--prefill_chunk", type=int, default=0,
+                    help="split long-prompt prefill into fixed-size "
+                         "chunks (causal-lm; O(chunk) attention memory "
+                         "instead of O(prompt), same tokens out)")
     ap.add_argument("--kv_cache", choices=["fp", "int8"], default="fp",
                     help="decode KV cache storage (Llama family): int8 "
                          "halves cache bytes read per step at long "

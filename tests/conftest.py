@@ -165,6 +165,7 @@ _SLOW_TESTS = {
     "test_speculative.py",       # whole module: two-model while_loop compiles
     "test_kv_cache.py::test_int8_kv_decode_matches_fp",
     "test_kv_cache.py::test_int8_kv_composes_with_speculative",
+    "test_prefill_chunk.py",     # whole module: scan-prefill compiles
 }
 
 
