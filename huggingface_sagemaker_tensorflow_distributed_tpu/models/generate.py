@@ -258,6 +258,13 @@ def generate_causal(model, params, input_ids, attention_mask=None,
     prefill_chunk = int(prefill_chunk)
     if prefill_chunk < 0:
         raise ValueError(f"prefill_chunk must be >= 0, got {prefill_chunk}")
+    if prefill_chunk and getattr(model.config, "num_experts", 0):
+        raise ValueError(
+            "prefill_chunk does not support MoE models (Mixtral): expert "
+            "capacity is a function of the apply's sequence length, so "
+            "chunked prefill could capacity-drop token->expert "
+            "assignments the single-pass prefill never drops — the "
+            "token-identical guarantee would silently break")
     if prefill_chunk >= input_ids.shape[1]:
         # chunking a prompt that fits one chunk would only PAD it up —
         # degenerate to the single-pass prefill
@@ -634,7 +641,9 @@ def generate_speculative(model, params, draft_model, draft_params,
     tokens — bucket prompt widths and each bucket compiles once instead
     of every distinct length retracing the two-model while_loop. Works
     with any decoder following the slot-indexed KV-cache convention
-    (GPT-2, the whole Llama family incl. Mixtral).
+    (GPT-2, the dense Llama family; MoE/Mixtral is rejected — expert
+    capacity depends on the apply's sequence length, so verify windows
+    could drop assignments single-token steps never drop).
     """
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if input_ids.ndim == 1:
@@ -654,6 +663,15 @@ def generate_speculative(model, params, draft_model, draft_params,
             "draft and target must share a vocabulary (got "
             f"{draft_model.config.vocab_size} vs "
             f"{model.config.vocab_size})")
+    if (getattr(model.config, "num_experts", 0)
+            or getattr(draft_model.config, "num_experts", 0)):
+        raise ValueError(
+            "generate_speculative does not support MoE models (Mixtral):"
+            " expert capacity is a function of the apply's sequence "
+            "length, so the (k+1)-token verify window could capacity-"
+            "drop token->expert assignments that generate_causal's "
+            "single-token steps never drop — the greedy-exact guarantee "
+            "would silently break")
     if speculate_k < 1:
         raise ValueError("speculate_k must be >= 1")
     return _speculative_jit(model, params, draft_model, draft_params,

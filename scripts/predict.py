@@ -179,7 +179,8 @@ def predict(args) -> list[dict]:
             if args.task != "causal-lm":
                 raise SystemExit("--prefill_chunk supports --task "
                                  "causal-lm only")
-            if args.draft_dir or args.self_speculate_layers:
+            if (getattr(args, "draft_dir", None)
+                    or getattr(args, "self_speculate_layers", 0)):
                 raise SystemExit("--prefill_chunk cannot combine with "
                                  "speculative decoding (its prefill is "
                                  "not chunked)")
@@ -253,7 +254,8 @@ def predict(args) -> list[dict]:
                                   temperature=args.temperature,
                                   top_k=args.top_k, top_p=args.top_p,
                                   seed=args.seed,
-                                  prefill_chunk=args.prefill_chunk)
+                                  prefill_chunk=getattr(args,
+                                                        "prefill_chunk", 0))
         for text, row in zip(texts, np.asarray(out)):
             results.append({"text": text,
                             "generated": tokenizer.decode(row),

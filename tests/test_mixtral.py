@@ -265,3 +265,35 @@ def test_mixtral_dp_ep_training_matches_single_device(devices8):
     single = run(MeshConfig(), devices8[:1])
     sharded = run(MeshConfig(dp=2, ep=2, tp=2), devices8)
     np.testing.assert_allclose(sharded, single, atol=3e-5)
+
+
+def test_mixtral_rejected_by_speculative_and_chunked_prefill():
+    """Expert capacity is a function of the apply's sequence length, so
+    multi-token verify windows / prefill chunks could capacity-drop
+    assignments that single-token steps (or the single-pass prefill)
+    never drop — both decode accelerators reject MoE models loudly
+    instead of silently breaking their token-exactness guarantees."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        generate_causal,
+        generate_speculative,
+    )
+
+    cfg = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                      num_heads=2, num_kv_heads=2, intermediate_size=32,
+                      max_position_embeddings=64, num_experts=2,
+                      model_type="mixtral")
+    model = LlamaForCausalLM(cfg)
+    params = init_params(model, cfg)
+    dense_cfg = LlamaConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                            num_heads=2, num_kv_heads=2,
+                            intermediate_size=32,
+                            max_position_embeddings=64)
+    dense = LlamaForCausalLM(dense_cfg)
+    dense_params = init_params(dense, dense_cfg)
+    ids = np.ones((1, 8), np.int64) * 5
+    with pytest.raises(ValueError, match="capacity"):
+        generate_speculative(model, params, dense, dense_params, ids)
+    with pytest.raises(ValueError, match="capacity"):
+        generate_speculative(dense, dense_params, model, params, ids)
+    with pytest.raises(ValueError, match="capacity"):
+        generate_causal(model, params, ids, prefill_chunk=4)
