@@ -414,6 +414,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
         return ["flash_banded_fwd_bwd_ms"]
     if args.llama_train:
         return ["llama_1b_train_samples_per_sec_per_chip"]
+    if args.mixtral_train:
+        return ["mixtral_moe_train_samples_per_sec_per_chip"]
     if args.lora:
         return ["bert_large_lora_r8_samples_per_sec_per_chip"]
     if args.model == "bert-large":
@@ -500,6 +502,9 @@ def _run_child(args: argparse.Namespace) -> None:
     elif args.llama_train:
         from benchmarks.llama_train_bench import bench_llama_train
         bench_llama_train()
+    elif args.mixtral_train:
+        from benchmarks.mixtral_train_bench import bench_mixtral_train
+        bench_mixtral_train()
     elif args.lora:
         bench_lora()
     elif args.model == "bert-large":
@@ -529,6 +534,11 @@ def main() -> None:
                         dest="llama_train",
                         help="TinyLlama-1.1B training throughput "
                              "(bf16 Adam + remat dots + fused CE)")
+    parser.add_argument("--mixtral-train", action="store_true",
+                        dest="mixtral_train",
+                        help="sparse-MoE (Mixtral-style, 8 experts "
+                             "alternating) training throughput, routed-"
+                             "FLOPs MFU convention")
     parser.add_argument("--batch", type=int, default=None,
                         help="per-chip batch override (headline mode)")
     parser.add_argument("--opt-state-bf16", action="store_true",
@@ -550,7 +560,8 @@ def main() -> None:
                               ("--mlm", args.mlm),
                               ("--lora", args.lora),
                               ("--banded", args.banded),
-                              ("--llama-train", args.llama_train)] if on]
+                              ("--llama-train", args.llama_train),
+                              ("--mixtral-train", args.mixtral_train)] if on]
     if len(picked) > 1:
         parser.error(f"pick one mode, got {' and '.join(picked)}")
     if (args.batch is not None or args.opt_state_bf16

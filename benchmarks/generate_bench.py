@@ -170,6 +170,17 @@ def bench_generate() -> None:
                                      max_new_tokens=new_tokens,
                                      speculate_k=4),
         new_tokens, 1)
+    _, spec_stats = generate_speculative(
+        llama, llama_params, draft, d_params, spec_prompt,
+        max_new_tokens=new_tokens, speculate_k=4, return_stats=True)
+    extra_detail = {
+        "llama_greedy_b1": {"batch": 1},
+        "llama_self_spec_b1": {
+            "batch": 1,
+            "accepted_per_window": spec_stats["accepted_per_window"],
+            "window_ceiling": spec_stats["window_ceiling"],
+            "draft_layers": draft_layers},
+    }
 
     bart = BartForConditionalGeneration(bart_cfg)
     bart_params = init_params(bart, bart_cfg, seed=0)
@@ -192,7 +203,8 @@ def bench_generate() -> None:
             "vs_baseline": 0.0,  # no reference decode number (BASELINE.md)
             "detail": {"batch": batch, "prompt_len": prompt_len,
                        "new_tokens": new_tokens,
-                       "model_scale": "real" if on_tpu else "smoke"},
+                       "model_scale": "real" if on_tpu else "smoke",
+                       **extra_detail.get(mode, {})},
         }))
 
 

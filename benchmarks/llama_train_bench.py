@@ -12,6 +12,11 @@ size with the interpret-mode fused loss.
 Emits samples/s/chip, tokens/s/chip and MFU from an analytic Llama
 FLOPs model (matmul-only, 3x forward, remat recompute excluded — the
 same convention as the BERT headline).
+
+``decoder_train_bench`` is the ONE shared runner for every decoder
+-family training bench (``--mixtral-train`` reuses it), so the 16G
+recipe, the fused-CE wiring, and the emission contract cannot drift
+between benches.
 """
 
 from __future__ import annotations
@@ -32,7 +37,12 @@ def llama_train_flops_per_token(hidden: int, layers: int, heads: int,
     return 3.0 * fwd
 
 
-def bench_llama_train() -> None:
+def decoder_train_bench(metric: str, cfg, per_chip_batch: int,
+                        seq_len: int, batches: int,
+                        flops_per_sample: float, detail: dict) -> None:
+    """Shared decoder-family training bench: the 16G HBM recipe (bf16
+    Adam moments + remat dots + fused vocab-CE on TPU), the real
+    ``Trainer.fit`` loop, and the one-JSON-line emission contract."""
     import jax
 
     from bench import _flops_detail, _on_tpu
@@ -51,7 +61,6 @@ def bench_llama_train() -> None:
         init_params,
     )
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
-        LlamaConfig,
         LlamaForCausalLM,
     )
     from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
@@ -64,23 +73,8 @@ def bench_llama_train() -> None:
     from huggingface_sagemaker_tensorflow_distributed_tpu.train.trainer import (
         make_fused_causal_lm_loss,
     )
-    import jax.numpy as jnp
 
     on_tpu = _on_tpu()
-    if on_tpu:
-        per_chip_batch, seq_len, batches = 4, 1024, 8
-        cfg = LlamaConfig(                             # TinyLlama-1.1B
-            vocab_size=32000, hidden_size=2048, num_layers=22,
-            num_heads=32, num_kv_heads=4, intermediate_size=5632,
-            max_position_embeddings=seq_len, dtype=jnp.bfloat16,
-            attention_impl="flash", remat=True, remat_policy="dots")
-    else:
-        per_chip_batch, seq_len, batches = 2, 64, 4
-        cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=2,
-                          num_heads=4, num_kv_heads=2,
-                          intermediate_size=256,
-                          max_position_embeddings=seq_len)
-
     n_chips = len(jax.devices())
     global_batch = per_chip_batch * n_chips
     mesh = build_mesh(MeshConfig(dp=-1))
@@ -88,6 +82,7 @@ def bench_llama_train() -> None:
                           dtype="bfloat16" if on_tpu else "float32",
                           train_batch_size=per_chip_batch,
                           max_seq_length=seq_len, log_every_steps=0,
+                          num_experts=getattr(cfg, "num_experts", 0),
                           optimizer_state_dtype="bfloat16" if on_tpu
                           else "float32",
                           remat=on_tpu, remat_policy="dots" if on_tpu
@@ -107,11 +102,8 @@ def bench_llama_train() -> None:
                                          shuffle=False, seed=0), epochs=2)
 
     sps = history["train_samples_per_second_per_chip"]
-    flops_per_sample = seq_len * llama_train_flops_per_token(
-        cfg.hidden_size, cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
-        cfg.intermediate_size, cfg.vocab_size, seq_len)
     line = {
-        "metric": "llama_1b_train_samples_per_sec_per_chip",
+        "metric": metric,
         "value": round(sps, 3),
         "unit": "samples/sec/chip",
         "vs_baseline": 0.0,    # no reference decoder-training anchor
@@ -122,9 +114,41 @@ def bench_llama_train() -> None:
     line["detail"] = {
         "per_chip_batch": per_chip_batch, "seq_len": seq_len,
         "recipe": "bf16-adam + remat dots + fused vocab-CE + flash",
-        "model_scale": "TinyLlama-1.1B" if on_tpu else "smoke",
+        **detail,
     }
     print(json.dumps(line))
+
+
+def bench_llama_train() -> None:
+    import jax.numpy as jnp
+
+    from bench import _on_tpu
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaConfig,
+    )
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        per_chip_batch, seq_len, batches = 4, 1024, 8
+        cfg = LlamaConfig(                             # TinyLlama-1.1B
+            vocab_size=32000, hidden_size=2048, num_layers=22,
+            num_heads=32, num_kv_heads=4, intermediate_size=5632,
+            max_position_embeddings=seq_len, dtype=jnp.bfloat16,
+            attention_impl="flash", remat=True, remat_policy="dots")
+    else:
+        per_chip_batch, seq_len, batches = 2, 64, 4
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=256,
+                          max_position_embeddings=seq_len)
+
+    flops_per_sample = seq_len * llama_train_flops_per_token(
+        cfg.hidden_size, cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+        cfg.intermediate_size, cfg.vocab_size, seq_len)
+    decoder_train_bench(
+        "llama_1b_train_samples_per_sec_per_chip", cfg, per_chip_batch,
+        seq_len, batches, flops_per_sample,
+        {"model_scale": "TinyLlama-1.1B" if on_tpu else "smoke"})
 
 
 if __name__ == "__main__":

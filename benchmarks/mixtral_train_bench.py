@@ -1,0 +1,91 @@
+"""Mixtral training throughput (bench.py --mixtral-train).
+
+A ~1.6B-param sparse-MoE decoder (TinyLlama dims with 8 SwiGLU experts
+every other layer, top-2 routing) training causal-LM on one chip — the
+MoE counterpart of ``--llama-train``, run through the SAME shared
+recipe/runner (``llama_train_bench.decoder_train_bench``: bf16 Adam
+moments, remat dots, fused vocab-CE, flash attention), plus the MoE
+machinery in the hot loop (fp32 router, dense dispatch/combine einsums,
+causal slot priority, Switch aux loss). On one chip there is no
+``expert`` mesh axis, so this measures the compute path; the ep
+all-to-all scaling is certified separately by ``dryrun_multichip``.
+
+MFU accounting: the sparse model executes only the ROUTED expert FLOPs
+(top-2 of 8 experts per token), so FLOPs/token counts expert_top_k
+expert MLPs per MoE layer — counting all 8 would overstate utilization
+~4x on the MoE layers. Dispatch/combine einsums and the router are
+excluded (few % at these shapes), the same matmul-only 3x-forward
+convention as every other bench.
+"""
+
+from __future__ import annotations
+
+
+def mixtral_train_flops_per_token(hidden: int, layers: int, heads: int,
+                                  kv_heads: int, intermediate: int,
+                                  vocab: int, seq_len: int,
+                                  moe_every: int, top_k: int) -> float:
+    """Analytic matmul FLOPs per TOKEN (3x fwd): the dense model's
+    figure plus (top_k - 1) extra routed SwiGLU MLPs on each MoE layer
+    — reuses the dense formula so the shared terms cannot drift."""
+    from benchmarks.llama_train_bench import llama_train_flops_per_token
+
+    dense = llama_train_flops_per_token(hidden, layers, heads, kv_heads,
+                                        intermediate, vocab, seq_len)
+    n_moe = layers // moe_every
+    extra_mlp = (top_k - 1) * 6 * hidden * intermediate
+    return dense + 3.0 * n_moe * extra_mlp
+
+
+def bench_mixtral_train() -> None:
+    import jax.numpy as jnp
+
+    from bench import _on_tpu
+    from benchmarks.llama_train_bench import decoder_train_bench
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaConfig,
+    )
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        # TinyLlama dims + 8 experts on alternating layers: ~1.6B params
+        # total, ~1.15B active per token — fits 16G with the bf16-Adam
+        # + remat-dots recipe at batch 2
+        per_chip_batch, seq_len, batches = 2, 1024, 8
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, num_layers=22,
+            num_heads=32, num_kv_heads=4, intermediate_size=5632,
+            max_position_embeddings=seq_len, dtype=jnp.bfloat16,
+            attention_impl="flash", remat=True, remat_policy="dots",
+            num_experts=8, expert_top_k=2, moe_every=2,
+            model_type="mixtral")
+    else:
+        per_chip_batch, seq_len, batches = 2, 64, 4
+        cfg = LlamaConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=256,
+                          max_position_embeddings=seq_len,
+                          num_experts=4, expert_top_k=2, moe_every=2,
+                          model_type="mixtral")
+
+    flops_per_sample = seq_len * mixtral_train_flops_per_token(
+        cfg.hidden_size, cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+        cfg.intermediate_size, cfg.vocab_size, seq_len, cfg.moe_every,
+        cfg.expert_top_k)
+    decoder_train_bench(
+        "mixtral_moe_train_samples_per_sec_per_chip", cfg, per_chip_batch,
+        seq_len, batches, flops_per_sample,
+        {"experts": cfg.num_experts, "top_k": cfg.expert_top_k,
+         "moe_every": cfg.moe_every,
+         "flops_convention": "routed experts only (top_k of E)",
+         "model_scale": ("TinyLlama+8e alternating (~1.6B total)"
+                         if on_tpu else "smoke")})
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    bench_mixtral_train()

@@ -77,6 +77,7 @@ run bert_large python bench.py --model bert-large
 run bert_large_lora python bench.py --lora
 run banded python bench.py --banded
 run llama_train python bench.py --llama-train
+run mixtral_train python bench.py --mixtral-train
 
 # 5. scaling instrument (collective fraction from a real trace)
 run mesh python bench.py --mesh

@@ -522,15 +522,17 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
              jnp.full((B,), P, jnp.int32),                     # n_ctx: slots
              n_real,                                           # n_pos: logical
              first, t_cache, d_cache, valid,
-             first == cfg.eos_token_id)                        # finished [B]
+             first == cfg.eos_token_id,                        # finished [B]
+             jnp.zeros((), jnp.int32),                         # iterations
+             jnp.zeros((), jnp.int32))                         # active windows
 
     def cond(state):
-        n_out, finished = state[1], state[-1]
+        n_out, finished = state[1], state[8]
         return jnp.any((n_out < T) & ~finished)
 
     def body(state):
         (out, n_out, n_ctx, n_pos, last, t_cache, d_cache, valid,
-         finished) = state
+         finished, iters, act_win) = state
         active = (n_out < T) & ~finished                       # [B]
 
         # 1. draft k greedy candidates autoregressively (its cache copy
@@ -609,16 +611,20 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
 
         last = jnp.where(active, bonus, last)
         return (out, n_out + n_new, new_ctx, n_pos + n_new, last,
-                t_cache, d_cache, valid, finished)
+                t_cache, d_cache, valid, finished, iters + 1,
+                act_win + jnp.sum(active.astype(jnp.int32)))
 
     state = lax.while_loop(cond, body, state)
-    return state[0][:, :T]
+    # (tokens, raw per-row counts incl. prefill, iterations, active
+    # row×window pairs — the denominator for acceptance accounting)
+    return state[0][:, :T], state[1], state[9], state[10]
 
 
 def generate_speculative(model, params, draft_model, draft_params,
                          input_ids, attention_mask=None,
                          max_new_tokens: int = 64,
-                         speculate_k: int = 4) -> jax.Array:
+                         speculate_k: int = 4,
+                         return_stats: bool = False):
     """Greedy speculative decoding: a small draft model proposes
     ``speculate_k`` tokens autoregressively, the target model scores the
     whole window in ONE decode pass, and the longest draft prefix that
@@ -674,10 +680,25 @@ def generate_speculative(model, params, draft_model, draft_params,
             "would silently break")
     if speculate_k < 1:
         raise ValueError("speculate_k must be >= 1")
-    return _speculative_jit(model, params, draft_model, draft_params,
-                            input_ids,
-                            jnp.asarray(attention_mask, jnp.int32),
-                            int(max_new_tokens), int(speculate_k))
+    tokens, n_out, iters, act_win = _speculative_jit(
+        model, params, draft_model, draft_params, input_ids,
+        jnp.asarray(attention_mask, jnp.int32), int(max_new_tokens),
+        int(speculate_k))
+    if not return_stats:
+        return tokens
+    produced = np.asarray(n_out)
+    # the first token comes from the prefill, not a verify window, so
+    # window-accepted tokens per row = n_out - 1 (RAW, not capped at
+    # max_new_tokens — the final window may overshoot the cap). Each
+    # ACTIVE (row, window) pair yields 1..k+1 tokens, so dividing by
+    # the active-pair count keeps the metric in that range even when
+    # rows finish at different times.
+    per_window = float(produced.sum() - len(produced)) / max(int(act_win), 1)
+    return tokens, {"iterations": int(iters),
+                    "tokens_generated":
+                        np.minimum(produced, int(max_new_tokens)).tolist(),
+                    "accepted_per_window": round(per_window, 3),
+                    "window_ceiling": int(speculate_k) + 1}
 
 
 def self_draft(model, params, num_layers: int):

@@ -18,8 +18,8 @@ _DEFAULT_PARITY = {"pass": 8, "fail": 0, "subset": True, "rc": 0}
 def _args(**kw):
     base = dict(model=None, buckets=False, mesh=False, generate=False,
                 causal_lm=False, mlm=False, lora=False, banded=False,
-                llama_train=False, batch=None, opt_state_bf16=False,
-                remat_policy=None)
+                llama_train=False, mixtral_train=False, batch=None,
+                opt_state_bf16=False, remat_policy=None)
     base.update(kw)
     ns = argparse.Namespace(**base)
     setattr(ns, "_child", False)

@@ -211,3 +211,25 @@ def test_self_draft_rejects_bad_layer_counts():
         self_draft(target, t_params, 0)
     with pytest.raises(ValueError, match="num_layers"):
         self_draft(target, t_params, 3)
+
+
+def test_speculative_stats_reporting():
+    """return_stats exposes iteration count and accepted-per-window —
+    a perfect draft accepts the full window every time."""
+    target, t_params = _llama(2, seed=0)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(3, 128, (1, 5))
+    tokens, stats = generate_speculative(
+        target, t_params, target, t_params, ids, max_new_tokens=12,
+        speculate_k=3, return_stats=True)
+    want = np.asarray(generate_causal(target, t_params, ids,
+                                      max_new_tokens=12))
+    np.testing.assert_array_equal(np.asarray(tokens), want)
+    assert stats["window_ceiling"] == 4
+    assert 1.0 <= stats["accepted_per_window"] <= 4.0
+    # perfect draft: every window fully accepted unless EOS cut it
+    # short — the metric uses RAW window yields (the final window may
+    # overshoot max_new_tokens), so it sits exactly at the ceiling
+    if not (want == 2).any():
+        assert stats["iterations"] == 3       # ceil((12-1)/4)
+        assert stats["accepted_per_window"] == 4.0
