@@ -293,6 +293,16 @@ def generate_causal(model, params, input_ids, attention_mask=None,
 _NEG = jnp.float32(-1e9)
 
 
+def _pool_merge(K, fin_scores, fin_tok, cand_scores, cand_tok):
+    """Keep the best K of (current finished pool) ∪ (candidates) — the
+    ONE finished-hypothesis merge both beam searches share."""
+    all_scores = jnp.concatenate([fin_scores, cand_scores], axis=1)
+    all_tok = jnp.concatenate([fin_tok, cand_tok], axis=1)
+    new_scores, idx = lax.top_k(all_scores, K)
+    return new_scores, jnp.take_along_axis(all_tok, idx[:, :, None],
+                                           axis=1)
+
+
 @functools.partial(jax.jit, static_argnames=("model", "num_beams",
                                              "max_new_tokens"))
 def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
@@ -338,13 +348,7 @@ def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
     fin_tok = jnp.full((B, K, T), cfg.pad_token_id, jnp.int32)
     done = jnp.zeros((B,), bool)
 
-    def pool_merge(fin_scores, fin_tok, cand_scores, cand_tok):
-        """Keep the best K of (current pool) ∪ (candidates)."""
-        all_scores = jnp.concatenate([fin_scores, cand_scores], axis=1)
-        all_tok = jnp.concatenate([fin_tok, cand_tok], axis=1)
-        new_scores, idx = lax.top_k(all_scores, K)
-        return new_scores, jnp.take_along_axis(all_tok, idx[:, :, None],
-                                               axis=1)
+    pool_merge = functools.partial(_pool_merge, K)
 
     def step(carry, t):
         (token, cache, live_scores, live_tok, fin_scores, fin_tok,
@@ -414,6 +418,167 @@ def _beam_search_jit(model, params, input_ids, attention_mask, num_beams,
     best = jnp.argmax(fin_scores, axis=1)                      # [B]
     return (jnp.take_along_axis(fin_tok, best[:, None, None], axis=1)[:, 0],
             jnp.take_along_axis(fin_scores, best[:, None], axis=1)[:, 0])
+
+
+@functools.partial(jax.jit, static_argnames=("model", "num_beams",
+                                             "max_new_tokens"))
+def _beam_search_causal_jit(model, params, input_ids, attention_mask,
+                            num_beams, max_new_tokens, length_penalty):
+    """Beam search for DECODER-ONLY models (GPT-2 / Llama family), the
+    same HF ``BeamSearchScorer`` semantics as ``_beam_search_jit``, with
+    two structural differences:
+
+    - there is no decoder-start token: the first candidate distribution
+      comes from the PREFILL's last-real-token logits, so step 0 runs
+      outside the scan (exactly ``generate_causal``'s shape). The
+      prefill runs ONCE per input row at [B]; its cache leaves are then
+      repeated across beams (the enc-dec variant's encode-once shape);
+    - HF normalizes hypotheses by GENERATED length for decoder-only
+      models too (``generated_len = cur_len - decoder_prompt_len`` in
+      modern ``BeamSearchScorer``), so the ``t + 1`` convention is
+      shared with the enc-dec scorer.
+
+    Beams ride the batch dim ([B*K] rows); the KV cache — including the
+    per-row ``cache_index`` vectors — is re-gathered by parent beam
+    each step (only true scalars like the model-level position_index
+    are exempt from the gather).
+    """
+    cfg = model.config
+    B, P = input_ids.shape
+    K, V, T = num_beams, cfg.vocab_size, max_new_tokens
+    BK = B * K
+    total = P + T
+
+    _, variables = model.apply(
+        {"params": params}, jnp.ones((B, total), jnp.int32), decode=True,
+        deterministic=True, mutable=["cache"])
+    cache = variables["cache"]
+    valid_row = jnp.concatenate(
+        [attention_mask, jnp.zeros((B, T), jnp.int32)], axis=1)
+    pos = jnp.clip(jnp.cumsum(attention_mask, axis=1) - 1,
+                   0).astype(jnp.int32)
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, input_ids, valid_row,
+        position_ids=pos, decode=True, deterministic=True,
+        mutable=["cache"])
+    last_real = P - 1 - jnp.argmax(attention_mask[:, ::-1], axis=1)
+    logp0 = jax.nn.log_softmax(jnp.take_along_axis(
+        logits.astype(jnp.float32), last_real[:, None, None],
+        axis=1)[:, 0])[:, None, :]                             # [B, 1, V]
+    logp0 = jnp.broadcast_to(logp0, (B, K, V))
+    # one prefill per row, K cache copies per row (encode-once shape)
+    cache = jax.tree.map(
+        lambda x: x if x.ndim == 0 else jnp.repeat(x, K, axis=0),
+        mut["cache"])
+    valid = jnp.repeat(valid_row, K, axis=0)                   # [BK, ...]
+    n_real = jnp.repeat(jnp.sum(attention_mask, axis=1), K,
+                        axis=0).astype(jnp.int32)
+
+    live_scores = jnp.tile(jnp.concatenate(
+        [jnp.zeros((1,), jnp.float32),
+         jnp.full((K - 1,), _NEG, jnp.float32)]), (B, 1))      # [B, K]
+    live_tok = jnp.full((B, K, T), cfg.pad_token_id, jnp.int32)
+    fin_scores = jnp.full((B, K), _NEG, jnp.float32)           # penalized
+    fin_tok = jnp.full((B, K, T), cfg.pad_token_id, jnp.int32)
+    done = jnp.zeros((B,), bool)
+
+    pool_merge = functools.partial(_pool_merge, K)
+
+    def select(t, logp, cache, live_scores, live_tok, fin_scores,
+               fin_tok, done):
+        """One round of HF candidate selection/banking at emitted-token
+        index ``t`` (generated hypothesis length = t + 1)."""
+        cand = live_scores[:, :, None] + logp                  # [B, K, V]
+        top2k, flat = lax.top_k(cand.reshape(B, K * V), 2 * K)
+        parent = flat // V                                     # [B, 2K]
+        tok2k = (flat % V).astype(jnp.int32)
+        is_eos = tok2k == cfg.eos_token_id
+
+        seq2k = jnp.take_along_axis(live_tok, parent[:, :, None], axis=1)
+        seq2k = lax.dynamic_update_index_in_dim(seq2k, tok2k, t, axis=2)
+
+        cur_len = (t + 1).astype(jnp.float32)
+        rank_ok = jnp.arange(2 * K)[None, :] < K
+        eos_norm = jnp.where(is_eos & rank_ok & ~done[:, None],
+                             top2k / cur_len ** length_penalty, _NEG)
+        fin_scores, fin_tok = pool_merge(fin_scores, fin_tok, eos_norm,
+                                         seq2k)
+
+        live_cand = jnp.where(is_eos, _NEG, top2k)
+        live_scores, keep = lax.top_k(live_cand, K)            # [B, K]
+        emit = jnp.take_along_axis(tok2k, keep, axis=1)
+        live_tok = jnp.take_along_axis(seq2k, keep[:, :, None], axis=1)
+        parent_k = jnp.take_along_axis(parent, keep, axis=1)
+        gather = (jnp.arange(B)[:, None] * K + parent_k).reshape(-1)
+        cache = jax.tree.map(
+            # k/v buffers AND per-row cache_index are [BK, ...]; only
+            # true scalars (model-level position_index) stay put
+            lambda x: x if x.ndim == 0 else jnp.take(x, gather, axis=0),
+            cache)
+
+        attainable = top2k[:, 0] / cur_len ** length_penalty
+        done = done | (jnp.min(fin_scores, axis=1) >= attainable)
+        return (emit.reshape(BK, 1), cache, live_scores, live_tok,
+                fin_scores, fin_tok, done)
+
+    token, cache, live_scores, live_tok, fin_scores, fin_tok, done = \
+        select(jnp.asarray(0), logp0, cache, live_scores, live_tok,
+               fin_scores, fin_tok, done)
+
+    def step(carry, t):
+        (token, cache, valid, live_scores, live_tok, fin_scores, fin_tok,
+         done) = carry
+        # the token emitted at t-1 writes cache slot P + t - 1 and
+        # carries logical position n_real + t - 1
+        valid = lax.dynamic_update_slice(
+            valid, jnp.ones((BK, 1), jnp.int32), (0, P + t - 1))
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, token, valid,
+            position_ids=(n_real + t - 1)[:, None], decode=True,
+            deterministic=True, mutable=["cache"])
+        logp = jax.nn.log_softmax(
+            logits[:, -1, :].astype(jnp.float32)).reshape(B, K, V)
+        out = select(t, logp, mut["cache"], live_scores, live_tok,
+                     fin_scores, fin_tok, done)
+        return (out[0], out[1], valid) + out[2:], None
+
+    carry = (token, cache, valid, live_scores, live_tok, fin_scores,
+             fin_tok, done)
+    (_, _, _, live_scores, live_tok, fin_scores, fin_tok, done), _ = \
+        lax.scan(step, carry, jnp.arange(1, T))
+
+    # HF finalize: rows not done bank live beams at generated length T
+    live_norm = jnp.where(done[:, None], _NEG,
+                          live_scores / jnp.float32(T) ** length_penalty)
+    fin_scores, fin_tok = pool_merge(fin_scores, fin_tok, live_norm,
+                                     live_tok)
+    best = jnp.argmax(fin_scores, axis=1)                      # [B]
+    return (jnp.take_along_axis(fin_tok, best[:, None, None], axis=1)[:, 0],
+            jnp.take_along_axis(fin_scores, best[:, None], axis=1)[:, 0])
+
+
+def beam_search_causal(model, params, input_ids, attention_mask=None,
+                       num_beams: int = 4, max_new_tokens: int = 64,
+                       length_penalty: float = 1.0,
+                       return_scores: bool = False):
+    """Beam-search decode for decoder-only models (GPT-2, dense Llama
+    family). Returns [batch, max_new_tokens] continuation ids (padded
+    after EOS); with ``return_scores``, also the winning hypotheses'
+    length-penalized scores. MoE models are rejected for the same
+    capacity reason as generate_speculative."""
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if attention_mask is None:
+        attention_mask = jnp.ones_like(input_ids)
+    attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    if getattr(model.config, "num_experts", 0):
+        raise ValueError(
+            "beam_search_causal does not support MoE models (Mixtral): "
+            "expert capacity depends on the apply's sequence length, so "
+            "beam prefill vs single-token steps could route differently")
+    ids, scores = _beam_search_causal_jit(
+        model, params, input_ids, attention_mask, int(num_beams),
+        int(max_new_tokens), jnp.float32(length_penalty))
+    return (ids, scores) if return_scores else ids
 
 
 def beam_search_generate(model, params, input_ids, attention_mask=None,

@@ -184,6 +184,10 @@ def predict(args) -> list[dict]:
                 raise SystemExit("--prefill_chunk cannot combine with "
                                  "speculative decoding (its prefill is "
                                  "not chunked)")
+            if args.num_beams > 1:
+                raise SystemExit("--prefill_chunk cannot combine with "
+                                 "--num_beams (beam prefill is not "
+                                 "chunked)")
         if args.task == "seq2seq":
             if args.num_beams > 1:
                 out = beam_search_generate(model, params, ids, mask,
@@ -248,6 +252,19 @@ def predict(args) -> list[dict]:
                 for i, r in enumerate(sel):
                     rows[r] = outs[i]
             out = np.stack(rows, axis=0)
+        elif args.num_beams > 1:
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+                beam_search_causal,
+            )
+
+            if args.temperature or args.top_k or args.top_p:
+                raise SystemExit("--num_beams is deterministic beam "
+                                 "search; it cannot combine with "
+                                 "--temperature/--top_k/--top_p")
+            out = beam_search_causal(model, params, ids, mask,
+                                     num_beams=args.num_beams,
+                                     max_new_tokens=args.max_new_tokens,
+                                     length_penalty=args.length_penalty)
         else:
             out = generate_causal(model, params, ids, mask,
                                   max_new_tokens=args.max_new_tokens,
