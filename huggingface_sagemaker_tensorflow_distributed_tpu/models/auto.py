@@ -381,12 +381,12 @@ def from_pretrained(
             f"pipeline_stages={wants_pp} is not supported for family "
             f"{family!r}; supported: {sorted(_PIPELINE_FAMILIES)}")
     wants_kv = config_overrides.get("kv_cache_dtype", "fp")
-    if wants_kv != "fp" and family != "llama":
+    if wants_kv != "fp" and family not in ("llama", "gpt2"):
         # fail with names here, not as a TypeError inside a frozen
         # config constructor (same convention as the MoE/pp guards)
         raise ValueError(
             f"kv_cache_dtype={wants_kv!r} is only supported for the "
-            f"llama family, not {family!r}")
+            f"decoder-only families (llama, gpt2), not {family!r}")
     if family in ("t5", "bart", "mbart") and task != "seq2seq":
         # failing loudly here beats a TypeError deep inside jit tracing
         # when the seq-cls loss feeds an encoder-decoder model

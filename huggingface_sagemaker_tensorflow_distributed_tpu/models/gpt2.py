@@ -96,6 +96,16 @@ class Gpt2Config:
     # int8 weight-only dense kernels for generation (models/quant.py;
     # load via quantize_gpt2 — never trained in this form)
     weight_quant: str = "none"            # none | int8
+    # Decode KV cache storage (same contract as LlamaConfig): "int8"
+    # stores symmetric per-(head, slot) int8 + fp32 scales — halves the
+    # cache bytes read per decode step vs bf16
+    kv_cache_dtype: str = "fp"            # fp | int8
+
+    def __post_init__(self):
+        if self.kv_cache_dtype not in ("fp", "int8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r} "
+                "(fp | int8)")
 
 
 def gpt2_config_from_hf(hf_config: dict, **overrides) -> Gpt2Config:
@@ -164,9 +174,19 @@ class Gpt2Attention(nn.Module):
         causal = True
         if decode:
             B = q.shape[0]
+            int8_kv = cfg.kv_cache_dtype == "int8"
+            kv_store = jnp.int8 if int8_kv else k.dtype
             is_init = self.has_variable("cache", "cached_key")
-            cached_k = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
-            cached_v = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            cached_k = self.variable("cache", "cached_key", jnp.zeros,
+                                     k.shape, kv_store)
+            cached_v = self.variable("cache", "cached_value", jnp.zeros,
+                                     v.shape, kv_store)
+            if int8_kv:
+                scale_shape = k.shape[:3] + (1,)
+                k_scale = self.variable("cache", "cached_key_scale",
+                                        jnp.zeros, scale_shape, jnp.float32)
+                v_scale = self.variable("cache", "cached_value_scale",
+                                        jnp.zeros, scale_shape, jnp.float32)
             # per-row write indices [B] — rows may sit at different
             # depths under speculative decode (models/generate.py)
             cache_index = self.variable("cache", "cache_index",
@@ -179,9 +199,29 @@ class Gpt2Attention(nn.Module):
                 def row_write(buf, new, c):
                     return lax.dynamic_update_slice(buf, new, (0, c, 0))
 
-                k = jax.vmap(row_write)(cached_k.value, k, cur)
-                v = jax.vmap(row_write)(cached_v.value, v, cur)
-                cached_k.value, cached_v.value = k, v
+                if int8_kv:
+                    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+                        kv_quantize,
+                    )
+
+                    qk, sk = kv_quantize(k)
+                    qv, sv = kv_quantize(v)
+                    cached_k.value = jax.vmap(row_write)(cached_k.value,
+                                                         qk, cur)
+                    cached_v.value = jax.vmap(row_write)(cached_v.value,
+                                                         qv, cur)
+                    k_scale.value = jax.vmap(row_write)(k_scale.value,
+                                                        sk, cur)
+                    v_scale.value = jax.vmap(row_write)(v_scale.value,
+                                                        sv, cur)
+                    k = (cached_k.value.astype(jnp.float32)
+                         * k_scale.value).astype(cfg.dtype)
+                    v = (cached_v.value.astype(jnp.float32)
+                         * v_scale.value).astype(cfg.dtype)
+                else:
+                    k = jax.vmap(row_write)(cached_k.value, k, cur)
+                    v = jax.vmap(row_write)(cached_v.value, v, cur)
+                    cached_k.value, cached_v.value = k, v
                 cache_index.value = cur + q_len
                 valid = jnp.arange(max_len)[None, None, :] <= (
                     cur[:, None, None] + jnp.arange(q_len)[None, :, None])

@@ -397,9 +397,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "chunks (causal-lm; O(chunk) attention memory "
                          "instead of O(prompt), same tokens out)")
     ap.add_argument("--kv_cache", choices=["fp", "int8"], default="fp",
-                    help="decode KV cache storage (Llama family): int8 "
-                         "halves cache bytes read per step at long "
-                         "context")
+                    help="decode KV cache storage (Llama family + "
+                         "GPT-2): int8 halves cache bytes read per "
+                         "step at long context")
     ap.add_argument("--draft_dir", default=None,
                     help="draft-model checkpoint dir for speculative "
                          "decoding (causal-lm, greedy-exact: the draft "

@@ -105,19 +105,42 @@ def test_unknown_kv_cache_dtype_rejected():
         LlamaConfig(kv_cache_dtype="int4")
 
 
-def test_int8_kv_rejected_for_non_llama(tmp_path):
-    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+def test_gpt2_int8_kv_decode_matches_fp():
+    """Same contract on the GPT-2 cache convention."""
     from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
         Gpt2Config,
         Gpt2LMHeadModel,
     )
 
-    cfg = Gpt2Config(vocab_size=64, hidden_size=16, num_layers=1,
-                     num_heads=2, intermediate_size=32,
-                     max_position_embeddings=32)
-    params = init_params(Gpt2LMHeadModel(cfg), cfg)
-    d = str(tmp_path / "gpt2")
-    auto_models.save_pretrained(d, params, "gpt2", cfg)
+    base = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                intermediate_size=64, max_position_embeddings=128,
+                hidden_dropout=0.0, embd_dropout=0.0,
+                attention_dropout=0.0)
+    params = init_params(Gpt2LMHeadModel(Gpt2Config(**base)),
+                         Gpt2Config(**base))
+    model_fp = Gpt2LMHeadModel(Gpt2Config(**base))
+    model_q = Gpt2LMHeadModel(Gpt2Config(**base, kv_cache_dtype="int8"))
+    rng = np.random.RandomState(3)
+    ids = rng.randint(3, 128, (2, 9))
+    want = np.asarray(generate_causal(model_fp, params, ids,
+                                      max_new_tokens=12))
+    got = np.asarray(generate_causal(model_q, params, ids,
+                                     max_new_tokens=12))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_int8_kv_rejected_for_non_decoder_family(tmp_path):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models import auto as auto_models
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+        T5Config,
+        T5ForConditionalGeneration,
+    )
+
+    cfg = T5Config(vocab_size=64, d_model=16, d_kv=8, d_ff=32,
+                   num_layers=1, num_decoder_layers=1, num_heads=2)
+    params = init_params(T5ForConditionalGeneration(cfg), cfg)
+    d = str(tmp_path / "t5")
+    auto_models.save_pretrained(d, params, "t5", cfg)
     with pytest.raises(ValueError, match="kv_cache_dtype"):
-        auto_models.from_pretrained(d, task="causal-lm",
+        auto_models.from_pretrained(d, task="seq2seq",
                                     kv_cache_dtype="int8")
