@@ -192,36 +192,17 @@ class Gpt2Attention(nn.Module):
             cache_index = self.variable("cache", "cache_index",
                                         lambda: jnp.zeros((B,), jnp.int32))
             if is_init:
+                from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+                    write_kv_cache,
+                )
+
                 cur = cache_index.value                       # [B]
                 max_len = cached_k.value.shape[2]
                 q_len = q.shape[2]
-
-                def row_write(buf, new, c):
-                    return lax.dynamic_update_slice(buf, new, (0, c, 0))
-
-                if int8_kv:
-                    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
-                        kv_quantize,
-                    )
-
-                    qk, sk = kv_quantize(k)
-                    qv, sv = kv_quantize(v)
-                    cached_k.value = jax.vmap(row_write)(cached_k.value,
-                                                         qk, cur)
-                    cached_v.value = jax.vmap(row_write)(cached_v.value,
-                                                         qv, cur)
-                    k_scale.value = jax.vmap(row_write)(k_scale.value,
-                                                        sk, cur)
-                    v_scale.value = jax.vmap(row_write)(v_scale.value,
-                                                        sv, cur)
-                    k = (cached_k.value.astype(jnp.float32)
-                         * k_scale.value).astype(cfg.dtype)
-                    v = (cached_v.value.astype(jnp.float32)
-                         * v_scale.value).astype(cfg.dtype)
-                else:
-                    k = jax.vmap(row_write)(cached_k.value, k, cur)
-                    v = jax.vmap(row_write)(cached_v.value, v, cur)
-                    cached_k.value, cached_v.value = k, v
+                k, v = write_kv_cache(
+                    cached_k, cached_v,
+                    (k_scale, v_scale) if int8_kv else None, k, v, cur,
+                    cfg.dtype)
                 cache_index.value = cur + q_len
                 valid = jnp.arange(max_len)[None, None, :] <= (
                     cur[:, None, None] + jnp.arange(q_len)[None, :, None])

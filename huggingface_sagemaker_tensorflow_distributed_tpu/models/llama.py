@@ -372,6 +372,39 @@ def kv_quantize(x):
     return jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8), scale
 
 
+def write_kv_cache(cached_k, cached_v, scales, k, v, cur, compute_dtype):
+    """The ONE decode-cache storage protocol shared by every decoder
+    family's attention (Llama family + GPT-2): per-row
+    ``dynamic_update_slice`` writes of the new K/V at each row's write
+    index ``cur`` [B]; when ``scales`` is a ``(k_scale, v_scale)``
+    variable pair the values are stored int8 with per-(head, slot)
+    fp32 scales and the returned buffers are dequantized to
+    ``compute_dtype`` (the read fuses the dequant). Returns the FULL
+    [B, H, max_len, D] key/value buffers for attention."""
+
+    def row_write(buf, new, c):
+        # buf [H, S, D], new [H, q, D], c scalar — one row's write
+        return lax.dynamic_update_slice(buf, new, (0, c, 0))
+
+    if scales is not None:
+        k_scale, v_scale = scales
+        qk, sk = kv_quantize(k)
+        qv, sv = kv_quantize(v)
+        cached_k.value = jax.vmap(row_write)(cached_k.value, qk, cur)
+        cached_v.value = jax.vmap(row_write)(cached_v.value, qv, cur)
+        k_scale.value = jax.vmap(row_write)(k_scale.value, sk, cur)
+        v_scale.value = jax.vmap(row_write)(v_scale.value, sv, cur)
+        k = (cached_k.value.astype(jnp.float32)
+             * k_scale.value).astype(compute_dtype)
+        v = (cached_v.value.astype(jnp.float32)
+             * v_scale.value).astype(compute_dtype)
+        return k, v
+    k = jax.vmap(row_write)(cached_k.value, k, cur)
+    v = jax.vmap(row_write)(cached_v.value, v, cur)
+    cached_k.value, cached_v.value = k, v
+    return k, v
+
+
 class LlamaAttention(nn.Module):
     """GQA self-attention with RoPE and an optional incremental KV cache
     (cached pre-repeat: [B, H_kv, max_len, D]; stored int8 + per-slot
@@ -432,32 +465,10 @@ class LlamaAttention(nn.Module):
                 cur = cache_index.value                       # [B]
                 max_len = cached_k.value.shape[2]
                 q_len = q.shape[2]
-
-                def row_write(buf, new, c):
-                    # buf [H, S, D], new [H, q, D], c scalar
-                    return lax.dynamic_update_slice(buf, new, (0, c, 0))
-
-                if int8_kv:
-                    qk, sk = kv_quantize(k)
-                    qv, sv = kv_quantize(v)
-                    cached_k.value = jax.vmap(row_write)(cached_k.value,
-                                                         qk, cur)
-                    cached_v.value = jax.vmap(row_write)(cached_v.value,
-                                                         qv, cur)
-                    k_scale.value = jax.vmap(row_write)(k_scale.value,
-                                                        sk, cur)
-                    v_scale.value = jax.vmap(row_write)(v_scale.value,
-                                                        sv, cur)
-                    # dequant fuses into the cache read; math continues
-                    # in the compute dtype
-                    k = (cached_k.value.astype(jnp.float32)
-                         * k_scale.value).astype(cfg.dtype)
-                    v = (cached_v.value.astype(jnp.float32)
-                         * v_scale.value).astype(cfg.dtype)
-                else:
-                    k = jax.vmap(row_write)(cached_k.value, k, cur)
-                    v = jax.vmap(row_write)(cached_v.value, v, cur)
-                    cached_k.value, cached_v.value = k, v
+                k, v = write_kv_cache(
+                    cached_k, cached_v,
+                    (k_scale, v_scale) if int8_kv else None, k, v, cur,
+                    cfg.dtype)
                 cache_index.value = cur + q_len
                 key_pos = jnp.arange(max_len)[None, :]        # [1, S]
                 qry_pos = (cur[:, None, None]

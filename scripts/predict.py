@@ -87,7 +87,8 @@ def predict(args) -> list[dict]:
     if getattr(args, "kv_cache", "fp") != "fp":
         if args.task != "causal-lm":
             raise SystemExit("--kv_cache int8 is a decode-cache knob "
-                             "(Llama family); use --task causal-lm")
+                             "(Llama family + GPT-2); use --task "
+                             "causal-lm")
         overrides["kv_cache_dtype"] = args.kv_cache
     model, params, family, config = auto_models.from_pretrained(
         args.model_dir, task=args.task, num_labels=args.num_labels,

@@ -101,8 +101,14 @@ def test_int8_kv_composes_with_speculative():
 
 
 def test_unknown_kv_cache_dtype_rejected():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+    )
+
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         LlamaConfig(kv_cache_dtype="int4")
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        Gpt2Config(kv_cache_dtype="int4")
 
 
 def test_gpt2_int8_kv_decode_matches_fp():
