@@ -2,9 +2,11 @@
 
 HF ``model.generate(num_beams=K, do_sample=False)`` parity for GPT-2
 and Llama on the same weights: the 2K-candidate grid, add-time length
-penalty over the FULL sequence length (prompt included — the decoder
--only difference from the enc-dec scorer), the finished-hypothesis
-pool, and is_done bookkeeping must all agree token-for-token.
+penalty over the GENERATED length (modern ``BeamSearchScorer``
+normalizes by ``cur_len - decoder_prompt_len``), the finished
+-hypothesis pool, and is_done bookkeeping must all agree token-for
+-token — and the sequences_scores must match numerically, which pins
+the normalization choice.
 """
 
 import numpy as np
@@ -177,3 +179,22 @@ def test_beam_causal_rejects_moe():
     params = init_params(model, cfg)
     with pytest.raises(ValueError, match="capacity"):
         gen.beam_search_causal(model, params, np.ones((1, 4), np.int64))
+
+
+def test_beam_composes_with_int8_kv(llama_dir):
+    """Beam search's per-step cache gather must carry the int8 scale
+    leaves along with the quantized buffers — beam under the int8 cache
+    equals beam under the fp cache on the tiny model."""
+    d, _ = llama_dir
+    model, params, _, _ = auto_models.from_pretrained(d, task="causal-lm")
+    model_q, params_q, _, _ = auto_models.from_pretrained(
+        d, task="causal-lm", kv_cache_dtype="int8")
+    rng = np.random.RandomState(9)
+    ids = rng.randint(3, 96, (2, 5))
+    want = np.asarray(gen.beam_search_causal(model, params, ids,
+                                             num_beams=3,
+                                             max_new_tokens=6))
+    got = np.asarray(gen.beam_search_causal(model_q, params_q, ids,
+                                            num_beams=3,
+                                            max_new_tokens=6))
+    np.testing.assert_array_equal(got, want)
