@@ -603,6 +603,39 @@ def beam_search_generate(model, params, input_ids, attention_mask=None,
 # ---------------------------------------------------------------------------
 
 
+def _speculative_accept(p, q, drafts, key):
+    """Speculative SAMPLING acceptance for one row's verify window
+    (Leviathan et al. 2023): draft token ``d_i ~ q_i`` is accepted with
+    probability ``min(1, p_i(d_i)/q_i(d_i))``; at the first rejection
+    the replacement is drawn from the residual ``max(p_i - q_i, 0)``
+    (renormalized), and if every draft survives the bonus token is
+    drawn from ``p_k``. The emitted marginal is EXACTLY the target
+    distribution ``p`` at every position — the draft changes speed,
+    never the distribution.
+
+    ``p`` [k+1, V] target probs, ``q`` [k, V] draft probs, ``drafts``
+    [k] the draft's sampled tokens. Returns (n_acc, next_token).
+    """
+    k = drafts.shape[0]
+    key_u, key_res, key_bonus = jax.random.split(key, 3)
+    u = jax.random.uniform(key_u, (k,))
+    p_d = jnp.take_along_axis(p[:k], drafts[:, None], axis=1)[:, 0]
+    q_d = jnp.take_along_axis(q, drafts[:, None], axis=1)[:, 0]
+    # u < min(1, p/q)  ⟺  u*q < p  (division-free; q(d) > 0 a.s. since
+    # d was sampled from q)
+    accept = (u * q_d < p_d).astype(jnp.int32)
+    n_acc = jnp.argmin(jnp.concatenate(
+        [accept, jnp.zeros((1,), jnp.int32)]))                 # first reject
+    res = jnp.maximum(p[n_acc] - q[jnp.minimum(n_acc, k - 1)], 0.0)
+    # all-zero residual can only mean p == q at this position (then the
+    # draft is never rejected); guard the renormalization anyway
+    res = jnp.where(jnp.sum(res) > 0, res, p[n_acc])
+    resampled = jax.random.categorical(key_res, jnp.log(res + 1e-30))
+    bonus = jax.random.categorical(key_bonus, jnp.log(p[k] + 1e-30))
+    nxt = jnp.where(n_acc == k, bonus, resampled)
+    return n_acc, nxt.astype(jnp.int32)
+
+
 def _rewind_cache(cache, n):
     """Decode cache with every write index set to ``n`` (traced scalar).
 
@@ -628,14 +661,17 @@ def _rewind_cache(cache, n):
 
 @functools.partial(jax.jit, static_argnames=("model", "draft_model",
                                              "max_new_tokens",
-                                             "speculate_k"))
+                                             "speculate_k", "temperature"))
 def _speculative_jit(model, params, draft_model, draft_params, input_ids,
-                     prompt_mask, max_new_tokens, speculate_k):
-    """Greedy speculative decode, exact target semantics (docstring of
-    :func:`generate_speculative`). All shapes static: the draft scan is
-    always ``k`` steps, the verify pass always ``k+1`` tokens, and the
-    while_loop carries a fixed-size output buffer with ``k+1`` slack so
-    the per-iteration window write never clamps.
+                     prompt_mask, rng, max_new_tokens, speculate_k,
+                     temperature):
+    """Speculative decode, exact target semantics — greedy prefix
+    matching at ``temperature=0``, Leviathan rejection SAMPLING at
+    ``temperature>0`` (docstring of :func:`generate_speculative`). All
+    shapes static: the draft scan is always ``k`` steps, the verify
+    pass always ``k+1`` tokens, and the while_loop carries a fixed-size
+    output buffer with ``k+1`` slack so the per-iteration window write
+    never clamps.
 
     ``prompt_mask`` supports RIGHT-padded prompts so callers can bucket
     prompt lengths (one compilation per bucket, not per length): slot
@@ -680,7 +716,8 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
     last_logits = jnp.take_along_axis(
         logits.astype(jnp.float32), (n_real - 1)[:, None, None],
         axis=1)[:, 0]                                          # [B, V]
-    first = jnp.argmax(last_logits, -1).astype(jnp.int32)      # [B]
+    rng, first_key = jax.random.split(rng)
+    first, _ = _sample_next(last_logits, temperature, 0, 0.0, first_key)
     out = jnp.full((B, T + k + 1), pad, jnp.int32)
     out = out.at[:, 0].set(first)
     state = (out, jnp.ones((B,), jnp.int32),                   # n_out
@@ -689,7 +726,8 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
              first, t_cache, d_cache, valid,
              first == cfg.eos_token_id,                        # finished [B]
              jnp.zeros((), jnp.int32),                         # iterations
-             jnp.zeros((), jnp.int32))                         # active windows
+             jnp.zeros((), jnp.int32),                         # active windows
+             rng)
 
     def cond(state):
         n_out, finished = state[1], state[8]
@@ -697,11 +735,15 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
 
     def body(state):
         (out, n_out, n_ctx, n_pos, last, t_cache, d_cache, valid,
-         finished, iters, act_win) = state
+         finished, iters, act_win, rng) = state
         active = (n_out < T) & ~finished                       # [B]
+        rng, draft_key, accept_key = jax.random.split(rng, 3)
 
-        # 1. draft k greedy candidates autoregressively (its cache copy
-        #    is discarded — step 3 replays the verified window instead)
+        # 1. draft k candidates autoregressively — greedy at
+        #    temperature 0, sampled from the draft's (tempered)
+        #    distribution otherwise, recording q for the acceptance
+        #    test. (Its cache copy is discarded — step 3 replays the
+        #    verified window instead.)
         def dstep(carry, t):
             tok, dc, vld = carry
             vld = jax.vmap(row_put)(vld, jnp.ones((B, 1), jnp.int32),
@@ -710,13 +752,21 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
                 {"params": draft_params, "cache": dc}, tok[:, None], vld,
                 position_ids=(n_pos + t)[:, None], decode=True,
                 deterministic=True, mutable=["cache"])
-            nxt = jnp.argmax(lg[:, -1, :].astype(jnp.float32),
-                             -1).astype(jnp.int32)
-            return (nxt, m["cache"], vld), nxt
+            lg = lg[:, -1, :].astype(jnp.float32)
+            if temperature == 0.0:
+                nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                qp = jnp.zeros_like(lg)                        # unused
+            else:
+                qp = jax.nn.softmax(lg / temperature, axis=-1)
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(draft_key, t),
+                    lg / temperature).astype(jnp.int32)
+            return (nxt, m["cache"], vld), (nxt, qp)
 
-        (_, _, _), drafts = lax.scan(dstep, (last, d_cache, valid),
-                                     jnp.arange(k))
+        (_, _, _), (drafts, q_probs) = lax.scan(
+            dstep, (last, d_cache, valid), jnp.arange(k))
         drafts = drafts.T                                      # [B, k]
+        q_probs = jnp.swapaxes(q_probs, 0, 1)                  # [B, k, V]
 
         # 2. ONE target pass over [last, d_0..d_{k-1}] verifies all k
         #    candidates per row at the cost of a single decode step's
@@ -729,17 +779,29 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
             {"params": params, "cache": t_cache}, verify_in, vwin,
             position_ids=vpos, decode=True, deterministic=True,
             mutable=["cache"])
-        t_pred = jnp.argmax(lg.astype(jnp.float32),
-                            -1).astype(jnp.int32)              # [B, k+1]
 
-        # longest matching prefix per row, then the target's own token
-        # as bonus
-        match = (drafts == t_pred[:, :k]).astype(jnp.int32)    # [B, k]
-        n_acc = jnp.argmin(jnp.concatenate(
-            [match, jnp.zeros((B, 1), jnp.int32)], axis=1),
-            axis=1)                                            # first miss
-        bonus = jnp.take_along_axis(t_pred, n_acc[:, None],
-                                    axis=1)[:, 0]              # [B]
+        if temperature == 0.0:
+            # greedy: longest matching prefix per row, then the
+            # target's own argmax token as bonus — token-exact vs
+            # generate_causal
+            t_pred = jnp.argmax(lg.astype(jnp.float32),
+                                -1).astype(jnp.int32)          # [B, k+1]
+            match = (drafts == t_pred[:, :k]).astype(jnp.int32)
+            n_acc = jnp.argmin(jnp.concatenate(
+                [match, jnp.zeros((B, 1), jnp.int32)], axis=1),
+                axis=1)                                        # first miss
+            bonus = jnp.take_along_axis(t_pred, n_acc[:, None],
+                                        axis=1)[:, 0]          # [B]
+        else:
+            # sampling: Leviathan rejection acceptance — the emitted
+            # marginal is exactly the target's tempered distribution
+            p_probs = jax.nn.softmax(
+                lg.astype(jnp.float32) / temperature, axis=-1)
+            row_keys = jax.vmap(
+                lambda b: jax.random.fold_in(accept_key, b))(
+                jnp.arange(B))
+            n_acc, bonus = jax.vmap(_speculative_accept)(
+                p_probs, q_probs, drafts, row_keys)
         idx = jnp.arange(k + 1)[None]                          # [1, k+1]
         emit = jnp.where(idx < n_acc[:, None],
                          jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
@@ -777,7 +839,7 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
         last = jnp.where(active, bonus, last)
         return (out, n_out + n_new, new_ctx, n_pos + n_new, last,
                 t_cache, d_cache, valid, finished, iters + 1,
-                act_win + jnp.sum(active.astype(jnp.int32)))
+                act_win + jnp.sum(active.astype(jnp.int32)), rng)
 
     state = lax.while_loop(cond, body, state)
     # (tokens, raw per-row counts incl. prefill, iterations, active
@@ -789,15 +851,22 @@ def generate_speculative(model, params, draft_model, draft_params,
                          input_ids, attention_mask=None,
                          max_new_tokens: int = 64,
                          speculate_k: int = 4,
+                         temperature: float = 0.0, seed: int = 0,
                          return_stats: bool = False):
-    """Greedy speculative decoding: a small draft model proposes
+    """Speculative decoding: a small draft model proposes
     ``speculate_k`` tokens autoregressively, the target model scores the
-    whole window in ONE decode pass, and the longest draft prefix that
-    matches the target's own greedy choices is accepted plus one bonus
-    token from the target. Output is EXACTLY ``generate_causal``'s
-    greedy continuation — the draft only changes how fast tokens land,
-    never which tokens (blockwise-parallel / assisted-generation
-    semantics with a greedy target).
+    whole window in ONE decode pass, and a prefix is accepted plus one
+    extra token from the target.
+
+    At ``temperature=0`` (default) acceptance is the longest prefix
+    matching the target's greedy choices — output is EXACTLY
+    ``generate_causal``'s greedy continuation, token for token. At
+    ``temperature>0`` it is speculative SAMPLING (Leviathan et al.
+    rejection acceptance, :func:`_speculative_accept`): each emitted
+    token's marginal is exactly the target's tempered distribution —
+    distribution-exact rather than bitwise-exact, since the rng
+    consumption pattern differs from plain sampling. Either way the
+    draft changes speed, never semantics.
 
     TPU-first shape discipline: fixed-k draft scan, fixed (k+1)-token
     verify, ``lax.while_loop`` over a static output buffer — one
@@ -845,10 +914,13 @@ def generate_speculative(model, params, draft_model, draft_params,
             "would silently break")
     if speculate_k < 1:
         raise ValueError("speculate_k must be >= 1")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     tokens, n_out, iters, act_win = _speculative_jit(
         model, params, draft_model, draft_params, input_ids,
-        jnp.asarray(attention_mask, jnp.int32), int(max_new_tokens),
-        int(speculate_k))
+        jnp.asarray(attention_mask, jnp.int32),
+        jax.random.PRNGKey(int(seed)), int(max_new_tokens),
+        int(speculate_k), float(temperature))
     if not return_stats:
         return tokens
     produced = np.asarray(n_out)

@@ -203,15 +203,17 @@ def predict(args) -> list[dict]:
                                seed=args.seed)
         elif (getattr(args, "draft_dir", None)
                 or getattr(args, "self_speculate_layers", 0)):
-            # speculative decoding: exact greedy output, the draft only
-            # buys speed — so it refuses knobs it would otherwise have
-            # to silently ignore
+            # speculative decoding: token-exact greedy at temperature 0,
+            # distribution-exact rejection sampling at temperature > 0;
+            # knobs it can't honor are refused, not silently ignored
             spec_flag = ("--draft_dir" if args.draft_dir
                          else "--self_speculate_layers")
-            if args.temperature or args.top_k or args.top_p:
+            if args.top_k or args.top_p:
                 raise SystemExit(
-                    f"{spec_flag} is greedy-exact speculative decoding; "
-                    "it cannot combine with --temperature/--top_k/--top_p")
+                    f"{spec_flag} supports greedy (temperature 0, token-"
+                    "exact) and plain temperature sampling (distribution-"
+                    "exact rejection acceptance); --top_k/--top_p warping "
+                    "is not implemented for the verify window")
             if args.num_beams > 1:
                 raise SystemExit(f"{spec_flag} cannot combine with "
                                  "--num_beams (speculative decode is "
@@ -249,7 +251,8 @@ def predict(args) -> list[dict]:
                     model, params, draft_model, draft_params,
                     ids_np[sel][:, :w], mask_np[sel][:, :w],
                     max_new_tokens=args.max_new_tokens,
-                    speculate_k=args.speculate_k))
+                    speculate_k=args.speculate_k,
+                    temperature=args.temperature, seed=args.seed))
                 for i, r in enumerate(sel):
                     rows[r] = outs[i]
             out = np.stack(rows, axis=0)
