@@ -128,16 +128,24 @@ def _force_token(logits, token_id):
     return forced.at[..., token_id].set(0.0)
 
 
-def _sample_next(logits, temperature, top_k, top_p, rng):
-    """One sampling decision from [batch, vocab] fp32 logits; returns
-    (next_token int32 [batch], rng)."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
+def _warp_logits(logits, temperature, top_k, top_p):
+    """The ONE warping sequence (temperature → top-k → top-p) shared by
+    plain sampling and speculative sampling, so the two paths cannot
+    drift. Caller guarantees ``temperature > 0``."""
     logits = logits / temperature
     if top_k:
         logits = _filter_top_k(logits, top_k)
     if top_p:
         logits = _filter_top_p(logits, top_p)
+    return logits
+
+
+def _sample_next(logits, temperature, top_k, top_p, rng):
+    """One sampling decision from [batch, vocab] fp32 logits; returns
+    (next_token int32 [batch], rng)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
+    logits = _warp_logits(logits, temperature, top_k, top_p)
     rng, sub = jax.random.split(rng)
     return jax.random.categorical(sub, logits, axis=-1).astype(jnp.int32), rng
 
@@ -661,10 +669,11 @@ def _rewind_cache(cache, n):
 
 @functools.partial(jax.jit, static_argnames=("model", "draft_model",
                                              "max_new_tokens",
-                                             "speculate_k", "temperature"))
+                                             "speculate_k", "temperature",
+                                             "top_k", "top_p"))
 def _speculative_jit(model, params, draft_model, draft_params, input_ids,
                      prompt_mask, rng, max_new_tokens, speculate_k,
-                     temperature):
+                     temperature, top_k=0, top_p=0.0):
     """Speculative decode, exact target semantics — greedy prefix
     matching at ``temperature=0``, Leviathan rejection SAMPLING at
     ``temperature>0`` (docstring of :func:`generate_speculative`). All
@@ -716,8 +725,18 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
     last_logits = jnp.take_along_axis(
         logits.astype(jnp.float32), (n_real - 1)[:, None, None],
         axis=1)[:, 0]                                          # [B, V]
+    def warp(lg):
+        """Warped logits — applied identically to the target's and the
+        draft's distributions, so the rejection acceptance operates on
+        exactly the warped p and q (the theorem holds for any p; q only
+        needs support on its own samples). Shares ``_warp_logits`` with
+        plain sampling so the first emitted token (drawn via
+        ``_sample_next``) follows the same distribution as the rest."""
+        return _warp_logits(lg, temperature, top_k, top_p)
+
     rng, first_key = jax.random.split(rng)
-    first, _ = _sample_next(last_logits, temperature, 0, 0.0, first_key)
+    first, _ = _sample_next(last_logits, temperature, top_k, top_p,
+                            first_key)
     out = jnp.full((B, T + k + 1), pad, jnp.int32)
     out = out.at[:, 0].set(first)
     state = (out, jnp.ones((B,), jnp.int32),                   # n_out
@@ -757,10 +776,11 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
                 nxt = jnp.argmax(lg, -1).astype(jnp.int32)
                 qp = jnp.zeros_like(lg)                        # unused
             else:
-                qp = jax.nn.softmax(lg / temperature, axis=-1)
+                warped = warp(lg)
+                qp = jax.nn.softmax(warped, axis=-1)
                 nxt = jax.random.categorical(
                     jax.random.fold_in(draft_key, t),
-                    lg / temperature).astype(jnp.int32)
+                    warped).astype(jnp.int32)
             return (nxt, m["cache"], vld), (nxt, qp)
 
         (_, _, _), (drafts, q_probs) = lax.scan(
@@ -794,9 +814,9 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
                                         axis=1)[:, 0]          # [B]
         else:
             # sampling: Leviathan rejection acceptance — the emitted
-            # marginal is exactly the target's tempered distribution
-            p_probs = jax.nn.softmax(
-                lg.astype(jnp.float32) / temperature, axis=-1)
+            # marginal is exactly the target's warped distribution
+            p_probs = jax.nn.softmax(warp(lg.astype(jnp.float32)),
+                                     axis=-1)
             row_keys = jax.vmap(
                 lambda b: jax.random.fold_in(accept_key, b))(
                 jnp.arange(B))
@@ -851,7 +871,8 @@ def generate_speculative(model, params, draft_model, draft_params,
                          input_ids, attention_mask=None,
                          max_new_tokens: int = 64,
                          speculate_k: int = 4,
-                         temperature: float = 0.0, seed: int = 0,
+                         temperature: float = 0.0, top_k: int = 0,
+                         top_p: float = 0.0, seed: int = 0,
                          return_stats: bool = False):
     """Speculative decoding: a small draft model proposes
     ``speculate_k`` tokens autoregressively, the target model scores the
@@ -863,10 +884,13 @@ def generate_speculative(model, params, draft_model, draft_params,
     ``generate_causal``'s greedy continuation, token for token. At
     ``temperature>0`` it is speculative SAMPLING (Leviathan et al.
     rejection acceptance, :func:`_speculative_accept`): each emitted
-    token's marginal is exactly the target's tempered distribution —
-    distribution-exact rather than bitwise-exact, since the rng
-    consumption pattern differs from plain sampling. Either way the
-    draft changes speed, never semantics.
+    token's marginal is exactly the target's WARPED distribution
+    (temperature, then optional ``top_k``/``top_p`` filtering — applied
+    identically to the draft) — distribution-exact rather than
+    bitwise-exact, since the rng consumption pattern differs from plain
+    sampling. ``top_k``/``top_p`` require ``temperature > 0`` (greedy
+    is argmax, which filtering cannot change). Either way the draft
+    changes speed, never semantics.
 
     TPU-first shape discipline: fixed-k draft scan, fixed (k+1)-token
     verify, ``lax.while_loop`` over a static output buffer — one
@@ -916,11 +940,16 @@ def generate_speculative(model, params, draft_model, draft_params,
         raise ValueError("speculate_k must be >= 1")
     if temperature < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if (top_k or top_p) and temperature == 0.0:
+        raise ValueError(
+            "top_k/top_p warping requires temperature > 0 (greedy "
+            "speculation is argmax, which filtering cannot change)")
     tokens, n_out, iters, act_win = _speculative_jit(
         model, params, draft_model, draft_params, input_ids,
         jnp.asarray(attention_mask, jnp.int32),
         jax.random.PRNGKey(int(seed)), int(max_new_tokens),
-        int(speculate_k), float(temperature))
+        int(speculate_k), float(temperature), top_k=int(top_k),
+        top_p=float(top_p))
     if not return_stats:
         return tokens
     produced = np.asarray(n_out)

@@ -208,12 +208,11 @@ def predict(args) -> list[dict]:
             # knobs it can't honor are refused, not silently ignored
             spec_flag = ("--draft_dir" if args.draft_dir
                          else "--self_speculate_layers")
-            if args.top_k or args.top_p:
+            if (args.top_k or args.top_p) and not args.temperature:
                 raise SystemExit(
-                    f"{spec_flag} supports greedy (temperature 0, token-"
-                    "exact) and plain temperature sampling (distribution-"
-                    "exact rejection acceptance); --top_k/--top_p warping "
-                    "is not implemented for the verify window")
+                    f"{spec_flag}: --top_k/--top_p need --temperature "
+                    "> 0 (greedy speculation is argmax, which filtering "
+                    "cannot change)")
             if args.num_beams > 1:
                 raise SystemExit(f"{spec_flag} cannot combine with "
                                  "--num_beams (speculative decode is "
@@ -252,7 +251,8 @@ def predict(args) -> list[dict]:
                     ids_np[sel][:, :w], mask_np[sel][:, :w],
                     max_new_tokens=args.max_new_tokens,
                     speculate_k=args.speculate_k,
-                    temperature=args.temperature, seed=args.seed))
+                    temperature=args.temperature, top_k=args.top_k,
+                    top_p=args.top_p, seed=args.seed))
                 for i, r in enumerate(sel):
                     rows[r] = outs[i]
             out = np.stack(rows, axis=0)

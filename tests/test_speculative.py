@@ -305,3 +305,36 @@ def test_sampled_speculative_end_to_end():
         eos = np.where(row == 2)[0]
         if len(eos):
             assert (row[eos[0] + 1:] == 0).all()
+
+
+def test_sampled_speculative_with_warpers():
+    """top-k/top-p warping applies to BOTH p and q (the theorem holds
+    for any warped target): deterministic per seed, valid tokens, and
+    at top_k >= vocab it reduces to plain temperature sampling with the
+    same rng stream (identical output)."""
+    target, t_params = _llama(2, seed=0)
+    draft, d_params = _llama(1, seed=1)
+    ids = np.random.RandomState(1).randint(3, 128, (1, 6))
+    a = np.asarray(generate_speculative(target, t_params, draft, d_params,
+                                        ids, max_new_tokens=10,
+                                        speculate_k=3, temperature=0.7,
+                                        top_k=5, seed=3))
+    b = np.asarray(generate_speculative(target, t_params, draft, d_params,
+                                        ids, max_new_tokens=10,
+                                        speculate_k=3, temperature=0.7,
+                                        top_k=5, seed=3))
+    np.testing.assert_array_equal(a, b)
+    assert (a >= 0).all() and (a < 128).all()
+    # top_k = vocab is a no-op filter: same tokens as unfiltered
+    c = np.asarray(generate_speculative(target, t_params, draft, d_params,
+                                        ids, max_new_tokens=10,
+                                        speculate_k=3, temperature=0.7,
+                                        top_k=128, seed=3))
+    d = np.asarray(generate_speculative(target, t_params, draft, d_params,
+                                        ids, max_new_tokens=10,
+                                        speculate_k=3, temperature=0.7,
+                                        seed=3))
+    np.testing.assert_array_equal(c, d)
+    with pytest.raises(ValueError, match="temperature"):
+        generate_speculative(target, t_params, draft, d_params, ids,
+                             top_k=5)
