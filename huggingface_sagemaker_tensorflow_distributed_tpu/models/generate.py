@@ -644,6 +644,25 @@ def _speculative_accept(p, q, drafts, key):
     return n_acc, nxt.astype(jnp.int32)
 
 
+def _spec_emit(drafts, n_acc, bonus, active, finished, pad, eos_id):
+    """Assemble one speculative iteration's emitted window [B, k+1]:
+    accepted draft prefix, the extra token at position n_acc, pads after
+    the first EOS and for inactive rows. Returns (emit, n_new,
+    finished) — shared by the decoder-only and seq2seq loops."""
+    B, k = drafts.shape
+    idx = jnp.arange(k + 1)[None]                              # [1, k+1]
+    emit = jnp.where(idx < n_acc[:, None],
+                     jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+                     pad)
+    emit = jnp.where(idx == n_acc[:, None], bonus[:, None], emit)
+    n_new = jnp.where(active, n_acc + 1, 0)                    # [B]
+    is_eos = (emit == eos_id) & (idx < n_new[:, None])
+    after = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1) -
+             is_eos.astype(jnp.int32)) > 0
+    emit = jnp.where(after | ~active[:, None], pad, emit)
+    return emit, n_new, finished | jnp.any(is_eos, axis=1)
+
+
 def _rewind_cache(cache, n):
     """Decode cache with every write index set to ``n`` (traced scalar).
 
@@ -822,27 +841,20 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
                 jnp.arange(B))
             n_acc, bonus = jax.vmap(_speculative_accept)(
                 p_probs, q_probs, drafts, row_keys)
-        idx = jnp.arange(k + 1)[None]                          # [1, k+1]
-        emit = jnp.where(idx < n_acc[:, None],
-                         jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
-                         pad)
-        emit = jnp.where(idx == n_acc[:, None], bonus[:, None], emit)
-        n_new = jnp.where(active, n_acc + 1, 0)                # [B]
-
-        # EOS: pad everything after the first one; inactive rows emit
-        # only pads (their slots past n_out were never written, so the
-        # write below is a value no-op for them)
-        is_eos = (emit == cfg.eos_token_id) & (idx < n_new[:, None])
-        after = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1) -
-                 is_eos.astype(jnp.int32)) > 0
-        emit = jnp.where(after | ~active[:, None], pad, emit)
-        finished = finished | jnp.any(is_eos, axis=1)
+        # emit assembly + EOS padding shared with the seq2seq loop;
+        # inactive rows emit only pads (their slots past n_out were
+        # never written, so the write below is a value no-op for them)
+        emit, n_new, finished = _spec_emit(drafts, n_acc, bonus, active,
+                                           finished, pad,
+                                           cfg.eos_token_id)
 
         out = jax.vmap(row_put)(out, emit, jnp.minimum(n_out, T))
         new_ctx = n_ctx + n_new
         # commit validity: accepted slots become 1, rejected stay 0
         valid = jax.vmap(row_put)(
-            valid, (idx < n_new[:, None]).astype(jnp.int32), n_ctx)
+            valid,
+            (jnp.arange(k + 1)[None] < n_new[:, None]).astype(jnp.int32),
+            n_ctx)
 
         # 3. commit caches: the target wrote the whole window — rewind
         #    its per-row indices to the accepted lengths; the draft's
@@ -1005,3 +1017,193 @@ def self_draft(model, params, num_layers: int):
             "self_draft found no per-layer blocks to truncate (expected "
             "backbone/layers_{i} or backbone/h_{i} params)")
     return draft_model, {**params, "backbone": kept}
+
+
+@functools.partial(jax.jit, static_argnames=("model", "draft_model",
+                                             "max_new_tokens",
+                                             "speculate_k", "temperature"))
+def _speculative_seq2seq_jit(model, params, draft_model, draft_params,
+                             input_ids, attention_mask, rng,
+                             max_new_tokens, speculate_k, temperature):
+    """Speculative decode for encoder-decoder models: each model encodes
+    the source ONCE, then the decoder runs the same draft-window /
+    one-pass-verify / per-row-rewind loop as the decoder-only variant.
+    Structurally simpler than the causal loop — there is no prompt in
+    the decoder (slot 0 is decoder_start, so slots == logical positions
+    and no validity mask rides along); T5's relative-position bias
+    follows the per-row cache indices automatically."""
+    cfg = model.config
+    k = speculate_k
+    B = input_ids.shape[0]
+    T = max_new_tokens
+    pad = jnp.int32(cfg.pad_token_id)
+    total = T + k + 2                       # decoder_start + overshoot
+
+    enc_t = model.apply({"params": params}, input_ids, attention_mask,
+                        deterministic=True, method=model.encode)
+    enc_d = draft_model.apply({"params": draft_params}, input_ids,
+                              attention_mask, deterministic=True,
+                              method=draft_model.encode)
+    t_cache = init_cache(model, params, enc_t, attention_mask, total)
+    d_cache = init_cache(draft_model, draft_params, enc_d, attention_mask,
+                         total)
+
+    def t_step(cache, tokens):
+        lg, mut = model.apply(
+            {"params": params, "cache": cache}, tokens, enc_t,
+            attention_mask, decode=True, deterministic=True,
+            mutable=["cache"], method=model.decode)
+        return lg.astype(jnp.float32), mut["cache"]
+
+    def d_step(cache, tokens):
+        lg, mut = draft_model.apply(
+            {"params": draft_params, "cache": cache}, tokens, enc_d,
+            attention_mask, decode=True, deterministic=True,
+            mutable=["cache"], method=draft_model.decode)
+        return lg.astype(jnp.float32), mut["cache"]
+
+    start = jnp.full((B, 1), cfg.decoder_start_token_id, jnp.int32)
+    lg, t_cache = t_step(t_cache, start)
+    _, d_cache = d_step(d_cache, start)
+    rng, first_key = jax.random.split(rng)
+    first, _ = _sample_next(lg[:, -1], temperature, 0, 0.0, first_key)
+
+    out = jnp.full((B, T + k + 1), pad, jnp.int32)
+    out = out.at[:, 0].set(first)
+    # n_out doubles as the slot count: slot 0 is decoder_start, every
+    # accepted token occupies the next slot — unlike the causal loop
+    # there is no prompt, so output index == cache depth always
+    state = (out, jnp.ones((B,), jnp.int32),                   # n_out
+             first, t_cache, d_cache,
+             first == cfg.eos_token_id,                        # finished [B]
+             jnp.zeros((), jnp.int32),                         # iterations
+             jnp.zeros((), jnp.int32),                         # active windows
+             rng)
+
+    def cond(state):
+        n_out, finished = state[1], state[5]
+        return jnp.any((n_out < T) & ~finished)
+
+    def body(state):
+        (out, n_out, last, t_cache, d_cache, finished, iters,
+         act_win, rng) = state
+        active = (n_out < T) & ~finished
+        rng, draft_key, accept_key = jax.random.split(rng, 3)
+
+        def dstep(carry, t):
+            tok, dc = carry
+            lg, dc = d_step(dc, tok[:, None])
+            lg = lg[:, -1, :]
+            if temperature == 0.0:
+                nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                qp = jnp.zeros_like(lg)
+            else:
+                warped = lg / temperature
+                qp = jax.nn.softmax(warped, axis=-1)
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(draft_key, t),
+                    warped).astype(jnp.int32)
+            return (nxt, dc), (nxt, qp)
+
+        (_, _), (drafts, q_probs) = lax.scan(dstep, (last, d_cache),
+                                             jnp.arange(k))
+        drafts = drafts.T                                      # [B, k]
+        q_probs = jnp.swapaxes(q_probs, 0, 1)                  # [B, k, V]
+
+        verify_in = jnp.concatenate([last[:, None], drafts], axis=1)
+        lg, t_cache2 = t_step(t_cache, verify_in)
+        if temperature == 0.0:
+            t_pred = jnp.argmax(lg, -1).astype(jnp.int32)      # [B, k+1]
+            match = (drafts == t_pred[:, :k]).astype(jnp.int32)
+            n_acc = jnp.argmin(jnp.concatenate(
+                [match, jnp.zeros((B, 1), jnp.int32)], axis=1), axis=1)
+            bonus = jnp.take_along_axis(t_pred, n_acc[:, None],
+                                        axis=1)[:, 0]
+        else:
+            p_probs = jax.nn.softmax(lg / temperature, axis=-1)
+            row_keys = jax.vmap(
+                lambda b: jax.random.fold_in(accept_key, b))(
+                jnp.arange(B))
+            n_acc, bonus = jax.vmap(_speculative_accept)(
+                p_probs, q_probs, drafts, row_keys)
+
+        emit, n_new, finished = _spec_emit(drafts, n_acc, bonus, active,
+                                           finished, pad,
+                                           cfg.eos_token_id)
+        out = jax.vmap(lambda row, upd, c: lax.dynamic_update_slice(
+            row, upd, (c,)))(out, emit, jnp.minimum(n_out, T))
+        t_cache = _rewind_cache(t_cache2, n_out + n_new)
+        _, mdr = d_step(d_cache, verify_in)
+        d_cache = _rewind_cache(mdr, n_out + n_new)
+        last = jnp.where(active, bonus, last)
+        return (out, n_out + n_new, last, t_cache, d_cache,
+                finished, iters + 1,
+                act_win + jnp.sum(active.astype(jnp.int32)), rng)
+
+    state = lax.while_loop(cond, body, state)
+    return state[0][:, :T], state[1], state[6], state[7]
+
+
+def generate_speculative_seq2seq(model, params, draft_model, draft_params,
+                                 input_ids, attention_mask=None,
+                                 max_new_tokens: int = 64,
+                                 speculate_k: int = 4,
+                                 temperature: float = 0.0, seed: int = 0,
+                                 return_stats: bool = False):
+    """Speculative decoding for encoder-decoder models (T5 family): the
+    draft encodes the source with its own encoder, proposes
+    ``speculate_k`` decoder tokens, and the target verifies the window
+    in one decoder pass. ``temperature=0`` is token-exact vs
+    :func:`generate` greedy; ``temperature>0`` is distribution-exact
+    rejection sampling (same acceptance core as the decoder-only
+    variant).
+
+    T5-family only: its decode-side positions (the relative-position
+    bias) derive entirely from the per-row cache indices, so rows can
+    rewind independently. BART/mBART track an absolute decoder position
+    in a shared scalar, which per-row rewinds would corrupt — rejected
+    loudly (as is mBART's forced_bos, which the verify window does not
+    thread).
+    """
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if input_ids.ndim == 1:
+        input_ids = input_ids[None]
+    if attention_mask is None:
+        attention_mask = jnp.ones_like(input_ids)
+    attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    for m, tag in ((model, "target"), (draft_model, "draft")):
+        name = type(m.config).__name__
+        if name != "T5Config":
+            raise ValueError(
+                f"generate_speculative_seq2seq supports the T5 family "
+                f"only ({tag} has {name}): BART's absolute decoder "
+                "positions live in a shared scalar that per-row cache "
+                "rewinds would corrupt")
+        if getattr(m.config, "attention_impl", "xla") == "ring":
+            raise ValueError(
+                f"generate_speculative_seq2seq cannot run the {tag} "
+                "with attention_impl='ring': the ring decode path "
+                "collapses per-row cache offsets to their max, which "
+                "would mis-bias rows behind the deepest one")
+    if getattr(model.config, "forced_bos_token_id", None) is not None:
+        raise ValueError("forced_bos_token_id is not supported under "
+                         "speculative decoding")
+    if model.config.vocab_size != draft_model.config.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    if speculate_k < 1:
+        raise ValueError("speculate_k must be >= 1")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    tokens, n_out, iters, act_win = _speculative_seq2seq_jit(
+        model, params, draft_model, draft_params, input_ids,
+        attention_mask, jax.random.PRNGKey(int(seed)),
+        int(max_new_tokens), int(speculate_k), float(temperature))
+    if not return_stats:
+        return tokens
+    produced = np.asarray(n_out)
+    per_window = float(produced.sum() - len(produced)) / max(int(act_win), 1)
+    return tokens, {"iterations": int(iters),
+                    "tokens_generated":
+                        np.minimum(produced, int(max_new_tokens)).tolist(),
+                    "accepted_per_window": round(per_window, 3),
+                    "window_ceiling": int(speculate_k) + 1}

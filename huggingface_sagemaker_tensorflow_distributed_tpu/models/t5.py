@@ -188,17 +188,27 @@ class T5Attention(nn.Module):
 
     def _position_bias(self, q_len: int, kv_len: int, offset=None):
         """[1, heads, q_len, kv_len] learned bias from bucketed relative
-        positions. ``offset`` shifts query positions (decode with cache)."""
+        positions. ``offset`` shifts query positions (decode with cache);
+        a PER-ROW [B] offset (rows at different depths under speculative
+        decode) yields a [B, heads, q_len, kv_len] bias. Uniform decode
+        (generate/beam) also takes the per-row branch since cache_index
+        is stored [B]; the extra cost is a batched bucket computation +
+        embed gather at decode shapes (q=1, kv=target_len) — noise next
+        to the step's matmuls, so no scalar fast path is kept."""
         cfg = self.config
         ctx = jnp.arange(q_len)[:, None]
         if offset is not None:
-            ctx = ctx + offset
+            off = jnp.asarray(offset)
+            ctx = (ctx + off if off.ndim == 0
+                   else ctx[None] + off[:, None, None])       # [B, q, 1]
         mem = jnp.arange(kv_len)[None, :]
         buckets = relative_position_bucket(
             mem - ctx, bidirectional=not self.causal,
             num_buckets=cfg.relative_attention_num_buckets,
             max_distance=cfg.relative_attention_max_distance)
         values = self._rel_bias_embed()(buckets)
+        if values.ndim == 4:                                  # [B, q, kv, h]
+            return values.transpose(0, 3, 1, 2)
         return values.transpose(2, 0, 1)[None]
 
     @nn.compact
@@ -222,22 +232,30 @@ class T5Attention(nn.Module):
         if decode and kv_hidden is None:
             # Incremental self-attention cache: full-length zero buffers are
             # created on the init pass; each decode step writes its k/v slice
-            # at cache_index and attends to positions <= its own.
+            # at cache_index and attends to positions <= its own. Write
+            # indices are PER-ROW [B] (the shared decoder-family protocol,
+            # models/llama.py::write_kv_cache): rows may sit at different
+            # depths under speculative decode.
+            from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+                write_kv_cache,
+            )
+
+            B = q.shape[0]
             is_init = self.has_variable("cache", "cached_key")
             cached_k = self.variable("cache", "cached_key", jnp.zeros, k.shape, k.dtype)
             cached_v = self.variable("cache", "cached_value", jnp.zeros, v.shape, v.dtype)
             cache_index = self.variable("cache", "cache_index",
-                                        lambda: jnp.array(0, jnp.int32))
+                                        lambda: jnp.zeros((B,), jnp.int32))
             if is_init:
-                cur = cache_index.value
+                cur = cache_index.value                       # [B]
                 max_len = cached_k.value.shape[2]
                 q_len = q.shape[2]
-                k = lax.dynamic_update_slice(cached_k.value, k, (0, 0, cur, 0))
-                v = lax.dynamic_update_slice(cached_v.value, v, (0, 0, cur, 0))
-                cached_k.value, cached_v.value = k, v
+                k, v = write_kv_cache(cached_k, cached_v, None, k, v, cur,
+                                      k.dtype)
                 cache_index.value = cur + q_len
-                valid = jnp.arange(max_len)[None, :] <= (cur + jnp.arange(q_len)[:, None])
-                step_mask = jnp.where(valid, 0.0, NEG_INF)[None, None]
+                valid = jnp.arange(max_len)[None, None, :] <= (
+                    cur[:, None, None] + jnp.arange(q_len)[None, :, None])
+                step_mask = jnp.where(valid, 0.0, NEG_INF)[:, None]
                 mask = step_mask if mask is None else mask + step_mask
                 cache_offset = cur
 
@@ -269,7 +287,10 @@ class T5Attention(nn.Module):
                 # blocks and the per-decode-step offset reuse it as-is)
                 ctx_pos = jnp.arange(q.shape[2])[:, None]
                 if cache_offset is not None:
-                    ctx_pos = ctx_pos + cache_offset
+                    # per-row offsets don't reach this branch (ring decode
+                    # advances uniformly); collapse [B] to its max — all
+                    # equal on this path
+                    ctx_pos = ctx_pos + jnp.max(cache_offset)
                 position_bias = relative_position_bias(
                     position_bias, ctx_pos, jnp.arange(k.shape[2])[None, :],
                     bidirectional=not self.causal,

@@ -170,12 +170,12 @@ def predict(args) -> list[dict]:
             generate_causal,
         )
 
-        if ((getattr(args, "draft_dir", None)
-             or getattr(args, "self_speculate_layers", 0))
+        if (getattr(args, "self_speculate_layers", 0)
                 and args.task != "causal-lm"):
-            raise SystemExit("--draft_dir/--self_speculate_layers "
-                             "(speculative decoding) support --task "
-                             "causal-lm only")
+            raise SystemExit("--self_speculate_layers (layer-skip "
+                             "self-speculation) supports --task "
+                             "causal-lm only; seq2seq speculation needs "
+                             "a separate --draft_dir checkpoint")
         if getattr(args, "prefill_chunk", 0):
             if args.task != "causal-lm":
                 raise SystemExit("--prefill_chunk supports --task "
@@ -190,7 +190,25 @@ def predict(args) -> list[dict]:
                                  "--num_beams (beam prefill is not "
                                  "chunked)")
         if args.task == "seq2seq":
-            if args.num_beams > 1:
+            if getattr(args, "draft_dir", None):
+                from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+                    generate_speculative_seq2seq,
+                )
+
+                if args.num_beams > 1 or args.top_k or args.top_p:
+                    raise SystemExit(
+                        "--draft_dir for seq2seq supports greedy and "
+                        "plain --temperature sampling only (no beams, "
+                        "no top-k/top-p)")
+                draft_model, draft_params, _, _ = \
+                    auto_models.from_pretrained(args.draft_dir,
+                                                task="seq2seq")
+                out = generate_speculative_seq2seq(
+                    model, params, draft_model, draft_params, ids, mask,
+                    max_new_tokens=args.max_new_tokens,
+                    speculate_k=args.speculate_k,
+                    temperature=args.temperature, seed=args.seed)
+            elif args.num_beams > 1:
                 out = beam_search_generate(model, params, ids, mask,
                                            num_beams=args.num_beams,
                                            max_new_tokens=args.max_new_tokens,
@@ -406,8 +424,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "step at long context")
     ap.add_argument("--draft_dir", default=None,
                     help="draft-model checkpoint dir for speculative "
-                         "decoding (causal-lm, greedy-exact: the draft "
-                         "changes speed, never tokens)")
+                         "decoding (causal-lm, or seq2seq for the T5 "
+                         "family; greedy-exact at temperature 0: the "
+                         "draft changes speed, never tokens)")
     ap.add_argument("--speculate_k", type=int, default=4,
                     help="draft tokens per verify window (--draft_dir / "
                          "--self_speculate_layers)")

@@ -168,6 +168,7 @@ _SLOW_TESTS = {
     "test_prefill_chunk.py",     # whole module: scan-prefill compiles
     "test_beam_causal.py",       # whole module: HF beam parity compiles
     "test_sharded_generation.py",  # whole module: tp-mesh decode compiles
+    "test_speculative_seq2seq.py",  # whole module: T5 spec-decode compiles
 }
 
 

@@ -1,0 +1,95 @@
+"""Seq2seq speculative decoding (generate_speculative_seq2seq, T5).
+
+Contract: temperature 0 output is EXACTLY ``generate``'s greedy
+continuation for every draft and acceptance pattern (the draft encodes
+the source with its OWN encoder and proposes decoder tokens; the
+target verifies each window in one decoder pass; per-row cache-index
+rewinds keep batched rows independent — T5's relative-position bias
+follows the per-row indices). BART is rejected loudly: its absolute
+decoder positions live in a shared scalar that per-row rewinds would
+corrupt.
+"""
+
+import numpy as np
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import init_params
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+    generate,
+    generate_speculative_seq2seq,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.t5 import (
+    T5Config,
+    T5ForConditionalGeneration,
+)
+
+
+def _t5(num_layers, seed):
+    cfg = T5Config(vocab_size=96, d_model=32, d_kv=8, d_ff=64,
+                   num_layers=num_layers, num_decoder_layers=num_layers,
+                   num_heads=4, dropout_rate=0.0)
+    model = T5ForConditionalGeneration(cfg)
+    return model, init_params(model, cfg, seed=seed)
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_t5_speculative_matches_greedy(k):
+    target, t_params = _t5(2, seed=0)
+    draft, d_params = _t5(1, seed=1)
+    rng = np.random.RandomState(0)
+    src = rng.randint(3, 96, (2, 8))
+    mask = np.ones((2, 8), np.int64)
+    mask[1, 6:] = 0                        # padded source row
+    want = np.asarray(generate(target, t_params, src, mask,
+                               max_new_tokens=12))
+    got = np.asarray(generate_speculative_seq2seq(
+        target, t_params, draft, d_params, src, mask, max_new_tokens=12,
+        speculate_k=k))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_t5_speculative_perfect_draft():
+    target, t_params = _t5(2, seed=0)
+    rng = np.random.RandomState(1)
+    src = rng.randint(3, 96, (2, 6))
+    want = np.asarray(generate(target, t_params, src, max_new_tokens=10))
+    got, stats = generate_speculative_seq2seq(
+        target, t_params, target, t_params, src, max_new_tokens=10,
+        speculate_k=4, return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["window_ceiling"] == 5
+    if not (want == 1).any():              # no EOS: full acceptance
+        assert stats["accepted_per_window"] == 5.0
+
+
+def test_t5_speculative_sampled_deterministic():
+    target, t_params = _t5(2, seed=0)
+    draft, d_params = _t5(1, seed=1)
+    src = np.random.RandomState(2).randint(3, 96, (1, 6))
+    a = np.asarray(generate_speculative_seq2seq(
+        target, t_params, draft, d_params, src, max_new_tokens=10,
+        speculate_k=3, temperature=0.8, seed=5))
+    b = np.asarray(generate_speculative_seq2seq(
+        target, t_params, draft, d_params, src, max_new_tokens=10,
+        speculate_k=3, temperature=0.8, seed=5))
+    np.testing.assert_array_equal(a, b)
+    assert (a >= 0).all() and (a < 96).all()
+
+
+def test_bart_rejected():
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.bart import (
+        BartConfig,
+        BartForConditionalGeneration,
+    )
+
+    cfg = BartConfig(vocab_size=64, d_model=32, encoder_layers=1,
+                     decoder_layers=1, encoder_attention_heads=4,
+                     decoder_attention_heads=4, encoder_ffn_dim=64,
+                     decoder_ffn_dim=64, max_position_embeddings=64,
+                     dropout=0.0, attention_dropout=0.0)
+    model = BartForConditionalGeneration(cfg)
+    params = init_params(model, cfg)
+    t5, t5_params = _t5(1, seed=0)
+    with pytest.raises(ValueError, match="T5 family"):
+        generate_speculative_seq2seq(model, params, t5, t5_params,
+                                     np.ones((1, 4), np.int64))
