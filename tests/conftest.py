@@ -12,6 +12,13 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# The persistent XLA compilation cache is a TPU warm-start feature; on
+# the CPU test mesh it buys nothing and the in-process CLI tests
+# (test_streaming/test_tasks call scripts.train.main directly) would
+# otherwise enable it for the WHOLE pytest process — where serializing
+# the suite's largest executables has segfaulted zstd inside jaxlib.
+# Empty string = disabled (config.py contract).
+os.environ["TPU_COMPILATION_CACHE_DIR"] = ""
 
 import jax  # noqa: E402
 
@@ -26,6 +33,21 @@ def devices8():
     devs = jax.devices()
     assert len(devs) == 8 and devs[0].platform == "cpu"
     return devs
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Free each module's compiled executables when it finishes. The
+    full suite jits thousands of programs in one process; keeping them
+    all resident exhausts per-process native resources (mapped JIT code
+    regions) and XLA's CPU compiler eventually segfaults mid-compile
+    around test 400 — modules are self-contained compilation-wise, so
+    dropping caches between them costs little and caps the footprint."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
 
 
 @pytest.fixture()
