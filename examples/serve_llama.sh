@@ -30,3 +30,10 @@ echo "--- greedy:";            eval "$P"
 echo "--- self-speculative:";  eval "$P --self_speculate_layers 1"
 echo "--- int8 KV cache:";     eval "$P --kv_cache int8"
 echo "--- chunked prefill:";   eval "$P --prefill_chunk 4"
+# beam search picks the best-scoring hypothesis, so its tokens may
+# legitimately differ from greedy; sampled speculation is distribution
+# -exact (seeded, so reproducible) rather than token-exact
+echo "--- beam search (4 beams, HF-exact scorer):"
+eval "$P --num_beams 4"
+echo "--- sampled speculation (temperature 0.8, rejection-exact):"
+eval "$P --self_speculate_layers 1 --temperature 0.8"
