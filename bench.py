@@ -301,6 +301,18 @@ def bench_bert_large() -> None:
 # Outage-resilient supervisor (parent process; never initializes JAX)
 # ---------------------------------------------------------------------------
 
+def _default_budget() -> float | None:
+    """Overall deadline for one bench invocation, settable without
+    touching the driver's command line (``BENCH_BUDGET_SECONDS``). None
+    preserves the unbounded-patience behavior (probe retries sized for
+    tunnel flaps + 30 min child timeout)."""
+    raw = os.environ.get("BENCH_BUDGET_SECONDS", "").strip()
+    try:
+        return float(raw) if raw else None
+    except ValueError:
+        return None
+
+
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 # The tunnel flaps on a scale of hours, not minutes (observed r2-r4):
 # 15 attempts with exponential backoff (5s doubling, capped 60s) plus
@@ -321,20 +333,31 @@ _PROBE_CODE = (
 )
 
 
-def probe_backend() -> dict:
+def probe_backend(deadline: float | None = None) -> dict:
     """Initialize the JAX backend in a short-timeout subprocess; return
     ``{'ok': True, 'platform': ...}`` or ``{'ok': False, 'attempts': [...]}``.
-    A hung accelerator tunnel hangs the CHILD, not this process."""
+    A hung accelerator tunnel hangs the CHILD, not this process.
+    ``deadline`` (monotonic seconds) caps total probe patience — under a
+    ``--budget-seconds`` run the probe must leave the measured body its
+    share of the budget instead of spending ~41 min on retries."""
     attempts = []
     for i in range(PROBE_ATTEMPTS):
+        per_probe = PROBE_TIMEOUT_S
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 1:
+                attempts.append({"attempt": i + 1,
+                                 "outcome": "budget_exhausted"})
+                break
+            per_probe = max(1, min(PROBE_TIMEOUT_S, int(remaining)))
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", _PROBE_CODE], cwd=_REPO_ROOT,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-                timeout=PROBE_TIMEOUT_S)
+                timeout=per_probe)
         except subprocess.TimeoutExpired:
             attempts.append({"attempt": i + 1,
-                             "outcome": f"timeout>{PROBE_TIMEOUT_S}s"})
+                             "outcome": f"timeout>{per_probe}s"})
         else:
             if proc.returncode == 0:
                 try:
@@ -350,7 +373,12 @@ def probe_backend() -> dict:
                                  "outcome": f"rc={proc.returncode}",
                                  "stderr_tail": proc.stderr[-300:]})
         if i + 1 < PROBE_ATTEMPTS:
-            time.sleep(min(PROBE_RETRY_CAP_S, PROBE_RETRY_WAIT_S * 2 ** i))
+            wait = min(PROBE_RETRY_CAP_S, PROBE_RETRY_WAIT_S * 2 ** i)
+            if deadline is not None:
+                wait = min(wait, max(deadline - time.monotonic(), 0))
+                if wait <= 0:
+                    continue  # next iteration records budget_exhausted
+            time.sleep(wait)
     return {"ok": False, "attempts": attempts}
 
 
@@ -423,40 +451,90 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
     return ["bert_base_finetune_samples_per_sec_per_chip"]
 
 
+def emit_provisional(metrics: list[str], stage: str, **extra) -> None:
+    """One parseable JSON line marking progress. THE fix for the
+    BENCH r05 empty-tail artifact: if the driver's own timeout kills this
+    process at ANY point after startup, the last stdout line is already
+    valid JSON naming the stage that was running — never an empty tail
+    with ``parsed: null``."""
+    line = {"metric": metrics[0], "value": None, "unit": None,
+            "vs_baseline": None, "provisional": True, "stage": stage}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def _forward_partial(metrics: list[str], partial: str, error: str,
+                     detail: dict) -> None:
+    """Forward whatever COMPLETE JSON lines a dead child managed to
+    print (partial results beat no results), then the error line."""
+    for ln in partial.splitlines():
+        try:
+            json.loads(ln)
+        except ValueError:
+            continue
+        print(ln)
+    emit_error(metrics, error, detail)
+
+
 def supervise(args: argparse.Namespace) -> None:
     """Probe the backend, then run the measured bench in a supervised
     child, forwarding its output; emit a structured error line (rc 0) on
-    unreachable backend / child crash / child hang."""
+    unreachable backend / child crash / child hang. With a budget
+    (``--budget-seconds`` / ``BENCH_BUDGET_SECONDS``) every stage gets a
+    deadline and a timeout degrades to partial output, not an empty tail."""
     metrics = _mode_metrics(args)
-    info = probe_backend()
+    budget = args.budget_seconds
+    t_start = time.monotonic()
+    deadline = t_start + budget if budget is not None else None
+    # the measured child streams telemetry (events.jsonl + trace.json):
+    # a run that dies mid-compile still leaves heartbeat/compile events
+    child_env = dict(os.environ)
+    child_env.setdefault("HSTD_TELEMETRY_DIR",
+                         os.path.join(os.getcwd(), "telemetry"))
+    emit_provisional(metrics, "probing",
+                     budget_s=budget, all_metrics=metrics)
+    info = probe_backend(deadline=deadline)
     if not info.get("ok"):
         emit_error(metrics, "backend_unreachable", info)
         return
     print(f"[bench] backend ok: {info.get('platform')} x{info.get('n')} "
           f"({info.get('device_kind')})", file=sys.stderr)
+    emit_provisional(metrics, "measuring", backend=info)
 
     child_argv = [sys.executable, os.path.abspath(__file__),
                   *sys.argv[1:], "--_child"]
+    child_timeout = CHILD_TIMEOUT_S
+    if deadline is not None:
+        # +10s grace: the child's own in-process alarm fires first and
+        # emits partial JSON + flushes telemetry; this outer timeout only
+        # catches a child wedged in native code where signals can't land
+        remaining = max(deadline - time.monotonic(), 5)
+        child_timeout = remaining + 10
+        child_env["_BENCH_CHILD_BUDGET"] = str(round(remaining, 1))
     try:
         proc = subprocess.run(
             child_argv, cwd=_REPO_ROOT, stdout=subprocess.PIPE,
-            stderr=sys.stderr, text=True, timeout=CHILD_TIMEOUT_S)
+            stderr=sys.stderr, text=True, timeout=child_timeout,
+            env=child_env)
     except subprocess.TimeoutExpired as e:
         partial = e.stdout or b""
         if isinstance(partial, bytes):
             partial = partial.decode(errors="replace")
-        emit_error(metrics, "bench_timeout",
-                   {"timeout_s": CHILD_TIMEOUT_S, "backend": info,
-                    "partial_stdout": partial[-500:]})
+        _forward_partial(metrics, partial, "bench_timeout",
+                         {"timeout_s": round(child_timeout, 1),
+                          "backend": info,
+                          "partial_stdout": partial[-500:]})
         return
     if proc.returncode != 0:
-        emit_error(metrics, "bench_failed",
-                   {"rc": proc.returncode, "backend": info,
-                    "stdout_tail": proc.stdout[-500:]})
+        _forward_partial(metrics, proc.stdout, "bench_failed",
+                         {"rc": proc.returncode, "backend": info,
+                          "stdout_tail": proc.stdout[-500:]})
         return
+    parity_affordable = (deadline is None
+                         or deadline - time.monotonic() > PARITY_TIMEOUT_S)
     if (metrics == ["bert_base_finetune_samples_per_sec_per_chip"]
             and args.batch is None and not args.opt_state_bf16
-            and args.remat_policy is None):
+            and args.remat_policy is None and parity_affordable):
         # default (driver) invocation only: append compiled-kernel-parity
         # evidence to the same line the driver records; the --batch /
         # --opt-state-bf16 sweep variants skip it so a tunnel-window
@@ -480,7 +558,69 @@ def supervise(args: argparse.Namespace) -> None:
     sys.stdout.flush()
 
 
+def _setup_child_telemetry() -> None:
+    """Instrument the measured child: file-backed telemetry, compile
+    tracker, and a fast heartbeat (10s default instead of 60: bench
+    bodies are minutes long, and the heartbeat is what leaves evidence
+    on disk when the run is killed mid-compile)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+    out = (os.environ.get(obs.ENV_DIR, "").strip()
+           or os.path.join(os.getcwd(), "telemetry"))
+    obs.configure(out_dir=out)
+    if not obs.has_sink():
+        return
+    obs.compile_tracker()
+    hb = obs.heartbeat(interval=obs.heartbeat_env_interval(default=10.0))
+    hb.start()
+    hb.watch_current_thread()
+    import atexit
+
+    atexit.register(obs.shutdown)
+
+
+def _install_child_budget(args: argparse.Namespace) -> None:
+    """SIGALRM/SIGTERM → partial-result JSON + telemetry flush + exit 0.
+    The alarm leads the supervisor's kill by design; if the process is
+    wedged in native code where Python signals can't run, the heartbeat
+    thread has been flushing trace.json all along and the supervisor
+    forwards whatever stdout exists."""
+    budget = os.environ.get("_BENCH_CHILD_BUDGET", "").strip()
+    try:
+        budget_s = float(budget) if budget else args.budget_seconds
+    except ValueError:
+        budget_s = args.budget_seconds
+    if budget_s is None:
+        return
+    import signal
+
+    metrics = _mode_metrics(args)
+
+    def _bail(signum, frame):
+        try:
+            from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+            obs.flush()
+        except Exception:  # noqa: BLE001 — partial emission must not die
+            pass
+        # leading newline: the alarm may land mid-print of a metric
+        # line; starting fresh keeps the final stdout line parseable
+        # (the whole point of the partial-result contract)
+        sys.stdout.write("\n")
+        emit_error(metrics, "budget_exceeded",
+                   {"budget_s": budget_s, "signal": int(signum),
+                    "partial": True})
+        sys.stdout.flush()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _bail)
+    if hasattr(signal, "SIGALRM"):
+        signal.signal(signal.SIGALRM, _bail)
+        signal.alarm(max(int(budget_s) - 5, 1))
+
+
 def _run_child(args: argparse.Namespace) -> None:
+    _setup_child_telemetry()
+    _install_child_budget(args)
     if args.mesh:
         from benchmarks.mesh_bench import bench_mesh
         bench_mesh()
@@ -549,6 +689,14 @@ def main() -> None:
                         choices=["full", "dots", "dots_no_batch"],
                         help="enable encoder remat with this checkpoint "
                              "policy (headline mode; default: remat off)")
+    parser.add_argument("--budget-seconds", dest="budget_seconds",
+                        type=float, default=_default_budget(),
+                        help="overall deadline for this invocation: the "
+                             "probe, measured child, and parity subset "
+                             "share it, and on expiry the run degrades "
+                             "to partial-result JSON (rc 0) instead of "
+                             "an empty tail (default: "
+                             "BENCH_BUDGET_SECONDS env or unbounded)")
     parser.add_argument("--_child", action="store_true",
                         help=argparse.SUPPRESS)  # internal: run measured body
     args = parser.parse_args()

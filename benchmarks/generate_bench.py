@@ -195,7 +195,13 @@ def bench_generate() -> None:
         new_tokens, batch)
 
     n_chips = len(jax.devices())
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
     for mode, tok_s in results.items():
+        # mirror every stdout line into the telemetry stream so bench
+        # JSONL and events.jsonl carry the same series names
+        obs.scalar(f"bench/generate_{mode}_tokens_per_sec_per_chip",
+                   tok_s / n_chips)
         print(json.dumps({
             "metric": f"generate_{mode}_tokens_per_sec_per_chip",
             "value": round(tok_s / n_chips, 1),

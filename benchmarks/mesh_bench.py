@@ -147,6 +147,11 @@ def bench_mesh() -> None:
     mesh = trainer.mesh
 
     summary = profile_train_steps(trainer, batcher)
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+    obs.scalar("bench/train_step_collective_fraction",
+               summary["collective_fraction"],
+               args={"wall_step_ms": round(summary["wall_step_ms"], 2)})
     print(json.dumps({
         "metric": "train_step_collective_fraction",
         "value": round(summary["collective_fraction"], 4),

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
@@ -33,6 +34,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from huggingface_sagemaker_tensorflow_distributed_tpu import obs
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
     batch_column_sharding,
 )
@@ -482,18 +484,57 @@ class MlmDataset(ArrayDataset):
 _PREFETCH_END = object()
 
 
-def _prefetch_producer(it, q: queue.Queue, stop: threading.Event) -> None:
+class _PrefetchStats:
+    """Producer-wait vs consumer-wait accounting: makes input-bound vs
+    compute-bound a one-glance read in the telemetry stream.
+
+    - ``producer_wait``: the producer thread sat on a FULL queue — the
+      input pipeline is AHEAD of the device (compute-bound, good).
+    - ``consumer_wait``: the train loop sat on an EMPTY queue — the
+      device waited for data (input-bound: raise prefetch depth, speed
+      up tokenization/gather).
+    """
+
+    __slots__ = ("producer_wait", "consumer_wait", "produced", "consumed",
+                 "_reported")
+
+    def __init__(self):
+        self.producer_wait = 0.0
+        self.consumer_wait = 0.0
+        self.produced = 0
+        self.consumed = 0
+        self._reported = False
+
+    def report(self) -> None:
+        if self._reported or not self.consumed:
+            return
+        self._reported = True
+        obs.scalar("data/producer_wait_s", self.producer_wait,
+                   args={"batches": self.produced})
+        obs.scalar("data/consumer_wait_s", self.consumer_wait,
+                   args={"batches": self.consumed,
+                         "verdict": ("input_bound"
+                                     if self.consumer_wait
+                                     > self.producer_wait
+                                     else "compute_bound")})
+
+
+def _prefetch_producer(it, q: queue.Queue, stop: threading.Event,
+                       stats: _PrefetchStats) -> None:
     # module-level target: the thread must NOT strongly reference the
     # PrefetchIterator, or threading's live-thread registry would keep it
     # reachable and the GC finalizer could never fire
     try:
         for item in it:
+            t0 = time.perf_counter()
             while not stop.is_set():
                 try:
                     q.put(item, timeout=0.1)
                     break
                 except queue.Full:
                     continue
+            stats.producer_wait += time.perf_counter() - t0
+            stats.produced += 1
             if stop.is_set():
                 return
         q.put(_PREFETCH_END)
@@ -525,8 +566,10 @@ class PrefetchIterator:
         self._done = False
         self._queue = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self.stats = _PrefetchStats()
         self._thread = threading.Thread(
-            target=_prefetch_producer, args=(it, self._queue, self._stop),
+            target=_prefetch_producer,
+            args=(it, self._queue, self._stop, self.stats),
             daemon=True)
         self._finalizer = weakref.finalize(
             self, _drain_and_stop, self._queue, self._stop)
@@ -538,16 +581,23 @@ class PrefetchIterator:
     def __next__(self):
         if self._done:
             raise StopIteration
-        item = self._queue.get()
+        with obs.span("data/next_batch"):
+            t0 = time.perf_counter()
+            item = self._queue.get()
+            self.stats.consumer_wait += time.perf_counter() - t0
         if item is _PREFETCH_END:
             self._done = True
+            self.stats.report()
             raise StopIteration
         if isinstance(item, BaseException):
             self._done = True
             raise item
+        self.stats.consumed += 1
         return item
 
     def close(self):
+        if not self._done:
+            self.stats.report()
         self._done = True
         self._finalizer()
 
@@ -743,11 +793,15 @@ class ShardedBatcher:
 
     def _device_batches(self, epoch: int, start_step: int) -> Iterator[dict[str, jax.Array]]:
         for batch in self.local_batches(epoch, start_step):
-            yield {
-                k: jax.make_array_from_process_local_data(
-                    self._column_sharding(v), v)
-                for k, v in batch.items()
-            }
+            # span closes BEFORE the yield: a generator suspended inside
+            # the with-block would bill consumer think-time to the span
+            with obs.span("data/host_to_device"):
+                out = {
+                    k: jax.make_array_from_process_local_data(
+                        self._column_sharding(v), v)
+                    for k, v in batch.items()
+                }
+            yield out
 
     def _column_sharding(self, v: np.ndarray) -> NamedSharding:
         key = (v.ndim, v.shape[1] if v.ndim >= 2 else None)

@@ -29,6 +29,7 @@ from typing import Optional
 
 import numpy as np
 
+from huggingface_sagemaker_tensorflow_distributed_tpu import obs
 from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (
     apply_mlm_masking,
     encode_mlm_clean,
@@ -82,12 +83,15 @@ class LineCorpus:
         locality)."""
         order = np.argsort(idx, kind="stable")
         out: list[Optional[str]] = [None] * len(idx)
-        with open(self.path, "rb") as f:
-            for j in order:
-                r = int(idx[j])
-                f.seek(self._offsets[r])
-                raw = f.read(int(self._offsets[r + 1] - self._offsets[r]))
-                out[j] = raw.decode("utf-8").rstrip("\r\n")
+        # span: how much of the producer thread's time is raw file I/O
+        # (vs tokenize/mask) — the streaming half of the input-bound story
+        with obs.span("data/corpus_read"):
+            with open(self.path, "rb") as f:
+                for j in order:
+                    r = int(idx[j])
+                    f.seek(self._offsets[r])
+                    raw = f.read(int(self._offsets[r + 1] - self._offsets[r]))
+                    out[j] = raw.decode("utf-8").rstrip("\r\n")
         return out
 
     def read_records(self, idx: np.ndarray) -> list[dict]:
@@ -166,6 +170,10 @@ class StreamingTextDataset:
         return self.corpus._offsets.nbytes
 
     def __getitem__(self, idx) -> dict[str, np.ndarray]:
+        with obs.span("data/stream_batch"):
+            return self._materialize(idx)
+
+    def _materialize(self, idx) -> dict[str, np.ndarray]:
         if not isinstance(idx, np.ndarray):
             idx = np.atleast_1d(np.asarray(idx, np.int64))
         if self.task == "seq2seq":

@@ -211,6 +211,11 @@ class LocalBackend:
                 "TPU_PROCESS_ID": str(host),
                 "TPU_OUTPUT_DATA_DIR": handle.output_data_dir,
                 "TPU_MODEL_DIR": handle.model_dir,
+                # telemetry (obs/): every host gets the rank-correct env;
+                # only host 0 writes files (obs rank-0 discipline), into
+                # the job dir next to the other artifacts
+                "HSTD_TELEMETRY_DIR": env.get("HSTD_TELEMETRY_DIR")
+                or os.path.join(handle.output_data_dir, "telemetry"),
             })
             log_path = os.path.join(job_dir, f"host_{host}.log")
             with open(log_path, "w") as log:  # child inherits the fd

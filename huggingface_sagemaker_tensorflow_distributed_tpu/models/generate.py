@@ -26,6 +26,30 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+
+def _traced_decode(phase: str, t0: float, out: jax.Array) -> jax.Array:
+    """Telemetry epilogue shared by the generation entry points: on
+    instrumented runs (a file sink is configured) block on the result so
+    the measurement covers real decode wall time, and emit tokens/sec;
+    on ordinary calls stay fully async — dispatch-only spans, no sync.
+    ``t0`` is the entry-point's perf_counter at call start, so a first
+    call's figure includes trace+compile (it shows as an outlier that
+    correlates with the compile events; steady-state calls are honest).
+    ``out`` is [batch, new_tokens]."""
+    if obs.has_sink():
+        import time
+
+        with obs.span(f"{phase}/wait"):
+            jax.block_until_ready(out)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        obs.scalar(f"{phase}/tokens_per_sec",
+                   out.shape[0] * out.shape[1] / dt,
+                   args={"batch": int(out.shape[0]),
+                         "new_tokens": int(out.shape[1])})
+    return out
+
 
 def init_cache(model, params, encoder_hidden, encoder_attention_mask,
                max_decoder_length: int):
@@ -110,14 +134,19 @@ def generate(model, params, input_ids, attention_mask=None,
     Returns [batch, max_new_tokens] ids, padded with ``pad_token_id``
     after EOS.
     """
+    import time
+
+    t0 = time.perf_counter()
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if attention_mask is None:
         attention_mask = jnp.ones_like(input_ids)
     attention_mask = jnp.asarray(attention_mask, jnp.int32)
-    return _generate_jit(model, params, input_ids, attention_mask,
-                         int(max_new_tokens), float(temperature),
-                         jax.random.PRNGKey(seed), top_k=int(top_k),
-                         top_p=float(top_p))
+    with obs.span("generate/seq2seq_dispatch"):
+        out = _generate_jit(model, params, input_ids, attention_mask,
+                            int(max_new_tokens), float(temperature),
+                            jax.random.PRNGKey(seed), top_k=int(top_k),
+                            top_p=float(top_p))
+    return _traced_decode("generate/seq2seq", t0, out)
 
 
 def _force_token(logits, token_id):
@@ -259,6 +288,9 @@ def generate_causal(model, params, input_ids, attention_mask=None,
     is right-padded to a chunk multiple internally — same tokens out).
     Returns [batch, max_new_tokens] continuation ids, ``pad_token_id``
     after EOS."""
+    import time
+
+    t0 = time.perf_counter()
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if attention_mask is None:
         attention_mask = jnp.ones_like(input_ids)
@@ -291,11 +323,16 @@ def generate_causal(model, params, input_ids, attention_mask=None,
             input_ids = jnp.pad(input_ids, ((0, 0), (0, short)),
                                 constant_values=pad_id)
             attention_mask = jnp.pad(attention_mask, ((0, 0), (0, short)))
-    return _generate_causal_jit(model, params, input_ids, attention_mask,
-                                int(max_new_tokens), float(temperature),
-                                jax.random.PRNGKey(seed), top_k=int(top_k),
-                                top_p=float(top_p),
-                                prefill_chunk=prefill_chunk)
+    with obs.span("generate/causal_dispatch",
+                  {"prompt_len": int(input_ids.shape[1]),
+                   "prefill_chunk": prefill_chunk} if obs.has_sink()
+                  else None):
+        out = _generate_causal_jit(model, params, input_ids, attention_mask,
+                                   int(max_new_tokens), float(temperature),
+                                   jax.random.PRNGKey(seed), top_k=int(top_k),
+                                   top_p=float(top_p),
+                                   prefill_chunk=prefill_chunk)
+    return _traced_decode("generate/causal", t0, out)
 
 
 _NEG = jnp.float32(-1e9)
@@ -583,9 +620,14 @@ def beam_search_causal(model, params, input_ids, attention_mask=None,
             "beam_search_causal does not support MoE models (Mixtral): "
             "expert capacity depends on the apply's sequence length, so "
             "beam prefill vs single-token steps could route differently")
-    ids, scores = _beam_search_causal_jit(
-        model, params, input_ids, attention_mask, int(num_beams),
-        int(max_new_tokens), jnp.float32(length_penalty))
+    import time
+
+    t0 = time.perf_counter()
+    with obs.span("generate/beam_causal_dispatch"):
+        ids, scores = _beam_search_causal_jit(
+            model, params, input_ids, attention_mask, int(num_beams),
+            int(max_new_tokens), jnp.float32(length_penalty))
+    _traced_decode("generate/beam_causal", t0, ids)
     return (ids, scores) if return_scores else ids
 
 
@@ -600,9 +642,15 @@ def beam_search_generate(model, params, input_ids, attention_mask=None,
     if attention_mask is None:
         attention_mask = jnp.ones_like(input_ids)
     attention_mask = jnp.asarray(attention_mask, jnp.int32)
-    ids, scores = _beam_search_jit(model, params, input_ids, attention_mask,
-                                   int(num_beams), int(max_new_tokens),
-                                   jnp.float32(length_penalty))
+    import time
+
+    t0 = time.perf_counter()
+    with obs.span("generate/beam_dispatch"):
+        ids, scores = _beam_search_jit(model, params, input_ids,
+                                       attention_mask, int(num_beams),
+                                       int(max_new_tokens),
+                                       jnp.float32(length_penalty))
+    _traced_decode("generate/beam", t0, ids)
     return (ids, scores) if return_scores else ids
 
 

@@ -1,0 +1,174 @@
+"""``obs``: in-repo, dependency-free telemetry.
+
+One process-wide :class:`~.core.ObsState` backs a module-level API so
+call sites never thread a tracer through ten layers::
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+    with obs.span("train/step"):
+        ...
+    obs.scalar("train/loss", 0.31, step=120)
+    obs.heartbeat().start(); obs.pulse()        # liveness + stall dumps
+
+Environment contract (documented in README "Telemetry"):
+
+- ``HSTD_TELEMETRY=0`` disables everything (zero hot-loop allocations:
+  ``span`` returns a shared singleton, ``scalar``/``pulse`` early-return).
+- ``HSTD_TELEMETRY_DIR=<dir>`` writes ``events.jsonl`` (streamed,
+  crash-safe append) and ``trace.json`` (Chrome trace viewer / Perfetto,
+  atomically replaced) into ``<dir>``. Unset → spans/metrics are no-ops
+  (the instrumentation is opt-in per run); no files or span buffers
+  accumulate in un-instrumented processes.
+- ``HSTD_HEARTBEAT_SECS`` sets the liveness cadence (default 60).
+
+Multi-host: host 0 owns the files; other hosts buffer in memory.
+``parallel.distributed.initialize_distributed`` reports the real rank
+via :func:`set_host`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs import core as _core
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.core import (  # noqa: F401
+    ENV_DIR,
+    ENV_ENABLE,
+    ENV_HEARTBEAT,
+    EventLog,
+    MetricsSink,
+    NULL_SPAN,
+    ObsState,
+    Tracer,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.schema import (  # noqa: F401
+    SCHEMA_VERSION,
+    iter_events,
+    validate_event,
+    validate_events_file,
+    validate_trace_file,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.watchdog import (  # noqa: F401
+    CompileTracker,
+    Heartbeat,
+    install_compile_tracker,
+    sample_device_memory,
+    thread_stacks,
+)
+
+_state = ObsState()
+_tracer = Tracer(_state)
+_metrics = MetricsSink(_state)
+_heartbeat: Optional[Heartbeat] = None
+
+
+def state() -> ObsState:
+    return _state
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def has_sink() -> bool:
+    """True when THIS process streams events to disk (host 0 of an
+    instrumented run)."""
+    return _state.events is not None
+
+
+def configured() -> bool:
+    """True when telemetry is enabled with an output dir. Unlike
+    :func:`has_sink` this is identical on EVERY host of a launcher job
+    (the env contract sets the dir everywhere; only host 0 gets the
+    file), so it is the correct guard for collectives that feed
+    telemetry — e.g. the per-epoch straggler gather."""
+    return _state.enabled and _state.dir is not None
+
+
+def configure(out_dir: Optional[str] = None,
+              enabled: Optional[bool] = None) -> None:
+    _state.configure(out_dir=out_dir, enabled=enabled)
+
+
+def set_host(index: int, count: int) -> None:
+    _state.set_host(index, count)
+
+
+def span(name: str, args: Optional[dict] = None):
+    """Nestable wall-time span (context manager). Allocation-free when
+    telemetry is disabled."""
+    return _tracer.span(name, args)
+
+
+def scalar(name: str, value, step: Optional[int] = None,
+           args: Optional[dict] = None) -> None:
+    _metrics.scalar(name, value, step, args)
+
+
+def metrics() -> MetricsSink:
+    return _metrics
+
+
+def heartbeat_env_interval(default: float = 60.0) -> float:
+    """``HSTD_HEARTBEAT_SECS`` as a float; malformed values fall back to
+    ``default`` — telemetry configuration must never kill the workload
+    it observes."""
+    raw = os.environ.get(ENV_HEARTBEAT, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def heartbeat(interval: Optional[float] = None,
+              stall_after: Optional[float] = None) -> Heartbeat:
+    """The process heartbeat (created on first use; interval from
+    ``HSTD_HEARTBEAT_SECS`` unless given)."""
+    global _heartbeat
+    if _heartbeat is None:
+        if interval is None:
+            interval = heartbeat_env_interval()
+        _heartbeat = Heartbeat(_state, interval=interval,
+                               stall_after=stall_after)
+    return _heartbeat
+
+
+def pulse() -> None:
+    """Mark forward progress for the stall watchdog (hot path: two
+    attribute stores; no-op until a heartbeat exists)."""
+    hb = _heartbeat
+    if hb is not None:
+        hb.pulse()
+
+
+def compile_tracker() -> Optional[CompileTracker]:
+    return install_compile_tracker(_state)
+
+
+def flush() -> None:
+    """Write/refresh trace.json from the span buffer; flush event file."""
+    _state.flush_trace()
+
+
+def shutdown() -> None:
+    global _heartbeat
+    if _heartbeat is not None:
+        _heartbeat.stop()
+        _heartbeat = None
+    _state.shutdown()
+
+
+def reset(out_dir: Optional[str] = None,
+          enabled: Optional[bool] = None) -> ObsState:
+    """Test helper: tear down and rebuild the process state (re-reading
+    the environment), optionally overriding dir/enabled."""
+    global _state, _tracer, _metrics, _heartbeat
+    shutdown()
+    _state = ObsState()
+    _tracer = Tracer(_state)
+    _metrics = MetricsSink(_state)
+    _heartbeat = None
+    if out_dir is not None or enabled is not None:
+        _state.configure(out_dir=out_dir, enabled=enabled)
+    return _state

@@ -1,0 +1,281 @@
+"""Telemetry core: the process-wide state, the crash-safe JSONL event
+log, the span tracer, and the scalar metrics sink.
+
+Design constraints (ISSUE 1 acceptance):
+
+- ``HSTD_TELEMETRY=0`` must cost exactly zero allocations on the trainer
+  hot loop: every public entry point early-returns on a cached bool, and
+  the disabled ``span()`` returns one shared singleton context manager.
+- Enabled-but-unconfigured (no output dir) runs buffer spans in a
+  bounded in-memory list and write no files — unit tests stay clean.
+- File emission is append + flush per line, so a SIGKILL tears at most
+  the final line (``schema.iter_events`` skips a torn tail); fsync runs
+  every ``_FSYNC_EVERY`` lines to bound data loss on power-cut-class
+  failures without paying fsync latency per event.
+- No jax imports anywhere in this module: the host/rank id comes from
+  the launcher env contract (``TPU_PROCESS_ID``) or an explicit
+  ``set_host`` call from ``parallel.distributed``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.schema import (
+    SCHEMA_VERSION,
+)
+
+ENV_ENABLE = "HSTD_TELEMETRY"
+ENV_DIR = "HSTD_TELEMETRY_DIR"
+ENV_HEARTBEAT = "HSTD_HEARTBEAT_SECS"
+
+_FSYNC_EVERY = 64
+_MAX_BUFFERED_SPANS = 200_000
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(ENV_ENABLE, "1").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+class EventLog:
+    """Append-only JSONL writer with the envelope fields stamped on.
+
+    The file opens lazily at the FIRST emit (with ``header`` written
+    ahead of it) — so merely constructing the log, e.g. on a host whose
+    rank is still an import-time guess, never touches a shared
+    filesystem; a later ``set_host`` demotion closes the unused log
+    before any line lands.
+    """
+
+    def __init__(self, path: str, host: int,
+                 header: Optional[tuple[str, dict]] = None):
+        self.path = path
+        self.host = host
+        self._header = header
+        self._lock = threading.Lock()
+        self._file = None
+        self._since_fsync = 0
+
+    def _stamp(self, etype: str, fields: dict) -> str:
+        record = {"v": SCHEMA_VERSION, "t": time.time(), "host": self.host,
+                  "pid": os.getpid(), "type": etype}
+        record.update(fields)
+        return json.dumps(record, default=str) + "\n"
+
+    def emit(self, etype: str, fields: dict) -> None:
+        line = self._stamp(etype, fields)
+        with self._lock:
+            if self._file is None:
+                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                self._file = open(self.path, "a", encoding="utf-8")
+                if self._header is not None:
+                    hdr_type, hdr_fields = self._header
+                    self._header = None
+                    self._file.write(self._stamp(hdr_type, hdr_fields))
+            self._file.write(line)
+            self._file.flush()
+            self._since_fsync += 1
+            if self._since_fsync >= _FSYNC_EVERY:
+                os.fsync(self._file.fileno())
+                self._since_fsync = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._file.close()
+                self._file = None
+
+
+class ObsState:
+    """One per process: configuration + span buffer + file sinks."""
+
+    def __init__(self):
+        self.enabled = _env_enabled()
+        self.host = int(os.environ.get("TPU_PROCESS_ID", "0") or 0)
+        self.host_count = int(os.environ.get("TPU_NUM_PROCESSES", "1") or 1)
+        self.dir: Optional[str] = None
+        self.events: Optional[EventLog] = None
+        self.mono0 = time.perf_counter()
+        self.spans: list = []          # (name, mono_start, dur, tid, depth)
+        self.spans_dropped = 0
+        self._tl = threading.local()
+        self._lock = threading.Lock()
+        env_dir = os.environ.get(ENV_DIR, "").strip()
+        if self.enabled and env_dir:
+            self._open_dir(env_dir)
+
+    # -- configuration ------------------------------------------------------
+
+    def _open_dir(self, path: str) -> None:
+        self.dir = path
+        # multi-host runs on a shared filesystem: only host 0 owns the
+        # files (interleaved appends from many writers would tear lines).
+        # The "run" header is written lazily with the first real event:
+        # a host whose rank is an env guess (auto-detected pods) never
+        # touches the file before initialize_distributed corrects it via
+        # set_host.
+        if self.host == 0:
+            self.events = EventLog(
+                os.path.join(path, "events.jsonl"), self.host,
+                header=("run", {"argv": sys.argv,
+                                "python": sys.version.split()[0]}))
+
+    def configure(self, out_dir: Optional[str] = None,
+                  enabled: Optional[bool] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self.enabled = enabled
+            if out_dir and self.enabled and self.dir != out_dir:
+                if self.events is not None:
+                    self.events.close()
+                    self.events = None
+                self._open_dir(out_dir)
+
+    def set_host(self, index: int, count: int) -> None:
+        self.host = index
+        self.host_count = count
+        if self.events is not None:
+            if index != 0:
+                # demoted from presumed-rank-0: stop writing
+                self.events.close()
+                self.events = None
+            else:
+                self.events.host = index
+
+    # -- span recording -----------------------------------------------------
+
+    def add_span(self, name: str, mono_start: float, dur: float,
+                 args: Optional[dict]) -> None:
+        tid = threading.get_ident() & 0x7FFFFFFF
+        depth = getattr(self._tl, "depth", 0)
+        if len(self.spans) < _MAX_BUFFERED_SPANS:
+            self.spans.append((name, mono_start, dur, tid, depth))
+        else:
+            self.spans_dropped += 1
+        if self.events is not None:
+            fields = {"name": name, "dur": round(dur, 9),
+                      "mono": round(mono_start, 9), "tid": tid,
+                      "depth": depth}
+            if args:
+                fields["args"] = args
+            self.events.emit("span", fields)
+
+    # -- trace.json projection ----------------------------------------------
+
+    def flush_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome-trace projection of the buffered spans
+        atomically (tmp + rename), so a concurrent kill never leaves a
+        half-written trace.json. Returns the path written, or None."""
+        if path is None:
+            if self.dir is None or self.host != 0:
+                return None
+            path = os.path.join(self.dir, "trace.json")
+        events = [
+            {"name": name, "ph": "X", "ts": round(mono * 1e6, 3),
+             "dur": round(dur * 1e6, 3), "pid": self.host, "tid": tid}
+            for name, mono, dur, tid, _depth in list(self.spans)
+        ]
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"schema_version": SCHEMA_VERSION,
+                             "spans_dropped": self.spans_dropped}}
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def shutdown(self) -> None:
+        self.flush_trace()
+        if self.events is not None:
+            self.events.close()
+            self.events = None
+
+
+class _NullSpan:
+    """The disabled-path span: ONE shared instance, allocation-free."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_state", "_name", "_args", "_t0")
+
+    def __init__(self, state: ObsState, name: str, args: Optional[dict]):
+        self._state = state
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        tl = self._state._tl
+        tl.depth = getattr(tl, "depth", 0) + 1
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        tl = self._state._tl
+        tl.depth = max(getattr(tl, "depth", 1) - 1, 0)
+        self._state.add_span(self._name, self._t0 - self._state.mono0,
+                             dur, self._args)
+        return False
+
+
+class Tracer:
+    """Nestable wall-time spans; ``span()`` is the only hot-path entry.
+
+    Recording requires an output dir (``configure``/``HSTD_TELEMETRY_DIR``)
+    — an un-instrumented process gets the shared no-op singleton, paying
+    neither per-span allocation nor the unreadable-by-anything span
+    buffer growing toward its cap."""
+
+    def __init__(self, state: ObsState):
+        self._state = state
+
+    def span(self, name: str, args: Optional[dict] = None):
+        state = self._state
+        if not state.enabled or state.dir is None:
+            return NULL_SPAN
+        return _Span(state, name, args)
+
+
+class MetricsSink:
+    """Rank-0 scalar series → events.jsonl ``metric`` lines.
+
+    Calls are positional on the hot path (no kwargs dict churn); when
+    telemetry is disabled or no file sink is configured, ``scalar`` is a
+    two-comparison early return.
+    """
+
+    def __init__(self, state: ObsState):
+        self._state = state
+
+    def scalar(self, name: str, value, step: Optional[int] = None,
+               args: Optional[dict] = None) -> None:
+        state = self._state
+        if not state.enabled or state.events is None:
+            return
+        fields: dict = {"name": name,
+                        "value": None if value is None else float(value)}
+        if step is not None:
+            fields["step"] = int(step)
+        if args:
+            fields["args"] = args
+        state.events.emit("metric", fields)

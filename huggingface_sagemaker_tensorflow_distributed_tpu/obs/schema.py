@@ -1,0 +1,154 @@
+"""Telemetry event schema: the stable contract every emitter writes and
+every consumer (BENCH harness, ``scripts/check_telemetry_schema.py``,
+Perfetto via ``trace.json``) parses.
+
+Deliberately stdlib-only and import-light: the schema validator must run
+in environments without jax (CI lint steps, the driver box), so nothing
+in this module — or anything it imports — may touch jax.
+
+One event = one JSON object on one line of ``events.jsonl``. Envelope
+fields present on EVERY event:
+
+    v     int    schema version (SCHEMA_VERSION)
+    t     float  unix wall-clock seconds at emission
+    host  int    process index (rank); 0 on single-host runs
+    pid   int    OS process id
+    type  str    one of EVENT_TYPES
+
+Per-type required fields are in ``REQUIRED_FIELDS``; extra fields are
+always allowed (forward compatibility), missing required fields are a
+schema error. ``trace.json`` is the Chrome-trace-viewer projection of
+the span events: ``{"traceEvents": [{"name", "ph": "X", "ts", "dur",
+"pid", "tid"}, ...]}`` with timestamps in microseconds.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, Optional
+
+SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+
+# type name -> {field: allowed python types}
+REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
+    # a completed wall-time span; "mono" is the monotonic start time so
+    # spans order/nest without wall-clock steps
+    "span": {"name": (str,), "dur": _NUM, "mono": _NUM, "tid": (int,)},
+    # one scalar sample of a named series (loss, lr, samples/sec, ...)
+    "metric": {"name": (str,), "value": _NUM + (type(None),)},
+    # liveness: emitted every HSTD_HEARTBEAT_SECS by the heartbeat thread
+    "heartbeat": {"uptime": _NUM, "progress": (int,), "progress_age": _NUM},
+    # the heartbeat's stall dump: all thread stacks at the moment the
+    # watched thread stopped pulsing
+    "stall": {"progress_age": _NUM, "stalled": (str,), "threads": (list,)},
+    # one XLA compilation, from jax.monitoring ("event" is the jax key)
+    "compile": {"event": (str,), "dur": _NUM, "count": (int,), "cum": _NUM},
+    # one device.memory_stats() sample (TPU/GPU; never emitted on CPU)
+    "memory": {"device": (str,), "stats": (dict,)},
+    # run metadata, first event after configure()
+    "run": {"argv": (list,)},
+}
+
+EVENT_TYPES = tuple(REQUIRED_FIELDS)
+
+ENVELOPE_FIELDS: dict[str, tuple] = {
+    "v": (int,),
+    "t": _NUM,
+    "host": (int,),
+    "pid": (int,),
+    "type": (str,),
+}
+
+
+def validate_event(obj: object) -> list[str]:
+    """Schema errors for one decoded event (empty list = valid)."""
+    if not isinstance(obj, dict):
+        return [f"event is {type(obj).__name__}, not an object"]
+    errors = []
+    for field, types in ENVELOPE_FIELDS.items():
+        if field not in obj:
+            errors.append(f"missing envelope field {field!r}")
+        elif not isinstance(obj[field], types) or isinstance(obj[field], bool):
+            errors.append(f"envelope field {field!r} has type "
+                          f"{type(obj[field]).__name__}")
+    etype = obj.get("type")
+    if isinstance(etype, str):
+        required = REQUIRED_FIELDS.get(etype)
+        if required is None:
+            errors.append(f"unknown event type {etype!r} "
+                          f"(known: {', '.join(EVENT_TYPES)})")
+        else:
+            for field, types in required.items():
+                if field not in obj:
+                    errors.append(f"{etype}: missing field {field!r}")
+                elif (not isinstance(obj[field], types)
+                      or (isinstance(obj[field], bool)
+                          and bool not in types)):
+                    errors.append(f"{etype}: field {field!r} has type "
+                                  f"{type(obj[field]).__name__}")
+    if obj.get("v") not in (None, SCHEMA_VERSION):
+        errors.append(f"schema version {obj.get('v')!r} != {SCHEMA_VERSION}")
+    return errors
+
+
+def iter_events(path: str, strict_tail: bool = False) -> Iterator[tuple[int, Optional[dict], Optional[str]]]:
+    """Yield ``(lineno, event_or_None, error_or_None)`` per line.
+
+    Crash tolerance: a process killed mid-write leaves at most one torn
+    FINAL line, which is skipped silently (unless ``strict_tail``); a
+    torn line anywhere else means corruption and is reported.
+    """
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            yield i + 1, json.loads(line), None
+        except ValueError:
+            if i == len(lines) - 1 and not strict_tail:
+                continue  # torn tail from a mid-write kill: expected
+            yield i + 1, None, "unparseable JSON"
+
+
+def validate_events_file(path: str, strict_tail: bool = False) -> tuple[int, list[str]]:
+    """(valid_event_count, error messages) for an events.jsonl file."""
+    count = 0
+    errors: list[str] = []
+    for lineno, obj, err in iter_events(path, strict_tail=strict_tail):
+        if err is not None:
+            errors.append(f"{path}:{lineno}: {err}")
+            continue
+        errs = validate_event(obj)
+        if errs:
+            errors.extend(f"{path}:{lineno}: {e}" for e in errs)
+        else:
+            count += 1
+    return count, errors
+
+
+def validate_trace_file(path: str) -> tuple[int, list[str]]:
+    """(event_count, error messages) for a Chrome-trace trace.json."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except ValueError as e:
+        return 0, [f"{path}: unparseable JSON ({e})"]
+    events = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    if not isinstance(events, list):
+        return 0, [f"{path}: expected a traceEvents list"]
+    errors = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"{path}: traceEvents[{i}] is not an object")
+            continue
+        for field, types in (("name", (str,)), ("ph", (str,)),
+                             ("ts", _NUM), ("pid", (int,)), ("tid", (int,))):
+            if not isinstance(ev.get(field), types):
+                errors.append(f"{path}: traceEvents[{i}] bad {field!r}")
+        if ev.get("ph") == "X" and not isinstance(ev.get("dur"), _NUM):
+            errors.append(f"{path}: traceEvents[{i}] complete event "
+                          "without numeric 'dur'")
+    return len(events), errors

@@ -1,0 +1,262 @@
+"""Watchdogs: liveness heartbeat (+ stall stack dump), XLA compile
+tracker, device-memory sampler.
+
+Exactly the instrumentation that would have made the BENCH r05 rc=124
+timeout diagnosable: a run that dies mid-compile leaves heartbeat lines
+(so the last-known-alive time is on disk), compile events (so "it was
+still compiling" is distinguishable from "it hung in the data loop"),
+and — if the watched thread stops pulsing while the process lives — a
+full stack dump naming the blocked thread.
+
+jax is imported inside functions only: the heartbeat and stall machinery
+must work in processes that never initialize a backend (the bench
+supervisor), and ``obs`` must stay importable without jax.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.core import ObsState
+
+# plain stdlib logging, NOT utils.logging: that package's __init__ pulls
+# jax, and obs must stay importable (and the schema validator runnable)
+# on jax-less boxes. Runs that configured utils.logging still format
+# these records — it configures the root logger.
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def thread_stacks() -> list[dict]:
+    """All live threads' stacks as schema ``stall.threads`` entries."""
+    frames = sys._current_frames()
+    out = []
+    for th in threading.enumerate():
+        frame = frames.get(th.ident)
+        stack = traceback.format_stack(frame) if frame is not None else []
+        out.append({"name": th.name, "ident": th.ident & 0x7FFFFFFF,
+                    "daemon": th.daemon,
+                    "stack": [ln.rstrip("\n") for ln in stack]})
+    return out
+
+
+class Heartbeat:
+    """Daemon thread emitting one liveness line every ``interval`` secs.
+
+    The thread being watched (whoever calls :meth:`pulse` — the train
+    loop, the bench body) registers progress; if no pulse lands for
+    ``stall_after`` seconds while the process is otherwise alive, the
+    heartbeat emits ONE ``stall`` event with every thread's stack and
+    the watched thread's name, then re-arms when pulses resume.
+
+    ``pulse()`` is allocation-free: two attribute stores.
+    """
+
+    def __init__(self, state: ObsState, interval: float = 60.0,
+                 stall_after: Optional[float] = None,
+                 sample_memory: bool = True):
+        self._state = state
+        self.interval = max(float(interval), 0.05)
+        self.stall_after = (stall_after if stall_after is not None
+                            else 3.0 * self.interval)
+        self.sample_memory = sample_memory
+        self._t0 = time.monotonic()
+        self._progress = 0
+        self._last_pulse = self._t0
+        self._watched = "main"
+        self._watched_ident = threading.main_thread().ident
+        self._watching = False
+        self._dumped = False
+        self._last_trace_n = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stall_count = 0
+
+    # -- watched-thread side (hot path) -------------------------------------
+
+    def pulse(self) -> None:
+        self._progress += 1
+        self._last_pulse = time.monotonic()
+
+    def watch_current_thread(self) -> None:
+        th = threading.current_thread()
+        self._watched = th.name
+        self._watched_ident = th.ident
+        self._watching = True
+        self._last_pulse = time.monotonic()
+
+    def unwatch(self) -> None:
+        """Disable stall detection (liveness beats continue) — call when
+        the watched loop finishes and legitimate idleness begins."""
+        self._watching = False
+
+    # -- thread management --------------------------------------------------
+
+    def start(self) -> "Heartbeat":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._last_pulse = time.monotonic()
+            self._thread = threading.Thread(target=self._run,
+                                            name="hstd-heartbeat",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+            self._thread = None
+
+    # -- heartbeat thread ---------------------------------------------------
+
+    def _beat_once(self) -> None:
+        now = time.monotonic()
+        age = now - self._last_pulse
+        if self._state.events is not None:
+            self._state.events.emit("heartbeat", {
+                "uptime": round(now - self._t0, 3),
+                "progress": self._progress,
+                "progress_age": round(age, 3)})
+        if self.sample_memory:
+            sample_device_memory(self._state)
+        # keep trace.json current: a later SIGKILL still leaves a valid,
+        # recent Chrome trace on disk (atomic replace). The rewrite is
+        # O(buffered spans), so skip it unless enough NEW spans landed
+        # to matter — end-of-fit/shutdown flushes cover the final state.
+        n_spans = len(self._state.spans)
+        if n_spans != self._last_trace_n and (
+                n_spans - self._last_trace_n >= 256 or n_spans < 4096):
+            try:
+                self._state.flush_trace()
+                self._last_trace_n = n_spans
+            except OSError:
+                pass
+        if self._watching and age > self.stall_after:
+            if not self._dumped:
+                self._dumped = True
+                self.stall_count += 1
+                self._dump_stall(age)
+        else:
+            self._dumped = False
+
+    def _dump_stall(self, age: float) -> None:
+        threads = thread_stacks()
+        watched = self._watched
+        for th in threads:
+            if th["ident"] == (self._watched_ident or 0) & 0x7FFFFFFF:
+                th["watched"] = True
+                watched = th["name"]
+        if self._state.events is not None:
+            self._state.events.emit("stall", {
+                "progress_age": round(age, 3), "stalled": watched,
+                "progress": self._progress, "threads": threads})
+        lines = [f"[hstd-heartbeat] STALL: thread {watched!r} made no "
+                 f"progress for {age:.1f}s (progress={self._progress}); "
+                 "all thread stacks follow"]
+        for th in threads:
+            mark = " <-- watched (blocked)" if th.get("watched") else ""
+            lines.append(f"--- thread {th['name']!r}"
+                         f" (daemon={th['daemon']}){mark}")
+            lines.extend(th["stack"])
+        dump = "\n".join(lines)
+        print(dump, file=sys.stderr, flush=True)
+        logger.error("heartbeat stall: %r blocked for %.1fs",
+                     watched, age)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._beat_once()
+            except Exception:  # noqa: BLE001 — liveness must not kill runs
+                logger.exception("heartbeat emission failed")
+
+
+class CompileTracker:
+    """Counts every XLA compilation via ``jax.monitoring`` listeners.
+
+    Emits one ``compile`` event per observed compilation with the
+    running count and cumulative seconds — the compile-vs-data-vs-step
+    attribution the throughput accounting needs (persistent-cache disk
+    hits surface as near-zero durations). Listener registration is
+    process-global in jax and cannot be unregistered, so ``install``
+    wires one module-level hook that follows the live ObsState.
+    """
+
+    _MARKERS = ("compile", "tracing", "lowering")
+
+    def __init__(self, state: ObsState):
+        self.state = state
+        self.count = 0
+        self.cum_secs = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, event: str, secs: float) -> None:
+        low = event.lower()
+        if not any(m in low for m in self._MARKERS):
+            return
+        with self._lock:
+            self.count += 1
+            self.cum_secs += secs
+            count, cum = self.count, self.cum_secs
+        if self.state.events is not None:
+            self.state.events.emit("compile", {
+                "event": event, "dur": round(secs, 6), "count": count,
+                "cum": round(cum, 3)})
+
+
+_INSTALLED: list[CompileTracker] = []
+
+
+def install_compile_tracker(state: ObsState) -> Optional[CompileTracker]:
+    """Idempotent per ObsState; returns the tracker (None if telemetry
+    is disabled or jax.monitoring is unavailable)."""
+    if not state.enabled:
+        return None
+    for tracker in _INSTALLED:
+        if tracker.state is state:
+            return tracker
+    try:
+        from jax import monitoring
+    except ImportError:
+        return None
+    if not hasattr(monitoring, "register_event_duration_secs_listener"):
+        return None
+    tracker = CompileTracker(state)
+    monitoring.register_event_duration_secs_listener(tracker.observe)
+    _INSTALLED.append(tracker)
+    return tracker
+
+
+def sample_device_memory(state: ObsState) -> int:
+    """Emit one ``memory`` event per local device reporting memory_stats
+    (TPU/GPU). Graceful no-op — returns 0 — on CPU backends, before jax
+    is imported anywhere, or if jax is not even importable."""
+    if not state.enabled or state.events is None:
+        return 0
+    if "jax" not in sys.modules:
+        return 0  # never force a backend init from the telemetry layer
+    jax = sys.modules["jax"]
+    try:
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — backend not initialized / gone
+        return 0
+    emitted = 0
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — CPU backend raises on some jaxlibs
+            stats = None
+        if not stats:
+            continue
+        state.events.emit("memory", {
+            "device": f"{d.platform}:{d.id}",
+            "stats": {k: int(v) for k, v in stats.items()
+                      if isinstance(v, (int, float))}})
+        emitted += 1
+    return emitted

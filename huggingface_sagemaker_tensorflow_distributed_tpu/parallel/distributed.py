@@ -66,8 +66,43 @@ def initialize_distributed() -> tuple[int, int]:
             "distributed init: process %d/%d, coordinator %s",
             jax.process_index(), jax.process_count(), coord,
         )
+    # telemetry learns the REAL rank (its import-time guess comes from
+    # env vars, which auto-detected GCP TPU VM setups don't set): only
+    # host 0 writes events.jsonl/trace.json on shared filesystems
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+    obs.set_host(jax.process_index(), jax.process_count())
     return jax.process_index(), jax.process_count()
 
 
 def is_host0() -> bool:
     return jax.process_index() == 0
+
+
+def host_step_stats(step_seconds: float) -> dict | None:
+    """Per-host step-time aggregation for straggler visibility: every
+    host contributes its mean step time; all return ``{n_hosts, min,
+    max, mean, straggler_ratio}`` (rank 0 records it via the metrics
+    sink). This is a COLLECTIVE on multi-host runs — every process must
+    call it under the same condition. Returns the trivial single-host
+    stats without touching any collective machinery when there is one
+    process, and None when the value is not a finite number yet (first
+    epoch shorter than one measured window)."""
+    import math
+
+    v = float(step_seconds)
+    if not math.isfinite(v):
+        return None
+    if jax.process_count() == 1:
+        return {"n_hosts": 1, "min": v, "max": v, "mean": v,
+                "straggler_ratio": 1.0}
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    vals = np.asarray(multihost_utils.process_allgather(
+        np.asarray([v], np.float64))).reshape(-1)
+    mean = float(vals.mean())
+    return {"n_hosts": int(jax.process_count()),
+            "min": float(vals.min()), "max": float(vals.max()),
+            "mean": mean,
+            "straggler_ratio": float(vals.max() / max(mean, 1e-12))}

@@ -21,6 +21,7 @@ samples/sec/chip meter the north-star metric needs (BASELINE.md).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import inspect
 from typing import Any, Callable, Optional
@@ -33,6 +34,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.config import TrainConfig
+from huggingface_sagemaker_tensorflow_distributed_tpu import obs
 from huggingface_sagemaker_tensorflow_distributed_tpu.ops.losses import (
     softmax_cross_entropy_with_integer_labels,
 )
@@ -241,13 +243,15 @@ def _make_sharded_fused_ce(block_n: int, block_v: int,
     batch_axes = data_axis_names()
     if mesh is not None and any(
             mesh.shape.get(a, 1) > 1 for a in batch_axes):
-        from jax import shard_map
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+            shard_map_compat,
+        )
         # check_vma=False: pallas_call does not annotate varying-mesh
         # axes on its outputs, which the default vma check rejects
-        ce = shard_map(ce, mesh=mesh,
-                       in_specs=(P(batch_axes), P(), P(batch_axes)),
-                       out_specs=(P(batch_axes), P(batch_axes)),
-                       check_vma=False)
+        ce = shard_map_compat(ce, mesh=mesh,
+                              in_specs=(P(batch_axes), P(), P(batch_axes)),
+                              out_specs=(P(batch_axes), P(batch_axes)),
+                              check_vma=False)
     return ce
 
 
@@ -394,15 +398,18 @@ def make_fused_mlm_loss(model, mask_cap: float = 0.25, block_n: int = 256,
         batch_axes = data_axis_names()
         if mesh is not None and any(
                 mesh.shape.get(a, 1) > 1 for a in batch_axes):
-            from jax import shard_map
+            from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+                shard_map_compat,
+            )
             # check_vma=False: pallas_call does not annotate varying-mesh
             # axes on its outputs, which the default vma check rejects
-            ce = shard_map(ce, mesh=mesh,
-                           in_specs=(P(batch_axes), P(), P(), P(batch_axes),
-                                     P(batch_axes)),
-                           out_specs=(P(batch_axes), P(batch_axes),
-                                      P(batch_axes), P(batch_axes)),
-                           check_vma=False)
+            ce = shard_map_compat(
+                ce, mesh=mesh,
+                in_specs=(P(batch_axes), P(), P(), P(batch_axes),
+                          P(batch_axes)),
+                out_specs=(P(batch_axes), P(batch_axes),
+                           P(batch_axes), P(batch_axes)),
+                check_vma=False)
         per_tok, pred, lab_sel, sel_valid = ce(hidden, table, bias,
                                                safe_labels, token_valid)
         correct = pred == lab_sel
@@ -729,7 +736,18 @@ class Trainer:
         """
         cfg = self.config
         epochs = cfg.epochs if epochs is None else epochs
-        meter = StepMeter(n_chips=self.n_chips)
+        # telemetry: spans/metrics stream to <HSTD_TELEMETRY_DIR> when one
+        # is configured; watchdogs (compile tracker, heartbeat w/ stall
+        # dump) only spin up on instrumented runs so unit-test fits never
+        # start background threads
+        obs_files = obs.has_sink()
+        heartbeat = None
+        if obs_files:
+            obs.compile_tracker()
+            heartbeat = obs.heartbeat().start()
+            heartbeat.watch_current_thread()
+        meter = StepMeter(n_chips=self.n_chips,
+                          sink=obs.metrics() if obs_files else None)
         history: dict[str, list] = {"loss": [], "sparse_categorical_accuracy": []}
         steps_per_epoch = train_batcher.steps_per_epoch()
         if cfg.steps_per_epoch:
@@ -740,9 +758,15 @@ class Trainer:
         gbs = train_batcher.global_batch_size
         profiling = False
         first_step = True
+        # compile-step exclusion beyond the first step: with length
+        # bucketing every NEW batch-shape signature recompiles; the meter
+        # must not fold that compile into epoch throughput (timing.py)
+        track_shapes = bool(getattr(train_batcher, "bucket_sizes", None))
+        seen_shapes: set = set()
 
         def sync(metrics_list):
-            fetched = jax.device_get(metrics_list)
+            with obs.span("train/sync"):
+                fetched = jax.device_get(metrics_list)
             meter.end_window()
             meter.begin_window()
             return fetched
@@ -754,7 +778,20 @@ class Trainer:
                 "no eval_batcher — both are inert this run (pass "
                 "eval_batcher=..., as scripts/train.py does)")
         epochs_since_best = 0
-        with Stopwatch() as sw:
+        # the telemetry epilogue must run even when fit raises mid-epoch
+        # (OOM, failed save): an armed stall watchdog over a dead loop
+        # would emit a false "blocked thread" dump to the post-mortem
+        # artifact, and the fit's spans would never reach trace.json
+        obs_epilogue = contextlib.ExitStack()
+
+        def _obs_fit_done():
+            if heartbeat is not None:
+                heartbeat.unwatch()
+            if obs_files:
+                obs.flush()
+
+        obs_epilogue.callback(_obs_fit_done)
+        with obs_epilogue, Stopwatch() as sw:
             for epoch in range(start_epoch, epochs):
                 start_step = start_step_in_epoch if epoch == start_epoch else 0
                 device_metrics: list = []
@@ -772,12 +809,31 @@ class Trainer:
                                 and step - start_step == 3:
                             jax.profiler.start_trace(cfg.profile_dir)
                             profiling = True
-                        self.state, metrics = self._train_step(self.state, batch)
+                        recompile = False
+                        if track_shapes:
+                            sig = tuple(v.shape for v in batch.values())
+                            if sig not in seen_shapes:
+                                seen_shapes.add(sig)
+                                recompile = not first_step
+                        if recompile:
+                            # close the running window at a sync point
+                            # BEFORE dispatching the compiling step, so
+                            # steady-state throughput never absorbs it
+                            if device_metrics:
+                                jax.block_until_ready(
+                                    device_metrics[-1]["loss"])
+                            meter.end_window()
+                        with obs.span("train/step_dispatch"):
+                            self.state, metrics = self._train_step(
+                                self.state, batch)
                         device_metrics.append(metrics)
                         meter.window_step(gbs)
-                        if first_step:
+                        obs.pulse()
+                        if first_step or recompile:
                             # exclude XLA compile from the throughput window
-                            jax.block_until_ready(metrics["loss"])
+                            with obs.span("xla/compile_wait"):
+                                jax.block_until_ready(metrics["loss"])
+                            meter.exclude_step(gbs)
                             meter.begin_window()
                             first_step = False
                         if profiling and step - start_step == 6:
@@ -797,11 +853,17 @@ class Trainer:
                                 "epoch %d step %d/%d loss %.4f acc %.4f (%.1f samples/s/chip)",
                                 epoch, step, steps_per_epoch, losses[-1], accs[-1],
                                 meter.samples_per_sec_per_chip)
+                            gstep = epoch * steps_per_epoch + step
+                            obs.scalar("train/loss", losses[-1], gstep)
+                            obs.scalar("train/accuracy", accs[-1], gstep)
+                            obs.scalar("train/samples_per_sec_per_chip",
+                                       meter.samples_per_sec_per_chip, gstep)
                         if want_ckpt:
                             if cfg.check_divergence:
                                 self.check_replica_divergence()
-                            checkpointer.save(self.state, epoch=epoch,
-                                              step_in_epoch=step + 1)
+                            with obs.span("train/checkpoint"):
+                                checkpointer.save(self.state, epoch=epoch,
+                                                  step_in_epoch=step + 1)
                 finally:
                     if hasattr(batch_iter, "close"):
                         batch_iter.close()
@@ -815,6 +877,21 @@ class Trainer:
                 logger.info("epoch %d done: loss %.4f acc %.4f", epoch,
                             history["loss"][-1],
                             history["sparse_categorical_accuracy"][-1])
+                obs.scalar("train/epoch_loss", history["loss"][-1], epoch)
+                if obs.configured():
+                    # straggler visibility: every host reports its mean
+                    # step time; rank 0 records min/max/mean. The gather
+                    # is a collective, so the guard must agree across
+                    # hosts — obs.configured() is env-driven and set
+                    # identically on every host by the launcher (unlike
+                    # has_sink, which is host-0-only).
+                    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.distributed import (
+                        host_step_stats,
+                    )
+                    stats = host_step_stats(meter.avg_step_time)
+                    if stats is not None:
+                        obs.scalar("train/step_time_hosts_mean",
+                                   stats["mean"], epoch, args=stats)
                 stop_early = False
                 if eval_batcher is not None:
                     res = self.evaluate(eval_batcher)
@@ -824,6 +901,8 @@ class Trainer:
                         res["eval_accuracy"])
                     logger.info("epoch %d eval: loss %.4f acc %.4f", epoch,
                                 res["eval_loss"], res["eval_accuracy"])
+                    obs.scalar("eval/loss", res["eval_loss"], epoch)
+                    obs.scalar("eval/accuracy", res["eval_accuracy"], epoch)
                     track_best = (cfg.keep_best
                                   or cfg.early_stopping_patience > 0)
                     if track_best:
@@ -878,6 +957,11 @@ class Trainer:
         history["train_samples_per_second"] = round(meter.samples_per_sec, 3)
         history["train_samples_per_second_per_chip"] = round(
             meter.samples_per_sec_per_chip, 3)
+        if obs_files:
+            obs.scalar("train/runtime", sw.elapsed)
+            obs.scalar("train/samples_per_sec_per_chip_final",
+                       meter.samples_per_sec_per_chip)
+            obs.scalar("train/compile_excluded_steps", meter.excluded_steps)
         return history
 
     def evaluate(self, eval_batcher) -> dict:
@@ -895,18 +979,23 @@ class Trainer:
         totals: dict[str, float] = {}
 
         def drain(device_sums):
-            for sums in jax.device_get(device_sums):
+            with obs.span("eval/sync"):
+                fetched = jax.device_get(device_sums)
+            for sums in fetched:
                 for key, val in sums.items():
                     totals[key] = totals.get(key, 0.0) + float(val)
 
         device_sums: list = []
         batch_iter = eval_batcher.global_arrays(epoch=0)
         try:
-            for batch in batch_iter:
-                device_sums.append(self._eval_step(self.state.params, batch))
-                if len(device_sums) >= chunk:
-                    drain(device_sums)
-                    device_sums = []
+            with obs.span("eval/run"):
+                for batch in batch_iter:
+                    device_sums.append(
+                        self._eval_step(self.state.params, batch))
+                    obs.pulse()
+                    if len(device_sums) >= chunk:
+                        drain(device_sums)
+                        device_sums = []
         finally:
             if hasattr(batch_iter, "close"):
                 batch_iter.close()

@@ -5,12 +5,27 @@ Capability parity with the reference's ``train_runtime`` measurement
 ``scripts/train.py:142,154``), extended with the per-step samples/sec/chip
 meter that the north-star metric requires (BASELINE.md): the reference has
 no throughput instrumentation at all.
+
+The meter feeds the telemetry layer (``obs.MetricsSink``) when given a
+sink: every closed measurement window emits a ``train/samples_per_sec``
+sample, so throughput over time is a series in ``events.jsonl`` instead
+of one number at exit.
+
+Compile-step exclusion: XLA recompiles whenever a NEW batch shape
+arrives — not just on the literal first step. With length bucketing a
+fresh bucket width mid-epoch pays 10s-of-seconds of compilation; both
+APIs therefore take an explicit "this step recompiled" signal
+(``end_step(..., recompiled=True)`` / a window restart around the
+compile) so epoch throughput reflects steady-state step time. The old
+skip-first-only accounting understated bucketed throughput by folding
+every later bucket's compile into the measured time.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from typing import Optional
 
 
 @dataclass
@@ -18,37 +33,47 @@ class StepMeter:
     """Accumulates step wall-times and computes throughput.
 
     ``skip_first`` steps are excluded from throughput (first step pays XLA
-    compilation, ~20-40s on TPU).
+    compilation, ~20-40s on TPU); ``end_step(..., recompiled=True)``
+    excludes any later compile step (new bucket width) the same way.
     """
 
     n_chips: int = 1
     skip_first: int = 1
-    _t0: float | None = None
+    sink: Optional[object] = None     # obs.MetricsSink-shaped (scalar())
+    metric_name: str = "train/samples_per_sec"
+    _t0: Optional[float] = None
     _steps: int = 0
     _samples: int = 0
     _measured_time: float = 0.0
     _measured_samples: int = 0
     _measured_steps: int = 0
+    _excluded_steps: int = 0
     _epoch_times: list = field(default_factory=list)
-    _w0: float | None = None
+    _w0: Optional[float] = None
     _w_samples: int = 0
     _w_steps: int = 0
 
     def start_step(self) -> None:
         self._t0 = time.perf_counter()
 
-    def end_step(self, batch_samples: int) -> float:
+    def end_step(self, batch_samples: int, recompiled: bool = False) -> float:
         dt = time.perf_counter() - self._t0
         self._steps += 1
         self._samples += batch_samples
-        if self._steps > self.skip_first:
+        if self._steps > self.skip_first and not recompiled:
             self._measured_time += dt
             self._measured_samples += batch_samples
             self._measured_steps += 1
+        else:
+            self._excluded_steps += 1
         return dt
 
     # -- window API: measure between explicit device-sync points, so the
-    # train loop never has to block per step (async dispatch preserved) --
+    # train loop never has to block per step (async dispatch preserved).
+    # A recompile mid-epoch is handled by the caller closing the window
+    # at a sync point BEFORE dispatching the compiling step, then
+    # restarting it after the compile completes (trainer.fit does this
+    # per new batch-shape signature). --------------------------------------
 
     def begin_window(self) -> None:
         self._w0 = time.perf_counter()
@@ -59,17 +84,31 @@ class StepMeter:
         self._w_samples += batch_samples
         self._w_steps += 1
 
+    def exclude_step(self, batch_samples: int) -> None:
+        """Count a step as run-but-excluded (it paid a compilation);
+        callers pair this with ``begin_window()`` so the open window's
+        counters reset without attributing the compile wall time."""
+        self._steps += 1
+        self._samples += batch_samples
+        self._excluded_steps += 1
+        self._w_samples = max(self._w_samples - batch_samples, 0)
+        self._w_steps = max(self._w_steps - 1, 0)
+
     def end_window(self) -> None:
         """Call right after a device sync; attributes the window's wall
         time to the samples dispatched inside it."""
         if self._w0 is None:
             return
-        self._measured_time += time.perf_counter() - self._w0
+        dt = time.perf_counter() - self._w0
+        self._measured_time += dt
         self._measured_samples += self._w_samples
         self._measured_steps += self._w_steps
         self._steps += self._w_steps
         self._samples += self._w_samples
         self._w0 = None
+        if self.sink is not None and self._w_steps and dt > 0:
+            self.sink.scalar(self.metric_name, self._w_samples / dt,
+                             self._steps)
 
     @property
     def samples_per_sec(self) -> float:
@@ -86,6 +125,12 @@ class StepMeter:
         if self._measured_steps == 0:
             return 0.0
         return self._measured_time / self._measured_steps
+
+    @property
+    def excluded_steps(self) -> int:
+        """Steps excluded from throughput (compiles: first step, new
+        bucket widths, explicit ``recompiled=True``)."""
+        return self._excluded_steps
 
 
 class Stopwatch:

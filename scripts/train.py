@@ -452,4 +452,16 @@ def main(argv=None) -> dict:
 
 
 if __name__ == "__main__":
+    # real CLI runs default their telemetry into the output dir (the
+    # <out_dir>/telemetry/{events.jsonl,trace.json} layout, README
+    # "Telemetry"); in-process callers (tests) opt in via
+    # HSTD_TELEMETRY_DIR or obs.configure instead, so importing/calling
+    # main() never writes files as a side effect
+    if not os.environ.get("HSTD_TELEMETRY_DIR", "").strip():
+        from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+        _out = os.environ.get("TPU_OUTPUT_DATA_DIR",
+                              os.environ.get("SM_OUTPUT_DATA_DIR", ""))
+        if _out:
+            obs.configure(out_dir=os.path.join(_out, "telemetry"))
     main(sys.argv[1:])

@@ -19,21 +19,38 @@ def _args(**kw):
     base = dict(model=None, buckets=False, mesh=False, generate=False,
                 causal_lm=False, mlm=False, lora=False, banded=False,
                 llama_train=False, mixtral_train=False, batch=None,
-                opt_state_bf16=False, remat_policy=None)
+                opt_state_bf16=False, remat_policy=None,
+                budget_seconds=None)
     base.update(kw)
     ns = argparse.Namespace(**base)
     setattr(ns, "_child", False)
     return ns
 
 
+def _data_lines(lines):
+    """Drop the provisional progress lines (they are parseable JSON with
+    ``provisional: true``) — what remains is the measurement contract."""
+    out = []
+    for ln in lines:
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            out.append(ln)
+            continue
+        if not rec.get("provisional"):
+            out.append(ln)
+    return out
+
+
 def _run(monkeypatch, capsys, args, child_stdout, parity=_DEFAULT_PARITY,
          probe_ok=True):
     monkeypatch.setattr(
         bench, "probe_backend",
-        lambda: ({"ok": True, "platform": "tpu", "n": 1,
-                  "device_kind": "TPU v5 lite"} if probe_ok
-                 else {"ok": False, "attempts": [{"attempt": 1,
-                                                  "outcome": "timeout>5s"}]}))
+        lambda deadline=None: (
+            {"ok": True, "platform": "tpu", "n": 1,
+             "device_kind": "TPU v5 lite"} if probe_ok
+            else {"ok": False, "attempts": [{"attempt": 1,
+                                             "outcome": "timeout>5s"}]}))
     if parity is not None:       # None → leave run_kernel_parity as-is
         monkeypatch.setattr(bench, "run_kernel_parity", lambda: parity)
     monkeypatch.setattr(
@@ -46,12 +63,27 @@ def _run(monkeypatch, capsys, args, child_stdout, parity=_DEFAULT_PARITY,
 
 def test_unreachable_backend_emits_structured_error(monkeypatch, capsys):
     lines = _run(monkeypatch, capsys, _args(), "", probe_ok=False)
-    assert len(lines) == 1
-    rec = json.loads(lines[0])
+    data = _data_lines(lines)
+    assert len(data) == 1
+    rec = json.loads(data[0])
     assert rec["metric"] == "bert_base_finetune_samples_per_sec_per_chip"
     assert rec["value"] is None
     assert rec["error"] == "backend_unreachable"
     assert rec["detail"]["attempts"]
+
+
+def test_every_line_is_parseable_and_never_empty(monkeypatch, capsys):
+    """The r05 empty-tail fix: from the FIRST line of stdout, a driver
+    that kills this process at any point finds a parseable JSON tail
+    naming the stage that was running."""
+    lines = _run(monkeypatch, capsys, _args(), "", probe_ok=False)
+    assert lines, "no output at all"
+    for ln in lines:
+        json.loads(ln)
+    first = json.loads(lines[0])
+    assert first["provisional"] is True
+    assert first["stage"] == "probing"
+    assert first["metric"] == "bert_base_finetune_samples_per_sec_per_chip"
 
 
 def test_headline_carries_kernel_parity_field(monkeypatch, capsys):
@@ -61,6 +93,7 @@ def test_headline_carries_kernel_parity_field(monkeypatch, capsys):
     lines = _run(monkeypatch, capsys, _args(), child + "\n",
                  parity={"pass": 8, "fail": 0, "subset": True, "rc": 0})
     rec = json.loads(lines[-1])
+    assert not rec.get("provisional")
     assert rec["value"] == 277.4
     assert rec["kernel_parity"] == {"pass": 8, "fail": 0, "subset": True,
                                     "rc": 0}
@@ -74,7 +107,8 @@ def test_headline_preserves_extra_lines(monkeypatch, capsys):
                            "value": 1.0, "unit": "samples/sec/chip",
                            "vs_baseline": 0.03}))
     lines = _run(monkeypatch, capsys, _args(), child)
-    assert lines[0] == "note line"
+    data = _data_lines(lines)
+    assert data[0] == "note line"
     assert "kernel_parity" in json.loads(lines[-1])
 
 
@@ -90,8 +124,8 @@ def test_sweep_variants_skip_parity(monkeypatch, capsys):
     monkeypatch.setattr(bench, "run_kernel_parity", boom)
     monkeypatch.setattr(
         bench, "probe_backend",
-        lambda: {"ok": True, "platform": "tpu", "n": 1,
-                 "device_kind": "TPU v5 lite"})
+        lambda deadline=None: {"ok": True, "platform": "tpu", "n": 1,
+                               "device_kind": "TPU v5 lite"})
     monkeypatch.setattr(
         bench.subprocess, "run",
         lambda *a, **k: types.SimpleNamespace(returncode=0, stdout=child))
@@ -111,14 +145,14 @@ def test_unparseable_headline_skips_parity_and_forwards(monkeypatch, capsys):
     monkeypatch.setattr(bench, "run_kernel_parity", boom)
     lines = _run(monkeypatch, capsys, _args(), "garbage not json\n",
                  parity=None)
-    assert lines == ["garbage not json"]
+    assert _data_lines(lines) == ["garbage not json"]
 
 
 def test_child_timeout_emits_partial_stdout(monkeypatch, capsys):
     monkeypatch.setattr(
         bench, "probe_backend",
-        lambda: {"ok": True, "platform": "tpu", "n": 1,
-                 "device_kind": "TPU v5 lite"})
+        lambda deadline=None: {"ok": True, "platform": "tpu", "n": 1,
+                               "device_kind": "TPU v5 lite"})
 
     def raise_timeout(*a, **k):
         raise subprocess.TimeoutExpired(cmd="x", timeout=1800,
@@ -145,6 +179,97 @@ def test_probe_backoff_is_capped(monkeypatch):
     info = bench.probe_backend()
     assert info["ok"] is False and len(info["attempts"]) == 6
     assert waits == [5, 10, 20, 40, 60]
+
+
+def test_child_timeout_forwards_partial_json_lines(monkeypatch, capsys):
+    """A child killed by the deadline may have printed complete metric
+    lines already — they must survive into the artifact ahead of the
+    error line (partial results beat no results)."""
+    monkeypatch.setattr(
+        bench, "probe_backend",
+        lambda deadline=None: {"ok": True, "platform": "tpu", "n": 1,
+                               "device_kind": "TPU v5 lite"})
+    done = json.dumps({"metric": "generate_gpt2_greedy_tokens_per_sec_per_chip",
+                       "value": 900.0, "unit": "tokens/sec/chip",
+                       "vs_baseline": 0.0})
+    partial = done + "\nhalf a li"
+
+    def raise_timeout(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=60,
+                                        output=partial.encode())
+
+    monkeypatch.setattr(bench.subprocess, "run", raise_timeout)
+    bench.supervise(_args(generate=True, budget_seconds=60))
+    lines = capsys.readouterr().out.strip().splitlines()
+    data = _data_lines(lines)
+    assert json.loads(data[0])["value"] == 900.0
+    tail = json.loads(lines[-1])
+    assert tail["error"] == "bench_timeout"
+
+
+def test_budget_caps_child_timeout_and_skips_parity(monkeypatch, capsys):
+    """With --budget-seconds the child deadline derives from the budget
+    (not the 30-min default) and the ~2-min parity subset is skipped
+    when it can't fit in what remains."""
+    seen = {}
+    monkeypatch.setattr(
+        bench, "probe_backend",
+        lambda deadline=None: {"ok": True, "platform": "tpu", "n": 1,
+                               "device_kind": "TPU v5 lite"})
+
+    def boom():
+        raise AssertionError("parity must not run on a tight budget")
+
+    monkeypatch.setattr(bench, "run_kernel_parity", boom)
+    child = json.dumps({"metric":
+                        "bert_base_finetune_samples_per_sec_per_chip",
+                        "value": 260.0, "unit": "samples/sec/chip",
+                        "vs_baseline": 8.1})
+
+    def fake_run(*a, **k):
+        seen["timeout"] = k.get("timeout")
+        seen["env"] = k.get("env", {})
+        return types.SimpleNamespace(returncode=0, stdout=child)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    bench.supervise(_args(budget_seconds=90))
+    assert seen["timeout"] <= 90 + 11
+    assert float(seen["env"]["_BENCH_CHILD_BUDGET"]) <= 90
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["value"] == 260.0
+    assert "kernel_parity" not in rec
+
+
+def test_probe_respects_budget_deadline(monkeypatch):
+    """Under a deadline the probe gives up when the budget is spent
+    instead of burning its ~41-min retry patience."""
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    def timeout_run(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", timeout_run)
+    info = bench.probe_backend(deadline=bench.time.monotonic() - 1)
+    assert info["ok"] is False
+    assert info["attempts"][-1]["outcome"] == "budget_exhausted"
+    assert len(info["attempts"]) == 1
+
+
+def test_install_child_budget_arms_alarm(monkeypatch):
+    """The child-side deadline: SIGALRM/SIGTERM handlers installed and
+    the alarm leads the budget by the 5s grace."""
+    import signal as _signal
+
+    armed = {}
+    monkeypatch.setattr(_signal, "signal",
+                        lambda sig, fn: armed.setdefault(sig, fn))
+    monkeypatch.setattr(_signal, "alarm",
+                        lambda s: armed.setdefault("alarm", s))
+    monkeypatch.setenv("_BENCH_CHILD_BUDGET", "60")
+    bench._install_child_budget(_args(budget_seconds=90))
+    assert armed["alarm"] == 55
+    assert _signal.SIGTERM in armed
+    assert callable(armed[_signal.SIGTERM])
 
 
 def test_parity_line_parser():

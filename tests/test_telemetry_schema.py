@@ -1,0 +1,113 @@
+"""Schema-drift gate: ``scripts/check_telemetry_schema.py`` (pure
+stdlib, runs without jax) must accept what the live emitters write and
+reject drifted/corrupt artifacts — so any change to the event schema
+that forgets the validator (or vice versa) fails tier-1 fast.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_REPO, "scripts", "check_telemetry_schema.py")
+
+
+def _run(*paths, extra=()):
+    return subprocess.run([sys.executable, _SCRIPT, *extra, *paths],
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True, cwd=_REPO)
+
+
+@pytest.fixture()
+def artifacts(tmp_path):
+    """Real artifacts from the real emitters — the round-trip the
+    validator must bless."""
+    out = tmp_path / "telemetry"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        with obs.span("train/step_dispatch"):
+            with obs.span("data/next_batch"):
+                pass
+        obs.scalar("train/loss", 1.25, 7)
+        obs.state().events.emit("compile", {
+            "event": "/jax/pjit/compile", "dur": 1.0, "count": 1,
+            "cum": 1.0})
+        obs.flush()
+    finally:
+        obs.reset()
+    return out
+
+
+def test_validator_accepts_live_emitter_output(artifacts):
+    proc = _run(str(artifacts / "events.jsonl"),
+                str(artifacts / "trace.json"))
+    assert proc.returncode == 0, proc.stdout
+    assert proc.stdout.count("OK") == 2
+
+
+def test_validator_accepts_directory_form(artifacts):
+    proc = _run(str(artifacts))
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_validator_runs_without_jax(artifacts):
+    """The pure-stdlib contract, enforced: jax import is poisoned."""
+    code = ("import sys, runpy; sys.modules['jax'] = None; "
+            "sys.argv = ['x', %r]; "
+            "runpy.run_path(%r, run_name='__main__')"
+            % (str(artifacts), _SCRIPT))
+    proc = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_validator_rejects_drifted_events(tmp_path):
+    bad = tmp_path / "events.jsonl"
+    rows = [
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "metric",
+         "name": "ok", "value": 1.0},
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "metric",
+         "value": 2.0},                                   # missing name
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "wat"},  # bad type
+    ]
+    bad.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    proc = _run(str(bad))
+    assert proc.returncode == 1
+    assert "missing field 'name'" in proc.stdout
+    assert "unknown event type" in proc.stdout
+
+
+def test_validator_rejects_empty_artifact(tmp_path):
+    empty = tmp_path / "events.jsonl"
+    empty.write_text("")
+    proc = _run(str(empty))
+    assert proc.returncode == 1
+    assert "empty artifact" in proc.stdout
+
+
+def test_validator_tolerates_torn_tail_not_middle(tmp_path):
+    ok = {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "metric",
+          "name": "a", "value": 1.0}
+    torn_tail = tmp_path / "tail.jsonl"
+    torn_tail.write_text(json.dumps(ok) + '\n{"v": 1, "t": 9')
+    assert _run(str(torn_tail)).returncode == 0
+    assert _run(str(torn_tail), extra=("--strict-tail",)).returncode == 1
+    torn_mid = tmp_path / "mid.jsonl"
+    torn_mid.write_text('{"v": 1, "t...\n' + json.dumps(ok) + "\n")
+    assert _run(str(torn_mid)).returncode == 1
+
+
+def test_validator_rejects_bad_trace(tmp_path):
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps({"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 1.0, "pid": 0, "tid": 1},  # no dur
+    ]}))
+    proc = _run(str(trace))
+    assert proc.returncode == 1
+    assert "without numeric 'dur'" in proc.stdout
