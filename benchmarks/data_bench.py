@@ -1,0 +1,156 @@
+"""Input-pipeline microbenchmark (ISSUE 2 satellite): one JSON line
+quantifying what the adaptive input pipeline buys.
+
+Two synthetic corpora exercise the prefetch autotuner with REAL threads
+and the real :class:`~...data.pipeline.PrefetchIterator`:
+
+- **input-bound**: a bursty producer (cheap batches with a periodic
+  expensive one — the epoch-re-mask / file-read-burst shape that a
+  fixed depth-2 queue cannot absorb) against a steady consumer. The
+  line reports the consumer-wait with the pre-autotune fixed depth 2
+  vs with the controller on, and the achieved depth — the acceptance
+  bar is a >= 2x consumer-wait reduction.
+- **compute-bound**: a fast steady producer against a slower consumer;
+  both configurations should show ~zero consumer wait (the autotuner
+  must not thrash where buffering cannot help).
+
+Plus the pad-waste comparison on a mixed-length corpus: length
+bucketing alone vs token packing (``pack_examples``) — the pad fraction
+each leaves on the table.
+
+Run directly (``python benchmarks/data_bench.py``) or supervised via
+``python bench.py --data``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _bursty_batches(n: int, shape, burst_every: int, burst_s: float,
+                    base_s: float):
+    for i in range(n):
+        time.sleep(burst_s if i % burst_every == burst_every - 1 else base_s)
+        yield {"input_ids": np.zeros(shape, np.int32)}
+
+
+def _consume(it, compute_s: float) -> tuple[float, int]:
+    """Drain ``it`` simulating a steady device step; returns the
+    iterator's (consumer_wait_s, achieved_depth)."""
+    for _ in it:
+        time.sleep(compute_s)
+    return it.stats.consumer_wait, it.depth
+
+
+def bench_prefetch(n_batches: int = 320) -> dict:
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.autotune import (
+        PrefetchAutotuner,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (
+        PrefetchIterator,
+    )
+
+    shape = (8, 128)
+    # input-bound corpus: mean producer rate (~5.9ms) just under the
+    # consumer's 6ms step, but delivered in bursts a depth-2 queue
+    # cannot ride out
+    burst = dict(burst_every=8, burst_s=0.040, base_s=0.001)
+    fixed_wait, _ = _consume(
+        PrefetchIterator(_bursty_batches(n_batches, shape, **burst), depth=2),
+        compute_s=0.006)
+    auto_wait, depth = _consume(
+        PrefetchIterator(_bursty_batches(n_batches, shape, **burst),
+                         autotuner=PrefetchAutotuner(min_depth=1,
+                                                     max_depth=32, window=2)),
+        compute_s=0.006)
+    # compute-bound corpus: steady fast producer, slower consumer — the
+    # controller must sit still (waits ~0 either way)
+    steady = dict(burst_every=10**9, burst_s=0.0, base_s=0.001)
+    cb_wait, cb_depth = _consume(
+        PrefetchIterator(_bursty_batches(n_batches // 2, shape, **steady),
+                         autotuner=PrefetchAutotuner(min_depth=1,
+                                                     max_depth=32, window=4)),
+        compute_s=0.004)
+    return {
+        "consumer_wait_fixed_depth2_s": round(fixed_wait, 4),
+        "consumer_wait_autotuned_s": round(auto_wait, 4),
+        "consumer_wait_reduction_x": round(
+            fixed_wait / max(auto_wait, 1e-6), 2),
+        "achieved_prefetch_depth": depth,
+        "compute_bound_consumer_wait_s": round(cb_wait, 4),
+        "compute_bound_depth": cb_depth,
+        "batches": n_batches,
+    }
+
+
+def bench_pad_waste(n_examples: int = 512, width: int = 256) -> dict:
+    """Mixed-length corpus: pad fraction under length bucketing alone vs
+    token packing — the waste bucketing leaves on the table because a
+    batch is always padded to its LONGEST row's bucket."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+        ArrayDataset,
+        WordHashTokenizer,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.pipeline import (
+        ShardedBatcher,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.data.sources import (
+        synthetic_text_classification,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+
+    tok = WordHashTokenizer()
+    texts, _ = synthetic_text_classification(n_examples, seed=0,
+                                             min_len=10, max_len=180)
+    ds = ArrayDataset.from_lm_texts(tok, texts, max_length=width)
+    real_tokens = int(ds.columns["attention_mask"].sum())
+    mesh = build_mesh(MeshConfig(dp=-1))
+    buckets = list(range(64, width + 1, 64))
+    batcher = ShardedBatcher(ds, 16, mesh, shuffle=True, seed=0,
+                             bucket_sizes=buckets,
+                             process_index=0, process_count=1)
+    padded_cells = 0
+    bucketed_tokens = 0
+    for batch in batcher.local_batches(0):
+        padded_cells += batch["input_ids"].size
+        bucketed_tokens += int(batch["attention_mask"].sum())
+    pad_waste_bucketed = 1.0 - bucketed_tokens / max(padded_cells, 1)
+    packed = ds.pack(width, causal=True)
+    pad_waste_packed = 1.0 - float(packed.columns["attention_mask"].mean())
+    return {
+        "corpus_examples": n_examples,
+        "real_tokens": real_tokens,
+        "pad_waste_bucketed_pct": round(100 * pad_waste_bucketed, 2),
+        "pad_waste_packed_pct": round(100 * pad_waste_packed, 2),
+        "packed_rows": len(packed),
+        "bucketed_rows": len(ds),
+    }
+
+
+def bench_data() -> None:
+    """One JSON line on stdout (the bench.py stage contract)."""
+    prefetch = bench_prefetch()
+    waste = bench_pad_waste()
+    line = {
+        "metric": "data_pipeline_microbench",
+        "value": prefetch["consumer_wait_reduction_x"],
+        "unit": "x_consumer_wait_reduction",
+        "vs_baseline": prefetch["consumer_wait_reduction_x"],
+        "detail": {**prefetch, **waste},
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    bench_data()

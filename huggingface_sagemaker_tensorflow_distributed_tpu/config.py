@@ -97,6 +97,13 @@ class TrainConfig:
     # causal-lm pretraining: pack documents EOS-joined into completely
     # full rows (zero pad waste — every MXU cycle on real tokens)
     packed_sequences: bool = False
+    # token packing WITH per-example boundaries (data/pipeline.py::
+    # pack_examples): short examples share rows behind segment ids +
+    # restarting positions, attention stays block-diagonal per example
+    # (cross-contamination-safe) and loss/metrics match unpacked exactly
+    # — the pad-waste fix for fine-tuning corpora where packed_sequences'
+    # cross-document attention is not acceptable. causal-lm and mlm.
+    segment_packing: bool = False
     from_scratch: bool = False     # random init instead of pretrained weights
 
     # --- data ---
@@ -269,9 +276,12 @@ class TrainConfig:
     # persistent XLA compilation cache: recompiles across runs (and across
     # bucket widths, restarts, resumes) become disk hits. Empty string
     # disables. ~3x faster warm startup measured on TPU.
+    # HSTD_COMPILE_CACHE_DIR is the documented env knob (the launcher
+    # sets it per job root so every host of a job shares one cache);
+    # TPU_COMPILATION_CACHE_DIR kept as the legacy spelling.
     compilation_cache_dir: str = field(
         default_factory=lambda: _env(
-            "TPU_COMPILATION_CACHE_DIR",
+            "HSTD_COMPILE_CACHE_DIR", "TPU_COMPILATION_CACHE_DIR",
             default=os.path.join(os.path.expanduser("~"), ".cache", "hstd-xla"))
     )
 
@@ -318,6 +328,25 @@ class TrainConfig:
                 "packed_sequences does not combine with --streaming "
                 "(the streaming tier tokenizes rows independently; "
                 "packing needs the whole token stream) — pick one")
+        if self.segment_packing and self.task not in ("causal-lm", "mlm"):
+            raise ValueError(
+                "segment_packing packs token-level examples behind "
+                "segment ids (causal-lm / mlm); per-example-label tasks "
+                f"cannot pack (got task={self.task!r})")
+        if self.segment_packing and self.packed_sequences:
+            raise ValueError(
+                "segment_packing and packed_sequences are alternative "
+                "packing layouts (per-example boundaries vs EOS-joined "
+                "stream) — pick one")
+        if self.segment_packing and self.streaming:
+            raise ValueError(
+                "segment_packing does not combine with --streaming "
+                "(packing re-groups rows at build time; the streaming "
+                "tier tokenizes per batch) — pick one")
+        if self.segment_packing and self.bucket_multiple:
+            raise ValueError(
+                "segment_packing already eliminates pad waste; "
+                "bucket_multiple would re-fragment packed rows — pick one")
         if self.streaming and self.span_corruption:
             raise ValueError(
                 "--streaming does not implement span corruption (the "

@@ -24,17 +24,21 @@ async), replacing tf.data's prefetch.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
 from dataclasses import dataclass
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Union
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
 from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+from huggingface_sagemaker_tensorflow_distributed_tpu.data.autotune import (
+    PrefetchAutotuner,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
     batch_column_sharding,
 )
@@ -85,6 +89,26 @@ class ArrayDataset:
             )
             return {k: native_gather(v, idx) for k, v in self.columns.items()}
         return {k: v[idx] for k, v in self.columns.items()}
+
+    def pack(self, max_length: Optional[int] = None,
+             causal: bool = False, pad_token_id: int = 0) -> "ArrayDataset":
+        """Token-packed view of this dataset (see :func:`pack_examples`):
+        short examples share rows, with ``segment_ids``/``position_ids``
+        columns keeping attention and positions per-example — the pad
+        waste that length bucketing alone leaves on the table goes to
+        ~zero. Token-level tasks only (causal-lm with ``causal=True``,
+        mlm/token-cls with the default); per-example labels cannot pack.
+        """
+        if getattr(self, "begin_epoch", None) is not None:
+            raise ValueError(
+                "packing re-groups rows at build time, which would freeze "
+                "this dataset's per-epoch transform (MLM re-masking) — "
+                "pack a plain ArrayDataset (e.g. static_masking=True)")
+        if max_length is None:
+            max_length = self.columns["attention_mask"].shape[1]
+        return ArrayDataset(pack_examples(self.columns, max_length,
+                                          causal=causal,
+                                          pad_token_id=pad_token_id))
 
     @classmethod
     def from_texts(cls, tokenizer, texts, labels=None, max_length: int = 512,
@@ -391,6 +415,83 @@ class ArrayDataset:
                     "labels": labels})
 
 
+def pack_examples(columns: dict[str, np.ndarray], max_length: int,
+                  causal: bool = False,
+                  pad_token_id: int = 0) -> dict[str, np.ndarray]:
+    """Token-pack a column dict: multiple short examples per row, with
+    ``segment_ids`` (1-based per-example id, 0 on padding) and
+    ``position_ids`` (restarting at 0 per example) columns so attention
+    stays cross-contamination-safe (``ops.attention.make_segment_mask``,
+    the Krell et al. 2021 construction) and positional embeddings match
+    the unpacked encode exactly.
+
+    Examples are placed first-fit-decreasing into ``max_length`` rows —
+    deterministic, so every host packs identically. All 2-D columns are
+    packed by copying each example's first ``len`` positions (its real
+    tokens per ``attention_mask``); padding gets mask 0, segment 0 and
+    label -100. Per-example scalar columns (seq-cls labels) cannot pack
+    and raise.
+
+    ``causal=True`` additionally sets each segment's FIRST token label
+    to -100: causal-LM losses shift labels left, so the target aligned
+    with a segment boundary would be the next example's first token — a
+    cross-contamination leak the mask cannot catch. Unpacked training
+    never uses that label (the shift drops row position 0), so masking
+    it keeps packed loss sums exactly equal to unpacked ones.
+    """
+    if "input_ids" not in columns or "attention_mask" not in columns:
+        raise ValueError("packing needs input_ids + attention_mask columns")
+    n, width = columns["attention_mask"].shape
+    bad = [k for k, v in columns.items() if v.ndim != 2 or v.shape[1] != width]
+    if bad:
+        raise ValueError(
+            f"columns {bad} are not [N, {width}] token columns — packing "
+            "merges examples along the token dim, so per-example scalars "
+            "(seq-cls labels) and ragged widths cannot pack")
+    lengths = (columns["attention_mask"] > 0).sum(axis=1).astype(np.int64)
+    if int(lengths.max(initial=0)) > max_length:
+        raise ValueError(
+            f"example of length {int(lengths.max())} exceeds the packed "
+            f"row width {max_length}")
+    # first-fit decreasing, stable on ties: identical on every host
+    order = np.argsort(-lengths, kind="stable")
+    bins: list[list[int]] = []
+    space: list[int] = []
+    for e in order:
+        need = int(lengths[e])
+        if need == 0:
+            continue  # fully-empty rows carry no tokens: drop
+        for b, free in enumerate(space):
+            if free >= need:
+                bins[b].append(int(e))
+                space[b] -= need
+                break
+        else:
+            bins.append([int(e)])
+            space.append(max_length - need)
+    rows = len(bins)
+    out: dict[str, np.ndarray] = {}
+    for k, v in columns.items():
+        fill = -100 if k == "labels" else (
+            pad_token_id if k == "input_ids" else 0)
+        out[k] = np.full((rows, max_length), fill, v.dtype)
+    out["segment_ids"] = np.zeros((rows, max_length), np.int32)
+    out["position_ids"] = np.zeros((rows, max_length), np.int32)
+    for r, members in enumerate(bins):
+        o = 0
+        for s, e in enumerate(members):
+            ln = int(lengths[e])
+            sel = columns["attention_mask"][e] > 0
+            for k, v in columns.items():
+                out[k][r, o: o + ln] = v[e][sel]
+            out["segment_ids"][r, o: o + ln] = s + 1
+            out["position_ids"][r, o: o + ln] = np.arange(ln)
+            if causal and "labels" in out:
+                out["labels"][r, o] = -100
+            o += ln
+    return out
+
+
 def apply_mlm_masking(clean_ids: np.ndarray, word_ids: np.ndarray,
                       rng: "np.random.RandomState", mask_token_id: int,
                       vocab_size: int, mlm_probability: float = 0.15,
@@ -463,6 +564,22 @@ class MlmDataset(ArrayDataset):
         super().__init__({"attention_mask": attention_mask})
         self.begin_epoch(0)
 
+    def pack(self, max_length: Optional[int] = None,
+             causal: bool = False, pad_token_id: int = 0) -> "ArrayDataset":
+        """Packing freezes row grouping at build time, which is only
+        sound when the masking draw is pinned (``static_masking``): the
+        seed draw's columns pack as a plain :class:`ArrayDataset`.
+        Per-epoch re-masking cannot combine with packing — packed rows'
+        word ids no longer align with the clean corpus."""
+        if not self._static:
+            raise ValueError(
+                "packing an MLM dataset freezes the masking draw, so it "
+                "requires static_masking=True (per-epoch re-masking "
+                "cannot re-mask packed rows)")
+        self.begin_epoch(0)
+        return ArrayDataset(dict(self.columns)).pack(
+            max_length, causal=causal, pad_token_id=pad_token_id)
+
     def begin_epoch(self, epoch: int) -> None:
         """Re-draw masks for ``epoch`` (idempotent per epoch).
         ``static_masking`` pins every epoch to the seed draw — the
@@ -482,6 +599,54 @@ class MlmDataset(ArrayDataset):
 
 
 _PREFETCH_END = object()
+
+
+class _AdaptiveQueue:
+    """Bounded FIFO whose capacity can change while threads wait on it —
+    what the prefetch autotuner adjusts. Mirrors the ``queue.Queue``
+    subset the producer/consumer use (``put`` with timeout raising
+    ``queue.Full``, blocking ``get``, ``get_nowait`` raising
+    ``queue.Empty``); a capacity change wakes blocked producers so a
+    deeper queue takes effect immediately."""
+
+    def __init__(self, capacity: int):
+        self._capacity = max(1, int(capacity))
+        self._items: collections.deque = collections.deque()
+        self._cond = threading.Condition()
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._cond:
+            self._capacity = max(1, int(capacity))
+            self._cond.notify_all()
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def put(self, item, timeout: Optional[float] = None) -> None:
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: len(self._items) < self._capacity, timeout=timeout)
+            if not ok:
+                raise queue.Full
+            self._items.append(item)
+            self._cond.notify_all()
+
+    def get(self, timeout: Optional[float] = None):
+        with self._cond:
+            ok = self._cond.wait_for(lambda: len(self._items) > 0,
+                                     timeout=timeout)
+            if not ok:
+                raise queue.Empty
+            item = self._items.popleft()
+            self._cond.notify_all()
+            return item
+
+    def get_nowait(self):
+        return self.get(timeout=0)
 
 
 class _PrefetchStats:
@@ -505,18 +670,23 @@ class _PrefetchStats:
         self.consumed = 0
         self._reported = False
 
-    def report(self) -> None:
+    def report(self, depth: Optional[int] = None) -> None:
         if self._reported or not self.consumed:
             return
         self._reported = True
         obs.scalar("data/producer_wait_s", self.producer_wait,
                    args={"batches": self.produced})
-        obs.scalar("data/consumer_wait_s", self.consumer_wait,
-                   args={"batches": self.consumed,
+        consumer_args = {"batches": self.consumed,
                          "verdict": ("input_bound"
                                      if self.consumer_wait
                                      > self.producer_wait
-                                     else "compute_bound")})
+                                     else "compute_bound")}
+        if depth is not None:
+            # achieved (final) prefetch depth, so the autotuner's end
+            # state reads off the same line as the wait verdict
+            consumer_args["depth"] = int(depth)
+        obs.scalar("data/consumer_wait_s", self.consumer_wait,
+                   args=consumer_args)
 
 
 def _prefetch_producer(it, q: queue.Queue, stop: threading.Event,
@@ -553,18 +723,36 @@ def _drain_and_stop(q: queue.Queue, stop: threading.Event) -> None:
         pass
 
 
+def _batch_nbytes(item) -> int:
+    """Host bytes one queued batch pins (dict of numpy columns; 0 when
+    the item shape is unknown — the autotuner then skips the mem cap)."""
+    if isinstance(item, dict):
+        return sum(int(getattr(v, "nbytes", 0)) for v in item.values())
+    return int(getattr(item, "nbytes", 0))
+
+
 class PrefetchIterator:
     """Iterator wrapper that materializes up to ``depth`` items ahead on a
     daemon thread. Exceptions from the producer re-raise at the consumer;
     ``close()`` stops the producer promptly, and dropping the iterator
     without closing triggers the same cleanup via ``weakref.finalize`` so
-    abandoned iterators don't pin prefetched device batches."""
+    abandoned iterators don't pin prefetched device batches.
 
-    def __init__(self, it: Iterator, depth: int = 2):
+    With an ``autotuner`` (:class:`~.autotune.PrefetchAutotuner`) the
+    depth is live: each consumed batch feeds the cumulative wait stats to
+    the controller, and a decision resizes the queue in place (emitting
+    an ``autotune`` telemetry event). Without one, ``depth`` is fixed —
+    the pre-autotune behavior."""
+
+    def __init__(self, it: Iterator, depth: int = 2,
+                 autotuner: Optional[PrefetchAutotuner] = None):
         import weakref
 
         self._done = False
-        self._queue = queue.Queue(maxsize=depth)
+        self._autotuner = autotuner
+        if autotuner is not None:
+            depth = autotuner.depth
+        self._queue = _AdaptiveQueue(depth)
         self._stop = threading.Event()
         self.stats = _PrefetchStats()
         self._thread = threading.Thread(
@@ -574,6 +762,10 @@ class PrefetchIterator:
         self._finalizer = weakref.finalize(
             self, _drain_and_stop, self._queue, self._stop)
         self._thread.start()
+
+    @property
+    def depth(self) -> int:
+        return self._queue.capacity
 
     def __iter__(self):
         return self
@@ -587,19 +779,113 @@ class PrefetchIterator:
             self.stats.consumer_wait += time.perf_counter() - t0
         if item is _PREFETCH_END:
             self._done = True
-            self.stats.report()
+            self.stats.report(depth=self.depth)
             raise StopIteration
         if isinstance(item, BaseException):
             self._done = True
             raise item
         self.stats.consumed += 1
+        if self._autotuner is not None:
+            decision = self._autotuner.observe(
+                self.stats.producer_wait, self.stats.consumer_wait,
+                self.stats.consumed, _batch_nbytes(item))
+            if decision is not None:
+                new_depth, reason = decision
+                self._queue.set_capacity(new_depth)
+                obs.autotune("data/prefetch_depth", new_depth, reason,
+                             args={"batches": self.stats.consumed})
         return item
 
     def close(self):
         if not self._done:
-            self.stats.report()
+            self.stats.report(depth=self.depth)
         self._done = True
         self._finalizer()
+
+
+_STAGER_END = object()
+
+
+class H2DStager:
+    """Device-side double buffer: overlap batch N+1's host→device
+    transfer with compute on batch N.
+
+    JAX dispatch is async, so the moment the consumer takes batch N and
+    dispatches its step, this iterator starts batch N+1's transfer —
+    one device batch is always in flight while the device computes,
+    without queueing unbounded device memory (exactly two live batches:
+    the one computing and the one staging; batch N's HBM frees for
+    batch N+2's landing when the consumer's loop variable rebinds).
+
+    Spans: each transfer dispatch is a ``data/h2d_stage`` span nested
+    around the ``data/host_to_device`` put, so the overlap is visible in
+    trace.json next to ``train/step_dispatch``; exhaustion emits one
+    ``data/h2d_stage_s`` metric with total staging seconds + batches.
+    """
+
+    def __init__(self, host_iter, put_batch):
+        self._it = host_iter
+        self._put = put_batch
+        self._pending = None
+        self._primed = False
+        self.stage_s = 0.0
+        self.staged = 0
+        self._reported = False
+
+    def __iter__(self):
+        return self
+
+    def _stage(self):
+        batch = next(self._it)  # StopIteration propagates to the caller
+        t0 = time.perf_counter()
+        with obs.span("data/h2d_stage"):
+            out = self._put(batch)
+        self.stage_s += time.perf_counter() - t0
+        self.staged += 1
+        return out
+
+    def __next__(self):
+        if self._pending is _STAGER_END:
+            raise StopIteration
+        if not self._primed:
+            self._primed = True
+            try:
+                self._pending = self._stage()
+            except StopIteration:
+                self._pending = _STAGER_END
+                self._report()
+                raise
+        current = self._pending
+        try:
+            self._pending = self._stage()
+        except StopIteration:
+            self._pending = _STAGER_END
+            self._report()
+        return current
+
+    def _report(self) -> None:
+        if self._reported or not self.staged:
+            return
+        self._reported = True
+        obs.scalar("data/h2d_stage_s", self.stage_s,
+                   args={"batches": self.staged})
+
+    @property
+    def stats(self) -> _PrefetchStats:
+        """The wrapped host prefetcher's wait accounting (producer vs
+        consumer wait — the autotuner's input), for callers that read
+        ``it.stats`` off ``global_arrays`` iterators."""
+        return self._it.stats
+
+    @property
+    def depth(self) -> int:
+        return getattr(self._it, "depth", 0)
+
+    def close(self):
+        self._pending = _STAGER_END
+        self._report()
+        if hasattr(self._it, "close"):
+            self._it.close()
 
 
 class ShardedBatcher:
@@ -623,7 +909,24 @@ class ShardedBatcher:
         process_count: Optional[int] = None,
         bucket_sizes: Optional[list[int]] = None,
         bucket_window: int = 16,
+        pack: bool = False,
+        pack_causal: bool = False,
     ):
+        if pack:
+            # token packing (pack_examples): short examples share rows
+            # behind segment ids, so there is no pad waste left for the
+            # bucket ladder to trim — the two modes are alternatives
+            if bucket_sizes:
+                raise ValueError(
+                    "pack=True already eliminates pad waste; combining it "
+                    "with bucket_sizes would re-fragment packed rows — "
+                    "pick one")
+            if not hasattr(dataset, "columns"):
+                raise ValueError(
+                    "pack=True re-groups rows at build time, which needs "
+                    "a materialized dataset (streaming tiers tokenize "
+                    "per batch)")
+            dataset = dataset.pack(causal=pack_causal)
         self.dataset = dataset
         self.global_batch_size = global_batch_size
         self.mesh = mesh
@@ -660,6 +963,13 @@ class ShardedBatcher:
             for name in ("attention_mask", "decoder_attention_mask"):
                 if name in dataset.columns:
                     self._lengths[name] = native_row_lengths(dataset.columns[name])
+        # bucket widths actually emitted (per mask column): when the XLA
+        # compile budget is exceeded (HSTD_COMPILE_BUDGET_S, obs/), new
+        # ladder rungs are capped to widths already compiled
+        self._used_buckets: dict[str, set[int]] = {}
+        # the last epoch's prefetch autotuner: its converged depth seeds
+        # the next epoch's controller instead of re-learning from 2
+        self._auto_tuner: Optional[PrefetchAutotuner] = None
         self.process_index = jax.process_index() if process_index is None else process_index
         self.process_count = jax.process_count() if process_count is None else process_count
         if global_batch_size % self.process_count != 0:
@@ -758,14 +1068,34 @@ class ShardedBatcher:
         holds the GLOBAL batch's longest row (all hosts agree: bucket
         choice derives from the shared order), so XLA compiles once per
         bucket size instead of padding every batch to the full width."""
+        # ladder cap (ROADMAP "Compile-time budget"): once the run's
+        # cumulative XLA compile time exceeds HSTD_COMPILE_BUDGET_S, stop
+        # minting NEW batch shapes — widen to the smallest width this
+        # batcher already emitted (already compiled), falling back to the
+        # full column width. Single-host only: the budget is crossed at a
+        # host-local instant, and multi-host bucket choices must agree.
+        capped = (self.process_count == 1
+                  and obs.compile_budget_exceeded())
         trims: dict[int, int] = {}  # original width -> bucket width
         for mask_name, lengths in self._lengths.items():
             width = self.dataset.columns[mask_name].shape[1]
             max_len = int(lengths[real_idx].max()) if len(real_idx) else 1
             bucket = self._bucket_for(max(max_len, 1), width)
+            used = self._used_buckets.setdefault(mask_name, set())
+            if capped and bucket not in used:
+                bucket = min((b for b in used if b >= bucket),
+                             default=width)
             # encoder/decoder columns with the SAME width share one trim:
             # take the safer (wider) bucket
             trims[width] = max(trims.get(width, 0), bucket)
+        for mask_name in self._lengths:
+            # record the APPLIED trim (post max-across-shared-widths) —
+            # a pre-max per-mask bucket may never actually be emitted,
+            # and treating it as "already compiled" would let the capped
+            # ladder mint a fresh shape later
+            width = self.dataset.columns[mask_name].shape[1]
+            self._used_buckets.setdefault(mask_name, set()).add(
+                trims[width])
         out = {}
         for k, v in batch.items():
             if v.ndim >= 2 and v.shape[1] in trims:
@@ -775,33 +1105,60 @@ class ShardedBatcher:
         return out
 
     def global_arrays(self, epoch: int = 0, start_step: int = 0,
-                      prefetch: int = 2):
+                      prefetch: Union[int, str] = "auto"):
         """Yield batches as globally-sharded jax.Arrays on the mesh.
 
         Token-dimension columns additionally shard over the ``seq`` axis
-        when the mesh has one (sequence parallelism). With ``prefetch > 0``
-        (the default) gather + host→device transfer of the next batches
-        runs on a background thread — the tf.data prefetch the reference
-        gets for free (``scripts/train.py:84-86``), and essential when the
-        device is reached over a network tunnel where each transfer has
-        real latency. The returned iterator has ``close()`` for early exit.
+        when the mesh has one (sequence parallelism). The returned
+        iterator has ``close()`` for early exit.
+
+        ``prefetch="auto"`` (the default): host-side gather/tokenize runs
+        on a background thread whose queue depth is AUTOTUNED from the
+        live producer-wait/consumer-wait ratio (``data/autotune.py``;
+        ``HSTD_PREFETCH_AUTOTUNE=0`` pins the pre-autotune depth 2), and
+        host→device transfer is double-buffered on the consumer side
+        (:class:`H2DStager`): batch N+1's ``device_put`` dispatches while
+        the device computes on batch N — the tf.data prefetch the
+        reference gets for free (``scripts/train.py:84-86``), essential
+        when the device sits behind a network tunnel where each transfer
+        has real latency.
+
+        ``prefetch=N`` keeps the fixed-depth behavior (transfer on the
+        producer thread); ``prefetch=0`` disables the thread entirely.
         """
+        if prefetch == "auto":
+            seed_depth = {}
+            if self._auto_tuner is not None:
+                # carry the converged depth across epochs: the waits the
+                # controller already paid to learn it are not re-paid
+                seed_depth = {"initial_depth": self._auto_tuner.depth}
+            tuner = PrefetchAutotuner.from_env(**seed_depth)
+            if tuner is not None:
+                self._auto_tuner = tuner
+            host_it = PrefetchIterator(self.local_batches(epoch, start_step),
+                                       depth=2, autotuner=tuner)
+            return H2DStager(host_it, self._put_batch)
         it = self._device_batches(epoch, start_step)
         if prefetch > 0:
             return PrefetchIterator(it, depth=prefetch)
         return it
 
+    def _put_batch(self, batch: dict[str, np.ndarray]) -> dict[str, jax.Array]:
+        """One host batch → globally-sharded device arrays (the mesh
+        helpers in ``parallel/sharding.py`` decide each column's spec)."""
+        with obs.span("data/host_to_device"):
+            return {
+                k: jax.make_array_from_process_local_data(
+                    self._column_sharding(v), v)
+                for k, v in batch.items()
+            }
+
     def _device_batches(self, epoch: int, start_step: int) -> Iterator[dict[str, jax.Array]]:
         for batch in self.local_batches(epoch, start_step):
-            # span closes BEFORE the yield: a generator suspended inside
-            # the with-block would bill consumer think-time to the span
-            with obs.span("data/host_to_device"):
-                out = {
-                    k: jax.make_array_from_process_local_data(
-                        self._column_sharding(v), v)
-                    for k, v in batch.items()
-                }
-            yield out
+            # _put_batch's span closes BEFORE the yield: a generator
+            # suspended inside the with-block would bill consumer
+            # think-time to the span
+            yield self._put_batch(batch)
 
     def _column_sharding(self, v: np.ndarray) -> NamedSharding:
         key = (v.ndim, v.shape[1] if v.ndim >= 2 else None)

@@ -216,6 +216,17 @@ class LocalBackend:
                 # the job dir next to the other artifacts
                 "HSTD_TELEMETRY_DIR": env.get("HSTD_TELEMETRY_DIR")
                 or os.path.join(handle.output_data_dir, "telemetry"),
+                # persistent XLA compile cache, shared across the JOBS of
+                # this root (not per-job: the point is that repeat runs
+                # hit the disk cache instead of recompiling; consumed by
+                # scripts/train.py via config.compilation_cache_dir →
+                # jax_compilation_cache_dir)
+                # the legacy TPU_COMPILATION_CACHE_DIR spelling must keep
+                # winning when the operator set it (config resolves the
+                # HSTD name first, so defaulting HSTD here would shadow it)
+                "HSTD_COMPILE_CACHE_DIR": env.get("HSTD_COMPILE_CACHE_DIR")
+                or env.get("TPU_COMPILATION_CACHE_DIR")
+                or os.path.join(job.job_root, "xla_cache"),
             })
             log_path = os.path.join(job_dir, f"host_{host}.log")
             with open(log_path, "w") as log:  # child inherits the fd
@@ -245,10 +256,18 @@ class TPUVMBackend:
     def launch(self, job: TPUJob, job_name: str, job_dir: str) -> JobHandle:
         entry = job.entry_point
         train_argv = ["python3", entry] + to_argv(job.hyperparameters)
+        # per-host persistent XLA compile cache on the TPU VM's local
+        # disk: warm restarts of the same job shape skip recompiles. An
+        # operator-set cache dir (either spelling) wins — same precedence
+        # invariant as LocalBackend
+        cache_dir = (os.environ.get("HSTD_COMPILE_CACHE_DIR")
+                     or os.environ.get("TPU_COMPILATION_CACHE_DIR")
+                     or os.path.join(job.job_root, "xla_cache"))
         remote = (
             f"cd {shlex.quote(job.source_dir)} && "
             f"TPU_OUTPUT_DATA_DIR={shlex.quote(os.path.join(job_dir, 'output'))} "
             f"TPU_MODEL_DIR={shlex.quote(os.path.join(job_dir, 'model'))} "
+            f"HSTD_COMPILE_CACHE_DIR={shlex.quote(cache_dir)} "
             + " ".join(shlex.quote(a) for a in train_argv)
         )
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", self.tpu_name,

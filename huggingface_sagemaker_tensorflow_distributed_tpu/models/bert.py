@@ -69,9 +69,12 @@ class BertForTokenClassification(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, position_ids=None,
+                 segment_ids=None):
         seq, _ = EncoderBackbone(self.config, name="backbone")(
-            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+            input_ids, attention_mask, token_type_ids,
+            position_ids=position_ids, deterministic=deterministic,
+            segment_ids=segment_ids)
         x = nn.Dropout(self.config.hidden_dropout)(seq, deterministic=deterministic)
         return _dense(self.config, self.num_labels, "classifier")(x)
 
@@ -101,9 +104,15 @@ class BertForMaskedLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 deterministic: bool = True, return_fused_inputs: bool = False):
+                 deterministic: bool = True, return_fused_inputs: bool = False,
+                 position_ids=None, segment_ids=None):
+        # position_ids/segment_ids: token-packed MLM batches
+        # (data.pipeline.pack_examples) — positions restart and attention
+        # stays block-diagonal per packed example
         seq, _ = EncoderBackbone(self.config, name="backbone")(
-            input_ids, attention_mask, token_type_ids, deterministic=deterministic)
+            input_ids, attention_mask, token_type_ids,
+            position_ids=position_ids, deterministic=deterministic,
+            segment_ids=segment_ids)
         table = self.variables["params"]["backbone"]["embeddings"][
             "word_embeddings"]["embedding"]
         head = MlmHead(self.config, name="mlm_head")

@@ -80,9 +80,17 @@ def _filter_top_p(logits, top_p: float):
     """Nucleus filtering: keep the smallest prefix of the sorted
     distribution whose cumulative probability exceeds ``top_p`` (the
     first token past the threshold is kept, HF semantics)."""
+    if top_p >= 1.0:
+        return logits     # HF semantics: top_p=1.0 means no filtering
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-    cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
-    keep_sorted = cum - jax.nn.softmax(sorted_logits, axis=-1) < top_p
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    # strict < with a tolerance: float32 cumsum rounds exact-boundary
+    # sums (0.5 + 0.3 → 0.79999995), which would leak one extra token
+    # past a top_p sitting exactly on the cumulative mass
+    keep_sorted = cum_before < top_p - 1e-6
+    # the argmax always stays (top_p ≤ 1e-6 must not empty the support)
+    keep_sorted = keep_sorted.at[..., 0].set(True)
     # threshold logit = smallest kept logit
     kth = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
                   axis=-1, keepdims=True)

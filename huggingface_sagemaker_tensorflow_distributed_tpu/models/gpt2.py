@@ -304,7 +304,8 @@ class Gpt2Model(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, position_ids=None,
-                 deterministic: bool = True, decode: bool = False):
+                 deterministic: bool = True, decode: bool = False,
+                 segment_ids=None):
         cfg = self.config
         B, S = input_ids.shape
 
@@ -328,9 +329,13 @@ class Gpt2Model(nn.Module):
             position_ids = offset + jnp.arange(S)[None, :]
 
         # training/prefill: [B, S] padding mask; decode: kv-buffer
-        # validity [B, max_len] — both become the additive form
-        additive_mask = (make_attention_mask(attention_mask)
-                         if attention_mask is not None else None)
+        # validity [B, max_len] — both become the additive form.
+        # segment_ids (token-packed pretraining batches): block-diagonal
+        # instead, so packed documents never attend across boundaries
+        additive_mask = (
+            make_attention_mask(attention_mask, segment_ids=segment_ids)
+            if attention_mask is not None or segment_ids is not None
+            else None)
 
         x = wte(input_ids) + wpe(position_ids)
         x = nn.Dropout(cfg.embd_dropout)(x, deterministic=deterministic)
@@ -376,18 +381,20 @@ class Gpt2LMHeadModel(nn.Module):
 
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  position_ids=None, deterministic: bool = True,
-                 decode: bool = False):
+                 decode: bool = False, segment_ids=None):
         # token_type_ids accepted for trainer-signature parity; GPT-2 has
-        # no segment embeddings
+        # no segment embeddings. segment_ids/position_ids: token-packed
+        # batches (data.pipeline.pack_examples)
         hidden, embedding = self.backbone(
-            input_ids, attention_mask, position_ids, deterministic, decode)
+            input_ids, attention_mask, position_ids, deterministic, decode,
+            segment_ids=segment_ids)
         logits = jnp.einsum("bsh,vh->bsv", hidden,
                             embedding.astype(self.config.dtype))
         return logits.astype(jnp.float32)
 
     def hidden_and_embedding(self, input_ids, attention_mask=None,
                              token_type_ids=None, position_ids=None,
-                             deterministic: bool = True):
+                             deterministic: bool = True, segment_ids=None):
         """(hidden [B, S, H], tied embedding [V, H]) — the fused-CE path."""
         return self.backbone(input_ids, attention_mask, position_ids,
-                             deterministic, False)
+                             deterministic, False, segment_ids=segment_ids)

@@ -334,11 +334,15 @@ class EncoderBackbone(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 position_ids=None, deterministic: bool = True):
+                 position_ids=None, deterministic: bool = True,
+                 segment_ids=None):
         cfg = self.config
         if attention_mask is None:
             attention_mask = jnp.ones_like(input_ids)
-        additive_mask = make_attention_mask(attention_mask)
+        # segment_ids (token-packed batches): block-diagonal mask so
+        # packed examples never attend across segment boundaries
+        additive_mask = make_attention_mask(attention_mask,
+                                            segment_ids=segment_ids)
         x = Embeddings(cfg, name="embeddings")(
             input_ids, token_type_ids, position_ids, attention_mask, deterministic)
         if cfg.embedding_size and cfg.embedding_size != cfg.hidden_size:

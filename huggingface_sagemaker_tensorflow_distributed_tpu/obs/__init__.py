@@ -106,6 +106,42 @@ def scalar(name: str, value, step: Optional[int] = None,
     _metrics.scalar(name, value, step, args)
 
 
+def autotune(name: str, depth: int, reason: str,
+             args: Optional[dict] = None) -> None:
+    """One input-pipeline controller decision (``autotune`` event):
+    ``name`` is the tuned knob, ``depth`` its new value, ``reason`` the
+    trigger. No-op without a file sink, like :func:`scalar`."""
+    if not _state.enabled or _state.events is None:
+        return
+    fields: dict = {"name": name, "depth": int(depth), "reason": str(reason)}
+    if args:
+        fields["args"] = args
+    _state.events.emit("autotune", fields)
+
+
+def alert(name: str, message: str, args: Optional[dict] = None) -> None:
+    """A budget/threshold warning (``alert`` event), mirrored to stderr
+    by callers that need operator visibility."""
+    if not _state.enabled or _state.events is None:
+        return
+    fields: dict = {"name": name, "message": message}
+    if args:
+        fields["args"] = args
+    _state.events.emit("alert", fields)
+
+
+def compile_budget_exceeded() -> bool:
+    """True once the live compile tracker has crossed
+    ``HSTD_COMPILE_BUDGET_S`` (latched; False with no budget or no
+    tracker installed). Bucket-ladder batchers consult this to stop
+    minting new widths."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.watchdog import (
+        _INSTALLED,
+    )
+
+    return any(t.state is _state and t.budget_exceeded for t in _INSTALLED)
+
+
 def metrics() -> MetricsSink:
     return _metrics
 

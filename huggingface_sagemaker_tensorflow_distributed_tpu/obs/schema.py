@@ -47,6 +47,15 @@ REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
     "compile": {"event": (str,), "dur": _NUM, "count": (int,), "cum": _NUM},
     # one device.memory_stats() sample (TPU/GPU; never emitted on CPU)
     "memory": {"device": (str,), "stats": (dict,)},
+    # one controller decision from the input-pipeline autotuners
+    # (data/autotune.py prefetch depth; data/streaming.py read-coalesce
+    # gap): "name" is the tuned knob, "depth" its new integer value,
+    # "reason" the trigger (input_bound / compute_bound / mem_cap /
+    # waste_high / waste_low)
+    "autotune": {"name": (str,), "depth": (int,), "reason": (str,)},
+    # a budget/threshold warning (e.g. compile_budget when cumulative XLA
+    # compile seconds exceed HSTD_COMPILE_BUDGET_S); mirrored to stderr
+    "alert": {"name": (str,), "message": (str,)},
     # run metadata, first event after configure()
     "run": {"argv": (list,)},
 }

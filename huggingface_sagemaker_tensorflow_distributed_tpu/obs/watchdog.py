@@ -15,6 +15,7 @@ supervisor), and ``obs`` must stay importable without jax.
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
@@ -177,6 +178,20 @@ class Heartbeat:
                 logger.exception("heartbeat emission failed")
 
 
+ENV_COMPILE_BUDGET = "HSTD_COMPILE_BUDGET_S"
+
+
+def compile_budget_env() -> Optional[float]:
+    """``HSTD_COMPILE_BUDGET_S`` as a float (None = no budget; malformed
+    values disable rather than kill the run — telemetry configuration
+    must never take the workload down)."""
+    raw = os.environ.get(ENV_COMPILE_BUDGET, "").strip()
+    try:
+        return float(raw) if raw else None
+    except ValueError:
+        return None
+
+
 class CompileTracker:
     """Counts every XLA compilation via ``jax.monitoring`` listeners.
 
@@ -186,28 +201,55 @@ class CompileTracker:
     hits surface as near-zero durations). Listener registration is
     process-global in jax and cannot be unregistered, so ``install``
     wires one module-level hook that follows the live ObsState.
+
+    With a compile budget (``HSTD_COMPILE_BUDGET_S``, ROADMAP
+    "Compile-time budget"), the first crossing of cumulative compile
+    seconds emits ONE ``alert`` event plus a stderr line, and
+    ``budget_exceeded`` latches — bucket-ladder batchers consult it
+    (via ``obs.compile_budget_exceeded``) to stop minting new widths.
     """
 
     _MARKERS = ("compile", "tracing", "lowering")
 
-    def __init__(self, state: ObsState):
+    def __init__(self, state: ObsState, budget_s: Optional[float] = None):
         self.state = state
         self.count = 0
         self.cum_secs = 0.0
+        self.budget_s = compile_budget_env() if budget_s is None else budget_s
+        self.budget_exceeded = False
         self._lock = threading.Lock()
 
     def observe(self, event: str, secs: float) -> None:
         low = event.lower()
         if not any(m in low for m in self._MARKERS):
             return
+        crossed = False
         with self._lock:
             self.count += 1
             self.cum_secs += secs
             count, cum = self.count, self.cum_secs
+            if (self.budget_s is not None and cum > self.budget_s
+                    and not self.budget_exceeded):
+                self.budget_exceeded = True
+                crossed = True
         if self.state.events is not None:
             self.state.events.emit("compile", {
                 "event": event, "dur": round(secs, 6), "count": count,
                 "cum": round(cum, 3)})
+        if crossed:
+            msg = (f"cumulative XLA compile time {cum:.1f}s exceeds "
+                   f"{ENV_COMPILE_BUDGET}={self.budget_s:g}s after "
+                   f"{count} compilations — bucket ladders will stop "
+                   "minting new widths; consider a persistent compile "
+                   "cache (HSTD_COMPILE_CACHE_DIR) or fewer bucket rungs")
+            if self.state.events is not None:
+                self.state.events.emit("alert", {
+                    "name": "compile_budget", "message": msg,
+                    "cum": round(cum, 3), "budget_s": self.budget_s,
+                    "count": count})
+            print(f"[hstd-obs] COMPILE BUDGET: {msg}", file=sys.stderr,
+                  flush=True)
+            logger.warning("compile budget exceeded: %s", msg)
 
 
 _INSTALLED: list[CompileTracker] = []

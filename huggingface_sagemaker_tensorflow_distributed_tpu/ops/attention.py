@@ -83,15 +83,38 @@ def dot_product_attention(q, k, v, mask=None, scale=None, impl: str = "xla",
     return xla_attention(q, k, v, mask=mask, scale=scale)
 
 
-def make_attention_mask(attention_mask, dtype=jnp.float32, neg=-1e9):
+def make_attention_mask(attention_mask, dtype=jnp.float32, neg=-1e9,
+                        segment_ids=None):
     """[batch, kv_len] {0,1} padding mask → additive [batch, 1, 1, kv_len].
 
     The reference feeds HF models a {0,1} ``attention_mask`` built by the
     tokenizer (``scripts/train.py:75-83``); this converts that contract to
     the additive-logit form the kernels use.
+
+    With ``segment_ids`` (token-packed batches, ``data.pipeline.
+    pack_examples``) the result is instead the block-diagonal
+    [batch, 1, q_len, kv_len] segment mask — packed examples must not
+    attend across segment boundaries.
     """
+    if segment_ids is not None:
+        return make_segment_mask(segment_ids, dtype=dtype, neg=neg)
     m = attention_mask[:, None, None, :].astype(dtype)
     return (1.0 - m) * neg
+
+
+def make_segment_mask(segment_ids, dtype=jnp.float32, neg=-1e9):
+    """[batch, len] int segment ids (1-based per packed example, 0 on
+    padding) → additive [batch, 1, q_len, kv_len] mask that keeps a
+    (query, key) pair iff both tokens belong to the SAME nonzero
+    segment — the cross-contamination guard of packed batching (Krell
+    et al., 2021, "Efficient Sequence Packing without
+    Cross-contamination"). Composes additively with the causal/banded
+    masks; padding queries attend nothing, which the ``neg``-additive
+    (not -inf) convention keeps NaN-free through softmax."""
+    seg_q = segment_ids[:, None, :, None]
+    seg_k = segment_ids[:, None, None, :]
+    keep = (seg_q == seg_k) & (seg_k > 0)
+    return jnp.where(keep, 0.0, neg).astype(dtype)
 
 
 def make_causal_mask(q_len: int, kv_len: int | None = None, dtype=jnp.float32, neg=-1e9):

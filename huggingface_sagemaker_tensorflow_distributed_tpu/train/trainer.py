@@ -91,11 +91,26 @@ def _masked_sums(per_example, correct, valid):
     return loss, {"loss_sum": loss_sum, "correct": correct_sum, "count": count}
 
 
+def _packed_kwargs(batch) -> dict:
+    """Pass-through of the token-packing columns (``pack_examples``):
+    ``segment_ids`` keeps attention block-diagonal per packed example,
+    ``position_ids`` restarts positions per example. Only forwarded when
+    present, so unpacked batches reach models that never grew the
+    kwargs."""
+    kw = {}
+    if "segment_ids" in batch:
+        kw["segment_ids"] = batch["segment_ids"]
+    if "position_ids" in batch:
+        kw["position_ids"] = batch["position_ids"]
+    return kw
+
+
 def _apply(apply_fn, params, batch, rngs, train):
     return apply_fn({"params": params}, batch["input_ids"],
                     batch["attention_mask"],
                     token_type_ids=batch.get("token_type_ids"),
-                    deterministic=not train, rngs=rngs)
+                    deterministic=not train, rngs=rngs,
+                    **_packed_kwargs(batch))
 
 
 def seq_cls_loss(apply_fn, params, batch, rngs, train: bool):
@@ -275,7 +290,8 @@ def make_fused_causal_lm_loss(model, block_n: int = 256, block_v: int = 512,
         hidden, embedding = apply_fn(
             {"params": params}, batch["input_ids"], batch["attention_mask"],
             deterministic=not train, rngs=rngs,
-            method=model.hidden_and_embedding)               # [B,S,H], [V,H]
+            method=model.hidden_and_embedding,
+            **_packed_kwargs(batch))                         # [B,S,H], [V,H]
         B = hidden.shape[0]
         labels = batch["labels"]
         shifted = jnp.concatenate(
@@ -360,7 +376,8 @@ def make_fused_mlm_loss(model, mask_cap: float = 0.25, block_n: int = 256,
         hidden, table, bias = apply_fn(
             {"params": params}, batch["input_ids"], batch["attention_mask"],
             token_type_ids=batch.get("token_type_ids"),
-            deterministic=not train, rngs=rngs, return_fused_inputs=True)
+            deterministic=not train, rngs=rngs, return_fused_inputs=True,
+            **_packed_kwargs(batch))
         labels = batch["labels"]
         token_valid = (labels != -100) & (batch["attention_mask"] > 0)
         if "valid" in batch:
@@ -568,6 +585,11 @@ class Trainer:
         # call runs under use_mesh so trace-time mesh consumers (ring
         # attention) always see THIS trainer's mesh, regardless of other
         # trainers constructed in the same process.
+        # NB: the input batch is NOT donated — its int32 buffers can
+        # never input-output-alias the f32 state/metrics, so donation
+        # would only emit "donated buffers were not usable" warnings.
+        # The H2D double buffer's HBM headroom comes from the fit loop
+        # dropping batch N's last reference when it rebinds to N+1.
         self._train_step = self._with_mesh(jax.jit(
             self._train_step_impl,
             in_shardings=(self.state_shardings, None),

@@ -151,15 +151,31 @@ def build_dataset(config: TrainConfig, tokenizer, split: str, max_len: int,
         # any text source works as an LM corpus; classification labels
         # are simply ignored
         texts, _ = load_text_classification(config.dataset, split, **kw)
-        return ArrayDataset.from_lm_texts(
+        ds = ArrayDataset.from_lm_texts(
             tokenizer, texts, max_len,
             packed=config.packed_sequences,
             eos_token_id=getattr(model_config, "eos_token_id", None))
+        if config.segment_packing:
+            # token packing with per-example boundaries: segment ids +
+            # restarting positions keep attention and loss per-example
+            # exact (vs packed_sequences' cross-document attention)
+            ds = ds.pack(max_len, causal=True)
+        return ds
     if config.task == "mlm":
         texts, _ = load_text_classification(config.dataset, split, **kw)
-        return ArrayDataset.from_mlm_texts(
+        ds = ArrayDataset.from_mlm_texts(
             tokenizer, texts, max_len, seed=config.seed,
             static_masking=config.mlm_static_masking)
+        if config.segment_packing:
+            # MlmDataset.pack enforces the static-masking requirement;
+            # re-raise with the CLI flag spelled out
+            if not config.mlm_static_masking:
+                raise ValueError(
+                    "--segment_packing with task=mlm requires "
+                    "--mlm_static_masking true (packing freezes the "
+                    "masking draw at build time)")
+            ds = ds.pack(max_len)
+        return ds
     if config.task == "rtd":
         texts, _ = load_text_classification(config.dataset, split, **kw)
         return ArrayDataset.from_rtd_texts(tokenizer, texts, max_len,
@@ -270,6 +286,26 @@ def main(argv=None) -> dict:
                 "with seq-sharded activations", config.sp)
         else:
             logger.info("sp=%d: ring attention selected", config.sp)
+    if config.segment_packing:
+        # only models that grew the segment_ids/position_ids kwargs can
+        # consume packed batches — anything else would TypeError at
+        # trace time with an opaque flax message
+        if family not in ("gpt2", "bert"):
+            raise ValueError(
+                "--segment_packing needs a model wired for segment_ids/"
+                "position_ids (gpt2 causal-lm, bert mlm); "
+                f"got family {family!r}")
+        if attention_impl == "ring":
+            raise ValueError(
+                "--segment_packing builds a [B,1,S,S] block-diagonal "
+                "mask, which ring attention (sp>1) cannot shard over the "
+                "seq axis — drop --sp or --segment_packing")
+        if attention_impl == "flash":
+            logger.warning(
+                "--segment_packing builds a [B,1,S,S] block-diagonal "
+                "mask, which the Pallas flash kernel treats as a general "
+                "mask and falls back to XLA attention — long-sequence "
+                "memory is O(S^2) on this run, not O(S)")
     tokenizer = load_tokenizer(config.model_name_or_path,
                                vocab_size=model_config.vocab_size)
 
