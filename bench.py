@@ -58,6 +58,16 @@ Extra modes (each also prints one JSON line per run):
   --llama-train        TinyLlama-1.1B causal-LM training on one chip
                        (bf16 Adam + remat dots + fused vocab-CE +
                        flash), samples/s + MFU.
+  --serve              continuous-batching serving engine (serve/:
+                       paged KV + iteration-level scheduling) vs
+                       static-batch generate_causal on a mixed-length
+                       request trace: speedup, TTFT p50/p99, KV-pool
+                       utilization, zero-recompile check.
+
+Every metric line additionally carries a ``memory`` watermark field on
+accelerator backends (peak_bytes_in_use vs bytes_limit, ROADMAP "Memory
+watermarks") so HBM-spill regressions surface next to the throughput
+they cost.
 
 Results across rounds are recorded in BENCH_EXTRA.md.
 """
@@ -214,6 +224,40 @@ def _flops_detail(samples_per_sec_per_chip: float,
     }
 
 
+def memory_watermark() -> dict | None:
+    """Peak-vs-limit device-memory watermark across local devices
+    (ROADMAP "Memory watermarks") — the figure that catches HBM-spill
+    regressions like the batch-64 spill story without a profiler trace.
+    None on CPU backends / before jax initializes (the supervisor
+    parent never initializes a backend, so it must never call this
+    successfully by accident)."""
+    if "jax" not in sys.modules:
+        return None
+    jax = sys.modules["jax"]
+    try:
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — backend gone / not initialized
+        return None
+    peaks = []
+    for d in devices:
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — CPU backends raise
+            stats = {}
+        if stats.get("peak_bytes_in_use"):
+            peaks.append((int(stats["peak_bytes_in_use"]),
+                          int(stats.get("bytes_limit") or 0)))
+    if not peaks:
+        return None
+    peak = max(p for p, _ in peaks)
+    limit = max((lim for _, lim in peaks if lim), default=0)
+    out = {"peak_bytes_in_use": peak}
+    if limit:
+        out["bytes_limit"] = limit
+        out["peak_frac"] = round(peak / limit, 3)
+    return out
+
+
 def emit(metric: str, value: float, baseline: float,
          flops_per_sample: float | None = None, **extra) -> None:
     line = {
@@ -224,6 +268,15 @@ def emit(metric: str, value: float, baseline: float,
     }
     if flops_per_sample is not None and _on_tpu():
         line.update(_flops_detail(value, flops_per_sample))
+    mem = memory_watermark()
+    if mem is not None:
+        # every stage line carries the watermark: a spill regression
+        # shows as peak_frac -> 1.0 next to the throughput it costs
+        line["memory"] = mem
+        print(f"[bench] memory watermark: peak {mem['peak_bytes_in_use']}"
+              + (f" / limit {mem['bytes_limit']}"
+                 f" ({mem['peak_frac']:.1%})" if "bytes_limit" in mem
+                 else ""), file=sys.stderr)
     line.update(extra)
     print(json.dumps(line))
 
@@ -443,6 +496,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
     # getattr: test harnesses build Namespaces predating this flag
     if getattr(args, "data", False):
         return ["data_pipeline_microbench"]
+    if getattr(args, "serve", False):
+        return ["serve_continuous_vs_static_speedup"]
     if args.llama_train:
         return ["llama_1b_train_samples_per_sec_per_chip"]
     if args.mixtral_train:
@@ -645,6 +700,9 @@ def _run_child(args: argparse.Namespace) -> None:
     elif getattr(args, "data", False):
         from benchmarks.data_bench import bench_data
         bench_data()
+    elif getattr(args, "serve", False):
+        from benchmarks.serve_bench import bench_serve
+        bench_serve()
     elif args.llama_train:
         from benchmarks.llama_train_bench import bench_llama_train
         bench_llama_train()
@@ -680,6 +738,13 @@ def main() -> None:
                         help="input-pipeline microbench: prefetch-depth "
                              "autotune consumer-wait reduction + pad-waste "
                              "bucketing-vs-packing (CPU-friendly)")
+    parser.add_argument("--serve", action="store_true",
+                        help="continuous-batching serving bench: mixed-"
+                             "length request trace through serve/engine "
+                             "(paged KV + iteration-level scheduling) vs "
+                             "static-batch generate_causal; TTFT "
+                             "p50/p99, aggregate tokens/sec, KV-pool "
+                             "utilization, compile flatness")
     parser.add_argument("--llama-train", action="store_true",
                         dest="llama_train",
                         help="TinyLlama-1.1B training throughput "
@@ -719,6 +784,7 @@ def main() -> None:
                               ("--lora", args.lora),
                               ("--banded", args.banded),
                               ("--data", args.data),
+                              ("--serve", args.serve),
                               ("--llama-train", args.llama_train),
                               ("--mixtral-train", args.mixtral_train)] if on]
     if len(picked) > 1:

@@ -1072,10 +1072,14 @@ class ShardedBatcher:
         # cumulative XLA compile time exceeds HSTD_COMPILE_BUDGET_S, stop
         # minting NEW batch shapes — widen to the smallest width this
         # batcher already emitted (already compiled), falling back to the
-        # full column width. Single-host only: the budget is crossed at a
-        # host-local instant, and multi-host bucket choices must agree.
-        capped = (self.process_count == 1
-                  and obs.compile_budget_exceeded())
+        # full column width. Single-host: acts the instant the local
+        # tracker crosses. Multi-host: acts on the epoch-boundary
+        # AGREED latch (trainer runs parallel.distributed.
+        # agree_compile_budget_crossed and calls obs.
+        # set_compile_budget_agreed on every host together), because the
+        # budget is crossed at a host-local instant and bucket choices
+        # must agree across hosts.
+        capped = obs.compile_budget_capped(self.process_count)
         trims: dict[int, int] = {}  # original width -> bucket width
         for mask_name, lengths in self._lengths.items():
             width = self.dataset.columns[mask_name].shape[1]

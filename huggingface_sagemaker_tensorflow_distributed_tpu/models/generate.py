@@ -190,13 +190,21 @@ def _sample_next(logits, temperature, top_k, top_p, rng):
 @functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
                                              "temperature", "top_k", "top_p",
                                              "prefill_chunk"))
-def _generate_causal_jit(model, params, input_ids, attention_mask,
-                         max_new_tokens, temperature, rng, top_k=0, top_p=0.0,
-                         prefill_chunk=0):
-    """Decoder-only generation: one prefill pass writes the prompt into
-    the KV cache, then a jitted scan decodes token-by-token. Left-padded
+def _prefill_causal_jit(model, params, input_ids, attention_mask,
+                        max_new_tokens, temperature, rng, top_k=0, top_p=0.0,
+                        prefill_chunk=0):
+    """Decoder-only PREFILL dispatch: allocate the full-length KV cache,
+    write the prompt into it, and sample the first continuation token.
+    Returns ``(first, cache, valid, finished, rng, n_real)`` — exactly
+    the carry ``_decode_causal_jit`` starts its scan from. Left-padded
     prompts are supported: positions come from the padding-mask cumsum
     and padded cache slots stay masked for the whole decode.
+
+    Split from the decode scan (ROADMAP "Decode-phase split") so the
+    host sees the prefill/decode boundary: the wrapper can time TTFT
+    separately from steady decode tokens/sec, and the serving path gets
+    the same two-dispatch shape. The ops are unchanged — outputs are
+    bit-identical to the old fused prefill+scan dispatch.
 
     ``prefill_chunk > 0`` splits the prefill into a ``lax.scan`` over
     fixed-size chunks (the wrapper pads the prompt width to a multiple):
@@ -262,6 +270,17 @@ def _generate_causal_jit(model, params, input_ids, attention_mask,
             logits, last_real[:, None, None], axis=1)[:, 0].astype(jnp.float32)
     first, rng = _sample_next(last_logits, temperature, top_k, top_p, rng)
     finished = first == cfg.eos_token_id
+    return first, cache, valid, finished, rng, n_real
+
+
+def _decode_causal(model, params, first, cache, valid, finished, rng,
+                   n_real, max_new_tokens, temperature, top_k=0, top_p=0.0):
+    """Decoder-only DECODE dispatch: the jitted token-by-token scan over
+    the cache ``_prefill_causal_jit`` produced. Same ops as the old
+    fused tail, so the concatenated output is bit-identical."""
+    cfg = model.config
+    B = first.shape[0]
+    P = valid.shape[1] - max_new_tokens
 
     def step(carry, t):
         token, cache, valid, finished, rng = carry
@@ -284,6 +303,20 @@ def _generate_causal_jit(model, params, input_ids, attention_mask,
     return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 
+@functools.lru_cache(maxsize=2)
+def _decode_causal_jit(donate: bool):
+    """The jitted decode dispatch. The prefill's cache/valid buffers are
+    donated on accelerator backends (the decode step consumes them; an
+    undonated [B, total, layers] cache would cost one full HBM copy per
+    generate call) — CPU doesn't implement donation and would warn."""
+    kw = {}
+    if donate:
+        kw["donate_argnames"] = ("cache", "valid")
+    return functools.partial(jax.jit, static_argnames=(
+        "model", "max_new_tokens", "temperature", "top_k", "top_p"),
+        **kw)(_decode_causal)
+
+
 def generate_causal(model, params, input_ids, attention_mask=None,
                     max_new_tokens: int = 64, temperature: float = 0.0,
                     top_k: int = 0, top_p: float = 0.0, seed: int = 0,
@@ -295,7 +328,16 @@ def generate_causal(model, params, input_ids, attention_mask=None,
     (O(chunk·total) attention memory instead of O(P·total); the prompt
     is right-padded to a chunk multiple internally — same tokens out).
     Returns [batch, max_new_tokens] continuation ids, ``pad_token_id``
-    after EOS."""
+    after EOS.
+
+    Prefill and decode are SEPARATE jitted dispatches (ROADMAP
+    "Decode-phase split"): on instrumented runs the wrapper blocks on
+    the prefill's first token and emits ``generate/causal_ttft_s``
+    before timing the decode scan on its own
+    (``generate/causal_decode_tokens_per_sec``) — so TTFT and steady
+    tokens/sec no longer share one opaque span. Uninstrumented calls
+    stay fully async: the decode dispatch chains on the prefill's
+    device buffers with no host sync between them."""
     import time
 
     t0 = time.perf_counter()
@@ -331,15 +373,34 @@ def generate_causal(model, params, input_ids, attention_mask=None,
             input_ids = jnp.pad(input_ids, ((0, 0), (0, short)),
                                 constant_values=pad_id)
             attention_mask = jnp.pad(attention_mask, ((0, 0), (0, short)))
-    with obs.span("generate/causal_dispatch",
+    with obs.span("generate/causal_prefill",
                   {"prompt_len": int(input_ids.shape[1]),
                    "prefill_chunk": prefill_chunk} if obs.has_sink()
                   else None):
-        out = _generate_causal_jit(model, params, input_ids, attention_mask,
-                                   int(max_new_tokens), float(temperature),
-                                   jax.random.PRNGKey(seed), top_k=int(top_k),
-                                   top_p=float(top_p),
-                                   prefill_chunk=prefill_chunk)
+        first, cache, valid, finished, rng, n_real = _prefill_causal_jit(
+            model, params, input_ids, attention_mask,
+            int(max_new_tokens), float(temperature),
+            jax.random.PRNGKey(seed), top_k=int(top_k),
+            top_p=float(top_p), prefill_chunk=prefill_chunk)
+        if obs.has_sink():
+            jax.block_until_ready(first)
+            obs.scalar("generate/causal_ttft_s",
+                       time.perf_counter() - t0,
+                       args={"prompt_len": int(input_ids.shape[1]),
+                             "batch": int(input_ids.shape[0])})
+    t_dec = time.perf_counter()
+    decode_fn = _decode_causal_jit(jax.default_backend() != "cpu")
+    with obs.span("generate/causal_decode"):
+        out = decode_fn(model, params, first, cache=cache, valid=valid,
+                        finished=finished, rng=rng, n_real=n_real,
+                        max_new_tokens=int(max_new_tokens),
+                        temperature=float(temperature), top_k=int(top_k),
+                        top_p=float(top_p))
+        if obs.has_sink():
+            jax.block_until_ready(out)
+            dt = max(time.perf_counter() - t_dec, 1e-9)
+            obs.scalar("generate/causal_decode_tokens_per_sec",
+                       out.shape[0] * out.shape[1] / dt)
     return _traced_decode("generate/causal", t0, out)
 
 

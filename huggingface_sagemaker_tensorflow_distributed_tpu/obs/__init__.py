@@ -119,6 +119,16 @@ def autotune(name: str, depth: int, reason: str,
     _state.events.emit("autotune", fields)
 
 
+def serve(event: str, **fields) -> None:
+    """One serving-engine lifecycle event (``serve`` event type):
+    ``event`` names the transition (submit / admit / first_token /
+    finish / preempt), extra keyword fields ride along (``request`` id,
+    ``ttft_s``, ``tokens``, ...). No-op without a file sink."""
+    if not _state.enabled or _state.events is None:
+        return
+    _state.events.emit("serve", {"event": str(event), **fields})
+
+
 def alert(name: str, message: str, args: Optional[dict] = None) -> None:
     """A budget/threshold warning (``alert`` event), mirrored to stderr
     by callers that need operator visibility."""
@@ -140,6 +150,38 @@ def compile_budget_exceeded() -> bool:
     )
 
     return any(t.state is _state and t.budget_exceeded for t in _INSTALLED)
+
+
+_budget_agreed = False
+
+
+def set_compile_budget_agreed() -> None:
+    """Latch the HOST-AGREED compile-budget crossing (ROADMAP
+    "multi-host ladder capping"): the trainer calls this after the
+    epoch-boundary collective (``parallel.distributed.
+    agree_compile_budget_crossed``) reports that some host crossed
+    ``HSTD_COMPILE_BUDGET_S``. Because every host latches from the SAME
+    collective at the SAME epoch boundary, all hosts stop minting new
+    bucket widths at the same step — which is what keeps multi-host
+    bucket choices (derived from shared order + this flag) in
+    agreement."""
+    global _budget_agreed
+    _budget_agreed = True
+
+
+def compile_budget_agreed() -> bool:
+    return _budget_agreed
+
+
+def compile_budget_capped(process_count: int) -> bool:
+    """Should a bucket ladder stop minting new widths? Single-host runs
+    act on the local tracker the instant it crosses (mid-epoch is fine:
+    there is nobody to disagree with); multi-host runs act only on the
+    epoch-boundary agreed latch, so every host's ladder caps at the
+    same step."""
+    if process_count == 1:
+        return compile_budget_exceeded()
+    return _budget_agreed
 
 
 def metrics() -> MetricsSink:
@@ -199,7 +241,8 @@ def reset(out_dir: Optional[str] = None,
           enabled: Optional[bool] = None) -> ObsState:
     """Test helper: tear down and rebuild the process state (re-reading
     the environment), optionally overriding dir/enabled."""
-    global _state, _tracer, _metrics, _heartbeat
+    global _state, _tracer, _metrics, _heartbeat, _budget_agreed
+    _budget_agreed = False
     shutdown()
     _state = ObsState()
     _tracer = Tracer(_state)

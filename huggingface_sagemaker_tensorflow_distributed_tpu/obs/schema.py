@@ -56,6 +56,11 @@ REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
     # a budget/threshold warning (e.g. compile_budget when cumulative XLA
     # compile seconds exceed HSTD_COMPILE_BUDGET_S); mirrored to stderr
     "alert": {"name": (str,), "message": (str,)},
+    # one serving-engine lifecycle event (serve/engine.py): "event" is
+    # submit / admit / first_token / finish / preempt; per-request
+    # events also carry an integer "request" id, and first_token /
+    # finish carry the latency/accounting extras (ttft_s, tokens)
+    "serve": {"event": (str,)},
     # run metadata, first event after configure()
     "run": {"argv": (list,)},
 }

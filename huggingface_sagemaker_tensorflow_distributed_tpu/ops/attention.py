@@ -137,6 +137,66 @@ def make_banded_causal_mask(q_len: int, window: int,
     return jnp.where(keep, 0.0, neg).astype(dtype)[None, None, :, :]
 
 
+# ---------------------------------------------------------------------------
+# Paged KV cache (serve/): block-table gather path
+# ---------------------------------------------------------------------------
+
+
+def gather_paged_kv(pool, block_tables):
+    """Materialize per-slot contiguous KV from a paged pool.
+
+    ``pool`` is one layer's preallocated block pool
+    [num_blocks, block_size, heads, head_dim]; ``block_tables``
+    [slots, blocks_per_slot] maps each decode slot's logical block index
+    to a physical pool block (vLLM's block table). Returns
+    [slots, heads, blocks_per_slot * block_size, head_dim] — logical
+    position ``p`` of slot ``s`` lives at
+    ``pool[block_tables[s, p // block_size], p % block_size]``, so the
+    gathered view is position-ordered exactly like a contiguous cache
+    buffer. The gather is O(context) reads per step — the same bytes a
+    contiguous cache read costs; what paging changes is the PERSISTENT
+    allocation, which scales with blocks actually held, not
+    ``slots × max_len``."""
+    g = pool[block_tables]                     # [S, nb, bs, H, D]
+    S, nb, bs, H, D = g.shape
+    return g.transpose(0, 3, 1, 2, 4).reshape(S, H, nb * bs, D)
+
+
+def scatter_paged_kv(pool, block_tables, positions, values):
+    """Write ``values`` [n, heads, head_dim] at logical ``positions``
+    [slots_or_n] of the slots owning them into the paged ``pool``
+    (inverse addressing of :func:`gather_paged_kv`). ``block_tables``
+    here is the [n, blocks_per_slot] table of the written slots (one row
+    per written token). Callers route writes for INACTIVE slots to the
+    reserved null block 0 (never allocated to a request), so a fully
+    static-shape step can always scatter."""
+    bs = pool.shape[1]
+    n = positions.shape[0]
+    block_ids = jnp.take_along_axis(
+        block_tables, (positions // bs)[:, None], axis=1)[:, 0]
+    return pool.at[block_ids, positions % bs].set(values)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                    scale=None):
+    """Single-token decode attention against a paged KV pool.
+
+    ``q`` [slots, heads, head_dim] (the step's one query per slot);
+    pools/[block_tables] as in :func:`gather_paged_kv`;
+    ``context_lens`` [slots] counts valid tokens per slot. Keys at
+    logical positions >= context_len (stale block tails, null-block
+    junk) are masked additively — the −1e9 convention keeps the softmax
+    NaN-free even for empty (context 0) slots. Returns
+    [slots, heads, head_dim]."""
+    k = gather_paged_kv(k_pool, block_tables)
+    v = gather_paged_kv(v_pool, block_tables)
+    max_ctx = k.shape[2]
+    valid = jnp.arange(max_ctx)[None, :] < context_lens[:, None]
+    mask = jnp.where(valid, 0.0, -1e9)[:, None, None, :]
+    return xla_attention(q[:, :, None, :], k, v, mask=mask,
+                         scale=scale)[:, :, 0, :]
+
+
 def relative_position_bucket(relative_position, bidirectional: bool,
                              num_buckets: int, max_distance: int):
     """HF ``T5Attention._relative_position_bucket`` semantics: log-spaced

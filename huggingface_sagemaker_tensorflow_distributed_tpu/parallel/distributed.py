@@ -106,3 +106,23 @@ def host_step_stats(step_seconds: float) -> dict | None:
             "min": float(vals.min()), "max": float(vals.max()),
             "mean": mean,
             "straggler_ratio": float(vals.max() / max(mean, 1e-12))}
+
+
+def agree_compile_budget_crossed(local_crossed: bool) -> bool:
+    """Epoch-boundary COLLECTIVE (multi-host): True iff ANY host's
+    compile tracker has crossed ``HSTD_COMPILE_BUDGET_S``. The budget
+    is crossed at a host-local instant (compiles race), so single-host
+    ladder capping cannot be applied under multi-host — bucket choices
+    must agree across hosts or ``global_arrays`` ships mismatched
+    shapes into collectives. Calling this under an identical condition
+    on every host (the trainer's epoch boundary, guarded by the
+    env-driven budget setting) and latching the OR gives every host the
+    same crossing step. Trivially local with one process."""
+    if jax.process_count() == 1:
+        return bool(local_crossed)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    vals = np.asarray(multihost_utils.process_allgather(
+        np.asarray([1.0 if local_crossed else 0.0], np.float64)))
+    return bool(vals.max() > 0.5)

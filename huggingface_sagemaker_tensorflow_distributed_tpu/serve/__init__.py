@@ -1,0 +1,30 @@
+"""``serve``: continuous-batching inference engine (ISSUE 3).
+
+- :mod:`~.paged_kv` — block-pool KV allocation (host-side policy).
+- :mod:`~.scheduler` — iteration-level admission/preemption over fixed
+  decode slots.
+- :mod:`~.engine` — the jitted prefill/decode step functions and the
+  driving loop (``scripts/serve.py`` is the CLI; ``bench.py --serve``
+  the measurement).
+"""
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (  # noqa: F401
+    BlockManager,
+    PoolExhausted,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (  # noqa: F401
+    Request,
+    Scheduler,
+)
+
+
+def __getattr__(name):
+    # ServeEngine pulls in jax; keep `import ...serve` cheap for
+    # host-only consumers (scheduler/block-manager tests)
+    if name in ("ServeEngine", "EngineStats", "CachePlan",
+                "build_cache_plan"):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.serve import (
+            engine,
+        )
+        return getattr(engine, name)
+    raise AttributeError(name)

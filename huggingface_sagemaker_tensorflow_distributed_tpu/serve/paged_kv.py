@@ -1,0 +1,122 @@
+"""Paged KV cache bookkeeping: a fixed population of fixed-size blocks,
+allocated to requests as their context grows (vLLM, Kwon et al. 2023).
+
+The device side is dumb on purpose — per-layer pools
+``[num_blocks, block_size, heads, head_dim]`` plus the gather/scatter
+addressing in ``ops.attention`` — so ALL allocation policy lives here in
+plain host Python where it is unit-testable without a backend:
+
+- :class:`BlockManager` owns the free list. Block 0 is reserved as the
+  **null block**: inactive decode slots scatter their (discarded) step
+  writes there, which is what lets the engine's jitted step keep fully
+  static shapes with no per-step masking of the write path.
+- memory scales with tokens actually resident: a request holds
+  ``ceil(context / block_size)`` blocks, not ``max_model_len`` slots.
+  Fragmentation is bounded by ``block_size - 1`` tokens per request
+  (the partially-filled last block) — the quantity
+  :meth:`BlockManager.fragmentation` reports and the tests pin.
+
+The engine frees a finished/preempted request's blocks immediately;
+there is no refcounting/copy-on-write (no beam forking through the
+serve path yet), so a block is owned by exactly one request.
+"""
+
+from __future__ import annotations
+
+
+class PoolExhausted(Exception):
+    """Raised by :meth:`BlockManager.allocate` when the pool cannot
+    satisfy a request — the scheduler catches it and preempts."""
+
+
+class BlockManager:
+    """Free-list allocator over ``num_blocks`` blocks of ``block_size``
+    token slots each. Block 0 is the reserved null block and is never
+    handed out."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (block 0 is the reserved "
+                             f"null block), got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO free list: recently-freed (cache-warm) blocks are reused
+        # first; block 0 excluded for good
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self.peak_used = 0
+
+    # -- capacity arithmetic -------------------------------------------------
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` context tokens."""
+        return -(-max(int(n_tokens), 0) // self.block_size)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def can_allocate(self, n_blocks: int) -> bool:
+        return n_blocks <= len(self._free)
+
+    def utilization(self) -> float:
+        """Fraction of allocatable blocks currently held by requests."""
+        return self.num_used / max(self.num_blocks - 1, 1)
+
+    def fragmentation(self, context_lens) -> float:
+        """Fraction of HELD token slots that are padding inside
+        partially-filled last blocks — the paged design's only waste
+        (≤ ``(block_size - 1) / block_size`` per request; a contiguous
+        ``max_len`` cache wastes ``1 - context/max_len`` instead)."""
+        held_tokens = sum(self.blocks_for(c) * self.block_size
+                          for c in context_lens)
+        if held_tokens == 0:
+            return 0.0
+        used_tokens = sum(int(c) for c in context_lens)
+        return 1.0 - used_tokens / held_tokens
+
+    # -- alloc/free ----------------------------------------------------------
+
+    def allocate(self, n_blocks: int) -> list[int]:
+        """Pop ``n_blocks`` physical block ids; raises
+        :class:`PoolExhausted` (allocating nothing) when short."""
+        if n_blocks > len(self._free):
+            raise PoolExhausted(
+                f"need {n_blocks} blocks, {len(self._free)} free "
+                f"(pool {self.num_blocks - 1} allocatable)")
+        out = [self._free.pop() for _ in range(n_blocks)]
+        self.peak_used = max(self.peak_used, self.num_used)
+        return out
+
+    def grow(self, table: list[int], n_tokens: int) -> list[int]:
+        """Extend ``table`` (a request's block table) to cover
+        ``n_tokens`` of context; returns the newly-allocated ids (empty
+        when the table already covers it). All-or-nothing on
+        :class:`PoolExhausted`."""
+        need = self.blocks_for(n_tokens) - len(table)
+        if need <= 0:
+            return []
+        fresh = self.allocate(need)
+        table.extend(fresh)
+        return fresh
+
+    def trim(self, table: list[int], n_tokens: int) -> None:
+        """Free table blocks beyond what ``n_tokens`` needs (chunked
+        prefill pads the prompt to a chunk multiple; the pad tail's
+        blocks come back here once the real length is known)."""
+        keep = self.blocks_for(n_tokens)
+        while len(table) > keep:
+            self.free([table.pop()])
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if not 1 <= b < self.num_blocks:
+                raise ValueError(f"freeing block {b} outside the pool")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)

@@ -1,0 +1,274 @@
+"""Iteration-level (continuous) batching scheduler — Orca (Yu et al.,
+OSDI 2022) semantics over a fixed set of decode slots.
+
+The engine calls :meth:`Scheduler.admit` between decode steps; requests
+join/leave the running batch at TOKEN granularity instead of waiting for
+a whole static batch to drain. The slot count is fixed so every jitted
+dispatch keeps one shape (zero recompiles after warmup); an empty slot
+simply rides along masked (its writes go to the KV pool's null block).
+
+States: WAITING (queued) → PREFILL (chunked prompt ingestion, one chunk
+per engine iteration) → DECODE (one token per decode step) → FINISHED.
+Preemption (KV pool exhausted mid-decode) is vLLM-style *recompute*: the
+victim — always the youngest running request, so the head of the line
+never livelocks — releases every block and re-enters the queue front
+with ``prompt + generated-so-far`` as its new prompt; under greedy
+decoding the recomputed continuation is exactly what it would have
+produced uninterrupted, so preemption changes latency, never tokens.
+
+Pure host-side Python over :class:`~.paged_kv.BlockManager` — all policy
+is unit-testable with no jax backend.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (
+    BlockManager,
+    PoolExhausted,
+)
+
+WAITING, PREFILL, DECODE, FINISHED = "waiting", "prefill", "decode", "finished"
+
+_rid = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request. ``prompt`` is token ids [P]; the engine
+    appends generated ids to ``output``. Timing fields are engine-side
+    ``perf_counter`` stamps (None until reached)."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    rid: int = field(default_factory=lambda: next(_rid))
+    output: list = field(default_factory=list)
+    state: str = WAITING
+    submit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    preemptions: int = 0
+    # recompute preemption folds generated tokens back into the prompt;
+    # this keeps the ORIGINAL prompt length so output accounting and
+    # first-token semantics survive a preemption
+    orig_prompt_len: int = field(default=-1)
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.orig_prompt_len < 0:
+            self.orig_prompt_len = len(self.prompt)
+        if len(self.prompt) < 1:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.submit_t is None or self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+
+class Slot:
+    """One decode slot's device-side bookkeeping: the physical block
+    table, how much context is resident (``context_len``), and how far
+    prefill has progressed (``prefill_pos``, over the CHUNK-PADDED
+    prompt width)."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.request: Optional[Request] = None
+        self.table: list[int] = []
+        self.context_len = 0
+        self.prefill_pos = 0
+        self.admit_seq = -1          # admission order, for victim choice
+
+    @property
+    def free(self) -> bool:
+        return self.request is None
+
+    def clear(self) -> None:
+        self.request = None
+        self.table = []
+        self.context_len = 0
+        self.prefill_pos = 0
+        self.admit_seq = -1
+
+
+class Scheduler:
+    """FIFO admission into ``num_slots`` decode slots, chunked prefill,
+    recompute preemption. The engine owns the clock and the device; this
+    class owns WHO runs."""
+
+    def __init__(self, num_slots: int, blocks: BlockManager,
+                 prefill_chunk: int, max_model_len: int):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        if prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if max_model_len % blocks.block_size:
+            raise ValueError(
+                f"max_model_len {max_model_len} must be a multiple of "
+                f"block_size {blocks.block_size}")
+        if max_model_len % prefill_chunk:
+            # padded_prompt_len must never exceed max_model_len (the
+            # engine's block tables are sized for it): with the chunk
+            # dividing the width, ceil(p/C)*C <= max_model_len for
+            # every admissible prompt
+            raise ValueError(
+                f"max_model_len {max_model_len} must be a multiple of "
+                f"prefill_chunk {prefill_chunk}")
+        self.slots = [Slot(i) for i in range(num_slots)]
+        self.blocks = blocks
+        self.prefill_chunk = int(prefill_chunk)
+        self.max_model_len = int(max_model_len)
+        self.waiting: list[Request] = []
+        self._admit_seq = itertools.count()
+        self._prefill_rr = 0
+        self.n_preemptions = 0
+
+    # -- queue side ----------------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        total = len(request.prompt) + request.max_new_tokens
+        if total > self.max_model_len:
+            raise ValueError(
+                f"request {request.rid}: prompt {len(request.prompt)} + "
+                f"max_new_tokens {request.max_new_tokens} exceeds "
+                f"max_model_len {self.max_model_len}")
+        # worst-case lifetime block need: admission reserves the padded
+        # prompt, decode grows to `total`, and a preemption at
+        # max_new - 1 folds the generation back into a prompt padded up
+        # to a chunk multiple again. A request whose worst case exceeds
+        # the WHOLE pool can never run — admit() would park it at the
+        # queue head forever (or a lone decode slot would preempt
+        # itself in a loop), so reject at submit instead of livelocking.
+        worst = max(self.padded_prompt_len(request), total,
+                    -(-(total - 1) // self.prefill_chunk)
+                    * self.prefill_chunk)
+        need = self.blocks.blocks_for(worst)
+        capacity = self.blocks.num_blocks - 1
+        if need > capacity:
+            raise ValueError(
+                f"request {request.rid} can need {need} KV blocks "
+                f"(context {worst} at block_size "
+                f"{self.blocks.block_size}) but the pool only holds "
+                f"{capacity}: grow num_blocks or shrink the request")
+        self.waiting.append(request)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(not s.free for s in self.slots)
+
+    # -- admission -----------------------------------------------------------
+
+    def padded_prompt_len(self, request: Request) -> int:
+        """Prompt width after right-padding to a prefill-chunk multiple
+        (the engine's prefill dispatch is one static chunk shape)."""
+        p = len(request.prompt)
+        return p + (-p % self.prefill_chunk)
+
+    def admit(self) -> list[Slot]:
+        """Move waiting requests into free slots while block capacity
+        for their (padded) prompt holds. Admission reserves the FULL
+        padded-prompt block span up front so prefill can never die
+        mid-prompt; the pad tail's blocks are trimmed back at prefill
+        completion. Returns the slots admitted this call."""
+        admitted = []
+        for slot in self.slots:
+            if not self.waiting:
+                break
+            if not slot.free:
+                continue
+            req = self.waiting[0]
+            need = self.blocks.blocks_for(self.padded_prompt_len(req))
+            if not self.blocks.can_allocate(need):
+                break                       # FIFO: no queue-jumping
+            self.waiting.pop(0)
+            slot.request = req
+            slot.table = self.blocks.allocate(need)
+            slot.context_len = 0
+            slot.prefill_pos = 0
+            slot.admit_seq = next(self._admit_seq)
+            req.state = PREFILL
+            admitted.append(slot)
+        return admitted
+
+    # -- prefill -------------------------------------------------------------
+
+    def next_prefill_slot(self) -> Optional[Slot]:
+        """Round-robin over slots in PREFILL state (one chunk per engine
+        iteration keeps prefill from starving in-flight decode — the
+        chunked-prefill interleaving of Sarathi/Agrawal et al. 2023)."""
+        n = len(self.slots)
+        for k in range(n):
+            slot = self.slots[(self._prefill_rr + k) % n]
+            if slot.request is not None and slot.request.state == PREFILL:
+                self._prefill_rr = (slot.index + 1) % n
+                return slot
+        return None
+
+    def finish_prefill(self, slot: Slot) -> None:
+        """Prefill consumed the whole padded prompt: context becomes the
+        REAL prompt length, pad-tail blocks return to the pool, and the
+        slot starts decoding."""
+        req = slot.request
+        slot.context_len = len(req.prompt)
+        self.blocks.trim(slot.table, slot.context_len)
+        req.state = DECODE
+
+    # -- decode-side capacity ------------------------------------------------
+
+    def decode_slots(self) -> list[Slot]:
+        return [s for s in self.slots
+                if s.request is not None and s.request.state == DECODE]
+
+    def ensure_decode_capacity(self) -> list[Request]:
+        """Guarantee every DECODE slot owns a block for its next token,
+        preempting youngest-first when the pool runs dry. Returns the
+        requests preempted this call. Termination: each preemption
+        frees ≥ 1 block and empties a slot, and a lone decode slot can
+        always be satisfied by the blocks everyone else released."""
+        preempted = []
+        while True:
+            ds = self.decode_slots()
+            if not ds:
+                return preempted
+            short = [s for s in ds
+                     if self.blocks.blocks_for(s.context_len + 1)
+                     > len(s.table)]
+            try:
+                for slot in short:
+                    self.blocks.grow(slot.table, slot.context_len + 1)
+                return preempted
+            except PoolExhausted:
+                victim = max(ds, key=lambda s: s.admit_seq)
+                victim_req = victim.request
+                self.preempt(victim)
+                preempted.append(victim_req)
+
+    def preempt(self, slot: Slot) -> None:
+        """Recompute-style preemption: release everything, fold the
+        generated tokens into the prompt, rejoin the queue FRONT (it
+        keeps its place — preemption must not reorder FIFO service)."""
+        req = slot.request
+        req.prompt = np.concatenate(
+            [req.prompt, np.asarray(req.output, np.int32)])
+        req.output = []
+        req.state = WAITING
+        req.preemptions += 1
+        self.n_preemptions += 1
+        self.blocks.free(slot.table)
+        slot.clear()
+        self.waiting.insert(0, req)
+
+    def finish(self, slot: Slot) -> Request:
+        req = slot.request
+        req.state = FINISHED
+        self.blocks.free(slot.table)
+        slot.clear()
+        return req

@@ -914,6 +914,31 @@ class Trainer:
                     if stats is not None:
                         obs.scalar("train/step_time_hosts_mean",
                                    stats["mean"], epoch, args=stats)
+                from huggingface_sagemaker_tensorflow_distributed_tpu.obs.watchdog import (
+                    compile_budget_env,
+                )
+                if (compile_budget_env() is not None
+                        and jax.process_count() > 1
+                        and not obs.compile_budget_agreed()):
+                    # multi-host ladder capping (ROADMAP): the budget is
+                    # crossed at a host-local instant, so the crossing
+                    # is AGREED at the epoch boundary — a collective
+                    # whose guard (env-driven budget, process_count,
+                    # the collectively-latched agreed flag) is
+                    # identical on every host. Once latched, every
+                    # host's bucket ladder stops minting new widths
+                    # from the same step.
+                    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.distributed import (
+                        agree_compile_budget_crossed,
+                    )
+                    if agree_compile_budget_crossed(
+                            obs.compile_budget_exceeded()):
+                        obs.set_compile_budget_agreed()
+                        logger.info(
+                            "compile budget crossing agreed across %d "
+                            "hosts at epoch %d: bucket ladders stop "
+                            "minting new widths", jax.process_count(),
+                            epoch)
                 stop_early = False
                 if eval_batcher is not None:
                     res = self.evaluate(eval_batcher)

@@ -1,0 +1,157 @@
+"""Serving entry point: drive the continuous-batching engine
+(``serve/engine.py``) over a request trace and report per-request
+latency + aggregate throughput.
+
+Requests come from ``--input_file`` (JSONL, one
+``{"prompt_ids": [...], "max_new_tokens": N}`` per line) or a synthetic
+mixed-length trace (default — the zero-egress smoke path). The model is
+a randomly-initialized GPT-2 shape by default (``--model_dir`` loads an
+exported causal-lm checkpoint the way ``scripts/predict.py`` does).
+
+  # synthetic trace on the smoke model, engine knobs explicit
+  python scripts/serve.py --requests 32 --num_slots 8 --block_size 16 \
+      --prefill_chunk 16
+
+  # real checkpoint
+  python scripts/serve.py --model_dir /path/to/export \
+      --input_file requests.jsonl
+
+One JSON line per finished request (ids, TTFT, decode tokens/sec), then
+one summary line (aggregate tokens/sec, TTFT percentiles, KV-pool peak
+utilization, preemptions). With ``HSTD_TELEMETRY_DIR`` set, the engine
+additionally streams ``serve`` lifecycle events + spans through ``obs``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def load_model(args):
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+
+    if args.model_dir:
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models import (
+            auto as auto_models,
+        )
+        model, params, _family, _config = auto_models.from_pretrained(
+            args.model_dir, task="causal-lm")
+        return model, params
+    cfg = Gpt2Config(vocab_size=1024, hidden_size=256, num_layers=4,
+                     num_heads=4, intermediate_size=1024,
+                     max_position_embeddings=512, hidden_dropout=0.0,
+                     embd_dropout=0.0, attention_dropout=0.0,
+                     eos_token_id=1023, pad_token_id=0,
+                     dtype=jnp.float32)
+    model = Gpt2LMHeadModel(cfg)
+    return model, init_params(model, cfg, seed=0)
+
+
+def load_trace(args, vocab: int):
+    if args.input_file:
+        trace = []
+        with open(args.input_file, "r", encoding="utf-8") as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                row = json.loads(line)
+                trace.append((np.asarray(row["prompt_ids"], np.int32),
+                              int(row.get("max_new_tokens",
+                                          args.max_new_tokens))))
+        return trace
+    from benchmarks.serve_bench import make_trace
+
+    rng = np.random.RandomState(args.seed)
+    return make_trace(rng, args.requests, vocab, args.prompt_min,
+                      args.prompt_max, (4, max(4, args.max_new_tokens // 4)),
+                      (args.max_new_tokens // 2, args.max_new_tokens),
+                      long_every=4)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model_dir", default=None)
+    parser.add_argument("--input_file", default=None,
+                        help="JSONL of {prompt_ids, max_new_tokens}")
+    parser.add_argument("--requests", type=int, default=32,
+                        help="synthetic-trace request count")
+    parser.add_argument("--prompt_min", type=int, default=8)
+    parser.add_argument("--prompt_max", type=int, default=48)
+    parser.add_argument("--max_new_tokens", type=int, default=64)
+    parser.add_argument("--num_slots", type=int, default=8)
+    parser.add_argument("--block_size", type=int, default=16)
+    parser.add_argument("--num_blocks", type=int, default=0,
+                        help="KV pool blocks incl. the null block "
+                             "(0 = 3/4 of slots * max_model_len)")
+    parser.add_argument("--prefill_chunk", type=int, default=16)
+    parser.add_argument("--max_model_len", type=int, default=0,
+                        help="0 = model max_position_embeddings")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    obs.configure()
+    model, params = load_model(args)
+    max_len = args.max_model_len or (
+        model.config.max_position_embeddings
+        // args.block_size) * args.block_size
+    num_blocks = args.num_blocks or (
+        1 + args.num_slots * (max_len // args.block_size) * 3 // 4)
+    engine = ServeEngine(model, params, num_slots=args.num_slots,
+                         block_size=args.block_size, num_blocks=num_blocks,
+                         prefill_chunk=args.prefill_chunk,
+                         max_model_len=max_len)
+    trace = load_trace(args, model.config.vocab_size - 1)
+    engine.warmup()
+    reqs = [engine.submit(p, m) for p, m in trace]
+    t0 = time.perf_counter()
+    engine.run()
+    wall = time.perf_counter() - t0
+
+    total = 0
+    for req in reqs:
+        ids = engine.output_ids(req)
+        total += len(ids)
+        print(json.dumps({
+            "request": req.rid, "prompt_len": req.orig_prompt_len,
+            "output_ids": [int(t) for t in ids],
+            "ttft_s": round(req.ttft_s, 4) if req.ttft_s else None,
+            "preemptions": req.preemptions}))
+    stats = engine.stats()
+    ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+    print(json.dumps({
+        "summary": True,
+        "requests": len(reqs),
+        "tokens": total,
+        "tokens_per_sec": round(total / wall, 1),
+        "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4),
+        "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 4),
+        "decode_steps": stats.decode_steps,
+        "prefill_chunks": stats.prefill_chunks,
+        "preemptions": stats.preemptions,
+        "kv_peak_utilization": round(stats.kv_peak_utilization, 3)}))
+    obs.flush()
+
+
+if __name__ == "__main__":
+    main()

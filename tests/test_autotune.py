@@ -306,3 +306,49 @@ def test_bucket_ladder_capped_when_over_budget(obs_dir):
     # a FRESH batcher (no used widths yet) must fall back to full width
     # for both batches instead of minting 16 then 48
     assert widths() == [64, 64]
+
+
+def test_bucket_ladder_multihost_caps_only_on_agreement(obs_dir):
+    """Multi-host ladder capping (ROADMAP leftover from PR 2): a
+    process_count > 1 batcher must IGNORE the host-local budget latch —
+    the budget crosses at a host-local instant, and bucket widths
+    derive from shared state, so one host capping alone would ship
+    mismatched shapes into collectives. It caps only once the trainer's
+    epoch-boundary collective (``agree_compile_budget_crossed``) has
+    latched the agreed flag on every host together."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+        MeshConfig,
+        build_mesh,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.distributed import (
+        agree_compile_budget_crossed,
+    )
+
+    n, width = 16, 64
+    ids = np.zeros((n, width), np.int32)
+    mask = np.zeros((n, width), np.int32)
+    for i in range(n):
+        L = 10 if i < 8 else 40
+        ids[i, :L] = 7
+        mask[i, :L] = 1
+    ds = ArrayDataset({"input_ids": ids, "attention_mask": mask,
+                       "labels": np.zeros(n, np.int32)})
+    mesh = build_mesh(MeshConfig())
+
+    def widths():
+        b = ShardedBatcher(ds, 8, mesh, shuffle=False,
+                           bucket_sizes=[16, 32, 48, 64],
+                           process_index=0, process_count=2)
+        return [batch["input_ids"].shape[1] for batch in b.local_batches(0)]
+
+    tracker = obs.compile_tracker()
+    tracker.budget_s = 0.1
+    tracker.observe("backend_compile_time", 1.0)   # local crossing only
+    assert obs.compile_budget_exceeded()
+    assert not obs.compile_budget_capped(2)
+    assert widths() == [16, 48]                    # still minting
+    # the epoch-boundary agreement (single-process: trivially local)
+    assert agree_compile_budget_crossed(obs.compile_budget_exceeded())
+    obs.set_compile_budget_agreed()
+    assert obs.compile_budget_capped(2)
+    assert widths() == [64, 64]                    # capped, all hosts alike

@@ -1,0 +1,387 @@
+"""serve/ subsystem: block-manager accounting, iteration-level
+scheduler policy, and the engine exactness gate — continuous-batched
+greedy decode must be token-for-token identical to per-request
+``generate_causal`` (with and without preemption), for both the GPT-2
+and Llama/GQA cache layouts."""
+
+import numpy as np
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (
+    BlockManager,
+    PoolExhausted,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (
+    DECODE,
+    PREFILL,
+    WAITING,
+    Request,
+    Scheduler,
+)
+
+
+# -- block manager (pure host) -----------------------------------------------
+
+def test_block_alloc_free_roundtrip():
+    bm = BlockManager(num_blocks=9, block_size=4)
+    assert bm.num_free == 8                        # block 0 reserved
+    got = bm.allocate(3)
+    assert len(got) == 3 and 0 not in got
+    assert bm.num_used == 3 and bm.peak_used == 3
+    bm.free(got)
+    assert bm.num_free == 8 and bm.peak_used == 3  # peak latches
+    with pytest.raises(ValueError):
+        bm.free([got[0], got[0]])                  # double free
+    with pytest.raises(ValueError):
+        bm.free([0])                               # the null block
+
+
+def test_pool_exhausted_is_all_or_nothing():
+    bm = BlockManager(num_blocks=5, block_size=4)
+    bm.allocate(2)
+    with pytest.raises(PoolExhausted):
+        bm.allocate(3)
+    assert bm.num_free == 2                        # nothing leaked
+
+
+def test_grow_and_trim_follow_context():
+    bm = BlockManager(num_blocks=9, block_size=4)
+    table = []
+    assert len(bm.grow(table, 1)) == 1             # 1 token -> 1 block
+    assert bm.grow(table, 4) == []                 # still fits
+    assert len(bm.grow(table, 5)) == 1             # crosses the boundary
+    assert len(table) == 2
+    bm.trim(table, 3)                              # back to 1 block
+    assert len(table) == 1 and bm.num_free == 7
+
+
+def test_fragmentation_is_last_block_padding():
+    bm = BlockManager(num_blocks=9, block_size=4)
+    # contexts 5 and 8: held slots 8 + 8, used 13 -> 3/16 wasted
+    assert bm.fragmentation([5, 8]) == pytest.approx(3 / 16)
+    assert bm.fragmentation([]) == 0.0
+
+
+# -- scheduler (pure host) ---------------------------------------------------
+
+def _sched(num_slots=2, num_blocks=9, block_size=4, chunk=4, max_len=32):
+    return Scheduler(num_slots, BlockManager(num_blocks, block_size),
+                     chunk, max_len)
+
+
+def test_admission_is_fifo_into_free_slots():
+    s = _sched()
+    reqs = [Request(prompt=np.arange(1, 4), max_new_tokens=4)
+            for _ in range(3)]
+    for r in reqs:
+        s.submit(r)
+    admitted = s.admit()
+    assert [sl.request.rid for sl in admitted] == [reqs[0].rid, reqs[1].rid]
+    assert reqs[0].state == PREFILL and reqs[2].state == WAITING
+    # padded-prompt reservation: 3 tokens pad to chunk 4 -> 1 block each
+    assert s.blocks.num_used == 2
+    assert s.admit() == []                         # no free slot
+
+
+def test_admission_respects_pool_capacity():
+    s = _sched(num_slots=2, num_blocks=4)          # 3 allocatable blocks
+    a = Request(prompt=np.arange(1, 9), max_new_tokens=4)   # pad 8 -> 2 blocks
+    b = Request(prompt=np.arange(1, 9), max_new_tokens=4)
+    s.submit(a)
+    s.submit(b)
+    assert [sl.request.rid for sl in s.admit()] == [a.rid]
+    assert b.state == WAITING                      # FIFO: b never jumps
+
+
+def test_submit_rejects_over_length_requests():
+    s = _sched(max_len=16)
+    with pytest.raises(ValueError):
+        s.submit(Request(prompt=np.arange(1, 14), max_new_tokens=8))
+
+
+def test_submit_rejects_requests_that_can_never_fit_the_pool():
+    """A request whose worst-case block need exceeds the WHOLE pool
+    would otherwise livelock the engine: admit() parks it at the queue
+    head forever (or a lone decode slot preempts itself in a loop)."""
+    s = _sched(num_slots=1, num_blocks=4, block_size=4, max_len=32)
+    with pytest.raises(ValueError, match="KV blocks"):
+        s.submit(Request(prompt=np.arange(1, 9), max_new_tokens=12))
+    # exactly at capacity is fine (3 blocks hold 12 tokens lifetime)
+    s.submit(Request(prompt=np.arange(1, 9), max_new_tokens=4))
+
+
+def test_scheduler_rejects_chunk_not_dividing_max_model_len():
+    """padded_prompt_len must never exceed max_model_len (block tables
+    are sized for it) — enforced by requiring the chunk to divide it."""
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        _sched(chunk=48, max_len=64)
+
+
+def test_preemption_evicts_youngest_and_requeues_front():
+    s = _sched(num_slots=2, num_blocks=6, block_size=4, chunk=4)
+    old = Request(prompt=np.arange(1, 5), max_new_tokens=16)
+    young = Request(prompt=np.arange(1, 5), max_new_tokens=16)
+    s.submit(old)
+    s.submit(young)
+    s.admit()
+    for slot in s.slots:                            # fake finished prefill
+        s.finish_prefill(slot)
+        slot.request.output = [7, 8]
+        slot.context_len = 6
+    # 4 allocatable blocks, both slots at 2 blocks each once they cross
+    # context 8; growing both is impossible -> youngest goes
+    s.slots[0].context_len = s.slots[1].context_len = 8
+    preempted = s.ensure_decode_capacity()
+    assert [r.rid for r in preempted] == [young.rid]
+    assert young.state == WAITING and s.waiting[0] is young
+    # recompute style: generated tokens folded into the prompt
+    assert list(young.prompt) == [1, 2, 3, 4, 7, 8]
+    assert young.output == [] and young.preemptions == 1
+    assert old.state == DECODE                     # survivor kept its slot
+
+
+# -- paged addressing primitives (ops/attention.py) --------------------------
+
+def test_paged_attention_matches_contiguous():
+    """gather/scatter round-trip + paged_attention == xla_attention over
+    the same contiguous KV — the addressing contract the engine's
+    cache-assembly path is built on."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        gather_paged_kv,
+        paged_attention,
+        scatter_paged_kv,
+        xla_attention,
+    )
+
+    rng = np.random.RandomState(0)
+    S, H, D, bs, nb_per = 3, 2, 4, 4, 3          # max_ctx = 12
+    max_ctx = bs * nb_per
+    ctx = np.array([5, 12, 1], np.int32)
+    k_ref = rng.randn(S, H, max_ctx, D).astype(np.float32)
+    v_ref = rng.randn(S, H, max_ctx, D).astype(np.float32)
+    # scatter each slot's context token-by-token into a shared pool
+    # through shuffled per-slot block tables (block 0 reserved null)
+    pool_k = jnp.zeros((1 + S * nb_per, bs, H, D), jnp.float32)
+    pool_v = jnp.zeros_like(pool_k)
+    ids = rng.permutation(np.arange(1, 1 + S * nb_per))
+    tables = ids.reshape(S, nb_per).astype(np.int32)
+    for s in range(S):
+        for p in range(int(ctx[s])):
+            row = jnp.asarray(tables[s:s + 1])
+            pos = jnp.asarray([p], jnp.int32)
+            pool_k = scatter_paged_kv(pool_k, row, pos,
+                                      jnp.asarray(k_ref[s:s + 1, :, p]))
+            pool_v = scatter_paged_kv(pool_v, row, pos,
+                                      jnp.asarray(v_ref[s:s + 1, :, p]))
+    gk = np.asarray(gather_paged_kv(pool_k, jnp.asarray(tables)))
+    for s in range(S):
+        np.testing.assert_array_equal(gk[s, :, :ctx[s]], k_ref[s, :, :ctx[s]])
+    q = jnp.asarray(rng.randn(S, H, D).astype(np.float32))
+    got = paged_attention(q, pool_k, pool_v, jnp.asarray(tables),
+                          jnp.asarray(ctx))
+    valid = np.arange(max_ctx)[None, :] < ctx[:, None]
+    mask = jnp.asarray(np.where(valid, 0.0, -1e9)[:, None, None, :],
+                       jnp.float32)
+    want = xla_attention(q[:, :, None, :], jnp.asarray(k_ref * valid[:, None, :, None]),
+                         jnp.asarray(v_ref * valid[:, None, :, None]),
+                         mask=mask)[:, :, 0, :]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+# -- engine exactness (the gate) ---------------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+
+    cfg = Gpt2Config(vocab_size=128, hidden_size=32, num_layers=2,
+                     num_heads=2, intermediate_size=64,
+                     max_position_embeddings=128, hidden_dropout=0.0,
+                     embd_dropout=0.0, attention_dropout=0.0,
+                     eos_token_id=127, pad_token_id=0, dtype=jnp.float32)
+    model = Gpt2LMHeadModel(cfg)
+    return cfg, model, init_params(model, cfg, seed=0)
+
+
+def _reference(model, params, prompt, max_new, eos):
+    """Per-request generate_causal greedy, trimmed EOS-inclusive."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        generate_causal,
+    )
+
+    ref = list(np.asarray(generate_causal(
+        model, params, jnp.asarray(prompt)[None], max_new_tokens=max_new))[0])
+    if eos in ref:
+        ref = ref[:ref.index(eos) + 1]
+    return [int(t) for t in ref]
+
+
+def _assert_engine_exact(model, params, trace, eos, **engine_kw):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    eng = ServeEngine(model, params, **engine_kw)
+    reqs = [eng.submit(p, m) for p, m in trace]
+    eng.run()
+    for (prompt, max_new), req in zip(trace, reqs):
+        got = [int(t) for t in eng.output_ids(req)]
+        assert got == _reference(model, params, prompt, max_new, eos), \
+            f"request {req.rid} diverged (preemptions={req.preemptions})"
+    return eng
+
+
+def test_engine_matches_generate_causal_mixed_lengths(gpt2_setup):
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(0)
+    # few DISTINCT prompt lengths: every length is a fresh XLA program
+    # on the reference side, and the gate is semantics, not compile time
+    trace = [(rng.randint(1, 120, (p,)).astype(np.int32), m)
+             for p, m in [(5, 7), (9, 3), (12, 10), (5, 1), (9, 8)]]
+    eng = _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                               num_slots=3, block_size=4, num_blocks=40,
+                               prefill_chunk=8, max_model_len=64)
+    assert eng.stats().preemptions == 0
+    assert eng.stats().tokens_generated == sum(
+        len(eng.output_ids(r)) for r in eng.finished.values())
+
+
+def test_engine_exact_under_preemption(gpt2_setup):
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(1)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 18)
+             for _ in range(5)]
+    # 9 allocatable blocks of 4 = 36 resident tokens for 5 requests
+    # that each want 27: preemption is forced
+    eng = _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                               num_slots=4, block_size=4, num_blocks=10,
+                               prefill_chunk=8, max_model_len=32)
+    assert eng.stats().preemptions > 0
+
+
+def test_engine_stops_at_eos_exactly(gpt2_setup):
+    import dataclasses
+
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(1, 120, (9,)).astype(np.int32)
+    # pick the reference's 3rd greedy token as EOS so the engine must
+    # stop early, then rebuild the model around that id
+    ref = _reference(model, params, prompt, 12, eos=-1)
+    eos_cfg = dataclasses.replace(cfg, eos_token_id=int(ref[2]))
+    eos_model = type(model)(eos_cfg)
+    _assert_engine_exact(eos_model, params, [(prompt, 12)],
+                         eos_cfg.eos_token_id, num_slots=2, block_size=4,
+                         num_blocks=20, prefill_chunk=8, max_model_len=64)
+
+
+def test_engine_exact_llama_gqa():
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_position_embeddings=128, eos_token_id=127,
+                      pad_token_id=0, dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    params = init_params(model, cfg, seed=0)
+    rng = np.random.RandomState(3)
+    trace = [(rng.randint(3, 120, (p,)).astype(np.int32), m)
+             for p, m in [(6, 6), (11, 9), (6, 4)]]
+    _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                         num_slots=2, block_size=8, num_blocks=20,
+                         prefill_chunk=8, max_model_len=64)
+
+
+def test_engine_rejects_unsupported_configs(gpt2_setup):
+    import dataclasses
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    cfg, model, params = gpt2_setup
+    int8 = type(model)(dataclasses.replace(cfg, kv_cache_dtype="int8"))
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        ServeEngine(int8, params, num_blocks=4)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        ServeEngine(model, params, num_blocks=4, max_model_len=1024)
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_engine_emits_valid_serve_events(gpt2_setup, tmp_path):
+    cfg, model, params = gpt2_setup
+    out = tmp_path / "telemetry"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        rng = np.random.RandomState(4)
+        trace = [(rng.randint(1, 120, (5,)).astype(np.int32), 7)
+                 for _ in range(3)]
+        _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                             num_slots=2, block_size=4, num_blocks=20,
+                             prefill_chunk=8, max_model_len=64)
+        obs.flush()
+    finally:
+        obs.reset()
+    events = [e for _, e, err in obs.iter_events(str(out / "events.jsonl"))
+              if err is None]
+    serve_ev = [e for e in events if e["type"] == "serve"]
+    kinds = {e["event"] for e in serve_ev}
+    assert {"submit", "admit", "first_token", "finish"} <= kinds
+    finishes = [e for e in serve_ev if e["event"] == "finish"]
+    assert len(finishes) == 3 and all("request" in e for e in finishes)
+    ttfts = [e for e in serve_ev if e["event"] == "first_token"]
+    assert all(e.get("ttft_s", 0) > 0 for e in ttfts)
+    count, errors = obs.validate_events_file(str(out / "events.jsonl"))
+    assert not errors and count >= len(events)
+
+
+def test_generate_causal_decode_phase_split_telemetry(gpt2_setup, tmp_path):
+    """ROADMAP "Decode-phase split": the one-shot path now reports TTFT
+    and decode tokens/sec as separate series, with prefill and decode
+    visible as separate spans."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+        generate_causal,
+    )
+
+    cfg, model, params = gpt2_setup
+    out = tmp_path / "telemetry"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        prompt = np.random.RandomState(5).randint(1, 120, (1, 6))
+        generate_causal(model, params, jnp.asarray(prompt),
+                        max_new_tokens=4)
+        obs.flush()
+    finally:
+        obs.reset()
+    events = [e for _, e, err in obs.iter_events(str(out / "events.jsonl"))
+              if err is None]
+    metrics = {e["name"] for e in events if e["type"] == "metric"}
+    assert "generate/causal_ttft_s" in metrics
+    assert "generate/causal_decode_tokens_per_sec" in metrics
+    spans = {e["name"] for e in events if e["type"] == "span"}
+    assert {"generate/causal_prefill", "generate/causal_decode"} <= spans

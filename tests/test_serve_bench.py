@@ -1,0 +1,32 @@
+"""Tier-1 smoke of ``bench.py --serve`` (benchmarks/serve_bench.py):
+the CPU gate runs the real measured body at smoke scale and pins the
+structural guarantees — greedy exactness vs the static baseline and
+ZERO new compiles across the measured (post-warmup) serving run. The
+≥2x speedup acceptance is measured by the full ``bench.py --serve``
+trace, not here: at smoke scale dispatch overhead dominates and the
+ratio is noise."""
+
+import json
+
+from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+
+def test_serve_bench_smoke(capsys, tmp_path):
+    from benchmarks.serve_bench import bench_serve
+
+    obs.reset(out_dir=str(tmp_path / "telemetry"), enabled=True)
+    try:
+        result = bench_serve(smoke=True)
+    finally:
+        obs.reset()
+    detail = result["detail"]
+    assert detail["exact_match"] is True
+    assert detail["compiles_steady"] == 0
+    assert result["value"] > 0 and detail["tokens"] > 0
+    assert detail["ttft_p99_s"] >= detail["ttft_p50_s"] > 0
+    assert 0 < detail["kv_peak_utilization"] <= 1
+    # the stdout line is the driver contract: one parseable JSON line
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    parsed = json.loads(lines[-1])
+    assert parsed["metric"] == "serve_continuous_vs_static_speedup"
