@@ -67,7 +67,13 @@ Extra modes (each also prints one JSON line per run):
 Every metric line additionally carries a ``memory`` watermark field on
 accelerator backends (peak_bytes_in_use vs bytes_limit, ROADMAP "Memory
 watermarks") so HBM-spill regressions surface next to the throughput
-they cost.
+they cost, plus an ``anomalies`` count from the run's anomaly detector
+(``obs/anomaly.py``; zero on healthy runs). MFU rides on every training
+line — on TPU from the peak table, elsewhere only under an explicit
+``HSTD_PEAK_TFLOPS`` override. A measured body whose training loss went
+non-finite exits ``ANOMALY_RC`` (3) AFTER printing its lines — the one
+deliberate exception to the rc-0 contract, so CI catches silent
+divergence (infra failures still exit 0 with structured error lines).
 
 Results across rounds are recorded in BENCH_EXTRA.md.
 """
@@ -92,41 +98,37 @@ V100_BERT_LARGE_SAMPLES_PER_SEC = 8.0
 BERT_LARGE = dict(hidden_size=1024, num_layers=24, num_heads=16,
                   intermediate_size=4096)
 
-# bf16 peak matmul TFLOP/s per chip, by jax device_kind substring
-# (public spec-sheet numbers; lowercase substring → peak).
-_TPU_PEAK_TFLOPS = (
-    ("v6", 918.0),        # v6e / Trillium
-    ("v5p", 459.0),
-    ("v5 lite", 197.0),   # v5e reports device_kind "TPU v5 lite"
-    ("v5e", 197.0),
-    ("v5", 459.0),        # bare "v5" after the lite variants: v5p
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 46.0),
-)
-
 
 def chip_peak_tflops(device_kind: str) -> float | None:
-    low = device_kind.lower()
-    for marker, peak in _TPU_PEAK_TFLOPS:
-        if marker in low:
-            return peak
-    return None
+    """Peak bf16 TFLOP/s for the chip — one source of truth in
+    ``obs/flops.py`` (device_kind table + ``HSTD_PEAK_TFLOPS`` env
+    override for chips the table doesn't know, CPU included)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.flops import (
+        peak_tflops,
+    )
+
+    return peak_tflops(device_kind)
 
 
 def train_flops_per_sample(seq_len: int, hidden_size: int = 768,
                            num_layers: int = 12,
                            intermediate_size: int = 3072) -> float:
     """Analytic matmul FLOPs for ONE training sample (fwd+bwd) of a
-    BERT-family encoder — the model-FLOPs convention (3× forward; remat
-    recompute excluded; embedding lookups / layernorms / softmax
-    excluded, ~2% of the total at these shapes)."""
-    h, ffn = hidden_size, intermediate_size
-    qkvo = 4 * 2 * h * h                # per token per layer
-    ffn_flops = 2 * 2 * h * ffn         # per token per layer
-    attn = 2 * 2 * seq_len * h          # QK^T + PV, per token per layer
-    fwd = seq_len * num_layers * (qkvo + ffn_flops + attn)
-    return 3.0 * fwd
+    BERT-family encoder. Delegates to the ONE FLOPs convention in
+    ``obs/flops.py`` (3× forward; remat recompute excluded; embedding
+    lookups / layernorms / softmax excluded, ~2% at these shapes) so
+    bench-line MFU and trainer-history MFU can never drift."""
+    import types
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.flops import (
+        train_flops_per_token,
+    )
+
+    cfg = types.SimpleNamespace(hidden_size=hidden_size,
+                                num_layers=num_layers,
+                                intermediate_size=intermediate_size,
+                                vocab_size=0)
+    return seq_len * train_flops_per_token(cfg, "seq-cls", seq_len)
 
 
 def build_harness(model_kwargs: dict, per_chip_batch: int, seq_len: int = 512,
@@ -210,18 +212,30 @@ def run_finetune(model_kwargs: dict, per_chip_batch: int,
 
 def _flops_detail(samples_per_sec_per_chip: float,
                   flops_per_sample: float) -> dict:
-    """TFLOP/s/chip + MFU fields for an emit line (TPU only; MFU is null
-    when the chip generation is unrecognized)."""
+    """TFLOP/s/chip + MFU fields for an emit line. MFU is null when the
+    chip's peak is unknown; on CPU the ``HSTD_PEAK_TFLOPS`` override is
+    the only way to get one (the obsctl acceptance path uses it)."""
     import jax
 
     achieved = samples_per_sec_per_chip * flops_per_sample / 1e12
     peak = chip_peak_tflops(jax.devices()[0].device_kind)
     return {
         "model_tflops_per_sample": round(flops_per_sample / 1e12, 4),
-        "achieved_tflops_per_chip": round(achieved, 1),
+        "achieved_tflops_per_chip": round(achieved, 4),
         "chip_peak_tflops": peak,
-        "mfu": round(achieved / peak, 3) if peak else None,
+        "mfu": round(achieved / peak, 6) if peak else None,
     }
+
+
+def _flops_reportable() -> bool:
+    """Should a metric line carry FLOPs/MFU fields? Always on TPU;
+    elsewhere only under an explicit ``HSTD_PEAK_TFLOPS`` (a guessed
+    CPU peak would make MFU noise, not a metric)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.flops import (
+        env_peak_tflops,
+    )
+
+    return _on_tpu() or env_peak_tflops() is not None
 
 
 def memory_watermark() -> dict | None:
@@ -258,6 +272,17 @@ def memory_watermark() -> dict | None:
     return out
 
 
+def anomaly_field() -> dict:
+    """The ``anomalies`` field every metric line carries: total count +
+    per-kind breakdown from the live detector (zero/empty on healthy
+    runs — which is what CI greps for)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+    counts = obs.anomaly_counts()
+    return {"anomalies": sum(counts.values()), **(
+        {"anomaly_kinds": counts} if counts else {})}
+
+
 def emit(metric: str, value: float, baseline: float,
          flops_per_sample: float | None = None, **extra) -> None:
     line = {
@@ -266,8 +291,9 @@ def emit(metric: str, value: float, baseline: float,
         "unit": "samples/sec/chip",
         "vs_baseline": round(value / baseline, 3),
     }
-    if flops_per_sample is not None and _on_tpu():
+    if flops_per_sample is not None and _flops_reportable():
         line.update(_flops_detail(value, flops_per_sample))
+    line.update(anomaly_field())
     mem = memory_watermark()
     if mem is not None:
         # every stage line carries the watermark: a spill regression
@@ -378,6 +404,9 @@ PROBE_RETRY_WAIT_S = int(os.environ.get("BENCH_PROBE_RETRY_WAIT", "5"))
 PROBE_RETRY_CAP_S = int(os.environ.get("BENCH_PROBE_RETRY_CAP", "60"))
 CHILD_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT", "1800"))
 PARITY_TIMEOUT_S = int(os.environ.get("BENCH_PARITY_TIMEOUT", "600"))
+# exit code reserved for "measured fine but the run diverged" (NaN-loss
+# anomaly): the child returns it, the supervisor propagates it
+ANOMALY_RC = 3
 
 _PROBE_CODE = (
     "import json, jax; d = jax.devices(); "
@@ -583,6 +612,17 @@ def supervise(args: argparse.Namespace) -> None:
                           "backend": info,
                           "partial_stdout": partial[-500:]})
         return
+    if proc.returncode == ANOMALY_RC:
+        # NaN-loss contract: the child measured and emitted real lines
+        # (each carrying the anomalies field) but the run diverged —
+        # forward the lines verbatim and PROPAGATE the nonzero exit so
+        # CI catches silent divergence. Infra failures below keep the
+        # rc-0 structured-error contract; divergence is a result, not
+        # an infra failure.
+        sys.stdout.write(proc.stdout)
+        sys.stdout.flush()
+        print("[bench] NaN-loss anomaly: exiting nonzero", file=sys.stderr)
+        sys.exit(ANOMALY_RC)
     if proc.returncode != 0:
         _forward_partial(metrics, proc.stdout, "bench_failed",
                          {"rc": proc.returncode, "backend": info,
@@ -676,6 +716,24 @@ def _install_child_budget(args: argparse.Namespace) -> None:
         signal.alarm(max(int(budget_s) - 5, 1))
 
 
+def _check_divergence_exit() -> None:
+    """NaN-loss gate (CI contract): a measured body whose training loss
+    went non-finite exits ``ANOMALY_RC`` AFTER its metric lines are on
+    stdout — silent divergence must not look like a healthy bench."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+    counts = obs.anomaly_counts()
+    if counts.get("nan_loss") or counts.get("nan_grad"):
+        print(f"[bench] divergence anomalies detected: {counts} — "
+              "exiting nonzero", file=sys.stderr)
+        try:
+            obs.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        sys.stdout.flush()
+        sys.exit(ANOMALY_RC)
+
+
 def _run_child(args: argparse.Namespace) -> None:
     _setup_child_telemetry()
     _install_child_budget(args)
@@ -717,6 +775,7 @@ def _run_child(args: argparse.Namespace) -> None:
         bench_headline(per_chip_batch=args.batch,
                        opt_state_bf16=args.opt_state_bf16,
                        remat_policy=args.remat_policy)
+    _check_divergence_exit()
 
 
 def main() -> None:

@@ -5,7 +5,12 @@ Runs the same workload twice through the real ``Trainer.fit`` loop —
 standard full-logits loss vs the fused vocab-CE path — and emits the
 fused samples/s/chip with ``vs_baseline`` = fused ÷ unfused. Off-TPU
 both runs shrink to smoke size and the fused path is forced into
-interpret mode so the kernel code itself is exercised."""
+interpret mode so the kernel code itself is exercised.
+
+The line carries the fused pass's ``mfu`` + ``achieved_tflops_per_chip``
+straight from the trainer's own accounting (``obs/flops.py`` analytic
+FLOPs × REAL token counts — so it exists on CPU too under an
+``HSTD_PEAK_TFLOPS`` override) and the run's ``anomalies`` count."""
 
 from __future__ import annotations
 
@@ -72,16 +77,23 @@ def run_fused_vs_unfused(task: str, metric: str, tpu_scale_label: str,
         ds = make_dataset(tok, texts, seq_len)
         batcher = ShardedBatcher(ds, global_batch, mesh, shuffle=False,
                                  seed=0)
-        history = trainer.fit(batcher, epochs=2)
-        return history["train_samples_per_second_per_chip"]
+        return trainer.fit(batcher, epochs=2)
 
-    unfused = one(False)
-    fused = one(True)
+    from bench import anomaly_field
+
+    unfused_hist = one(False)
+    fused_hist = one(True)
+    unfused = unfused_hist["train_samples_per_second_per_chip"]
+    fused = fused_hist["train_samples_per_second_per_chip"]
     print(json.dumps({
         "metric": metric,
         "value": round(fused, 3),
         "unit": "samples/sec/chip",
         "vs_baseline": round(fused / unfused, 3),   # fused ÷ unfused
+        "mfu": fused_hist.get("train_mfu"),
+        "achieved_tflops_per_chip":
+            fused_hist.get("train_achieved_tflops_per_chip"),
+        **anomaly_field(),
         "detail": {"unfused_samples_per_sec_per_chip": round(unfused, 3),
                    "model_scale": tpu_scale_label if on_tpu else "smoke"},
     }))

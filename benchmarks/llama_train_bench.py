@@ -27,14 +27,20 @@ import json
 def llama_train_flops_per_token(hidden: int, layers: int, heads: int,
                                 kv_heads: int, intermediate: int,
                                 vocab: int, seq_len: int) -> float:
-    """Analytic matmul FLOPs per TOKEN for one training step (3x fwd)."""
-    kv_ratio = kv_heads / heads
-    qkvo = 2 * hidden * hidden * (2 + 2 * kv_ratio)   # q,o full; k,v scaled
-    attn = 4 * seq_len * hidden                        # qk^T + pv
-    mlp = 6 * hidden * intermediate                    # gate, up, down
-    head = 2 * hidden * vocab
-    fwd = layers * (qkvo + attn + mlp) + head
-    return 3.0 * fwd
+    """Analytic matmul FLOPs per TOKEN for one training step (3x fwd).
+    Delegates to the ONE FLOPs convention in ``obs/flops.py`` (GQA
+    -scaled k/v projections, gated SwiGLU MLP, LM head per token)."""
+    import types
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.flops import (
+        train_flops_per_token,
+    )
+
+    cfg = types.SimpleNamespace(hidden_size=hidden, num_layers=layers,
+                                num_heads=heads, num_kv_heads=kv_heads,
+                                intermediate_size=intermediate,
+                                vocab_size=vocab)
+    return train_flops_per_token(cfg, "causal-lm", seq_len)
 
 
 def decoder_train_bench(metric: str, cfg, per_chip_batch: int,
@@ -45,7 +51,7 @@ def decoder_train_bench(metric: str, cfg, per_chip_batch: int,
     ``Trainer.fit`` loop, and the one-JSON-line emission contract."""
     import jax
 
-    from bench import _flops_detail, _on_tpu
+    from bench import _flops_detail, _flops_reportable, _on_tpu, anomaly_field
     from huggingface_sagemaker_tensorflow_distributed_tpu.config import (
         TrainConfig,
     )
@@ -109,8 +115,9 @@ def decoder_train_bench(metric: str, cfg, per_chip_batch: int,
         "vs_baseline": 0.0,    # no reference decoder-training anchor
         "tokens_per_sec_per_chip": round(sps * seq_len, 1),
     }
-    if on_tpu:
+    if _flops_reportable():
         line.update(_flops_detail(sps, flops_per_sample))
+    line.update(anomaly_field())
     line["detail"] = {
         "per_chip_batch": per_chip_batch, "seq_len": seq_len,
         "recipe": "bf16-adam + remat dots + fused vocab-CE + flash",

@@ -116,7 +116,9 @@ def run_engine(model, params, trace, *, num_slots: int, block_size: int,
     """Measured continuous-batching pass: engine warmup + one full
     throwaway pass (compiles everything), then the timed pass on a
     fresh engine reusing nothing but the params. Returns
-    (wall_s, outputs, tokens, ttfts, stats, compile_delta)."""
+    (wall_s, outputs, tokens, stats, compile_delta, slo_summary) —
+    TTFT/e2e latency flows exclusively through the engine's
+    ``slo_summary()`` (one percentile convention with obsctl)."""
     from huggingface_sagemaker_tensorflow_distributed_tpu import obs
     from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
         ServeEngine,
@@ -145,9 +147,8 @@ def run_engine(model, params, trace, *, num_slots: int, block_size: int,
     wall = time.perf_counter() - t0
     compile_delta = (tracker.count - count0) if tracker else None
     outs = [list(eng.output_ids(r)) for r in reqs]
-    ttfts = [r.ttft_s for r in reqs]
-    return wall, outs, sum(len(o) for o in outs), ttfts, eng.stats(), \
-        compile_delta
+    return wall, outs, sum(len(o) for o in outs), eng.stats(), \
+        compile_delta, eng.slo_summary()
 
 
 def bench_serve(smoke: bool = False) -> dict:
@@ -163,11 +164,12 @@ def bench_serve(smoke: bool = False) -> dict:
     )
 
     try:
-        from bench import _on_tpu, memory_watermark
+        from bench import _on_tpu, anomaly_field, memory_watermark
         on_tpu = _on_tpu()
     except ImportError:                     # direct module invocation
         on_tpu = False
         memory_watermark = lambda: None  # noqa: E731
+        anomaly_field = lambda: {"anomalies": 0}  # noqa: E731
 
     rng = np.random.RandomState(0)
     if smoke:
@@ -213,8 +215,8 @@ def bench_serve(smoke: bool = False) -> dict:
         s_wall, s_outs, s_tokens = run_static(model, params, trace, slots,
                                               cfg.eos_token_id)
     with obs.span("bench/serve_engine"):
-        (e_wall, e_outs, e_tokens, ttfts, stats,
-         compile_delta) = run_engine(
+        (e_wall, e_outs, e_tokens, stats,
+         compile_delta, slo) = run_engine(
             model, params, trace, num_slots=slots, block_size=block,
             num_blocks=num_blocks, prefill_chunk=chunk,
             max_model_len=max_len)
@@ -223,7 +225,6 @@ def bench_serve(smoke: bool = False) -> dict:
     static_tps = s_tokens / s_wall
     engine_tps = e_tokens / e_wall
     speedup = engine_tps / static_tps
-    ttfts = [t for t in ttfts if t is not None]
     # the structural gates are ENFORCED here, not just reported: a
     # speedup bought by changed tokens or steady-state retraces is not
     # a measurement, so the line degrades to the structured-failure
@@ -243,8 +244,17 @@ def bench_serve(smoke: bool = False) -> dict:
             "block_size": block,
             "num_blocks": num_blocks,
             "prefill_chunk": chunk,
-            "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4),
-            "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 4),
+            # TTFT/e2e latency + scheduler gauges straight from the
+            # engine's own SLO summary (the same nearest-rank figures
+            # its final `serve` report event carries), so the bench
+            # line never disagrees with obsctl on the same run
+            "ttft_p50_s": slo.get("ttft_p50_s"),
+            "ttft_p95_s": slo.get("ttft_p95_s"),
+            "ttft_p99_s": slo.get("ttft_p99_s"),
+            "e2e_p50_s": slo.get("e2e_p50_s"),
+            "e2e_p95_s": slo.get("e2e_p95_s"),
+            "e2e_p99_s": slo.get("e2e_p99_s"),
+            "peak_waiting_depth": slo.get("peak_waiting_depth"),
             "kv_peak_utilization": round(stats.kv_peak_utilization, 3),
             "preemptions": stats.preemptions,
             "decode_steps": stats.decode_steps,
@@ -256,6 +266,7 @@ def bench_serve(smoke: bool = False) -> dict:
             "speedup_measured": round(speedup, 3),
         },
     }
+    result.update(anomaly_field())
     if not gate_ok:
         result["error"] = ("engine_output_diverged" if not exact
                           else "steady_state_recompiled")

@@ -980,6 +980,25 @@ class ShardedBatcher:
         # column shardings depend only on (ndim, token dim): compute once,
         # not per column per step (mesh scans are host-side hot-path work)
         self._sharding_cache: dict[tuple, NamedSharding] = {}
+        # MFU accounting (obs/flops.py): REAL token counts served by
+        # THIS host — attention-mask nonzeros summed on the host numpy
+        # batch just before device transfer, so the figure is
+        # packing-aware by construction (pad positions never count).
+        # ``token_log`` holds one (tokens, dec_tokens) entry PER BATCH
+        # in yield order; the trainer pops one entry per dispatched
+        # step, which keeps attribution exact under prefetch/H2D
+        # lookahead (a staged-but-never-dispatched batch is cleared at
+        # the next epoch). Bounded so non-popping consumers (eval) never
+        # grow it. Counting is opt-in like every other obs cost: only
+        # when something can consume an MFU figure — telemetry
+        # configured, or a peak-FLOPs override set (the CPU bench path)
+        # — does the H2D hot path pay the mask scan.
+        from huggingface_sagemaker_tensorflow_distributed_tpu.obs.flops import (
+            env_peak_tflops,
+        )
+        self._count_tokens = obs.enabled() and (
+            obs.configured() or env_peak_tflops() is not None)
+        self.token_log: collections.deque = collections.deque(maxlen=8192)
 
     def steps_per_epoch(self) -> int:
         n = len(self.dataset)
@@ -1150,6 +1169,17 @@ class ShardedBatcher:
     def _put_batch(self, batch: dict[str, np.ndarray]) -> dict[str, jax.Array]:
         """One host batch → globally-sharded device arrays (the mesh
         helpers in ``parallel/sharding.py`` decide each column's spec)."""
+        if self._count_tokens:
+            am = batch.get("attention_mask")
+            if am is not None:
+                tok = int(np.count_nonzero(am))
+            elif "input_ids" in batch:
+                tok = int(batch["input_ids"].size)
+            else:
+                tok = 0
+            dm = batch.get("decoder_attention_mask")
+            dec = int(np.count_nonzero(dm)) if dm is not None else 0
+            self.token_log.append((tok, dec))
         with obs.span("data/host_to_device"):
             return {
                 k: jax.make_array_from_process_local_data(

@@ -10,7 +10,7 @@ call sites never thread a tracer through ten layers::
     obs.scalar("train/loss", 0.31, step=120)
     obs.heartbeat().start(); obs.pulse()        # liveness + stall dumps
 
-Environment contract (documented in README "Telemetry"):
+Environment contract (documented in README "Observability"):
 
 - ``HSTD_TELEMETRY=0`` disables everything (zero hot-loop allocations:
   ``span`` returns a shared singleton, ``scalar``/``pulse`` early-return).
@@ -24,6 +24,12 @@ Environment contract (documented in README "Telemetry"):
 Multi-host: host 0 owns the files; other hosts buffer in memory.
 ``parallel.distributed.initialize_distributed`` reports the real rank
 via :func:`set_host`.
+
+The run-level plane on top (ISSUE 4): ``obs.flops`` (analytic FLOPs →
+MFU accounting), ``obs.anomaly``/``obs.flight`` (detectors + flight
+-recorder ring + anomaly-triggered profiler windows; see
+:func:`anomalies`), and ``obs.report`` (cross-host run reports, driven
+by ``scripts/obsctl.py``).
 """
 
 from __future__ import annotations
@@ -32,6 +38,12 @@ import os
 from typing import Optional
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.obs import core as _core
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs import (  # noqa: F401
+    flops,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.anomaly import (  # noqa: F401
+    AnomalyDetector,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.obs.core import (  # noqa: F401
     ENV_DIR,
     ENV_ENABLE,
@@ -49,6 +61,10 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.obs.schema import (  # noq
     validate_events_file,
     validate_trace_file,
 )
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    ProfilerCapture,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.obs.watchdog import (  # noqa: F401
     CompileTracker,
     Heartbeat,
@@ -61,6 +77,7 @@ _state = ObsState()
 _tracer = Tracer(_state)
 _metrics = MetricsSink(_state)
 _heartbeat: Optional[Heartbeat] = None
+_detector: Optional[AnomalyDetector] = None
 
 
 def state() -> ObsState:
@@ -127,6 +144,31 @@ def serve(event: str, **fields) -> None:
     if not _state.enabled or _state.events is None:
         return
     _state.events.emit("serve", {"event": str(event), **fields})
+
+
+def anomalies() -> AnomalyDetector:
+    """The process anomaly detector (created on first use; detectors
+    read ``HSTD_ANOMALY`` / ``HSTD_ANOMALY_COOLDOWN_S`` /
+    ``HSTD_STRAGGLER_ALERT``, the evidence side reads
+    ``HSTD_FLIGHT_RING`` / ``HSTD_PROFILE_ON_ANOMALY``)."""
+    global _detector
+    if _detector is None:
+        _detector = AnomalyDetector(_state, recorder=_state.ring)
+    return _detector
+
+
+def anomaly_counts() -> dict:
+    """Per-kind anomaly counts so far ({} before any detector use)."""
+    return dict(_detector.counts) if _detector is not None else {}
+
+
+def anomaly_total() -> int:
+    return _detector.total if _detector is not None else 0
+
+
+def flight_recorder():
+    """The process flight-recorder ring (None when HSTD_FLIGHT_RING=0)."""
+    return _state.ring
 
 
 def alert(name: str, message: str, args: Optional[dict] = None) -> None:
@@ -230,10 +272,13 @@ def flush() -> None:
 
 
 def shutdown() -> None:
-    global _heartbeat
+    global _heartbeat, _detector
     if _heartbeat is not None:
         _heartbeat.stop()
         _heartbeat = None
+    if _detector is not None:
+        _detector.shutdown()     # close any open profiler window
+        _detector = None
     _state.shutdown()
 
 

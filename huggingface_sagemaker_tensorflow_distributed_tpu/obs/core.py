@@ -26,6 +26,9 @@ import threading
 import time
 from typing import Optional
 
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.flight import (
+    FlightRecorder,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.obs.schema import (
     SCHEMA_VERSION,
 )
@@ -33,6 +36,12 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.obs.schema import (
 ENV_ENABLE = "HSTD_TELEMETRY"
 ENV_DIR = "HSTD_TELEMETRY_DIR"
 ENV_HEARTBEAT = "HSTD_HEARTBEAT_SECS"
+# every host writes its own event file (events.host<K>.jsonl; host 0
+# keeps events.jsonl) — per-host FILES, so shared-filesystem runs never
+# interleave appends into one file. Off by default: rank-0-only is the
+# PR 1 discipline, this is the opt-in that makes `obsctl report` a real
+# N-host merge.
+ENV_ALL_HOSTS = "HSTD_TELEMETRY_ALL_HOSTS"
 
 _FSYNC_EVERY = 64
 _MAX_BUFFERED_SPANS = 200_000
@@ -41,6 +50,18 @@ _MAX_BUFFERED_SPANS = 200_000
 def _env_enabled() -> bool:
     return os.environ.get(ENV_ENABLE, "1").strip().lower() not in (
         "0", "false", "off", "no")
+
+
+def _all_hosts_env() -> bool:
+    return os.environ.get(ENV_ALL_HOSTS, "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def event_filename(host: int) -> str:
+    """Per-host event file name: host 0 keeps the historical
+    ``events.jsonl``; other hosts (under ``HSTD_TELEMETRY_ALL_HOSTS``)
+    get unique names so shared-filesystem appends never interleave."""
+    return "events.jsonl" if host == 0 else f"events.host{host}.jsonl"
 
 
 class EventLog:
@@ -54,22 +75,33 @@ class EventLog:
     """
 
     def __init__(self, path: str, host: int,
-                 header: Optional[tuple[str, dict]] = None):
+                 header: Optional[tuple[str, dict]] = None,
+                 ring: Optional[FlightRecorder] = None):
         self.path = path
         self.host = host
+        self.ring = ring
         self._header = header
         self._lock = threading.Lock()
         self._file = None
         self._since_fsync = 0
 
-    def _stamp(self, etype: str, fields: dict) -> str:
+    def stamp_record(self, etype: str, fields: dict) -> dict:
         record = {"v": SCHEMA_VERSION, "t": time.time(), "host": self.host,
                   "pid": os.getpid(), "type": etype}
         record.update(fields)
-        return json.dumps(record, default=str) + "\n"
+        return record
+
+    def _stamp(self, etype: str, fields: dict) -> str:
+        return json.dumps(self.stamp_record(etype, fields),
+                          default=str) + "\n"
 
     def emit(self, etype: str, fields: dict) -> None:
-        line = self._stamp(etype, fields)
+        record = self.stamp_record(etype, fields)
+        if self.ring is not None:
+            # flight recorder (obs/flight.py): every written event also
+            # lands in the bounded ring an anomaly dump snapshots
+            self.ring.record(record)
+        line = json.dumps(record, default=str) + "\n"
         with self._lock:
             if self._file is None:
                 os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -106,6 +138,10 @@ class ObsState:
         self.mono0 = time.perf_counter()
         self.spans: list = []          # (name, mono_start, dur, tid, depth)
         self.spans_dropped = 0
+        # flight recorder (obs/flight.py): bounded ring of recent event
+        # records, dumped by the anomaly detector at an incident.
+        # HSTD_FLIGHT_RING=0 disables it.
+        self.ring: Optional[FlightRecorder] = FlightRecorder.from_env()
         self._tl = threading.local()
         self._lock = threading.Lock()
         env_dir = os.environ.get(ENV_DIR, "").strip()
@@ -116,17 +152,24 @@ class ObsState:
 
     def _open_dir(self, path: str) -> None:
         self.dir = path
-        # multi-host runs on a shared filesystem: only host 0 owns the
-        # files (interleaved appends from many writers would tear lines).
-        # The "run" header is written lazily with the first real event:
-        # a host whose rank is an env guess (auto-detected pods) never
-        # touches the file before initialize_distributed corrects it via
-        # set_host.
-        if self.host == 0:
-            self.events = EventLog(
-                os.path.join(path, "events.jsonl"), self.host,
-                header=("run", {"argv": sys.argv,
-                                "python": sys.version.split()[0]}))
+        # multi-host runs on a shared filesystem: by default only host 0
+        # owns the files (interleaved appends from many writers would
+        # tear lines); HSTD_TELEMETRY_ALL_HOSTS=1 gives every host its
+        # OWN file (event_filename) so a cross-host `obsctl report`
+        # merge is possible without any append interleaving. The "run"
+        # header is written lazily with the first real event: a host
+        # whose rank is an env guess (auto-detected pods) never touches
+        # a file before initialize_distributed corrects it via set_host.
+        if self.host == 0 or _all_hosts_env():
+            self._open_event_log()
+
+    def _open_event_log(self) -> None:
+        header = ("run", {"argv": sys.argv,
+                          "python": sys.version.split()[0]}) \
+            if self.host == 0 else None
+        self.events = EventLog(
+            os.path.join(self.dir, event_filename(self.host)), self.host,
+            header=header, ring=self.ring)
 
     def configure(self, out_dir: Optional[str] = None,
                   enabled: Optional[bool] = None) -> None:
@@ -140,15 +183,19 @@ class ObsState:
                 self._open_dir(out_dir)
 
     def set_host(self, index: int, count: int) -> None:
+        changed = index != self.host
         self.host = index
         self.host_count = count
+        if not changed:
+            return
         if self.events is not None:
-            if index != 0:
-                # demoted from presumed-rank-0: stop writing
-                self.events.close()
-                self.events = None
-            else:
-                self.events.host = index
+            # the rank guess was wrong: close the unused log (lazy open
+            # means no file was touched) and reopen under the real rank
+            self.events.close()
+            self.events = None
+        if (self.dir is not None and self.enabled
+                and (index == 0 or _all_hosts_env())):
+            self._open_event_log()
 
     # -- span recording -----------------------------------------------------
 

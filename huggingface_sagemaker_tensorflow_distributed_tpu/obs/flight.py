@@ -1,0 +1,201 @@
+"""Flight recorder: a bounded in-memory ring of the most recent
+telemetry events, dumped to disk the moment an anomaly fires — evidence
+captured AT the incident, not reconstructed after — plus the bounded
+``jax.profiler`` capture window the anomaly detector can open.
+
+The ring mirrors every record the event log writes (spans, metrics,
+heartbeats, compiles, serve events, ...), so a ``flight_<step>.jsonl``
+dump is a self-contained replay of the run's last ``HSTD_FLIGHT_RING``
+events in schema-valid form — ``scripts/check_telemetry_schema.py``
+lints it like any events file.
+
+No jax imports at module level (the ``obs`` import contract); the
+profiler window touches jax only through ``sys.modules`` and never
+forces a backend init.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+ENV_RING = "HSTD_FLIGHT_RING"            # ring capacity (events); 0 disables
+ENV_PROFILE = "HSTD_PROFILE_ON_ANOMALY"  # 0 off | 1 accelerators | force: CPU too
+ENV_PROFILE_SECS = "HSTD_PROFILE_SECS"   # capture window length (default 10)
+
+DEFAULT_RING = 512
+DEFAULT_PROFILE_SECS = 10.0
+MAX_PROFILE_WINDOWS = 2   # per process: a capture is expensive evidence,
+                          # not a metric — two incidents' worth is plenty
+
+
+def ring_capacity_env(default: int = DEFAULT_RING) -> int:
+    raw = os.environ.get(ENV_RING, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class FlightRecorder:
+    """Bounded deque of event records (dicts, already envelope-stamped).
+
+    ``record`` is the event log's hot path: one deque append under a
+    lock (the deque's maxlen handles eviction). ``dump`` writes the
+    ring atomically (tmp + rename) so a crash mid-dump never leaves a
+    half-written flight file.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_RING):
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(self.capacity, 1))
+        self._lock = threading.Lock()
+        self.dumps: list[str] = []
+
+    @classmethod
+    def from_env(cls) -> Optional["FlightRecorder"]:
+        cap = ring_capacity_env()
+        return cls(cap) if cap > 0 else None
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, record: dict) -> None:
+        with self._lock:
+            self._ring.append(record)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, out_dir: Optional[str], step: Optional[int],
+             extra: Optional[dict] = None,
+             tag: Optional[str] = None) -> Optional[str]:
+        """Write ``flight_<tag>.jsonl`` (ring order, oldest first;
+        ``extra`` — typically the triggering anomaly record — appended
+        last). ``tag`` defaults to the step number; callers that can
+        collide (several anomaly kinds at one step, several hosts on a
+        shared filesystem) pass a disambiguated tag so each incident's
+        evidence file really contains ITS trigger. Returns the path, or
+        None without an output dir. Never raises: evidence capture must
+        not take down the workload."""
+        if not out_dir:
+            return None
+        records = self.snapshot()
+        if extra is not None:
+            records.append(extra)
+        if not records:
+            return None
+        if tag is None:
+            tag = "unknown" if step is None else str(int(step))
+        path = os.path.join(out_dir, f"flight_{tag}.jsonl")
+        if os.path.exists(path):   # one dump per step tag: keep the first
+            return path
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rec in records:
+                    f.write(json.dumps(rec, default=str) + "\n")
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        self.dumps.append(path)
+        return path
+
+
+def profile_mode_env() -> str:
+    """``HSTD_PROFILE_ON_ANOMALY``: "off" (default), "on" (accelerator
+    backends only — a CPU profile of a CPU-smoke run is noise), or
+    "force" (capture regardless of backend; tests use it)."""
+    raw = os.environ.get(ENV_PROFILE, "").strip().lower()
+    if raw in ("", "0", "false", "off", "no"):
+        return "off"
+    if raw == "force":
+        return "force"
+    return "on"
+
+
+def profile_secs_env(default: float = DEFAULT_PROFILE_SECS) -> float:
+    raw = os.environ.get(ENV_PROFILE_SECS, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class ProfilerCapture:
+    """Bounded, rate-limited ``jax.profiler`` window opened at an
+    anomaly. ``maybe_start`` opens a trace into
+    ``<out_dir>/profile_anomaly_<step>/``; ``poll`` (called from every
+    detector observation) closes it once the window elapses, and
+    ``stop`` closes it unconditionally (``obs.shutdown``). At most
+    ``MAX_PROFILE_WINDOWS`` per process."""
+
+    def __init__(self, mode: Optional[str] = None,
+                 window_s: Optional[float] = None):
+        self.mode = profile_mode_env() if mode is None else mode
+        self.window_s = profile_secs_env() if window_s is None else window_s
+        self.windows = 0
+        self.dirs: list[str] = []
+        self._active_since: Optional[float] = None
+
+    @property
+    def active(self) -> bool:
+        return self._active_since is not None
+
+    def _backend_ok(self) -> bool:
+        if self.mode == "force":
+            return True
+        if self.mode != "on":
+            return False
+        if "jax" not in sys.modules:
+            return False
+        jax = sys.modules["jax"]
+        try:
+            return jax.devices()[0].platform != "cpu"
+        except Exception:  # noqa: BLE001 — backend not initialized / gone
+            return False
+
+    def maybe_start(self, out_dir: Optional[str],
+                    step: Optional[int]) -> Optional[str]:
+        if (self.active or not out_dir or self.windows >= MAX_PROFILE_WINDOWS
+                or not self._backend_ok() or "jax" not in sys.modules):
+            return None
+        jax = sys.modules["jax"]
+        tag = "unknown" if step is None else str(int(step))
+        trace_dir = os.path.join(out_dir, f"profile_anomaly_{tag}")
+        try:
+            jax.profiler.start_trace(trace_dir)
+        except Exception:  # noqa: BLE001 — profiling must not kill the run
+            return None
+        self._active_since = time.monotonic()
+        self.windows += 1
+        self.dirs.append(trace_dir)
+        return trace_dir
+
+    def poll(self) -> bool:
+        """Close the window if its time is up; True if one was closed."""
+        if (self._active_since is not None
+                and time.monotonic() - self._active_since >= self.window_s):
+            return self.stop()
+        return False
+
+    def stop(self) -> bool:
+        if self._active_since is None:
+            return False
+        self._active_since = None
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return False
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            return False
+        return True

@@ -1,0 +1,362 @@
+"""Cross-host run reports: merge per-host ``HSTD_TELEMETRY_DIR``
+artifacts into ONE deterministic view of an N-host run.
+
+Consumed by ``scripts/obsctl.py``. Stdlib-only by the same contract as
+``obs/schema.py`` — the merge runs on jax-less boxes (the driver, CI).
+
+Input: any mix of telemetry dirs (each holding an ``events.jsonl``),
+dirs of per-host subdirs, or event files directly. Host identity comes
+from the ``host`` envelope field, NOT the directory layout, so a shared
+-filesystem run (one dir, host 0 writing) and a dir-per-host run merge
+identically.
+
+Determinism: every section is keyed and sorted (hosts numerically,
+events by timestamp with name tiebreaks), so the same inputs in ANY
+argument order produce byte-identical reports — the property the
+fixture test pins. No wall-clock is stamped into the report for the
+same reason.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.schema import (
+    SCHEMA_VERSION,
+    iter_events,
+    validate_event,
+)
+
+REPORT_VERSION = 1
+
+
+def _is_event_stream(name: str) -> bool:
+    """``events.jsonl`` + the per-host ``events.host<K>.jsonl`` files
+    (``HSTD_TELEMETRY_ALL_HOSTS``). ``flight_*.jsonl`` is deliberately
+    EXCLUDED — flight dumps duplicate ring events."""
+    return name == "events.jsonl" or (
+        name.startswith("events.host") and name.endswith(".jsonl"))
+
+
+def find_event_files(paths: Iterable[str]) -> list[str]:
+    """Expand dirs / per-host subdirs / files into a sorted list of
+    event-stream files."""
+    out = set()
+    for p in paths:
+        if os.path.isfile(p):
+            out.add(os.path.abspath(p))
+            continue
+        if not os.path.isdir(p):
+            continue
+        for name in sorted(os.listdir(p)):
+            direct = os.path.join(p, name)
+            if os.path.isfile(direct) and _is_event_stream(name):
+                out.add(os.path.abspath(direct))
+                continue
+            if os.path.isdir(direct):
+                for sub in sorted(os.listdir(direct)):
+                    if _is_event_stream(sub):
+                        out.add(os.path.abspath(
+                            os.path.join(direct, sub)))
+    return sorted(out)
+
+
+def percentile(sorted_vals: list, p: float) -> float:
+    """Nearest-rank percentile over an ALREADY-SORTED list — the ONE
+    rank convention shared by the report's distributions and the serve
+    engine's SLO summary (so obsctl never disagrees with the engine)."""
+    n = len(sorted_vals)
+    return float(sorted_vals[min(n - 1, int(p * (n - 1) + 0.5))])
+
+
+def _dist(values: list) -> Optional[dict]:
+    """{count, mean, p50, p95, max} of a numeric series (None if empty):
+    the compact distribution shape every per-host section uses."""
+    vals = sorted(float(v) for v in values
+                  if isinstance(v, (int, float)) and v == v)
+    if not vals:
+        return None
+    n = len(vals)
+    return {"count": n, "mean": round(sum(vals) / n, 6),
+            "p50": round(percentile(vals, 0.50), 6),
+            "p95": round(percentile(vals, 0.95), 6),
+            "max": round(vals[-1], 6)}
+
+
+def _metric_series(events: list[dict], name: str) -> list:
+    return [e.get("value") for e in events
+            if e["type"] == "metric" and e.get("name") == name
+            and e.get("value") is not None]
+
+
+def _host_section(events: list[dict]) -> dict:
+    """One host's rollup (events already filtered to this host and in
+    file order, which is emission order)."""
+    compiles = [e for e in events if e["type"] == "compile"]
+    memory_peaks = [int(e["stats"].get("peak_bytes_in_use", 0))
+                    for e in events if e["type"] == "memory"
+                    and isinstance(e.get("stats"), dict)]
+    memory_limits = [int(e["stats"].get("bytes_limit", 0))
+                     for e in events if e["type"] == "memory"
+                     and isinstance(e.get("stats"), dict)]
+    heartbeats = [e for e in events if e["type"] == "heartbeat"]
+    mfu_series = _metric_series(events, "train/mfu")
+    section = {
+        "events": len(events),
+        "step_time_s": _dist(_metric_series(events, "train/step_time_s")),
+        "samples_per_sec": _dist(
+            _metric_series(events, "train/samples_per_sec")),
+        "mfu": _dist(mfu_series),
+        "compile": {
+            "count": compiles[-1].get("count", len(compiles)) if compiles
+            else 0,
+            "cum_s": round(float(compiles[-1].get("cum", 0.0)), 3)
+            if compiles else 0.0,
+        },
+        "memory": {
+            "peak_bytes_in_use": max(memory_peaks, default=0),
+            "bytes_limit": max(memory_limits, default=0),
+        },
+        "heartbeats": len(heartbeats),
+        "max_progress_age_s": round(max(
+            (float(e.get("progress_age", 0.0)) for e in heartbeats),
+            default=0.0), 3),
+        "stalls": sum(1 for e in events if e["type"] == "stall"),
+        "alerts": sum(1 for e in events if e["type"] == "alert"),
+        "anomalies": sum(1 for e in events if e["type"] == "anomaly"),
+    }
+    return section
+
+
+def _straggler_timeline(events: list[dict]) -> list[dict]:
+    """Per-epoch straggler rows. The underlying metric comes from an
+    allgather, so under HSTD_TELEMETRY_ALL_HOSTS every host emits an
+    identical copy per epoch — keep ONE row per (epoch, occurrence),
+    taken from the lowest-host stream (events arrive host-sorted)."""
+    rows = []
+    seen: set = set()
+    for e in events:
+        if e["type"] != "metric" \
+                or e.get("name") != "train/step_time_hosts_mean":
+            continue
+        args = e.get("args") or {}
+        row = {
+            "epoch": int(e.get("step", len(rows))),
+            "mean_s": round(float(args.get("mean", e.get("value") or 0.0)),
+                            6),
+            "max_s": round(float(args.get("max", 0.0)), 6),
+            "straggler_ratio": round(float(args.get("straggler_ratio",
+                                                    1.0)), 4),
+            "argmax_host": args.get("argmax"),
+        }
+        dedup = (row["epoch"], row["mean_s"], row["max_s"],
+                 row["straggler_ratio"], row["argmax_host"])
+        if dedup in seen:
+            continue     # another host's copy of the same allgather
+        seen.add(dedup)
+        rows.append(row)
+    rows.sort(key=lambda r: r["epoch"])
+    return rows
+
+
+def _anomaly_index(events: list[dict]) -> list[dict]:
+    """All anomaly events, one entry per DISTINCT incident: collective
+    -derived anomalies (straggler) fire with identical name/step/message
+    on every host — collapse those to the lowest host's entry (events
+    arrive host-sorted); host-specific incidents (a rank-3 NaN) differ
+    in message or step and are all kept."""
+    rows = []
+    seen: set = set()
+    for e in events:
+        if e["type"] != "anomaly":
+            continue
+        dedup = (e.get("name"), e.get("step"), e.get("message"))
+        if dedup in seen:
+            continue
+        seen.add(dedup)
+        rows.append({
+            "t": float(e.get("t", 0.0)),
+            "host": int(e.get("host", 0)),
+            "name": e.get("name"),
+            "step": e.get("step"),
+            "message": e.get("message"),
+            "evidence": e.get("evidence"),
+        })
+    rows.sort(key=lambda r: (r["t"], r["host"], str(r["name"])))
+    return rows
+
+
+def _serve_summary(events: list[dict]) -> Optional[dict]:
+    """The engine's final ``serve`` report event wins (it carries the
+    SLO percentiles); without one, reconstruct what the lifecycle
+    events allow (TTFT distribution from first_token events)."""
+    serves = [e for e in events if e["type"] == "serve"]
+    if not serves:
+        return None
+    reports = [e for e in serves if e.get("event") == "report"]
+    if reports:
+        last = reports[-1]
+        return {k: v for k, v in last.items()
+                if k not in ("v", "t", "host", "pid", "type", "event")}
+    ttfts = [e.get("ttft_s") for e in serves
+             if e.get("event") == "first_token"
+             and e.get("ttft_s") is not None]
+    return {
+        "requests": sum(1 for e in serves if e.get("event") == "finish"),
+        "preemptions": sum(1 for e in serves
+                           if e.get("event") == "preempt"),
+        "ttft": _dist(ttfts),
+    }
+
+
+def build_report(paths: Iterable[str]) -> dict:
+    """The merged run report. ``errors`` carries per-file schema
+    problems (a drifted host does not abort the merge — a sick host is
+    exactly when you want the report)."""
+    files = find_event_files(paths)
+    by_host: dict[int, list[dict]] = {}
+    errors: list[str] = []
+    total = 0
+    for path in files:
+        try:
+            rows = list(iter_events(path))
+        except OSError as e:
+            errors.append(f"{path}: unreadable ({e})")
+            continue
+        for lineno, event, err in rows:
+            if err is not None:
+                errors.append(f"{path}:{lineno}: {err}")
+                continue
+            errs = validate_event(event)
+            if errs:
+                errors.extend(f"{path}:{lineno}: {m}" for m in errs)
+                continue
+            total += 1
+            by_host.setdefault(int(event.get("host", 0)), []).append(event)
+    all_events = [e for h in sorted(by_host) for e in by_host[h]]
+    run_headers = [e for e in all_events if e["type"] == "run"]
+    report = {
+        "report_version": REPORT_VERSION,
+        "schema_version": SCHEMA_VERSION,
+        "files": [os.path.join(os.path.basename(os.path.dirname(f)),
+                               os.path.basename(f)) for f in files],
+        "run": {
+            "argv": run_headers[0].get("argv") if run_headers else None,
+            "n_hosts": len(by_host),
+            "events": total,
+        },
+        "hosts": {str(h): _host_section(evts)
+                  for h, evts in sorted(by_host.items())},
+        "straggler_timeline": _straggler_timeline(all_events),
+        "anomaly_index": _anomaly_index(all_events),
+        "serve": _serve_summary(all_events),
+        "errors": sorted(errors),
+    }
+    return report
+
+
+def validate_report(doc) -> list[str]:
+    """Schema check for a report document (empty list = valid) — the
+    gate ``obsctl report`` applies to its own output before printing."""
+    if not isinstance(doc, dict):
+        return [f"report is {type(doc).__name__}, not an object"]
+    problems = []
+    for field, types in (("report_version", (int,)),
+                         ("schema_version", (int,)),
+                         ("run", (dict,)), ("hosts", (dict,)),
+                         ("straggler_timeline", (list,)),
+                         ("anomaly_index", (list,)),
+                         ("errors", (list,))):
+        if not isinstance(doc.get(field), types):
+            problems.append(f"missing/mistyped field {field!r}")
+    if doc.get("report_version") not in (None, REPORT_VERSION):
+        problems.append(
+            f"report_version {doc.get('report_version')!r} "
+            f"!= {REPORT_VERSION}")
+    hosts = doc.get("hosts")
+    if isinstance(hosts, dict):
+        if not hosts:
+            problems.append("no hosts (empty run)")
+        for key, section in hosts.items():
+            if not isinstance(section, dict):
+                problems.append(f"host {key!r} section is not an object")
+                continue
+            for field in ("events", "compile", "heartbeats", "anomalies"):
+                if field not in section:
+                    problems.append(f"host {key!r}: missing {field!r}")
+    return problems
+
+
+def render_text(report: dict) -> str:
+    """Human-readable rendering of a report dict."""
+    lines = []
+    run = report.get("run", {})
+    lines.append(f"run: {run.get('n_hosts', 0)} host(s), "
+                 f"{run.get('events', 0)} events")
+    if run.get("argv"):
+        lines.append(f"  argv: {' '.join(map(str, run['argv']))}")
+    for host, sec in sorted(report.get("hosts", {}).items(),
+                            key=lambda kv: int(kv[0])):
+        lines.append(f"host {host}: {sec['events']} events, "
+                     f"{sec['compile']['count']} compiles "
+                     f"({sec['compile']['cum_s']}s), "
+                     f"{sec['heartbeats']} heartbeats, "
+                     f"{sec['stalls']} stalls, "
+                     f"{sec['anomalies']} anomalies")
+        st = sec.get("step_time_s")
+        if st:
+            lines.append(f"  step time: p50 {st['p50']}s  p95 {st['p95']}s"
+                         f"  max {st['max']}s  ({st['count']} windows)")
+        mfu = sec.get("mfu")
+        if mfu:
+            lines.append(f"  mfu: mean {mfu['mean']}  p50 {mfu['p50']}"
+                         f"  max {mfu['max']}")
+        mem = sec.get("memory", {})
+        if mem.get("peak_bytes_in_use"):
+            frac = (f" ({mem['peak_bytes_in_use'] / mem['bytes_limit']:.1%}"
+                    " of limit)" if mem.get("bytes_limit") else "")
+            lines.append(
+                f"  memory peak: {mem['peak_bytes_in_use']} bytes{frac}")
+    timeline = report.get("straggler_timeline", [])
+    if timeline:
+        # mark epochs from the run's OWN straggler anomalies (which
+        # applied the configured HSTD_STRAGGLER_ALERT threshold), so
+        # the text rendering never disagrees with the anomaly index
+        alerted = {a.get("step") for a in report.get("anomaly_index", [])
+                   if a.get("name") == "straggler"}
+        lines.append("straggler timeline:")
+        for row in timeline:
+            mark = (" <-- host %s slow" % row["argmax_host"]
+                    if row["epoch"] in alerted
+                    and row["argmax_host"] is not None else "")
+            lines.append(f"  epoch {row['epoch']}: mean {row['mean_s']}s  "
+                         f"ratio {row['straggler_ratio']}{mark}")
+    anomalies = report.get("anomaly_index", [])
+    if anomalies:
+        lines.append(f"anomalies ({len(anomalies)}):")
+        for a in anomalies:
+            step = f" step {a['step']}" if a.get("step") is not None else ""
+            lines.append(f"  [host {a['host']}]{step} {a['name']}: "
+                         f"{a['message']}")
+    else:
+        lines.append("anomalies: none")
+    serve = report.get("serve")
+    if serve:
+        parts = [f"{serve.get('requests', 0)} requests"]
+        if serve.get("ttft_p50_s") is not None:
+            parts.append(f"ttft p50 {serve['ttft_p50_s']}s "
+                         f"p99 {serve.get('ttft_p99_s')}s")
+        if serve.get("e2e_p50_s") is not None:
+            parts.append(f"e2e p50 {serve['e2e_p50_s']}s "
+                         f"p99 {serve.get('e2e_p99_s')}s")
+        if serve.get("preemptions") is not None:
+            parts.append(f"{serve['preemptions']} preemptions")
+        lines.append("serve: " + ", ".join(parts))
+    errors = report.get("errors", [])
+    if errors:
+        lines.append(f"schema errors ({len(errors)}):")
+        lines.extend(f"  {e}" for e in errors[:20])
+        if len(errors) > 20:
+            lines.append(f"  ... and {len(errors) - 20} more")
+    return "\n".join(lines) + "\n"

@@ -56,6 +56,14 @@ REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
     # a budget/threshold warning (e.g. compile_budget when cumulative XLA
     # compile seconds exceed HSTD_COMPILE_BUDGET_S); mirrored to stderr
     "alert": {"name": (str,), "message": (str,)},
+    # one anomaly-detector trigger (obs/anomaly.py): "name" is the kind
+    # (nan_loss / nan_grad / grad_explosion / step_time_spike /
+    # straggler / heartbeat_stall), "message" the human-readable
+    # diagnosis; extras ride along ("step", "evidence" = the flight
+    # dump path, "profile_dir" = the profiler capture, kind-specific
+    # numbers). Rate-limited at the source — one per incident, not per
+    # observation
+    "anomaly": {"name": (str,), "message": (str,)},
     # one serving-engine lifecycle event (serve/engine.py): "event" is
     # submit / admit / first_token / finish / preempt; per-request
     # events also carry an integer "request" id, and first_token /

@@ -169,6 +169,18 @@ class Heartbeat:
         print(dump, file=sys.stderr, flush=True)
         logger.error("heartbeat stall: %r blocked for %.1fs",
                      watched, age)
+        # anomaly plane (obs/anomaly.py): a stall is an incident — give
+        # it a flight dump + index entry next to the stack dump. Only an
+        # ALREADY-CREATED detector is notified (the heartbeat thread
+        # must not instantiate policy objects behind the run's back).
+        try:
+            obs_pkg = sys.modules.get(
+                "huggingface_sagemaker_tensorflow_distributed_tpu.obs")
+            det = getattr(obs_pkg, "_detector", None)
+            if det is not None and det._state is self._state:
+                det.observe_stall(age, watched)
+        except Exception:  # noqa: BLE001 — liveness must not kill runs
+            logger.exception("stall anomaly notification failed")
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):

@@ -95,7 +95,7 @@ def host_step_stats(step_seconds: float) -> dict | None:
         return None
     if jax.process_count() == 1:
         return {"n_hosts": 1, "min": v, "max": v, "mean": v,
-                "straggler_ratio": 1.0}
+                "straggler_ratio": 1.0, "argmax": 0}
     import numpy as np
     from jax.experimental import multihost_utils
 
@@ -105,7 +105,10 @@ def host_step_stats(step_seconds: float) -> dict | None:
     return {"n_hosts": int(jax.process_count()),
             "min": float(vals.min()), "max": float(vals.max()),
             "mean": mean,
-            "straggler_ratio": float(vals.max() / max(mean, 1e-12))}
+            "straggler_ratio": float(vals.max() / max(mean, 1e-12)),
+            # the slow host's index (allgather order = process index):
+            # what the straggler anomaly names
+            "argmax": int(vals.argmax())}
 
 
 def agree_compile_budget_crossed(local_crossed: bool) -> bool:

@@ -302,6 +302,8 @@ class ServeEngine:
         self.decode_steps = 0
         self.prefill_chunks = 0
         self.tokens_generated = 0
+        self.iterations = 0
+        self.peak_waiting = 0
         self._warm = False
 
     # -- public API ----------------------------------------------------------
@@ -348,14 +350,52 @@ class ServeEngine:
 
     def run(self) -> dict[int, Request]:
         """Drive the loop until every submitted request finishes;
-        returns {rid: Request}."""
+        returns {rid: Request}. Ends with one ``serve`` *report* event
+        carrying the run's SLO summary (TTFT / end-to-end latency
+        percentiles) so the cross-host report (`obs/report.py`) reads
+        the serving story from a single line."""
         self.warmup()
         with obs.span("serve/run"):
             while self.sched.has_work():
                 self.step()
         obs.scalar("serve/kv_peak_utilization",
                    self.blocks.peak_used / max(self.blocks.num_blocks - 1, 1))
+        summary = self.slo_summary()
+        if summary:
+            obs.serve("report", **summary)
         return self.finished
+
+    def slo_summary(self) -> dict:
+        """TTFT / end-to-end latency percentiles + scheduler gauges over
+        every FINISHED request ({} until one finishes)."""
+        reqs = list(self.finished.values())
+        if not reqs:
+            return {}
+        ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+        e2es = [r.finish_t - r.submit_t for r in reqs
+                if r.finish_t is not None and r.submit_t is not None]
+        out = {
+            "requests": len(reqs),
+            "tokens": self.tokens_generated,
+            "iterations": self.iterations,
+            "preemptions": self.sched.n_preemptions,
+            "peak_waiting_depth": self.peak_waiting,
+            "kv_peak_utilization": round(
+                self.blocks.peak_used
+                / max(self.blocks.num_blocks - 1, 1), 4),
+        }
+        from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+            percentile,
+        )
+
+        for label, vals in (("ttft", ttfts), ("e2e", e2es)):
+            if not vals:
+                continue
+            s = sorted(vals)
+            out[f"{label}_p50_s"] = round(percentile(s, 0.50), 6)
+            out[f"{label}_p95_s"] = round(percentile(s, 0.95), 6)
+            out[f"{label}_p99_s"] = round(percentile(s, 0.99), 6)
+        return out
 
     def stats(self) -> EngineStats:
         return EngineStats(
@@ -388,6 +428,17 @@ class ServeEngine:
             obs.serve("preempt", request=req.rid,
                       reason="kv_pool_exhausted")
         self._decode_all()
+        # per-iteration scheduler gauges (SLO telemetry): queue pressure
+        # and slot occupancy as series, one sample per engine iteration
+        waiting = len(self.sched.waiting)
+        self.peak_waiting = max(self.peak_waiting, waiting)
+        if obs.has_sink():
+            obs.scalar("serve/waiting_depth", waiting, self.iterations)
+            obs.scalar("serve/running_slots",
+                       len(self.sched.decode_slots()), self.iterations)
+            obs.scalar("serve/preemptions", self.sched.n_preemptions,
+                       self.iterations)
+        self.iterations += 1
 
     def _prefill_one(self) -> bool:
         """One prefill chunk for the next PREFILL-state slot

@@ -510,6 +510,14 @@ class Trainer:
         # collection mutable and adds every sowed value to the task loss.
         self._has_sown_losses = (
             getattr(getattr(model, "config", None), "num_experts", 0) or 0) > 0
+        # anomaly plane (obs/anomaly.py): the jitted step only computes
+        # the grad-norm reduction when a detector will actually read it
+        # — un-instrumented runs pay nothing (captured at trace time,
+        # consistent with every other opt-in obs cost here)
+        from huggingface_sagemaker_tensorflow_distributed_tpu.obs.anomaly import (
+            anomaly_enabled_env,
+        )
+        self._emit_grad_norm = obs.configured() and anomaly_enabled_env()
 
         self.tx, self.scaled_lr = build_optimizer(
             config, world_size=self.dp_size, total_steps=total_steps)
@@ -667,6 +675,11 @@ class Trainer:
             "loss": loss,
             "accuracy": sums["correct"] / jnp.maximum(sums["count"], 1.0),
         }
+        if self._emit_grad_norm:
+            # one global reduction over the grad tree — fetched only at
+            # the loop's existing sync points; the anomaly detector's
+            # explosion/NaN signal (obs/anomaly.py)
+            metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
     def _eval_step_impl(self, params, batch):
@@ -768,8 +781,34 @@ class Trainer:
             obs.compile_tracker()
             heartbeat = obs.heartbeat().start()
             heartbeat.watch_current_thread()
+        # anomaly plane (obs/anomaly.py): NaN/Inf loss, grad explosion,
+        # step-time spikes, persistent stragglers — instrumented runs
+        # only (obs.configured() is identical on every host, so the
+        # detector exists everywhere; only host 0 writes the events)
+        detector = obs.anomalies() if obs.configured() else None
+        if detector is not None:
+            # fresh rolling baselines per fit: a second fit's different
+            # step-time regime must not read as a spike
+            detector.begin_fit()
+        # MFU accounting (obs/flops.py): analytic per-REAL-token train
+        # FLOPs for this model/task + the chip's peak → per-window
+        # train/mfu series and the history's train_mfu figure
+        fpt, dec_fpt = obs.flops.trainer_flops_per_token(
+            getattr(self.model, "config", None), self.task,
+            cfg.max_seq_length)
+        peak = obs.flops.peak_tflops(jax.devices()[0].device_kind)
         meter = StepMeter(n_chips=self.n_chips,
-                          sink=obs.metrics() if obs_files else None)
+                          sink=obs.metrics() if obs_files else None,
+                          flops_per_token=fpt, dec_flops_per_token=dec_fpt,
+                          peak_tflops=peak)
+        # real-token window accounting: the batcher logs one
+        # (tokens, dec_tokens) entry per staged batch; popping one entry
+        # per dispatched step keeps attribution EXACT under prefetch /
+        # H2D lookahead. × process_count approximates the global figure
+        # (shards are balanced by construction). Tokens of excluded
+        # (compiling) steps are dropped by the begin_window() reset.
+        tok_scale = jax.process_count()
+        token_log = getattr(train_batcher, "token_log", None)
         history: dict[str, list] = {"loss": [], "sparse_categorical_accuracy": []}
         steps_per_epoch = train_batcher.steps_per_epoch()
         if cfg.steps_per_epoch:
@@ -789,8 +828,17 @@ class Trainer:
         def sync(metrics_list):
             with obs.span("train/sync"):
                 fetched = jax.device_get(metrics_list)
-            meter.end_window()
+            window = meter.end_window()
             meter.begin_window()
+            if detector is not None:
+                if window is not None and window["steps"]:
+                    detector.observe_step_time(meter._steps,
+                                               window["step_time_s"])
+                for m in fetched:
+                    detector.observe_loss(meter._steps, float(m["loss"]))
+                    if "grad_norm" in m:
+                        detector.observe_grad_norm(meter._steps,
+                                                   float(m["grad_norm"]))
             return fetched
 
         if eval_batcher is None and (cfg.keep_best
@@ -819,10 +867,15 @@ class Trainer:
                 device_metrics: list = []
                 losses, accs = [], []
 
+                if token_log is not None:
+                    # a batch staged last epoch but never dispatched
+                    # (steps_per_epoch cap) would misalign every pop
+                    token_log.clear()
                 # close() in finally: early exit (steps_per_epoch cap) and
                 # exceptions (OOM, failed checkpoint save) must both stop
                 # the prefetch thread, or it keeps transferring batches
                 batch_iter = train_batcher.global_arrays(epoch, start_step)
+                meter.begin_window()
                 try:
                     for step, batch in enumerate(batch_iter, start=start_step):
                         if step >= steps_per_epoch:
@@ -850,12 +903,19 @@ class Trainer:
                                 self.state, batch)
                         device_metrics.append(metrics)
                         meter.window_step(gbs)
+                        if token_log:
+                            tok, dec = token_log.popleft()
+                            meter.window_tokens(tok * tok_scale,
+                                                dec * tok_scale)
                         obs.pulse()
                         if first_step or recompile:
                             # exclude XLA compile from the throughput window
                             with obs.span("xla/compile_wait"):
                                 jax.block_until_ready(metrics["loss"])
                             meter.exclude_step(gbs)
+                            # begin_window resets the window's token
+                            # counters too — the compile batch's tokens
+                            # (popped above) are dropped with its time
                             meter.begin_window()
                             first_step = False
                         if profiling and step - start_step == 6:
@@ -883,9 +943,14 @@ class Trainer:
                         if want_ckpt:
                             if cfg.check_divergence:
                                 self.check_replica_divergence()
+                            # checkpoint wall time is not step time:
+                            # bracket it out of the throughput window
+                            # (and the spike detector's series)
+                            meter.end_window()
                             with obs.span("train/checkpoint"):
                                 checkpointer.save(self.state, epoch=epoch,
                                                   step_in_epoch=step + 1)
+                            meter.begin_window()
                 finally:
                     if hasattr(batch_iter, "close"):
                         batch_iter.close()
@@ -893,6 +958,11 @@ class Trainer:
                 for m in sync(device_metrics):
                     losses.append(float(m["loss"]))
                     accs.append(float(m["accuracy"]))
+                # the epoch boundary's eval/checkpoint/collective time is
+                # NOT step time: discard the freshly-begun empty window
+                # so none of it reaches throughput or the spike detector
+                # (the next epoch's loop opens a fresh one)
+                meter.end_window()
                 history["loss"].append(float(np.mean(losses)) if losses else float("nan"))
                 history["sparse_categorical_accuracy"].append(
                     float(np.mean(accs)) if accs else float("nan"))
@@ -914,6 +984,11 @@ class Trainer:
                     if stats is not None:
                         obs.scalar("train/step_time_hosts_mean",
                                    stats["mean"], epoch, args=stats)
+                        if detector is not None:
+                            # straggler alert (ROADMAP): ratio above
+                            # HSTD_STRAGGLER_ALERT for 2 consecutive
+                            # epochs → one anomaly naming the slow host
+                            detector.observe_straggler(epoch, stats)
                 from huggingface_sagemaker_tensorflow_distributed_tpu.obs.watchdog import (
                     compile_budget_env,
                 )
@@ -1004,11 +1079,18 @@ class Trainer:
         history["train_samples_per_second"] = round(meter.samples_per_sec, 3)
         history["train_samples_per_second_per_chip"] = round(
             meter.samples_per_sec_per_chip, 3)
+        achieved = meter.achieved_tflops_per_chip
+        if achieved is not None:
+            history["train_achieved_tflops_per_chip"] = round(achieved, 6)
+            if meter.mfu is not None:
+                history["train_mfu"] = round(meter.mfu, 5)
         if obs_files:
             obs.scalar("train/runtime", sw.elapsed)
             obs.scalar("train/samples_per_sec_per_chip_final",
                        meter.samples_per_sec_per_chip)
             obs.scalar("train/compile_excluded_steps", meter.excluded_steps)
+            if meter.mfu is not None:
+                obs.scalar("train/mfu_final", meter.mfu)
         return history
 
     def evaluate(self, eval_batcher) -> dict:

@@ -41,17 +41,27 @@ class StepMeter:
     skip_first: int = 1
     sink: Optional[object] = None     # obs.MetricsSink-shaped (scalar())
     metric_name: str = "train/samples_per_sec"
+    # FLOPs/MFU accounting (obs/flops.py conventions): per-REAL-token
+    # training FLOPs (decoder stream separate for seq2seq) and the
+    # chip's peak TFLOP/s. Zero/None disables the accounting — windows
+    # then carry throughput only.
+    flops_per_token: float = 0.0
+    dec_flops_per_token: float = 0.0
+    peak_tflops: Optional[float] = None
     _t0: Optional[float] = None
     _steps: int = 0
     _samples: int = 0
     _measured_time: float = 0.0
     _measured_samples: int = 0
     _measured_steps: int = 0
+    _measured_flops: float = 0.0
     _excluded_steps: int = 0
     _epoch_times: list = field(default_factory=list)
     _w0: Optional[float] = None
     _w_samples: int = 0
     _w_steps: int = 0
+    _w_tokens: int = 0
+    _w_dec_tokens: int = 0
 
     def start_step(self) -> None:
         self._t0 = time.perf_counter()
@@ -79,10 +89,20 @@ class StepMeter:
         self._w0 = time.perf_counter()
         self._w_samples = 0
         self._w_steps = 0
+        self._w_tokens = 0
+        self._w_dec_tokens = 0
 
     def window_step(self, batch_samples: int) -> None:
         self._w_samples += batch_samples
         self._w_steps += 1
+
+    def window_tokens(self, tokens: int, dec_tokens: int = 0) -> None:
+        """Attribute REAL (attention-mask) token counts to the open
+        window — the trainer feeds batcher-counter deltas at sync
+        points, which is what makes the FLOPs figure packing-aware
+        (padded tokens never count)."""
+        self._w_tokens += int(tokens)
+        self._w_dec_tokens += int(dec_tokens)
 
     def exclude_step(self, batch_samples: int) -> None:
         """Count a step as run-but-excluded (it paid a compilation);
@@ -94,21 +114,65 @@ class StepMeter:
         self._w_samples = max(self._w_samples - batch_samples, 0)
         self._w_steps = max(self._w_steps - 1, 0)
 
-    def end_window(self) -> None:
+    def end_window(self) -> Optional[dict]:
         """Call right after a device sync; attributes the window's wall
-        time to the samples dispatched inside it."""
+        time to the samples (and real tokens) dispatched inside it.
+        Returns a summary dict for the closed window ({dt, steps,
+        samples, tokens, step_time_s, model_flops,
+        achieved_tflops_per_chip, mfu} — FLOPs fields None without the
+        accounting configured), or None when no window was open."""
         if self._w0 is None:
-            return
+            return None
+        if self._w_steps == 0:
+            # a window that saw no steps carries only dead time (eval,
+            # checkpoint saves, epoch bookkeeping) — attributing it
+            # would deflate throughput and poison the step-time series,
+            # so it is discarded, which is what lets callers bracket
+            # non-step work with end_window()/begin_window()
+            self._w0 = None
+            self._w_tokens = 0
+            self._w_dec_tokens = 0
+            return None
         dt = time.perf_counter() - self._w0
         self._measured_time += dt
         self._measured_samples += self._w_samples
         self._measured_steps += self._w_steps
         self._steps += self._w_steps
         self._samples += self._w_samples
-        self._w0 = None
+        flops = (self._w_tokens * self.flops_per_token
+                 + self._w_dec_tokens * self.dec_flops_per_token)
+        self._measured_flops += flops
+        summary = {
+            "dt": dt, "steps": self._w_steps, "samples": self._w_samples,
+            "tokens": self._w_tokens + self._w_dec_tokens,
+            "step_time_s": dt / self._w_steps if self._w_steps else None,
+            "model_flops": flops if flops > 0 else None,
+            "achieved_tflops_per_chip": None,
+            "mfu": None,
+        }
+        if flops > 0 and dt > 0:
+            achieved = flops / dt / max(1, self.n_chips) / 1e12
+            summary["achieved_tflops_per_chip"] = achieved
+            if self.peak_tflops:
+                summary["mfu"] = achieved / self.peak_tflops
         if self.sink is not None and self._w_steps and dt > 0:
             self.sink.scalar(self.metric_name, self._w_samples / dt,
                              self._steps)
+            self.sink.scalar("train/step_time_s", summary["step_time_s"],
+                             self._steps)
+            if summary["model_flops"] is not None:
+                self.sink.scalar("train/model_flops",
+                                 summary["model_flops"], self._steps)
+                self.sink.scalar("train/achieved_tflops_per_chip",
+                                 summary["achieved_tflops_per_chip"],
+                                 self._steps)
+                if summary["mfu"] is not None:
+                    self.sink.scalar("train/mfu", summary["mfu"],
+                                     self._steps)
+        self._w0 = None
+        self._w_tokens = 0
+        self._w_dec_tokens = 0
+        return summary
 
     @property
     def samples_per_sec(self) -> float:
@@ -131,6 +195,25 @@ class StepMeter:
         """Steps excluded from throughput (compiles: first step, new
         bucket widths, explicit ``recompiled=True``)."""
         return self._excluded_steps
+
+    # -- FLOPs/MFU over the whole measured run ------------------------------
+
+    @property
+    def achieved_tflops_per_chip(self) -> Optional[float]:
+        if self._measured_flops <= 0 or self._measured_time <= 0:
+            return None
+        return (self._measured_flops / self._measured_time
+                / max(1, self.n_chips) / 1e12)
+
+    @property
+    def mfu(self) -> Optional[float]:
+        """Model-FLOPs utilization over every measured window (real
+        tokens × analytic FLOPs ÷ wall ÷ chip peak); None without the
+        accounting or an unknown chip peak."""
+        achieved = self.achieved_tflops_per_chip
+        if achieved is None or not self.peak_tflops:
+            return None
+        return achieved / self.peak_tflops
 
 
 class Stopwatch:

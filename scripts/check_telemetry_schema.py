@@ -36,8 +36,10 @@ def expand(paths: list[str]) -> list[str]:
     out = []
     for p in paths:
         if os.path.isdir(p):
-            found = [os.path.join(p, n) for n in ("events.jsonl", "trace.json")
-                     if os.path.exists(os.path.join(p, n))]
+            found = [os.path.join(p, n) for n in sorted(os.listdir(p))
+                     if n in ("events.jsonl", "trace.json")
+                     or (n.endswith(".jsonl")
+                         and n.startswith(("events.host", "flight_")))]
             if not found:
                 out.append(os.path.join(p, "events.jsonl"))  # report missing
             out.extend(found)

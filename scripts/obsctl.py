@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""obsctl — operate on telemetry artifacts from outside the run.
+
+Usage::
+
+    # one merged, deterministic run report (JSON on stdout)
+    python scripts/obsctl.py report telemetry/
+    # several per-host dirs -> one report; readable rendering; save JSON
+    python scripts/obsctl.py report host0/ host1/ host2/ --text -o report.json
+    # schema-lint events/trace/flight artifacts (check_telemetry_schema)
+    python scripts/obsctl.py validate telemetry/
+
+``report`` merges every ``events.jsonl`` it finds under the given
+paths (a run dir, per-host dirs, or dirs of per-host subdirs) into one
+report: per-host step-time/MFU distributions, compile counts, memory
+watermarks, the straggler timeline, the anomaly index, and the serving
+SLO summary. The report is validated against its own schema before
+printing and the command exits nonzero if it does not pass — a report
+you can't trust is worse than none. Schema errors in the INPUT are
+carried in the report's ``errors`` field without failing the merge (a
+sick host is exactly when you want the report).
+
+Pure stdlib by construction (``obs.report``/``obs.schema`` import
+nothing outside the standard library): runs on boxes without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (  # noqa: E402
+    build_report,
+    find_event_files,
+    render_text,
+    validate_report,
+)
+
+
+def cmd_report(args: argparse.Namespace) -> int:
+    if not find_event_files(args.paths):
+        print(f"obsctl: no events.jsonl under {', '.join(args.paths)}",
+              file=sys.stderr)
+        return 1
+    report = build_report(args.paths)
+    problems = validate_report(report)
+    if problems:
+        for p in problems:
+            print(f"obsctl: invalid report: {p}", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"obsctl: wrote {args.out}", file=sys.stderr)
+    if args.text:
+        sys.stdout.write(render_text(report))
+    else:
+        json.dump(report, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    return 0
+
+
+def cmd_validate(args: argparse.Namespace) -> int:
+    from scripts.check_telemetry_schema import main as check_main
+
+    return check_main(args.paths)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="obsctl", description=__doc__)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    rep = sub.add_parser("report",
+                         help="merge per-host telemetry into one run report")
+    rep.add_argument("paths", nargs="+",
+                     help="telemetry dir(s), per-host dirs, or event files")
+    rep.add_argument("--text", action="store_true",
+                     help="readable rendering instead of JSON")
+    rep.add_argument("-o", "--out", default=None,
+                     help="also write the JSON report to this path")
+    rep.set_defaults(func=cmd_report)
+
+    val = sub.add_parser("validate",
+                         help="schema-lint telemetry artifacts "
+                              "(check_telemetry_schema)")
+    val.add_argument("paths", nargs="+")
+    val.set_defaults(func=cmd_validate)
+
+    args = parser.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

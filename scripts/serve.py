@@ -138,14 +138,22 @@ def main() -> None:
             "ttft_s": round(req.ttft_s, 4) if req.ttft_s else None,
             "preemptions": req.preemptions}))
     stats = engine.stats()
-    ttfts = [r.ttft_s for r in reqs if r.ttft_s is not None]
+    # SLO summary from the engine's own accounting (the same figures
+    # its final `serve` report telemetry event carries): TTFT + e2e
+    # latency percentiles and scheduler gauges
+    slo = engine.slo_summary()
     print(json.dumps({
         "summary": True,
         "requests": len(reqs),
         "tokens": total,
         "tokens_per_sec": round(total / wall, 1),
-        "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4),
-        "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 4),
+        "ttft_p50_s": slo.get("ttft_p50_s"),
+        "ttft_p95_s": slo.get("ttft_p95_s"),
+        "ttft_p99_s": slo.get("ttft_p99_s"),
+        "e2e_p50_s": slo.get("e2e_p50_s"),
+        "e2e_p95_s": slo.get("e2e_p95_s"),
+        "e2e_p99_s": slo.get("e2e_p99_s"),
+        "peak_waiting_depth": slo.get("peak_waiting_depth"),
         "decode_steps": stats.decode_steps,
         "prefill_chunks": stats.prefill_chunks,
         "preemptions": stats.preemptions,

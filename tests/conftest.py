@@ -188,6 +188,8 @@ _SLOW_TESTS = {
     "test_kv_cache.py::test_int8_kv_decode_matches_fp",
     "test_kv_cache.py::test_int8_kv_composes_with_speculative",
     "test_prefill_chunk.py",     # whole module: scan-prefill compiles
+    # observability plane (ISSUE 4): first jax.profiler trace ≈ 17s
+    "test_anomaly.py::test_profiler_window_on_anomaly",
     "test_beam_causal.py",       # whole module: HF beam parity compiles
     "test_sharded_generation.py",  # whole module: tp-mesh decode compiles
     "test_speculative_seq2seq.py",  # whole module: T5 spec-decode compiles

@@ -1,0 +1,272 @@
+"""obsctl / cross-host report tests (ISSUE 4): synthetic 3-host
+telemetry (one straggler, one anomaly) merges into one deterministic
+report — identical across every input ordering — that passes its own
+schema validator; the CLI round-trips it; host identity comes from the
+events, not the directory layout.
+"""
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+    build_report,
+    find_event_files,
+    render_text,
+    validate_report,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_OBSCTL = os.path.join(_REPO, "scripts", "obsctl.py")
+
+
+def _ev(host, t, etype, **fields):
+    return {"v": 1, "t": t, "host": host, "pid": 100 + host,
+            "type": etype, **fields}
+
+
+def _write(path, events):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+@pytest.fixture()
+def three_hosts(tmp_path):
+    """Host 0 (rank 0: run header, straggler timeline, serve report),
+    host 1 (healthy), host 2 (the straggler, with one anomaly)."""
+    step_times = {0: 0.10, 1: 0.11, 2: 0.19}
+    dirs = []
+    for host in range(3):
+        events = []
+        t = 1000.0 + host
+        if host == 0:
+            events.append(_ev(0, t, "run", argv=["train.py", "--epochs=2"]))
+        for i in range(4):
+            t += 1
+            events.append(_ev(host, t, "metric", name="train/step_time_s",
+                              value=step_times[host], step=i))
+            events.append(_ev(host, t, "metric",
+                              name="train/samples_per_sec",
+                              value=100.0 / step_times[host], step=i))
+            events.append(_ev(host, t, "metric", name="train/mfu",
+                              value=0.31 - 0.01 * host, step=i))
+        events.append(_ev(host, t + 1, "compile",
+                          event="/jax/pjit/compile", dur=2.0,
+                          count=5 + host, cum=11.5))
+        events.append(_ev(host, t + 2, "heartbeat", uptime=60.0,
+                          progress=400, progress_age=0.5))
+        events.append(_ev(host, t + 3, "memory", device="tpu:0",
+                          stats={"peak_bytes_in_use": 9 << 30,
+                                 "bytes_limit": 16 << 30}))
+        if host == 0:
+            for epoch in range(2):
+                events.append(_ev(0, t + 4 + epoch, "metric",
+                                  name="train/step_time_hosts_mean",
+                                  value=0.133, step=epoch,
+                                  args={"n_hosts": 3, "min": 0.10,
+                                        "max": 0.19, "mean": 0.133,
+                                        "straggler_ratio": 1.425,
+                                        "argmax": 2}))
+            events.append(_ev(0, t + 7, "anomaly", name="straggler",
+                              message="host 2 is a persistent "
+                                      "straggler: step-time ratio 1.425 "
+                                      "> 1.1 for 2 consecutive epochs "
+                                      "(epoch 1)",
+                              step=1, slow_host=2))
+            events.append(_ev(0, t + 8, "serve", event="report",
+                              requests=48, tokens=512, iterations=90,
+                              preemptions=2, peak_waiting_depth=7,
+                              kv_peak_utilization=0.83,
+                              ttft_p50_s=0.02, ttft_p95_s=0.05,
+                              ttft_p99_s=0.07, e2e_p50_s=0.4,
+                              e2e_p95_s=0.9, e2e_p99_s=1.2))
+        if host == 2:
+            events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
+                              message="step time 0.9s exceeds rolling "
+                                      "median 0.19s", step=3,
+                              evidence="flight_3.jsonl"))
+        d = tmp_path / f"host{host}"
+        _write(str(d / "events.jsonl"), events)
+        dirs.append(str(d))
+    return dirs
+
+
+def test_merged_report_structure(three_hosts):
+    report = build_report(three_hosts)
+    assert validate_report(report) == []
+    assert sorted(report["hosts"]) == ["0", "1", "2"]
+    assert report["run"]["n_hosts"] == 3
+    assert report["run"]["argv"] == ["train.py", "--epochs=2"]
+    # the straggler is visible twice: per-epoch timeline + host section
+    timeline = report["straggler_timeline"]
+    assert len(timeline) == 2
+    assert all(row["argmax_host"] == 2 for row in timeline)
+    assert timeline[0]["straggler_ratio"] == pytest.approx(1.425)
+    # host 2's step-time distribution sits above host 0's
+    assert (report["hosts"]["2"]["step_time_s"]["p50"]
+            > report["hosts"]["0"]["step_time_s"]["p50"])
+    # the anomaly index carries both incidents: host 0's straggler
+    # alert (epoch 1) and host 2's local spike
+    assert len(report["anomaly_index"]) == 2
+    assert {(a["host"], a["name"]) for a in report["anomaly_index"]} \
+        == {(0, "straggler"), (2, "step_time_spike")}
+    assert report["hosts"]["2"]["anomalies"] == 1
+    assert report["hosts"]["0"]["anomalies"] == 1
+    # serving SLO summary came from the engine's report event
+    assert report["serve"]["requests"] == 48
+    assert report["serve"]["ttft_p99_s"] == pytest.approx(0.07)
+    assert report["serve"]["peak_waiting_depth"] == 7
+    # compile + memory rollups
+    assert report["hosts"]["1"]["compile"] == {"count": 6, "cum_s": 11.5}
+    assert report["hosts"]["0"]["memory"]["peak_bytes_in_use"] == 9 << 30
+    assert report["errors"] == []
+
+
+def test_report_deterministic_across_input_orderings(three_hosts):
+    reference = build_report(three_hosts)
+    for perm in itertools.permutations(three_hosts):
+        assert build_report(list(perm)) == reference
+    # byte-identical JSON, not just dict-equal
+    blob = json.dumps(reference, sort_keys=True)
+    for perm in itertools.permutations(three_hosts):
+        assert json.dumps(build_report(list(perm)), sort_keys=True) == blob
+
+
+def test_parent_dir_discovers_host_subdirs(three_hosts, tmp_path):
+    assert len(find_event_files([str(tmp_path)])) == 3
+    report = build_report([str(tmp_path)])
+    assert report == build_report(three_hosts)
+
+
+def test_schema_errors_reported_not_fatal(three_hosts, tmp_path):
+    bad = tmp_path / "host3"
+    _write(str(bad / "events.jsonl"),
+           [_ev(3, 2000.0, "metric", value=1.0),     # missing name
+            _ev(3, 2001.0, "metric", name="ok", value=2.0)])
+    report = build_report(three_hosts + [str(bad)])
+    assert validate_report(report) == []
+    assert sorted(report["hosts"]) == ["0", "1", "2", "3"]
+    assert report["hosts"]["3"]["events"] == 1       # valid line kept
+    assert any("missing field 'name'" in e for e in report["errors"])
+
+
+def test_render_text_readable(three_hosts):
+    text = render_text(build_report(three_hosts))
+    assert "host 2:" in text and "1 anomalies" in text
+    assert "straggler timeline:" in text and "host 2 slow" in text
+    assert "serve: 48 requests" in text
+    assert "step time: p50" in text
+
+
+def test_cli_report_json_and_text(three_hosts, tmp_path):
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, _OBSCTL, "report", *three_hosts,
+         "-o", str(out)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=_REPO)
+    assert proc.returncode == 0, proc.stderr
+    stdout_report = json.loads(proc.stdout)
+    assert validate_report(stdout_report) == []
+    assert json.loads(out.read_text()) == stdout_report
+    text = subprocess.run(
+        [sys.executable, _OBSCTL, "report", "--text", *three_hosts],
+        stdout=subprocess.PIPE, text=True, cwd=_REPO)
+    assert "straggler timeline:" in text.stdout
+
+
+def test_cli_runs_without_jax(three_hosts):
+    """The stdlib contract: obsctl must work on jax-less boxes."""
+    code = ("import sys, runpy; sys.modules['jax'] = None; "
+            "sys.argv = ['obsctl', 'report'] + %r; "
+            "runpy.run_path(%r, run_name='__main__')"
+            % (list(three_hosts), _OBSCTL))
+    proc = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_cli_report_rejects_empty_input(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, _OBSCTL, "report", str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=_REPO)
+    assert proc.returncode == 1
+    assert "no events.jsonl" in proc.stderr
+
+
+def test_allgather_duplicates_collapse_to_one_incident(tmp_path):
+    """Under HSTD_TELEMETRY_ALL_HOSTS every host emits the SAME
+    allgathered straggler metric and the same collective-derived
+    anomaly; the merge must report one timeline row per epoch and one
+    incident, not N copies."""
+    args = {"n_hosts": 2, "min": 0.1, "max": 0.2, "mean": 0.15,
+            "straggler_ratio": 1.33, "argmax": 1}
+    for host in range(2):
+        _write(str(tmp_path / f"h{host}" / "events.jsonl"), [
+            _ev(host, 1000.0 + host, "metric",
+                name="train/step_time_hosts_mean", value=0.15, step=0,
+                args=args),
+            _ev(host, 1001.0 + host, "anomaly", name="straggler",
+                message="host 1 is a persistent straggler", step=0,
+                slow_host=1),
+        ])
+    report = build_report([str(tmp_path / "h0"), str(tmp_path / "h1")])
+    assert len(report["straggler_timeline"]) == 1
+    assert len(report["anomaly_index"]) == 1
+    assert report["anomaly_index"][0]["host"] == 0   # lowest host kept
+
+
+def test_all_hosts_event_files_produced_and_merged(tmp_path, monkeypatch):
+    """HSTD_TELEMETRY_ALL_HOSTS=1: a non-zero host writes its OWN
+    events.host<K>.jsonl (no shared-file append interleaving), and the
+    report merges it — the path that makes N-host reports real."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+    monkeypatch.setenv("HSTD_TELEMETRY_ALL_HOSTS", "1")
+    out = tmp_path / "t"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        obs.set_host(1, 2)
+        obs.scalar("train/step_time_s", 0.25, 3)
+        obs.flush()
+    finally:
+        obs.reset()
+    assert (out / "events.host1.jsonl").exists()
+    assert not (out / "events.jsonl").exists()   # host 0 never wrote
+    assert find_event_files([str(out)]) == [str(out /
+                                                "events.host1.jsonl")]
+    report = build_report([str(out)])
+    assert list(report["hosts"]) == ["1"]
+    assert report["hosts"]["1"]["step_time_s"]["count"] == 1
+
+
+def test_default_demotion_still_closes_nonzero_hosts(tmp_path):
+    """Without the all-hosts knob, the PR 1 discipline holds: a host
+    demoted from the rank-0 guess writes nothing."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+    out = tmp_path / "t"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        obs.set_host(1, 2)
+        obs.scalar("train/loss", 1.0, 0)
+        obs.flush()
+    finally:
+        obs.reset()
+    assert find_event_files([str(out)]) == []
+
+
+def test_cli_validate_subcommand(three_hosts):
+    proc = subprocess.run(
+        [sys.executable, _OBSCTL, "validate", three_hosts[0]],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=_REPO)
+    assert proc.returncode == 0, proc.stdout

@@ -103,6 +103,99 @@ def test_validator_tolerates_torn_tail_not_middle(tmp_path):
     assert _run(str(torn_mid)).returncode == 1
 
 
+def test_produced_train_and_serve_artifacts_validate(tmp_path):
+    """The drift gate the hand-built fixtures can't provide: run a REAL
+    tiny instrumented train + serve step and push the PRODUCED
+    events.jsonl through the validator script end-to-end — a new event
+    type (like PR 3's ``serve``) that forgets the schema, or a schema
+    change that forgets an emitter, fails here fast."""
+    import numpy as np
+
+    out = tmp_path / "telemetry"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        from tests.test_trainer import _data, _tiny_model
+        from huggingface_sagemaker_tensorflow_distributed_tpu.config import (
+            TrainConfig,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.data import (
+            ShardedBatcher,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+            init_params,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+            Gpt2Config,
+            Gpt2LMHeadModel,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel import (
+            MeshConfig,
+            build_mesh,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+            ServeEngine,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.train import (
+            Trainer,
+        )
+
+        cfg = TrainConfig(epochs=1, train_batch_size=2, dtype="float32",
+                          scale_lr_by_world_size=False,
+                          output_data_dir=str(tmp_path), log_every_steps=2)
+        mesh = build_mesh(MeshConfig())
+        model, params = _tiny_model()
+        Trainer(cfg, model, params, mesh).fit(
+            ShardedBatcher(_data(n=32), 16, mesh, shuffle=False, seed=0))
+
+        gcfg = Gpt2Config(vocab_size=128, hidden_size=32, num_layers=1,
+                          num_heads=2, intermediate_size=64,
+                          max_position_embeddings=64, hidden_dropout=0.0,
+                          embd_dropout=0.0, attention_dropout=0.0,
+                          eos_token_id=127, pad_token_id=0)
+        gmodel = Gpt2LMHeadModel(gcfg)
+        eng = ServeEngine(gmodel, init_params(gmodel, gcfg, seed=0),
+                          num_slots=2, block_size=8, num_blocks=17,
+                          prefill_chunk=8, max_model_len=32)
+        eng.submit(np.arange(1, 6, dtype=np.int32), 4)
+        eng.submit(np.arange(2, 10, dtype=np.int32), 3)
+        eng.run()
+        obs.flush()
+        events = [e for _, e, err in obs.iter_events(
+            str(out / "events.jsonl")) if err is None]
+    finally:
+        obs.reset()
+    types = {e["type"] for e in events}
+    # both subsystems actually emitted (an empty gate proves nothing)
+    assert {"metric", "span", "serve"} <= types
+    serve_events = {e.get("event") for e in events if e["type"] == "serve"}
+    assert {"submit", "first_token", "finish", "report"} <= serve_events
+    proc = _run(str(out))
+    assert proc.returncode == 0, proc.stdout
+    assert proc.stdout.count("OK") == 2          # events.jsonl + trace.json
+
+
+def test_validator_accepts_anomaly_and_flight_artifacts(tmp_path):
+    """Anomaly events and flight dumps are schema-valid artifacts the
+    validator blesses like any event stream."""
+    out = tmp_path / "telemetry"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        obs.scalar("train/loss", 1.0, 1)
+        det = obs.anomalies()
+        for i in range(10):
+            det.observe_step_time(i, 0.1)
+        det.observe_step_time(10, 9.0)
+        obs.flush()
+        flights = [f for f in os.listdir(out)
+                   if f.startswith("flight_")]
+        assert flights
+    finally:
+        obs.reset()
+    proc = _run(str(out / "events.jsonl"),
+                *(str(out / f) for f in flights))
+    assert proc.returncode == 0, proc.stdout
+
+
 def test_validator_rejects_bad_trace(tmp_path):
     trace = tmp_path / "trace.json"
     trace.write_text(json.dumps({"traceEvents": [
