@@ -61,8 +61,12 @@ Extra modes (each also prints one JSON line per run):
   --serve              continuous-batching serving engine (serve/:
                        paged KV + iteration-level scheduling) vs
                        static-batch generate_causal on a mixed-length
-                       request trace: speedup, TTFT p50/p99, KV-pool
-                       utilization, zero-recompile check.
+                       request trace (speedup, TTFT p50/p99, KV-pool
+                       utilization, compile-flatness check), plus the
+                       width-bucketed gather line: bucketed vs
+                       full-width decode tokens/sec on a short-context
+                       trace (>=1.3x CPU gate, identical outputs,
+                       compiles <= #buckets).
 
 Every metric line additionally carries a ``memory`` watermark field on
 accelerator backends (peak_bytes_in_use vs bytes_limit, ROADMAP "Memory
@@ -526,7 +530,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
     if getattr(args, "data", False):
         return ["data_pipeline_microbench"]
     if getattr(args, "serve", False):
-        return ["serve_continuous_vs_static_speedup"]
+        return ["serve_continuous_vs_static_speedup",
+                "serve_bucketed_gather_decode_speedup"]
     if args.llama_train:
         return ["llama_1b_train_samples_per_sec_per_chip"]
     if args.mixtral_train:
@@ -801,9 +806,11 @@ def main() -> None:
                         help="continuous-batching serving bench: mixed-"
                              "length request trace through serve/engine "
                              "(paged KV + iteration-level scheduling) vs "
-                             "static-batch generate_causal; TTFT "
+                             "static-batch generate_causal (TTFT "
                              "p50/p99, aggregate tokens/sec, KV-pool "
-                             "utilization, compile flatness")
+                             "utilization, compile flatness) + the "
+                             "bucketed-gather decode speedup on a "
+                             "short-context trace")
     parser.add_argument("--llama-train", action="store_true",
                         dest="llama_train",
                         help="TinyLlama-1.1B training throughput "

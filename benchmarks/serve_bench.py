@@ -1,33 +1,36 @@
-"""Serving bench (``bench.py --serve``): continuous batching + paged KV
-vs static-batch ``generate_causal`` on a mixed-length request trace.
+"""Serving bench (``bench.py --serve``): two JSON metric lines.
 
-The trace is the static-batching WORST CASE that real traffic actually
-looks like (Orca's motivating workload): most requests want a short
-continuation, a minority want a long one, and prompt lengths vary. A
-static batch runs every row for the batch's LONGEST request (the short
-rows ride along emitting pads), and admits nothing until the whole
-batch drains; the engine refills each slot the moment its request
-finishes. Both sides run the same model, the same per-step batch width
-(``num_slots``), and produce token-for-token identical greedy outputs —
-the bench asserts that, so the speedup is bought by scheduling and
-paging alone, not by changed semantics.
+1. ``serve_continuous_vs_static_speedup`` — continuous batching + paged
+   KV vs static-batch ``generate_causal`` on a mixed-length request
+   trace. The trace is the static-batching WORST CASE that real traffic
+   actually looks like (Orca's motivating workload): most requests want
+   a short continuation, a minority want a long one, and prompt lengths
+   vary. A static batch runs every row for the batch's LONGEST request
+   and admits nothing until the whole batch drains; the engine refills
+   each slot the moment its request finishes. Both sides run the same
+   model, the same per-step batch width (``num_slots``), and produce
+   token-for-token identical greedy outputs — the bench asserts that,
+   so the speedup is bought by scheduling and paging alone, not by
+   changed semantics. (ISSUE 3 acceptance: ≥ 2x on the CPU trace.)
 
-Reported (one JSON line, ``serve_continuous_vs_static_speedup``):
+2. ``serve_bucketed_gather_decode_speedup`` — the ISSUE 5 decode fast
+   path, isolated: a SHORT-CONTEXT trace (every resident context far
+   below ``max_model_len``) served twice by the same engine geometry,
+   once with the width-bucketed gather ladder and once forced to
+   full-width gather. The value is the ratio of DECODE tokens/sec
+   (decode-dispatch wall time only, from the engine's own accounting),
+   i.e. exactly the KV read traffic bucketing eliminates. Acceptance
+   (enforced in the line on the full CPU trace, structural gates
+   always): ratio ≥ 1.3x, identical outputs both ways, and
+   steady-state compile delta ≤ the number of configured buckets.
 
-- ``value``      engine aggregate tokens/sec ÷ static tokens/sec
-                 (the ISSUE 3 acceptance gate is ≥ 2x on the CPU trace)
-- ``detail``     both absolute tokens/sec figures, TTFT p50/p99 across
-                 requests, KV-pool peak utilization + block
-                 fragmentation, preemption count, and
-                 ``compiles_steady`` — the compile-tracker event delta
-                 across the measured (post-warmup) engine run, which
-                 MUST be 0 (static shapes: nothing retraces).
-
-Both sides are measured on their second pass (first pass compiles).
+Structural gates degrade the line to the structured-error shape (value
+null + ``error``) rather than lying with a number. Both sides of every
+comparison are measured on their second pass (first pass compiles).
 ``smoke=True`` shrinks the model/trace for the tier-1 CPU gate
-(``tests/test_serve_bench.py``); the full CPU mode uses a model large
-enough that per-step compute dominates dispatch overhead, so the
-speedup measures scheduling waste, not Python.
+(``tests/test_serve_bench.py``) and skips the ratio acceptance (at
+smoke scale dispatch overhead dominates); the full CPU modes use
+models large enough that per-step compute dominates dispatch overhead.
 """
 
 from __future__ import annotations
@@ -112,13 +115,17 @@ def run_static(model, params, trace, batch_size: int, eos: int):
 
 
 def run_engine(model, params, trace, *, num_slots: int, block_size: int,
-               num_blocks: int, prefill_chunk: int, max_model_len: int):
+               num_blocks: int, prefill_chunk: int, max_model_len: int,
+               gather_buckets=None):
     """Measured continuous-batching pass: engine warmup + one full
     throwaway pass (compiles everything), then the timed pass on a
     fresh engine reusing nothing but the params. Returns
-    (wall_s, outputs, tokens, stats, compile_delta, slo_summary) —
-    TTFT/e2e latency flows exclusively through the engine's
-    ``slo_summary()`` (one percentile convention with obsctl)."""
+    (wall_s, outputs, tokens, stats, compile_delta, slo_summary,
+    gather_buckets) — the bucket ladder comes from the MEASURED engine
+    (which may have read ``HSTD_SERVE_GATHER_BUCKETS``), so the
+    caller's compile gate bounds what actually ran; TTFT/e2e latency
+    flows exclusively through the engine's ``slo_summary()`` (one
+    percentile convention with obsctl)."""
     from huggingface_sagemaker_tensorflow_distributed_tpu import obs
     from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
         ServeEngine,
@@ -128,7 +135,8 @@ def run_engine(model, params, trace, *, num_slots: int, block_size: int,
         return ServeEngine(model, params, num_slots=num_slots,
                            block_size=block_size, num_blocks=num_blocks,
                            prefill_chunk=prefill_chunk,
-                           max_model_len=max_model_len)
+                           max_model_len=max_model_len,
+                           gather_buckets=gather_buckets)
 
     warm = build()
     for prompt, max_new in trace:
@@ -148,10 +156,36 @@ def run_engine(model, params, trace, *, num_slots: int, block_size: int,
     compile_delta = (tracker.count - count0) if tracker else None
     outs = [list(eng.output_ids(r)) for r in reqs]
     return wall, outs, sum(len(o) for o in outs), eng.stats(), \
-        compile_delta, eng.slo_summary()
+        compile_delta, eng.slo_summary(), eng.gather_buckets
 
 
-def bench_serve(smoke: bool = False) -> dict:
+def _bench_env():
+    try:
+        from bench import _on_tpu, anomaly_field, memory_watermark
+        on_tpu = _on_tpu()
+    except ImportError:                     # direct module invocation
+        on_tpu = False
+        memory_watermark = lambda: None  # noqa: E731
+        anomaly_field = lambda: {"anomalies": 0}  # noqa: E731
+    return on_tpu, anomaly_field, memory_watermark
+
+
+def _emit(result, anomaly_field, memory_watermark, speedup_key: str):
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+
+    result.update(anomaly_field())
+    mem = memory_watermark()
+    if mem is not None:
+        result["memory"] = mem
+    if result["value"] is not None:
+        obs.scalar(speedup_key, result["value"])
+    print(json.dumps(result))
+    return result
+
+
+def bench_serve_mixed(smoke: bool = False) -> dict:
+    """Metric line 1: continuous batching vs static batching on the
+    mixed-length skewed trace."""
     import jax.numpy as jnp
 
     from huggingface_sagemaker_tensorflow_distributed_tpu import obs
@@ -163,13 +197,7 @@ def bench_serve(smoke: bool = False) -> dict:
         Gpt2LMHeadModel,
     )
 
-    try:
-        from bench import _on_tpu, anomaly_field, memory_watermark
-        on_tpu = _on_tpu()
-    except ImportError:                     # direct module invocation
-        on_tpu = False
-        memory_watermark = lambda: None  # noqa: E731
-        anomaly_field = lambda: {"anomalies": 0}  # noqa: E731
+    on_tpu, anomaly_field, memory_watermark = _bench_env()
 
     rng = np.random.RandomState(0)
     if smoke:
@@ -216,11 +244,12 @@ def bench_serve(smoke: bool = False) -> dict:
                                               cfg.eos_token_id)
     with obs.span("bench/serve_engine"):
         (e_wall, e_outs, e_tokens, stats,
-         compile_delta, slo) = run_engine(
+         compile_delta, slo, eng_buckets) = run_engine(
             model, params, trace, num_slots=slots, block_size=block,
             num_blocks=num_blocks, prefill_chunk=chunk,
             max_model_len=max_len)
 
+    n_buckets = len(eng_buckets)
     exact = e_outs == s_outs
     static_tps = s_tokens / s_wall
     engine_tps = e_tokens / e_wall
@@ -228,8 +257,13 @@ def bench_serve(smoke: bool = False) -> dict:
     # the structural gates are ENFORCED here, not just reported: a
     # speedup bought by changed tokens or steady-state retraces is not
     # a measurement, so the line degrades to the structured-failure
-    # shape (value null + "error") that the driver contract defines
-    gate_ok = exact and compile_delta in (None, 0)
+    # shape (value null + "error") that the driver contract defines.
+    # Compile flatness allows one lazy compile per configured gather
+    # bucket (the ISSUE 5 contract: steady-state decode compiles ≤
+    # #buckets); the warm pass normally precompiles them all, so the
+    # observed delta is still 0.
+    gate_ok = exact and (compile_delta is None
+                         or compile_delta <= n_buckets)
     result = {
         "metric": "serve_continuous_vs_static_speedup",
         "value": round(speedup, 3) if gate_ok else None,
@@ -259,6 +293,11 @@ def bench_serve(smoke: bool = False) -> dict:
             "preemptions": stats.preemptions,
             "decode_steps": stats.decode_steps,
             "prefill_chunks": stats.prefill_chunks,
+            "prefill_dispatches": stats.prefill_dispatches,
+            "gather_buckets": eng_buckets,
+            "bucket_switches": stats.bucket_switches,
+            "gather_read_waste_peak": round(stats.gather_waste_peak, 3),
+            "gather_read_waste_mean": round(stats.gather_waste_mean, 3),
             "compiles_steady": compile_delta,
             "exact_match": exact,
             "model_scale": ("smoke" if smoke
@@ -266,16 +305,153 @@ def bench_serve(smoke: bool = False) -> dict:
             "speedup_measured": round(speedup, 3),
         },
     }
-    result.update(anomaly_field())
     if not gate_ok:
         result["error"] = ("engine_output_diverged" if not exact
                           else "steady_state_recompiled")
-    mem = memory_watermark()
-    if mem is not None:
-        result["memory"] = mem
-    obs.scalar("bench/serve_speedup", speedup)
-    print(json.dumps(result))
-    return result
+    return _emit(result, anomaly_field, memory_watermark,
+                 "bench/serve_speedup")
+
+
+def bench_serve_bucketed(smoke: bool = False) -> dict:
+    """Metric line 2: the short-context trace where width-bucketed
+    gather must win — the same engine geometry served with the bucket
+    ladder vs forced full-width gather, compared on DECODE tokens/sec
+    (decode-dispatch wall time only). Greedy both ways, identical
+    outputs asserted: the ratio isolates read traffic, not semantics."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+
+    on_tpu, anomaly_field, memory_watermark = _bench_env()
+
+    rng = np.random.RandomState(1)
+    if smoke:
+        cfg = Gpt2Config(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=128,
+                         max_position_embeddings=128, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0,
+                         eos_token_id=255, pad_token_id=0)
+        slots, block, chunk, max_len = 4, 8, 8, 64
+        buckets = [16, 64]
+        n_req, prompt_lo, prompt_hi = 8, 2, 6
+        short_new, long_new, long_every = (2, 5), (4, 8), 4
+    elif on_tpu:
+        cfg = Gpt2Config(dtype=jnp.bfloat16, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0)  # 124M
+        slots, block, chunk, max_len = 16, 16, 16, 1024
+        buckets = [128, 1024]
+        n_req, prompt_lo, prompt_hi = 64, 16, 48
+        short_new, long_new, long_every = (16, 32), (48, 64), 8
+    else:
+        # CPU short-context trace (the ISSUE 5 acceptance surface):
+        # every resident context fits the small bucket, so the bucketed
+        # engine's decode step gathers/attends 1/16 of the full-width
+        # KV span — the read-traffic elimination the ratio measures.
+        # The model is sized so the per-step gather is a real memory
+        # cost (not hidden under Python dispatch), and the width gap is
+        # wide enough that the ≥1.3x gate holds across this container's
+        # large run-to-run memory-bandwidth variance (observed
+        # 1.7x-6.1x at a 512 span; 1024 doubles the full-width read).
+        cfg = Gpt2Config(vocab_size=2048, hidden_size=256, num_layers=4,
+                         num_heads=8, intermediate_size=1024,
+                         max_position_embeddings=1024, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0,
+                         eos_token_id=2047, pad_token_id=0)
+        slots, block, chunk, max_len = 8, 16, 8, 1024
+        buckets = [64, 1024]
+        n_req, prompt_lo, prompt_hi = 32, 4, 8
+        short_new, long_new, long_every = (8, 16), (24, 32), 6
+    # roomy pool: the comparison isolates gather width, not preemption
+    num_blocks = 1 + slots * (max(short_new[1], long_new[1])
+                              + prompt_hi + block) // block + slots
+
+    model = Gpt2LMHeadModel(cfg)
+    params = init_params(model, cfg, seed=0)
+    trace = make_trace(rng, n_req, min(cfg.vocab_size - 2, 1 << 16),
+                       prompt_lo, prompt_hi, short_new, long_new,
+                       long_every)
+    kw = dict(num_slots=slots, block_size=block, num_blocks=num_blocks,
+              prefill_chunk=chunk, max_model_len=max_len)
+
+    with obs.span("bench/serve_bucketed_full"):
+        (f_wall, f_outs, _f_tokens, f_stats, f_delta,
+         _f_slo, _) = run_engine(model, params, trace,
+                                 gather_buckets=[max_len], **kw)
+    with obs.span("bench/serve_bucketed_ladder"):
+        (b_wall, b_outs, _b_tokens, b_stats, b_delta,
+         _b_slo, buckets) = run_engine(model, params, trace,
+                                       gather_buckets=buckets, **kw)
+
+    exact = b_outs == f_outs
+    full_tps = (f_stats.decode_tokens / f_stats.decode_time_s
+                if f_stats.decode_time_s > 0 else 0.0)
+    bucketed_tps = (b_stats.decode_tokens / b_stats.decode_time_s
+                    if b_stats.decode_time_s > 0 else 0.0)
+    ratio = bucketed_tps / full_tps if full_tps > 0 else 0.0
+    # each side is bounded by ITS OWN ladder: the forced full-width
+    # engine has exactly one bucket, so a retrace there (which would
+    # inflate the reported speedup) is never excused by the ladder size
+    compiles_ok = ((f_delta is None or f_delta <= 1)
+                   and (b_delta is None or b_delta <= len(buckets)))
+    # structural gates always; the ≥1.3x acceptance only where it is a
+    # measurement (the full CPU trace — smoke scale is dispatch-bound,
+    # and the TPU number is banked, not gated, until hardware runs it)
+    gate_ok = exact and compiles_ok and (
+        smoke or on_tpu or ratio >= 1.3)
+    result = {
+        "metric": "serve_bucketed_gather_decode_speedup",
+        "value": round(ratio, 3) if gate_ok else None,
+        "unit": "x" if gate_ok else None,
+        "vs_baseline": round(ratio, 3) if gate_ok else None,
+        "detail": {
+            "bucketed_decode_tokens_per_sec": round(bucketed_tps, 1),
+            "fullwidth_decode_tokens_per_sec": round(full_tps, 1),
+            "bucketed_wall_s": round(b_wall, 3),
+            "fullwidth_wall_s": round(f_wall, 3),
+            "gather_buckets": buckets,
+            "max_model_len": max_len,
+            "bucket_switches": b_stats.bucket_switches,
+            "gather_read_waste_peak_bucketed": round(
+                b_stats.gather_waste_peak, 3),
+            "gather_read_waste_mean_bucketed": round(
+                b_stats.gather_waste_mean, 3),
+            "gather_read_waste_mean_fullwidth": round(
+                f_stats.gather_waste_mean, 3),
+            "requests": n_req,
+            "num_slots": slots,
+            "block_size": block,
+            "prefill_chunk": chunk,
+            "decode_steps": b_stats.decode_steps,
+            "compiles_steady_bucketed": b_delta,
+            "compiles_steady_fullwidth": f_delta,
+            "exact_match": exact,
+            "model_scale": ("smoke" if smoke
+                            else "real" if on_tpu else "cpu"),
+            "ratio_measured": round(ratio, 3),
+            "ratio_gated": not (smoke or on_tpu),
+        },
+    }
+    if not gate_ok:
+        result["error"] = (
+            "bucketed_output_diverged" if not exact
+            else "steady_state_recompiled" if not compiles_ok
+            else "bucketed_speedup_below_gate")
+    return _emit(result, anomaly_field, memory_watermark,
+                 "bench/serve_bucketed_speedup")
+
+
+def bench_serve(smoke: bool = False) -> list[dict]:
+    """Both serve metric lines, mixed-trace first (the driver reads
+    stdout lines; the return value is for tests)."""
+    return [bench_serve_mixed(smoke=smoke),
+            bench_serve_bucketed(smoke=smoke)]
 
 
 if __name__ == "__main__":

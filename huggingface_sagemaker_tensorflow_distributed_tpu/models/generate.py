@@ -97,6 +97,62 @@ def _filter_top_p(logits, top_p: float):
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
+def warp_logits_per_slot(logits, temperature, top_k, top_p):
+    """Per-ROW warping for batches where every row carries its own
+    sampling configuration (the serve engine's decode slots): the same
+    temperature → top-k → top-p sequence as :func:`_warp_logits`, with
+    the knobs as [rows] arrays instead of static scalars. Numeric
+    conventions match the static filters exactly (strict-``<`` top-p
+    boundary with the 1e-6 float32-cumsum tolerance, the argmax always
+    kept, oversized/zero ``top_k`` keeping everything) so a per-slot
+    configuration can never drift from what ``generate`` would sample.
+    Rows with ``temperature == 0`` pass through UNWARPED — greedy rows
+    select via argmax on the raw logits, not these."""
+    V = logits.shape[-1]
+    t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / t
+    # dynamic top-k: k-th largest per row via sort + dynamic index
+    # (k <= 0 or k >= V keeps everything, as _filter_top_k does)
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k = jnp.clip(top_k, 0, V)
+    kth = jnp.take_along_axis(sorted_desc,
+                              jnp.maximum(k - 1, 0)[:, None], axis=-1)
+    k_on = ((k > 0) & (k < V))[:, None]
+    filtered = jnp.where(k_on & (scaled < kth), -jnp.inf, scaled)
+    # dynamic top-p over the top-k survivors (the _warp_logits order)
+    sorted_f = jnp.sort(filtered, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep_sorted = cum_before < top_p[:, None] - 1e-6
+    keep_sorted = keep_sorted.at[..., 0].set(True)
+    pth = jnp.min(jnp.where(keep_sorted, sorted_f, jnp.inf),
+                  axis=-1, keepdims=True)
+    p_on = ((top_p > 0.0) & (top_p < 1.0))[:, None]
+    return jnp.where(p_on & (filtered < pth), -jnp.inf, filtered)
+
+
+def sample_per_slot(logits, temperature, top_k, top_p, keys, folds):
+    """One per-row sampling step for mixed greedy/sampled batches (the
+    serve engine's decode and final-prefill dispatches). ``logits``
+    [rows, vocab] fp32; ``keys`` [rows, 2] uint32 per-request base PRNG
+    keys; ``folds`` [rows] the request-global index of the token being
+    drawn. The effective key is ``fold_in(base_key, fold)`` — a pure
+    function of (request seed, token index), which is what makes
+    sampled streams bitwise-reproducible across preemption/requeue
+    (recompute preemption replays earlier tokens teacher-forced, then
+    re-derives the SAME key for the next index). Greedy rows
+    (``temperature == 0``) return the argmax of the RAW logits —
+    bitwise the pre-sampling greedy path."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    warped = warp_logits_per_slot(logits, temperature, top_k, top_p)
+
+    def draw(key, fold, row):
+        return jax.random.categorical(jax.random.fold_in(key, fold), row)
+
+    sampled = jax.vmap(draw)(keys, folds, warped).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
 @functools.partial(jax.jit, static_argnames=("model", "max_new_tokens",
                                              "temperature", "top_k", "top_p"))
 def _generate_jit(model, params, input_ids, attention_mask, max_new_tokens,

@@ -288,6 +288,127 @@ def validate_report(doc) -> list[str]:
     return problems
 
 
+# ---------------------------------------------------------------------------
+# Report diffing (`obsctl diff`): one-command regression triage between
+# two runs' reports — same stdlib-only contract as the merge above.
+# ---------------------------------------------------------------------------
+
+# metric name -> (direction, kind). direction +1 = higher is worse
+# (latency, anomaly counts), -1 = lower is worse (MFU). kind "ratio"
+# metrics regress past the relative threshold; "count" metrics regress
+# on ANY increase (an anomaly delta of one is a finding, not noise).
+DIFF_METRICS: dict[str, tuple[int, str]] = {
+    "step_time_p50_s": (+1, "ratio"),
+    "step_time_p95_s": (+1, "ratio"),
+    "mfu_mean": (-1, "ratio"),
+    "compile_cum_s": (+1, "ratio"),
+    "compile_count": (+1, "count"),
+    "anomalies": (+1, "count"),
+    "serve_ttft_p50_s": (+1, "ratio"),
+    "serve_ttft_p99_s": (+1, "ratio"),
+    "serve_e2e_p50_s": (+1, "ratio"),
+    "serve_e2e_p99_s": (+1, "ratio"),
+    "serve_decode_tokens_per_sec": (-1, "ratio"),
+    "serve_preemptions": (+1, "count"),
+}
+
+
+def _report_scalars(report: dict) -> dict:
+    """Flatten one report to the comparable scalar surface ``diff``
+    operates on (cross-host means for distributions, sums for counters;
+    None where a report has no data for a metric)."""
+    hosts = [h for h in report.get("hosts", {}).values()
+             if isinstance(h, dict)]
+
+    def host_mean(field: str, sub: str):
+        vals = [h[field][sub] for h in hosts
+                if isinstance(h.get(field), dict)
+                and isinstance(h[field].get(sub), (int, float))]
+        return round(sum(vals) / len(vals), 6) if vals else None
+
+    serve = report.get("serve") or {}
+    out = {
+        "step_time_p50_s": host_mean("step_time_s", "p50"),
+        "step_time_p95_s": host_mean("step_time_s", "p95"),
+        "mfu_mean": host_mean("mfu", "mean"),
+        "compile_count": sum(int(h.get("compile", {}).get("count", 0))
+                             for h in hosts) if hosts else None,
+        "compile_cum_s": round(sum(
+            float(h.get("compile", {}).get("cum_s", 0.0))
+            for h in hosts), 6) if hosts else None,
+        "anomalies": len(report.get("anomaly_index", [])),
+    }
+    for key in ("ttft_p50_s", "ttft_p99_s", "e2e_p50_s", "e2e_p99_s",
+                "decode_tokens_per_sec", "preemptions"):
+        val = serve.get(key)
+        out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
+    return out
+
+
+def diff_reports(a: dict, b: dict, threshold_pct: float = 5.0) -> dict:
+    """Deterministic delta document between two run reports (``a`` the
+    baseline, ``b`` the candidate). Per metric: both values, the
+    absolute delta, the percent change, and whether the metric REGRESSED
+    — moved in its worse direction past ``threshold_pct`` (relative),
+    or at all for count metrics (anomalies, compiles, preemptions).
+    Metrics either side lacks are listed in ``skipped`` instead of
+    silently vanishing. Same inputs → byte-identical output (keys
+    sorted, no wall-clock stamped)."""
+    sa, sb = _report_scalars(a), _report_scalars(b)
+    metrics: dict = {}
+    regressions: list[str] = []
+    skipped: list[str] = []
+    for name in sorted(DIFF_METRICS):
+        direction, kind = DIFF_METRICS[name]
+        va, vb = sa.get(name), sb.get(name)
+        if va is None or vb is None:
+            skipped.append(name)
+            continue
+        delta = round(vb - va, 6)
+        pct = round(100.0 * delta / va, 3) if va else None
+        if kind == "count":
+            regressed = direction * delta > 0
+        else:
+            worse = direction * delta
+            # a zero baseline has no percentage but ANY worsening from
+            # it is a regression (e.g. compile_cum_s 0.0 under a warm
+            # persistent cache -> 120s of recompiles must not pass
+            # silently because the ratio is undefined)
+            regressed = worse > 0 and (pct is None
+                                       or abs(pct) > threshold_pct)
+        metrics[name] = {
+            "a": va, "b": vb, "delta": delta, "pct": pct,
+            "worse_direction": "up" if direction > 0 else "down",
+            "regressed": regressed,
+        }
+        if regressed:
+            regressions.append(name)
+    return {
+        "report_version": REPORT_VERSION,
+        "threshold_pct": threshold_pct,
+        "metrics": metrics,
+        "regressions": regressions,
+        "skipped": skipped,
+    }
+
+
+def render_diff_text(diff: dict) -> str:
+    """Human-readable rendering of a :func:`diff_reports` document."""
+    lines = [f"diff (threshold {diff.get('threshold_pct')}%):"]
+    for name, row in sorted(diff.get("metrics", {}).items()):
+        pct = f" ({row['pct']:+}%)" if row.get("pct") is not None else ""
+        mark = "  <-- REGRESSED" if row.get("regressed") else ""
+        lines.append(f"  {name}: {row['a']} -> {row['b']}{pct}{mark}")
+    skipped = diff.get("skipped", [])
+    if skipped:
+        lines.append(f"  skipped (missing in a report): "
+                     f"{', '.join(skipped)}")
+    regs = diff.get("regressions", [])
+    lines.append(f"regressions: {len(regs)}"
+                 + (f" ({', '.join(regs)})" if regs else ""))
+    return "\n".join(lines) + "\n"
+
+
 def render_text(report: dict) -> str:
     """Human-readable rendering of a report dict."""
     lines = []
@@ -352,6 +473,9 @@ def render_text(report: dict) -> str:
                          f"p99 {serve.get('e2e_p99_s')}s")
         if serve.get("preemptions") is not None:
             parts.append(f"{serve['preemptions']} preemptions")
+        if serve.get("gather_read_waste_peak") is not None:
+            parts.append("gather waste peak "
+                         f"{serve['gather_read_waste_peak']}")
         lines.append("serve: " + ", ".join(parts))
     errors = report.get("errors", [])
     if errors:

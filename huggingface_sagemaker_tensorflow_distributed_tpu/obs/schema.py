@@ -65,12 +65,22 @@ REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
     # observation
     "anomaly": {"name": (str,), "message": (str,)},
     # one serving-engine lifecycle event (serve/engine.py): "event" is
-    # submit / admit / first_token / finish / preempt; per-request
-    # events also carry an integer "request" id, and first_token /
-    # finish carry the latency/accounting extras (ttft_s, tokens)
+    # submit / admit / first_token / finish / preempt / bucket_switch /
+    # report; per-request events also carry an integer "request" id,
+    # first_token / finish carry the latency/accounting extras
+    # (ttft_s, tokens), submit carries "sampled" and bucket_switch
+    # carries "gather_bucket" (typed below when present)
     "serve": {"event": (str,)},
     # run metadata, first event after configure()
     "run": {"argv": (list,)},
+}
+
+# optional per-type fields that are TYPE-CHECKED when present (absence
+# is fine — they ride specific event subtypes): the serve engine's
+# decode gather-width bucket and the per-request sampling flag
+OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
+    "serve": {"gather_bucket": (int,), "sampled": (bool,),
+              "request": (int,)},
 }
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)
@@ -110,6 +120,14 @@ def validate_event(obj: object) -> list[str]:
                           and bool not in types)):
                     errors.append(f"{etype}: field {field!r} has type "
                                   f"{type(obj[field]).__name__}")
+            for field, types in OPTIONAL_FIELDS.get(etype, {}).items():
+                val = obj.get(field)
+                if val is None:
+                    continue
+                if (not isinstance(val, types)
+                        or (isinstance(val, bool) and bool not in types)):
+                    errors.append(f"{etype}: optional field {field!r} "
+                                  f"has type {type(val).__name__}")
     if obj.get("v") not in (None, SCHEMA_VERSION):
         errors.append(f"schema version {obj.get('v')!r} != {SCHEMA_VERSION}")
     return errors

@@ -142,7 +142,7 @@ def make_banded_causal_mask(q_len: int, window: int,
 # ---------------------------------------------------------------------------
 
 
-def gather_paged_kv(pool, block_tables):
+def gather_paged_kv(pool, block_tables, width: int | None = None):
     """Materialize per-slot contiguous KV from a paged pool.
 
     ``pool`` is one layer's preallocated block pool
@@ -156,7 +156,26 @@ def gather_paged_kv(pool, block_tables):
     buffer. The gather is O(context) reads per step — the same bytes a
     contiguous cache read costs; what paging changes is the PERSISTENT
     allocation, which scales with blocks actually held, not
-    ``slots × max_len``."""
+    ``slots × max_len``.
+
+    ``width`` (a STATIC python int, multiple of the block size) gathers
+    only the first ``width`` logical token slots per row — the
+    width-bucketed read path: when every resident context fits in a
+    bucket far below ``max_model_len``, the step's read traffic (and
+    the attention mask/logits width behind it) shrinks to the bucket
+    instead of the full table span. Callers guarantee every valid
+    logical position is ``< width``."""
+    bs = pool.shape[1]
+    if width is not None:
+        if width % bs:
+            raise ValueError(f"bucket width {width} must be a multiple "
+                             f"of block_size {bs}")
+        nb = width // bs
+        if nb > block_tables.shape[1]:
+            raise ValueError(
+                f"bucket width {width} needs {nb} blocks/slot but the "
+                f"block table holds {block_tables.shape[1]}")
+        block_tables = block_tables[:, :nb]
     g = pool[block_tables]                     # [S, nb, bs, H, D]
     S, nb, bs, H, D = g.shape
     return g.transpose(0, 3, 1, 2, 4).reshape(S, H, nb * bs, D)
@@ -178,7 +197,7 @@ def scatter_paged_kv(pool, block_tables, positions, values):
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
-                    scale=None):
+                    scale=None, width: int | None = None):
     """Single-token decode attention against a paged KV pool.
 
     ``q`` [slots, heads, head_dim] (the step's one query per slot);
@@ -186,10 +205,11 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     ``context_lens`` [slots] counts valid tokens per slot. Keys at
     logical positions >= context_len (stale block tails, null-block
     junk) are masked additively — the −1e9 convention keeps the softmax
-    NaN-free even for empty (context 0) slots. Returns
-    [slots, heads, head_dim]."""
-    k = gather_paged_kv(k_pool, block_tables)
-    v = gather_paged_kv(v_pool, block_tables)
+    NaN-free even for empty (context 0) slots. ``width`` (static)
+    restricts the gather to a context-width bucket — callers guarantee
+    ``context_lens <= width``. Returns [slots, heads, head_dim]."""
+    k = gather_paged_kv(k_pool, block_tables, width=width)
+    v = gather_paged_kv(v_pool, block_tables, width=width)
     max_ctx = k.shape[2]
     valid = jnp.arange(max_ctx)[None, :] < context_lens[:, None]
     mask = jnp.where(valid, 0.0, -1e9)[:, None, None, :]

@@ -1,11 +1,15 @@
-"""``serve``: continuous-batching inference engine (ISSUE 3).
+"""``serve``: continuous-batching inference engine (ISSUE 3 + the
+ISSUE 5 decode fast path: width-bucketed KV gather, batched prefill,
+per-slot seeded sampling).
 
-- :mod:`~.paged_kv` — block-pool KV allocation (host-side policy).
+- :mod:`~.paged_kv` — block-pool KV allocation + gather read-waste
+  accounting (host-side policy).
 - :mod:`~.scheduler` — iteration-level admission/preemption over fixed
-  decode slots.
-- :mod:`~.engine` — the jitted prefill/decode step functions and the
-  driving loop (``scripts/serve.py`` is the CLI; ``bench.py --serve``
-  the measurement).
+  decode slots, per-iteration max-context + tokens-per-dispatch
+  prefill budget.
+- :mod:`~.engine` — the jitted prefill/decode step functions (compiled
+  per gather bucket) and the driving loop (``scripts/serve.py`` is the
+  CLI; ``bench.py --serve`` the measurement).
 """
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (  # noqa: F401
@@ -22,7 +26,7 @@ def __getattr__(name):
     # ServeEngine pulls in jax; keep `import ...serve` cheap for
     # host-only consumers (scheduler/block-manager tests)
     if name in ("ServeEngine", "EngineStats", "CachePlan",
-                "build_cache_plan"):
+                "build_cache_plan", "parse_gather_buckets"):
         from huggingface_sagemaker_tensorflow_distributed_tpu.serve import (
             engine,
         )

@@ -1,7 +1,8 @@
 """The serving engine: continuous batching over a paged KV cache with
-prefill/decode disaggregation.
+prefill/decode disaggregation and a width-bucketed decode fast path.
 
-Architecture (ISSUE 3 tentpole; vLLM + Orca + Sarathi lineage):
+Architecture (ISSUE 3 tentpole + ISSUE 5 fast path; vLLM + Orca +
+Sarathi lineage):
 
 - **Paged KV** — one preallocated pool per KV leaf of the model's flax
   ``"cache"`` collection, ``[num_blocks, block_size, heads, head_dim]``.
@@ -13,31 +14,59 @@ Architecture (ISSUE 3 tentpole; vLLM + Orca + Sarathi lineage):
   ``models/generate.py`` drives), then scatter the newly-written K/V
   back into the pools. No model code changes: paging is an addressing
   layer around the existing cache contract.
+- **Width-bucketed gather** — the decode step is compiled at a small
+  ladder of context-width buckets (``HSTD_SERVE_GATHER_BUCKETS`` /
+  ``gather_buckets``; default quarter-width + full width) and each
+  iteration runs the smallest bucket covering the scheduler's
+  per-iteration max resident context
+  (``Scheduler.max_decode_context``). When most contexts are short the
+  step's KV read traffic (and the attention mask/logits width behind
+  it) shrinks from ``max_model_len`` to the bucket — the read-waste
+  elimination of PagedAttention's motivating analysis. Growth is
+  immediate (correctness), shrinking has hysteresis so bucket churn is
+  bounded; every switch is telemetered (``bucket_switch`` serve event
+  + ``serve/gather_bucket`` series), and each bucket compiles exactly
+  once (the bench asserts steady-state decode compiles ≤ #buckets).
 - **Iteration-level scheduling** — a fixed set of ``num_slots`` decode
   slots (static shapes, so after one warmup compile of each step
   function NOTHING retraces); requests admit/evict between decode
   steps (``serve/scheduler.py``).
-- **Prefill/decode disaggregation** — prompt ingestion runs as its own
-  fixed-width chunked dispatch (one chunk per engine iteration,
-  interleaved against in-flight decode), so TTFT and steady decode
-  tokens/sec are separately visible host-side and a long prompt never
-  stalls running streams for more than one chunk.
+- **Batched chunked prefill** — prompt ingestion packs up to
+  ``prefill_batch`` prefilling slots' chunks into ONE fixed-shape
+  dispatch (one row per slot; each row attends only the KV its own
+  block table gathers, so cross-request isolation is structural — the
+  property token-packing buys with ``make_segment_mask``, bought here
+  by the paged addressing itself, and test-gated either way). The
+  scheduler's adaptive budget is denominated in tokens-per-dispatch
+  (Sarathi-style): a full decode batch admits one chunk's tokens per
+  iteration (bounding the decode stall a long prompt can inject), and
+  every idle decode slot buys one more chunk, packed into as few
+  dispatches as possible — which is what cuts TTFT under bursty
+  arrivals.
 
-Greedy decoding only (the serving throughput story; temperature
-sampling stays on the ``models/generate.py`` one-shot paths), and
-token-for-token identical to per-request ``generate_causal`` — the
-exactness gate ``tests/test_serve.py`` pins.
+Decoding is greedy by default and token-for-token identical to
+per-request ``generate_causal`` — the exactness gate
+``tests/test_serve.py`` pins, including with bucketing enabled and
+under preemption. Per-request ``temperature``/``top_k``/``top_p``
+sampling rides the same dispatches via per-slot PRNG keys (the
+filtering semantics of ``models/generate.py``'s ``_filter_top_p`` et
+al., vectorized per row): the n-th token's key is
+``fold_in(PRNGKey(seed), n)``, a pure function of (request seed, token
+index), so sampled streams are bitwise-reproducible under a fixed seed
+even across recompute preemption — the seeded-determinism gate.
 
 Telemetry: ``serve`` events (``obs/schema.py``) for request lifecycle
-(submit/admit/first_token/finish/preempt), spans around every prefill
-and decode dispatch, and pool-utilization metrics.
+(submit/admit/first_token/finish/preempt, submit carrying ``sampled``)
+plus ``bucket_switch`` events, spans around every prefill and decode
+dispatch, and pool-utilization/read-waste metrics.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 import time
-from typing import Any, NamedTuple, Optional
+from typing import Any, NamedTuple, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +74,9 @@ import numpy as np
 from jax import lax
 
 from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+    sample_per_slot,
+)
 from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
     gather_paged_kv,
     scatter_paged_kv,
@@ -56,6 +88,44 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (
     Request,
     Scheduler,
 )
+
+ENV_GATHER_BUCKETS = "HSTD_SERVE_GATHER_BUCKETS"
+
+
+def parse_gather_buckets(spec: Union[str, Sequence[int], None],
+                         max_model_len: int, block_size: int) -> list[int]:
+    """The decode gather-width ladder from a knob value.
+
+    ``spec`` is the comma-separated ``HSTD_SERVE_GATHER_BUCKETS`` form
+    (``"512,2048"``), a sequence of ints, or None/``"auto"`` for the
+    default ladder (quarter width + full width). ``"full"``/``"off"``
+    disables bucketing (full-width gather only). Widths are rounded UP
+    to a block multiple and clipped to ``max_model_len``, which is
+    itself always present (the fallback bucket every admissible context
+    fits). Returns the sorted ascending ladder."""
+    if spec is None or (isinstance(spec, str)
+                        and spec.strip().lower() in ("", "auto")):
+        widths = [max_model_len // 4]
+    elif isinstance(spec, str):
+        s = spec.strip().lower()
+        if s in ("full", "off", "0"):
+            widths = []
+        else:
+            try:
+                widths = [int(x) for x in spec.split(",") if x.strip()]
+            except ValueError:
+                raise ValueError(
+                    f"unparseable {ENV_GATHER_BUCKETS} value {spec!r}: "
+                    "expected comma-separated widths, 'auto', or 'full'")
+    else:
+        widths = [int(x) for x in spec]
+    out = set()
+    for w in widths:
+        if w <= 0:
+            continue
+        out.add(min(max_model_len, -(-w // block_size) * block_size))
+    out.add(max_model_len)
+    return sorted(out)
 
 
 class CachePlan(NamedTuple):
@@ -119,13 +189,16 @@ def build_cache_plan(model, params, max_ctx: int) -> tuple[CachePlan, list]:
     return result
 
 
-def _assemble_cache(plan: CachePlan, pools, block_tables, context_lens):
+def _assemble_cache(plan: CachePlan, pools, block_tables, context_lens,
+                    width: Optional[int] = None):
     """The model-facing cache pytree: contiguous per-slot KV gathered
-    from the pools, write indices set to each slot's context length."""
+    from the pools (restricted to the static ``width`` bucket when
+    given), write indices set to each slot's context length."""
     leaves = []
     for kind in plan.kinds:
         if kind[0] == "kv":
-            leaves.append(gather_paged_kv(pools[kind[1]], block_tables))
+            leaves.append(gather_paged_kv(pools[kind[1]], block_tables,
+                                          width=width))
         elif kind[0] == "index":
             leaves.append(context_lens.astype(jnp.int32))
         else:
@@ -134,25 +207,31 @@ def _assemble_cache(plan: CachePlan, pools, block_tables, context_lens):
 
 
 def _decode_step(model, params, pools, tokens, block_tables, context_lens,
-                 active, plan: CachePlan):
+                 active, temps, top_ks, top_ps, keys, folds,
+                 plan: CachePlan, width: int, sampled: bool):
     """One decode iteration over ALL slots (static [S] shapes): feed
-    each slot's last token, write its K/V at ``context_len`` (scattered
-    back to the pools; inactive slots write the reserved null block 0),
-    return the greedy next token per slot."""
-    S = tokens.shape[0]
-    bs = pools[0].shape[1]
-    max_ctx = block_tables.shape[1] * bs
-    cache = _assemble_cache(plan, pools, block_tables, context_lens)
+    each slot's last token against a ``width``-bucket gathered cache,
+    write its K/V at ``context_len`` (scattered back to the pools;
+    inactive slots write the reserved null block 0), return the next
+    token per slot — greedy argmax, or the per-slot seeded sample for
+    rows with ``temperature > 0`` when the (static) ``sampled`` mode is
+    on. Callers guarantee ``context_len + 1 <= width`` for every active
+    slot."""
+    cache = _assemble_cache(plan, pools, block_tables, context_lens,
+                            width=width)
     # kv-buffer validity includes the slot being written this step —
-    # exactly generate_causal's decode-step mask
-    valid = (jnp.arange(max_ctx)[None, :]
+    # exactly generate_causal's decode-step mask, at bucket width
+    valid = (jnp.arange(width)[None, :]
              <= context_lens[:, None]).astype(jnp.int32)
     logits, mut = model.apply(
         {"params": params, "cache": cache}, tokens[:, None], valid,
         position_ids=context_lens[:, None], decode=True,
         deterministic=True, mutable=["cache"])
-    next_tok = jnp.argmax(logits[:, -1, :].astype(jnp.float32),
-                          axis=-1).astype(jnp.int32)
+    last = logits[:, -1, :].astype(jnp.float32)
+    if sampled:
+        next_tok = sample_per_slot(last, temps, top_ks, top_ps, keys, folds)
+    else:
+        next_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
     # scatter the step's writes back; inactive slots route to the null
     # block so the scatter itself needs no masking
     safe_tables = jnp.where(active[:, None], block_tables, 0)
@@ -169,14 +248,20 @@ def _decode_step(model, params, pools, tokens, block_tables, context_lens,
     return next_tok, new_pools
 
 
-def _prefill_chunk(model, params, pools, chunk, block_tables, start, rel,
-                   plan: CachePlan):
-    """One fixed-width prefill chunk for ONE request (batch 1): write
-    the chunk's K/V into the request's blocks starting at ``start``,
-    and return the greedy token after the prompt position ``rel``
-    (chunk-relative index of the last REAL prompt token; meaningful on
-    the final chunk only — earlier chunks return a discarded value)."""
-    C = chunk.shape[1]
+def _prefill_chunk(model, params, pools, chunks, block_tables, start, rel,
+                   temps, top_ks, top_ps, keys, folds, plan: CachePlan,
+                   sampled: bool):
+    """One BATCHED prefill dispatch: up to G prefilling slots' chunks as
+    G independent rows (static [G, C] shape; unused rows carry pad
+    tokens against the null block table). Each row writes its chunk's
+    K/V into its own blocks starting at ``start[g]`` and returns the
+    token after prompt position ``rel[g]`` (chunk-relative index of the
+    last REAL prompt token; meaningful on a final chunk only — other
+    rows return a discarded value). Isolation between the packed
+    requests is structural: row g's attention reads exactly the KV its
+    own block table gathers, so no mask can leak another request's
+    context into it."""
+    G, C = chunks.shape
     bs = pools[0].shape[1]
     max_ctx = block_tables.shape[1] * bs
     cache = _assemble_cache(plan, pools, block_tables, start)
@@ -189,52 +274,65 @@ def _prefill_chunk(model, params, pools, chunk, block_tables, start, rel,
              < start[:, None] + C).astype(jnp.int32)
     pos_ids = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
     logits, mut = model.apply(
-        {"params": params, "cache": cache}, chunk, valid,
+        {"params": params, "cache": cache}, chunks, valid,
         position_ids=pos_ids, decode=True, deterministic=True,
         mutable=["cache"])
     sel = jnp.take_along_axis(
         logits.astype(jnp.float32),
-        jnp.clip(rel, 0, C - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
-    next_tok = jnp.argmax(sel, axis=-1).astype(jnp.int32)      # [1]
-    start0 = start[0]
-    positions = start0 + jnp.arange(C, dtype=jnp.int32)
-    tables_c = jnp.broadcast_to(block_tables, (C, block_tables.shape[1]))
+        jnp.clip(rel, 0, C - 1)[:, None, None], axis=1)[:, 0]  # [G, V]
+    if sampled:
+        next_tok = sample_per_slot(sel, temps, top_ks, top_ps, keys, folds)
+    else:
+        next_tok = jnp.argmax(sel, axis=-1).astype(jnp.int32)   # [G]
+    positions = (start[:, None]
+                 + jnp.arange(C, dtype=jnp.int32)[None, :]).reshape(-1)
+    tables_tok = jnp.repeat(block_tables, C, axis=0)       # [G*C, nb]
     mut_leaves = jax.tree_util.tree_leaves(mut["cache"])
     new_pools = list(pools)
     for leaf, kind in zip(mut_leaves, plan.kinds):
         if kind[0] != "kv":
             continue
         h, d = leaf.shape[1], leaf.shape[3]
-        written = lax.dynamic_slice(
-            leaf, (0, 0, start0, 0), (1, h, C, d))[0].transpose(1, 0, 2)
+        written = jax.vmap(
+            lambda row, s: lax.dynamic_slice(row, (0, s, 0), (h, C, d))
+        )(leaf, start)                                      # [G, H, C, D]
+        written = written.transpose(0, 2, 1, 3).reshape(G * C, h, d)
         new_pools[kind[1]] = scatter_paged_kv(
-            new_pools[kind[1]], tables_c, positions, written)
+            new_pools[kind[1]], tables_tok, positions, written)
     return next_tok, new_pools
 
 
 @functools.lru_cache(maxsize=2)
 def _decode_step_jit(donate: bool):
-    """Process-wide jitted decode step (one per donation mode). ``plan``
-    and ``model`` are static; pools are donated on accelerator backends
-    so the scatter updates them in place (CPU has no donation and would
-    warn every call)."""
-    return jax.jit(_decode_step, static_argnums=(0, 7),
+    """Process-wide jitted decode step (one per donation mode).
+    ``model``/``plan``/``width``/``sampled`` are static — each gather
+    bucket (and each sampling mode actually used) compiles exactly
+    once; pools are donated on accelerator backends so the scatter
+    updates them in place (CPU has no donation and would warn every
+    call)."""
+    return jax.jit(_decode_step, static_argnums=(0, 12, 13, 14),
                    donate_argnums=(2,) if donate else ())
 
 
 @functools.lru_cache(maxsize=2)
 def _prefill_chunk_jit(donate: bool):
-    return jax.jit(_prefill_chunk, static_argnums=(0, 7),
+    return jax.jit(_prefill_chunk, static_argnums=(0, 12, 13),
                    donate_argnums=(2,) if donate else ())
 
 
 class EngineStats(NamedTuple):
     decode_steps: int
     prefill_chunks: int
+    prefill_dispatches: int
     tokens_generated: int
+    decode_tokens: int
+    decode_time_s: float
     preemptions: int
+    bucket_switches: int
     kv_peak_utilization: float
     kv_utilization: float
+    gather_waste_peak: float
+    gather_waste_mean: float
 
 
 class ServeEngine:
@@ -245,12 +343,24 @@ class ServeEngine:
     ``(num_blocks - 1) * block_size`` tokens, shared by every request —
     size it for the expected CONCURRENT context, not
     ``num_slots × max_model_len``.
-    """
+
+    ``gather_buckets`` is the decode gather-width ladder (None reads
+    ``HSTD_SERVE_GATHER_BUCKETS``, default quarter + full width; pass
+    ``[max_model_len]`` or ``"full"`` to force full-width gather).
+    ``prefill_batch`` caps how many prefilling slots' chunks one
+    prefill dispatch packs (clamped to ``num_slots``)."""
+
+    #: consecutive iterations a smaller bucket must suffice before the
+    #: engine shrinks to it — bounds bucket churn when the max resident
+    #: context oscillates around a bucket boundary
+    SHRINK_PATIENCE = 4
 
     def __init__(self, model, params, *, num_slots: int = 8,
                  block_size: int = 16, num_blocks: int = 129,
                  prefill_chunk: int = 16,
-                 max_model_len: Optional[int] = None):
+                 max_model_len: Optional[int] = None,
+                 gather_buckets: Union[str, Sequence[int], None] = None,
+                 prefill_batch: int = 4):
         cfg = model.config
         if getattr(cfg, "num_experts", 0):
             raise ValueError(
@@ -285,6 +395,11 @@ class ServeEngine:
         self.sched = Scheduler(num_slots, self.blocks, prefill_chunk,
                                self.max_model_len)
         self.max_blocks_per_seq = self.max_model_len // block_size
+        if gather_buckets is None:
+            gather_buckets = os.environ.get(ENV_GATHER_BUCKETS)
+        self.gather_buckets = parse_gather_buckets(
+            gather_buckets, self.max_model_len, block_size)
+        self.prefill_batch = max(1, min(int(prefill_batch), self.num_slots))
 
         plan, pool_shapes = build_cache_plan(model, params,
                                              self.max_model_len)
@@ -292,30 +407,50 @@ class ServeEngine:
         self._pools = [jnp.zeros((num_blocks, block_size, h, d), dtype)
                        for h, d, dtype in pool_shapes]
         # the jitted step functions are MODULE-level and keyed on
-        # (model, plan) static args: a second engine over the same
-        # model/geometry — the bench's measured pass, a restarted
-        # server — reuses the compiled executables instead of retracing
+        # (model, plan, width, sampled) static args: a second engine
+        # over the same model/geometry — the bench's measured pass, a
+        # restarted server — reuses the compiled executables instead of
+        # retracing
         donate = jax.default_backend() != "cpu"
         self._decode_fn = _decode_step_jit(donate)
         self._prefill_fn = _prefill_chunk_jit(donate)
         self.finished: dict[int, Request] = {}
+        self._keys: dict[int, np.ndarray] = {}   # rid -> base PRNG key
         self.decode_steps = 0
         self.prefill_chunks = 0
+        self.prefill_dispatches = 0
         self.tokens_generated = 0
+        self.decode_tokens = 0
+        self.decode_time_s = 0.0
         self.iterations = 0
         self.peak_waiting = 0
+        self.bucket_switches = 0
+        self._bucket = self.gather_buckets[0]
+        self._shrink_streak = 0
         self._warm = False
 
     # -- public API ----------------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens: int) -> Request:
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 0.0, seed: int = 0) -> Request:
+        """Queue one request. ``temperature == 0`` (default) is greedy;
+        ``temperature > 0`` samples with the given truncation knobs,
+        seeded per request — same knob semantics as
+        ``models.generate.generate_causal``."""
         req = Request(prompt=np.asarray(prompt, np.int32),
-                      max_new_tokens=int(max_new_tokens))
+                      max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), top_k=int(top_k),
+                      top_p=float(top_p), seed=int(seed))
         req.submit_t = time.perf_counter()
         self.sched.submit(req)
+        if req.sampled:
+            self._keys[req.rid] = np.asarray(jax.random.PRNGKey(req.seed),
+                                             np.uint32)
         obs.serve("submit", request=req.rid,
                   prompt_len=len(req.prompt),
-                  max_new_tokens=req.max_new_tokens)
+                  max_new_tokens=req.max_new_tokens,
+                  sampled=req.sampled)
         return req
 
     def output_ids(self, req: Request) -> np.ndarray:
@@ -325,35 +460,50 @@ class ServeEngine:
             [folded, np.asarray(req.output, np.int32)]).astype(np.int32)
 
     def warmup(self) -> None:
-        """Compile both step functions on null work so the serving loop
-        itself never traces: the compile-tracker event count is FLAT
-        across steady state (the bench asserts it)."""
+        """Compile the prefill step and EVERY bucket's decode step on
+        null work so the serving loop itself never traces: the
+        compile-tracker event count stays flat across steady state (the
+        bench asserts decode compiles ≤ #buckets). The sampling-mode
+        variants compile lazily on the first sampled batch."""
         if self._warm:
             return
         with obs.span("serve/warmup"):
             C = self.sched.prefill_chunk
             nb = self.max_blocks_per_seq
-            zero_tables1 = np.zeros((1, nb), np.int32)
-            tok, self._pools = self._prefill_fn(
-                self.model, self.params, self._pools,
-                np.zeros((1, C), np.int32), zero_tables1,
-                np.zeros((1,), np.int32), np.full((1,), -1, np.int32),
-                self._plan)
+            # both prefill dispatch shapes: the lone-request [1, C]
+            # variant and the batched [prefill_batch, C] one
+            for G in sorted({1, self.prefill_batch}):
+                zf = np.zeros((G,), np.float32)
+                zi = np.zeros((G,), np.int32)
+                tok, self._pools = self._prefill_fn(
+                    self.model, self.params, self._pools,
+                    np.zeros((G, C), np.int32),
+                    np.zeros((G, nb), np.int32),
+                    zi, np.full((G,), -1, np.int32), zf, zi, zf,
+                    np.zeros((G, 2), np.uint32), zi, self._plan, False)
             S = self.num_slots
-            tok, self._pools = self._decode_fn(
-                self.model, self.params, self._pools,
-                np.zeros((S,), np.int32), np.zeros((S, nb), np.int32),
-                np.zeros((S,), np.int32), np.zeros((S,), bool),
-                self._plan)
+            sf = np.zeros((S,), np.float32)
+            si = np.zeros((S,), np.int32)
+            for bucket in self.gather_buckets:
+                tok, self._pools = self._decode_fn(
+                    self.model, self.params, self._pools, si,
+                    np.zeros((S, nb), np.int32), si,
+                    np.zeros((S,), bool), sf, si, sf,
+                    np.zeros((S, 2), np.uint32), si, self._plan,
+                    bucket, False)
             jax.block_until_ready(tok)
+        # announce the starting bucket so every instrumented run has a
+        # bucket baseline to diff switches against
+        obs.serve("bucket_switch", gather_bucket=self._bucket,
+                  prev_bucket=None, max_context=0)
         self._warm = True
 
     def run(self) -> dict[int, Request]:
         """Drive the loop until every submitted request finishes;
         returns {rid: Request}. Ends with one ``serve`` *report* event
         carrying the run's SLO summary (TTFT / end-to-end latency
-        percentiles) so the cross-host report (`obs/report.py`) reads
-        the serving story from a single line."""
+        percentiles, gather-bucket accounting) so the cross-host report
+        (`obs/report.py`) reads the serving story from a single line."""
         self.warmup()
         with obs.span("serve/run"):
             while self.sched.has_work():
@@ -366,8 +516,8 @@ class ServeEngine:
         return self.finished
 
     def slo_summary(self) -> dict:
-        """TTFT / end-to-end latency percentiles + scheduler gauges over
-        every FINISHED request ({} until one finishes)."""
+        """TTFT / end-to-end latency percentiles + scheduler/gather
+        gauges over every FINISHED request ({} until one finishes)."""
         reqs = list(self.finished.values())
         if not reqs:
             return {}
@@ -376,14 +526,24 @@ class ServeEngine:
                 if r.finish_t is not None and r.submit_t is not None]
         out = {
             "requests": len(reqs),
+            "sampled_requests": sum(1 for r in reqs if r.sampled),
             "tokens": self.tokens_generated,
             "iterations": self.iterations,
             "preemptions": self.sched.n_preemptions,
             "peak_waiting_depth": self.peak_waiting,
+            "bucket_switches": self.bucket_switches,
+            "gather_bucket": self._bucket,
+            "gather_read_waste_peak": round(
+                self.blocks.peak_gather_waste, 4),
+            "gather_read_waste_mean": round(
+                self.blocks.gather_waste(), 4),
             "kv_peak_utilization": round(
                 self.blocks.peak_used
                 / max(self.blocks.num_blocks - 1, 1), 4),
         }
+        if self.decode_time_s > 0:
+            out["decode_tokens_per_sec"] = round(
+                self.decode_tokens / self.decode_time_s, 1)
         from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
             percentile,
         )
@@ -401,29 +561,37 @@ class ServeEngine:
         return EngineStats(
             decode_steps=self.decode_steps,
             prefill_chunks=self.prefill_chunks,
+            prefill_dispatches=self.prefill_dispatches,
             tokens_generated=self.tokens_generated,
+            decode_tokens=self.decode_tokens,
+            decode_time_s=self.decode_time_s,
             preemptions=self.sched.n_preemptions,
+            bucket_switches=self.bucket_switches,
             kv_peak_utilization=self.blocks.peak_used
             / max(self.blocks.num_blocks - 1, 1),
-            kv_utilization=self.blocks.utilization())
+            kv_utilization=self.blocks.utilization(),
+            gather_waste_peak=self.blocks.peak_gather_waste,
+            gather_waste_mean=self.blocks.gather_waste())
 
     # -- one engine iteration ------------------------------------------------
 
     def step(self) -> None:
-        """Admit → prefill chunks → one decode step over all slots.
-
-        The prefill budget is adaptive (Sarathi-flavored): with a full
-        decode batch only ONE chunk runs per iteration (bounding the
-        decode stall a long prompt can inject), but every idle decode
-        slot buys one more chunk — refilling drained slots fast is
-        worth more than the stall when the batch is running light."""
+        """Admit → batched prefill under the token budget → one decode
+        step over all slots at the iteration's gather bucket."""
         for slot in self.sched.admit():
             obs.serve("admit", request=slot.request.rid, slot=slot.index,
                       queue_depth=len(self.sched.waiting))
-        budget = max(1, self.num_slots - len(self.sched.decode_slots()))
-        for _ in range(budget):
-            if not self._prefill_one():
+        C = self.sched.prefill_chunk
+        budget = self.sched.prefill_token_budget(
+            len(self.sched.decode_slots()))
+        while budget >= C:
+            # charged at DISPATCH cost (incl. pad rows of a partially
+            # filled batch), not real chunks — the budget bounds the
+            # decode stall, and the stall is what the device computes
+            dispatched_rows = self._prefill_batch(budget // C)
+            if not dispatched_rows:
                 break
+            budget -= dispatched_rows * C
         for req in self.sched.ensure_decode_capacity():
             obs.serve("preempt", request=req.rid,
                       reason="kv_pool_exhausted")
@@ -438,69 +606,147 @@ class ServeEngine:
                        len(self.sched.decode_slots()), self.iterations)
             obs.scalar("serve/preemptions", self.sched.n_preemptions,
                        self.iterations)
+            obs.scalar("serve/gather_bucket", self._bucket,
+                       self.iterations)
         self.iterations += 1
 
-    def _prefill_one(self) -> bool:
-        """One prefill chunk for the next PREFILL-state slot
-        (round-robin); False when no prefill work exists."""
-        slot = self.sched.next_prefill_slot()
-        if slot is None:
-            return False
-        req = slot.request
+    def _select_bucket(self, need: int) -> int:
+        """Smallest configured bucket covering ``need`` resident
+        context, with shrink hysteresis: growth is immediate
+        (correctness — the write position must be addressable),
+        shrinking waits ``SHRINK_PATIENCE`` consecutive iterations
+        where the smaller bucket would have sufficed, so churn around
+        a boundary stays bounded. Every switch is telemetered."""
+        fit = next(b for b in self.gather_buckets if b >= need)
+        if fit > self._bucket:
+            self._switch_bucket(fit, need)
+        elif fit < self._bucket:
+            self._shrink_streak += 1
+            if self._shrink_streak >= self.SHRINK_PATIENCE:
+                self._switch_bucket(fit, need)
+        else:
+            self._shrink_streak = 0
+        return self._bucket
+
+    def _switch_bucket(self, new: int, need: int) -> None:
+        prev, self._bucket = self._bucket, new
+        self._shrink_streak = 0
+        self.bucket_switches += 1
+        obs.serve("bucket_switch", gather_bucket=new, prev_bucket=prev,
+                  max_context=need)
+
+    def _prefill_batch(self, max_rows: int) -> int:
+        """One batched prefill dispatch over up to
+        ``min(max_rows, prefill_batch)`` prefilling slots (static
+        [G, C] shape — unused rows ride to the null block). A LONE
+        prefilling request runs the [1, C] variant instead: padding it
+        to the full batch would multiply low-load prefill compute (and
+        TTFT) by ``prefill_batch``. Two compiled shapes total, both
+        warmed. Returns the DISPATCHED row count G — pad rows included,
+        so the caller's token budget charges what the device actually
+        computed, keeping the decode-stall bound honest at partial
+        load (0 = no prefill work)."""
+        slots = self.sched.next_prefill_slots(
+            min(max_rows, self.prefill_batch))
+        if not slots:
+            return 0
+        G = 1 if len(slots) == 1 else self.prefill_batch
         C = self.sched.prefill_chunk
-        padded = self.sched.padded_prompt_len(req)
-        pos = slot.prefill_pos
-        chunk = np.full((1, C), self.pad_token_id, np.int32)
-        real = req.prompt[pos:pos + C]
-        chunk[0, :len(real)] = real
-        final = pos + C >= padded
-        rel = (len(req.prompt) - 1) - pos if final else -1
-        table = self._slot_table(slot)
-        with obs.span("serve/prefill_chunk"):
+        chunks = np.full((G, C), self.pad_token_id, np.int32)
+        tables = np.zeros((G, self.max_blocks_per_seq), np.int32)
+        start = np.zeros((G,), np.int32)
+        rel = np.full((G,), -1, np.int32)
+        temps = np.zeros((G,), np.float32)
+        top_ks = np.zeros((G,), np.int32)
+        top_ps = np.zeros((G,), np.float32)
+        keys = np.zeros((G, 2), np.uint32)
+        folds = np.zeros((G,), np.int32)
+        finals = []
+        sampled = False
+        for i, slot in enumerate(slots):
+            req = slot.request
+            pos = slot.prefill_pos
+            real = req.prompt[pos:pos + C]
+            chunks[i, :len(real)] = real
+            tables[i, :len(slot.table)] = slot.table
+            start[i] = pos
+            if pos + C >= self.sched.padded_prompt_len(req):
+                rel[i] = (len(req.prompt) - 1) - pos
+                finals.append((i, slot))
+                if req.sampled:
+                    sampled = True
+                    temps[i] = req.temperature
+                    top_ks[i] = req.top_k
+                    top_ps[i] = req.top_p
+                    keys[i] = self._keys[req.rid]
+                    folds[i] = self._generated(req)
+        with obs.span("serve/prefill_chunk",
+                      {"chunks": len(slots)} if obs.has_sink() else None):
             tok, self._pools = self._prefill_fn(
-                self.model, self.params, self._pools, chunk, table,
-                np.asarray([pos], np.int32), np.asarray([rel], np.int32),
-                self._plan)
-        slot.prefill_pos += C
-        self.prefill_chunks += 1
-        if final:
-            self.sched.finish_prefill(slot)
-            # fetch the sampled continuation token; also the sync point
-            # that makes TTFT an honest end-to-end wall time
-            self._append(slot, int(jax.device_get(tok)[0]))
-        return True
+                self.model, self.params, self._pools, chunks, tables,
+                start, rel, temps, top_ks, top_ps, keys, folds,
+                self._plan, sampled)
+        for slot in slots:
+            slot.prefill_pos += C
+        self.prefill_chunks += len(slots)
+        self.prefill_dispatches += 1
+        if finals:
+            # fetch the continuation tokens; also the sync point that
+            # makes TTFT an honest end-to-end wall time
+            tok_host = np.asarray(jax.device_get(tok))
+            for i, slot in finals:
+                self.sched.finish_prefill(slot)
+                self._append(slot, int(tok_host[i]))
+        return G
 
     def _decode_all(self) -> None:
         ds = self.sched.decode_slots()
         if not ds:
             return
+        bucket = self._select_bucket(self.sched.max_decode_context())
         S = self.num_slots
         tokens = np.zeros((S,), np.int32)
         tables = np.zeros((S, self.max_blocks_per_seq), np.int32)
         ctx = np.zeros((S,), np.int32)
         active = np.zeros((S,), bool)
+        temps = np.zeros((S,), np.float32)
+        top_ks = np.zeros((S,), np.int32)
+        top_ps = np.zeros((S,), np.float32)
+        keys = np.zeros((S, 2), np.uint32)
+        folds = np.zeros((S,), np.int32)
+        sampled = False
         for slot in ds:
-            tokens[slot.index] = slot.request.output[-1]
-            tables[slot.index] = self._slot_table(slot)[0]
-            ctx[slot.index] = slot.context_len
-            active[slot.index] = True
+            req = slot.request
+            i = slot.index
+            tokens[i] = req.output[-1]
+            tables[i, :len(slot.table)] = slot.table
+            ctx[i] = slot.context_len
+            active[i] = True
+            if req.sampled:
+                sampled = True
+                temps[i] = req.temperature
+                top_ks[i] = req.top_k
+                top_ps[i] = req.top_p
+                keys[i] = self._keys[req.rid]
+                folds[i] = self._generated(req)
+        self.blocks.note_gather([s.context_len + 1 for s in ds], bucket)
+        t0 = time.perf_counter()
         with obs.span("serve/decode_step",
-                      {"active": len(ds)} if obs.has_sink() else None):
+                      {"active": len(ds), "gather_bucket": bucket}
+                      if obs.has_sink() else None):
             nxt, self._pools = self._decode_fn(
                 self.model, self.params, self._pools, tokens, tables,
-                ctx, active, self._plan)
-        nxt = np.asarray(jax.device_get(nxt))
+                ctx, active, temps, top_ks, top_ps, keys, folds,
+                self._plan, bucket, sampled)
+            nxt = np.asarray(jax.device_get(nxt))
+        self.decode_time_s += time.perf_counter() - t0
         self.decode_steps += 1
+        self.decode_tokens += len(ds)
         for slot in ds:
             slot.context_len += 1        # the fed token's K/V landed
             self._append(slot, int(nxt[slot.index]))
 
     # -- helpers -------------------------------------------------------------
-
-    def _slot_table(self, slot) -> np.ndarray:
-        out = np.zeros((1, self.max_blocks_per_seq), np.int32)
-        out[0, :len(slot.table)] = slot.table
-        return out
 
     def _generated(self, req: Request) -> int:
         return (len(req.prompt) - req.orig_prompt_len) + len(req.output)
@@ -520,6 +766,7 @@ class ServeEngine:
             req.finish_t = now
             self.sched.finish(slot)
             self.finished[req.rid] = req
+            self._keys.pop(req.rid, None)
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
                       preemptions=req.preemptions)

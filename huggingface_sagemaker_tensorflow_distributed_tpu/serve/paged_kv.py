@@ -15,6 +15,11 @@ plain host Python where it is unit-testable without a backend:
   Fragmentation is bounded by ``block_size - 1`` tokens per request
   (the partially-filled last block) — the quantity
   :meth:`BlockManager.fragmentation` reports and the tests pin.
+- the READ side wastes separately: every decode step gathers a full
+  context-width bucket per slot regardless of how much context the slot
+  actually holds. :meth:`BlockManager.note_gather` accounts that
+  bucket-padded read waste (peak + token-weighted mean) so the serve
+  report can show what width bucketing saves.
 
 The engine frees a finished/preempted request's blocks immediately;
 there is no refcounting/copy-on-write (no beam forking through the
@@ -46,6 +51,11 @@ class BlockManager:
         # first; block 0 excluded for good
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self.peak_used = 0
+        # bucket-padded READ waste (decode-side, orthogonal to the
+        # allocation fragmentation below): latched by note_gather()
+        self.peak_gather_waste = 0.0
+        self._gather_read_tokens = 0
+        self._gather_useful_tokens = 0
 
     # -- capacity arithmetic -------------------------------------------------
 
@@ -79,6 +89,34 @@ class BlockManager:
             return 0.0
         used_tokens = sum(int(c) for c in context_lens)
         return 1.0 - used_tokens / held_tokens
+
+    def note_gather(self, context_lens, width: int) -> float:
+        """Record one decode step's bucket-padded KV READ: the gather
+        materializes ``width`` token slots per ACTIVE slot while only
+        that slot's context is useful, so the step's read waste is
+        ``1 - sum(context) / (slots * width)``. This is the decode-side
+        counterpart of :meth:`fragmentation` (which accounts allocation
+        padding): bucketing exists precisely to shrink it, and the
+        engine surfaces both the PEAK (``peak_gather_waste``, latched
+        here) and the token-weighted run mean (:meth:`gather_waste`) in
+        its ``serve`` report event and the bench detail line. Returns
+        the step's waste fraction (0.0 for an empty step)."""
+        read = len(context_lens) * int(width)
+        if read == 0:
+            return 0.0
+        useful = sum(min(int(c), int(width)) for c in context_lens)
+        waste = 1.0 - useful / read
+        self.peak_gather_waste = max(self.peak_gather_waste, waste)
+        self._gather_read_tokens += read
+        self._gather_useful_tokens += useful
+        return waste
+
+    def gather_waste(self) -> float:
+        """Token-weighted mean bucket-padded read waste across every
+        :meth:`note_gather`-recorded decode step (0.0 before any)."""
+        if self._gather_read_tokens == 0:
+            return 0.0
+        return 1.0 - self._gather_useful_tokens / self._gather_read_tokens
 
     # -- alloc/free ----------------------------------------------------------
 

@@ -42,7 +42,14 @@ _rid = itertools.count()
 class Request:
     """One generation request. ``prompt`` is token ids [P]; the engine
     appends generated ids to ``output``. Timing fields are engine-side
-    ``perf_counter`` stamps (None until reached)."""
+    ``perf_counter`` stamps (None until reached).
+
+    Sampling is per request: ``temperature == 0`` (the default) is
+    greedy — the mode the engine's exactness gate vs ``generate_causal``
+    pins; ``temperature > 0`` samples with optional top-k/top-p
+    truncation, seeded by ``seed`` so the stream is reproducible
+    (including across recompute preemption — the engine derives the
+    n-th token's PRNG key from (seed, n) alone)."""
 
     prompt: np.ndarray
     max_new_tokens: int
@@ -53,6 +60,10 @@ class Request:
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     preemptions: int = 0
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
     # recompute preemption folds generated tokens back into the prompt;
     # this keeps the ORIGINAL prompt length so output accounting and
     # first-token semantics survive a preemption
@@ -66,6 +77,16 @@ class Request:
             raise ValueError("empty prompt")
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if not 0.0 <= self.top_p <= 1.0:
+            raise ValueError("top_p must be in [0, 1]")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+
+    @property
+    def sampled(self) -> bool:
+        return self.temperature > 0
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -200,17 +221,35 @@ class Scheduler:
 
     # -- prefill -------------------------------------------------------------
 
-    def next_prefill_slot(self) -> Optional[Slot]:
-        """Round-robin over slots in PREFILL state (one chunk per engine
-        iteration keeps prefill from starving in-flight decode — the
-        chunked-prefill interleaving of Sarathi/Agrawal et al. 2023)."""
+    def next_prefill_slots(self, max_n: int) -> list[Slot]:
+        """Up to ``max_n`` DISTINCT prefill-state slots, round-robin
+        from where the last call left off — the batch the engine packs
+        into ONE prefill dispatch. Rotation is preserved across calls so
+        no prefilling request starves when more exist than fit a
+        dispatch."""
         n = len(self.slots)
+        out: list[Slot] = []
         for k in range(n):
+            if len(out) >= max_n:
+                break
             slot = self.slots[(self._prefill_rr + k) % n]
             if slot.request is not None and slot.request.state == PREFILL:
-                self._prefill_rr = (slot.index + 1) % n
-                return slot
-        return None
+                out.append(slot)
+        if out:
+            self._prefill_rr = (out[-1].index + 1) % n
+        return out
+
+    def prefill_token_budget(self, n_active_decode: int) -> int:
+        """The iteration's prefill budget in TOKENS-PER-DISPATCH terms
+        (Sarathi-flavored, redefined for batched prefill): with a full
+        decode batch exactly one chunk's worth of tokens runs per
+        iteration — bounding the decode stall a long prompt can inject
+        to one chunk of compute — and every idle decode slot buys one
+        more chunk of tokens, which the engine packs into as few
+        batched dispatches as possible (refilling drained slots fast is
+        worth more than the stall when the batch is running light)."""
+        idle = max(1, len(self.slots) - n_active_decode)
+        return self.prefill_chunk * idle
 
     def finish_prefill(self, slot: Slot) -> None:
         """Prefill consumed the whole padded prompt: context becomes the
@@ -226,6 +265,14 @@ class Scheduler:
     def decode_slots(self) -> list[Slot]:
         return [s for s in self.slots
                 if s.request is not None and s.request.state == DECODE]
+
+    def max_decode_context(self) -> int:
+        """The iteration's max resident decode context INCLUDING the
+        slot being written this step (a decode dispatch must address
+        ``context_len + 1`` KV positions per slot) — the quantity the
+        engine's gather-bucket choice covers. 0 with no decode work."""
+        return max((s.context_len + 1 for s in self.decode_slots()),
+                   default=0)
 
     def ensure_decode_capacity(self) -> list[Request]:
         """Guarantee every DECODE slot owns a block for its next token,

@@ -9,6 +9,11 @@ Usage::
     python scripts/obsctl.py report host0/ host1/ host2/ --text -o report.json
     # schema-lint events/trace/flight artifacts (check_telemetry_schema)
     python scripts/obsctl.py validate telemetry/
+    # regression triage between two saved reports: step-time/MFU/
+    # anomaly/serve-SLO deltas; exit 2 when any metric moves past the
+    # threshold in its worse direction (count metrics — anomalies,
+    # compiles, preemptions — regress on ANY increase)
+    python scripts/obsctl.py diff baseline.json candidate.json --threshold-pct 5
 
 ``report`` merges every ``events.jsonl`` it finds under the given
 paths (a run dir, per-host dirs, or dirs of per-host subdirs) into one
@@ -35,7 +40,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (  # noqa: E402
     build_report,
+    diff_reports,
     find_event_files,
+    render_diff_text,
     render_text,
     validate_report,
 )
@@ -65,6 +72,40 @@ def cmd_report(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_diff(args: argparse.Namespace) -> int:
+    """Delta two saved reports (``obsctl report -o``). Exit codes:
+    0 = no regression, 1 = unreadable/invalid input, 2 = at least one
+    metric regressed past the threshold — the shape CI gates on."""
+    reports = []
+    for path in (args.a, args.b):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"obsctl: cannot read report {path}: {e}",
+                  file=sys.stderr)
+            return 1
+        problems = validate_report(doc)
+        if problems:
+            for p in problems:
+                print(f"obsctl: invalid report {path}: {p}",
+                      file=sys.stderr)
+            return 1
+        reports.append(doc)
+    diff = diff_reports(reports[0], reports[1],
+                        threshold_pct=args.threshold_pct)
+    if args.text:
+        sys.stdout.write(render_diff_text(diff))
+    else:
+        json.dump(diff, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    if diff["regressions"]:
+        print(f"obsctl: {len(diff['regressions'])} regression(s): "
+              f"{', '.join(diff['regressions'])}", file=sys.stderr)
+        return 2
+    return 0
+
+
 def cmd_validate(args: argparse.Namespace) -> int:
     from scripts.check_telemetry_schema import main as check_main
 
@@ -84,6 +125,19 @@ def main(argv: list[str] | None = None) -> int:
     rep.add_argument("-o", "--out", default=None,
                      help="also write the JSON report to this path")
     rep.set_defaults(func=cmd_report)
+
+    dif = sub.add_parser("diff",
+                         help="step-time/MFU/anomaly/serve-SLO deltas "
+                              "between two saved reports (exit 2 over "
+                              "the threshold)")
+    dif.add_argument("a", help="baseline report JSON (obsctl report -o)")
+    dif.add_argument("b", help="candidate report JSON")
+    dif.add_argument("--threshold-pct", type=float, default=5.0,
+                     help="relative worsening that counts as a "
+                          "regression for ratio metrics (default 5)")
+    dif.add_argument("--text", action="store_true",
+                     help="readable rendering instead of JSON")
+    dif.set_defaults(func=cmd_diff)
 
     val = sub.add_parser("validate",
                          help="schema-lint telemetry artifacts "

@@ -3,8 +3,13 @@
 latency + aggregate throughput.
 
 Requests come from ``--input_file`` (JSONL, one
-``{"prompt_ids": [...], "max_new_tokens": N}`` per line) or a synthetic
-mixed-length trace (default — the zero-egress smoke path). The model is
+``{"prompt_ids": [...], "max_new_tokens": N}`` per line, optionally
+carrying per-request ``temperature``/``top_k``/``top_p``/``seed``) or a
+synthetic mixed-length trace (default — the zero-egress smoke path).
+``--temperature/--top_k/--top_p/--sample_seed`` set the default
+sampling configuration (greedy when temperature is 0);
+``--gather_buckets`` overrides the decode gather-width ladder
+(``HSTD_SERVE_GATHER_BUCKETS``; ``full`` disables bucketing). The model is
 a randomly-initialized GPT-2 shape by default (``--model_dir`` loads an
 exported causal-lm checkpoint the way ``scripts/predict.py`` does).
 
@@ -63,25 +68,55 @@ def load_model(args):
     return model, init_params(model, cfg, seed=0)
 
 
+def _sampling_kw(row, defaults, where: str) -> dict:
+    """Per-request sampling fields from one JSONL row, validated
+    LOUDLY: a drifted trace (bool/string/fractional top_k) must name
+    its line, not silently serve different truncation than specified.
+    JSON null (and absence) mean "use the CLI default"."""
+    kw = {}
+    for k, default in defaults.items():
+        raw = row.get(k)
+        if raw is None:
+            kw[k] = default
+            continue
+        if isinstance(raw, bool) or not isinstance(raw, (int, float)):
+            raise SystemExit(
+                f"serve: {where}: field {k!r} must be a number, "
+                f"got {raw!r}")
+        if isinstance(default, int) and raw != int(raw):
+            raise SystemExit(
+                f"serve: {where}: field {k!r} must be an integer, "
+                f"got {raw!r}")
+        kw[k] = type(default)(raw)
+    return kw
+
+
 def load_trace(args, vocab: int):
+    """[(prompt_ids, max_new_tokens, sampling_kwargs)] — per-request
+    JSONL fields override the CLI-wide sampling defaults."""
+    defaults = {"temperature": args.temperature, "top_k": args.top_k,
+                "top_p": args.top_p, "seed": args.sample_seed}
     if args.input_file:
         trace = []
         with open(args.input_file, "r", encoding="utf-8") as f:
-            for line in f:
+            for lineno, line in enumerate(f, 1):
                 if not line.strip():
                     continue
                 row = json.loads(line)
+                kw = _sampling_kw(row, defaults,
+                                  f"{args.input_file}:{lineno}")
                 trace.append((np.asarray(row["prompt_ids"], np.int32),
                               int(row.get("max_new_tokens",
-                                          args.max_new_tokens))))
+                                          args.max_new_tokens)), kw))
         return trace
     from benchmarks.serve_bench import make_trace
 
     rng = np.random.RandomState(args.seed)
-    return make_trace(rng, args.requests, vocab, args.prompt_min,
+    base = make_trace(rng, args.requests, vocab, args.prompt_min,
                       args.prompt_max, (4, max(4, args.max_new_tokens // 4)),
                       (args.max_new_tokens // 2, args.max_new_tokens),
                       long_every=4)
+    return [(p, m, dict(defaults)) for p, m in base]
 
 
 def main() -> None:
@@ -100,8 +135,21 @@ def main() -> None:
                         help="KV pool blocks incl. the null block "
                              "(0 = 3/4 of slots * max_model_len)")
     parser.add_argument("--prefill_chunk", type=int, default=16)
+    parser.add_argument("--prefill_batch", type=int, default=4,
+                        help="max prefilling slots packed per dispatch")
     parser.add_argument("--max_model_len", type=int, default=0,
                         help="0 = model max_position_embeddings")
+    parser.add_argument("--gather_buckets", default=None,
+                        help="decode gather-width ladder, e.g. "
+                             "'64,256' ('full' disables bucketing; "
+                             "default: HSTD_SERVE_GATHER_BUCKETS or "
+                             "quarter+full width)")
+    parser.add_argument("--temperature", type=float, default=0.0,
+                        help="0 = greedy (the default); > 0 samples")
+    parser.add_argument("--top_k", type=int, default=0)
+    parser.add_argument("--top_p", type=float, default=0.0)
+    parser.add_argument("--sample_seed", type=int, default=0,
+                        help="per-request sampling seed default")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
 
@@ -120,10 +168,12 @@ def main() -> None:
     engine = ServeEngine(model, params, num_slots=args.num_slots,
                          block_size=args.block_size, num_blocks=num_blocks,
                          prefill_chunk=args.prefill_chunk,
-                         max_model_len=max_len)
+                         prefill_batch=args.prefill_batch,
+                         max_model_len=max_len,
+                         gather_buckets=args.gather_buckets)
     trace = load_trace(args, model.config.vocab_size - 1)
     engine.warmup()
-    reqs = [engine.submit(p, m) for p, m in trace]
+    reqs = [engine.submit(p, m, **kw) for p, m, kw in trace]
     t0 = time.perf_counter()
     engine.run()
     wall = time.perf_counter() - t0
@@ -136,6 +186,7 @@ def main() -> None:
             "request": req.rid, "prompt_len": req.orig_prompt_len,
             "output_ids": [int(t) for t in ids],
             "ttft_s": round(req.ttft_s, 4) if req.ttft_s else None,
+            "sampled": req.sampled, "seed": req.seed,
             "preemptions": req.preemptions}))
     stats = engine.stats()
     # SLO summary from the engine's own accounting (the same figures
@@ -155,8 +206,16 @@ def main() -> None:
         "e2e_p99_s": slo.get("e2e_p99_s"),
         "peak_waiting_depth": slo.get("peak_waiting_depth"),
         "decode_steps": stats.decode_steps,
+        "decode_tokens_per_sec": round(
+            stats.decode_tokens / stats.decode_time_s, 1)
+        if stats.decode_time_s > 0 else None,
         "prefill_chunks": stats.prefill_chunks,
+        "prefill_dispatches": stats.prefill_dispatches,
         "preemptions": stats.preemptions,
+        "gather_buckets": engine.gather_buckets,
+        "bucket_switches": stats.bucket_switches,
+        "gather_read_waste_peak": round(stats.gather_waste_peak, 3),
+        "gather_read_waste_mean": round(stats.gather_waste_mean, 3),
         "kv_peak_utilization": round(stats.kv_peak_utilization, 3)}))
     obs.flush()
 

@@ -270,3 +270,140 @@ def test_cli_validate_subcommand(three_hosts):
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=_REPO)
     assert proc.returncode == 0, proc.stdout
+
+
+# -- report diffing (ISSUE 5: `obsctl diff`) ---------------------------------
+
+def _perturbed(report, step_p50=None, decode_tps=None, anomalies=0):
+    import copy
+
+    doc = copy.deepcopy(report)
+    if step_p50 is not None:
+        for sec in doc["hosts"].values():
+            if sec.get("step_time_s"):
+                sec["step_time_s"]["p50"] = step_p50
+    if decode_tps is not None:
+        doc.setdefault("serve", {})["decode_tokens_per_sec"] = decode_tps
+    for i in range(anomalies):
+        doc["anomaly_index"].append(
+            {"t": 2000.0 + i, "host": 1, "name": "nan_loss", "step": 9,
+             "message": "loss is NaN", "evidence": None})
+    return doc
+
+
+def test_diff_reports_flags_worse_directions(three_hosts):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    same = diff_reports(base, base, threshold_pct=5.0)
+    assert same["regressions"] == []
+    # identical inputs -> byte-identical output (the determinism the
+    # one-command triage relies on)
+    a = json.dumps(diff_reports(base, base, 5.0), sort_keys=True)
+    b = json.dumps(diff_reports(base, base, 5.0), sort_keys=True)
+    assert a == b
+
+    worse = _perturbed(base, step_p50=0.30, anomalies=1)
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "step_time_p50_s" in d["regressions"]
+    assert "anomalies" in d["regressions"]        # count metric: any up
+    assert d["metrics"]["step_time_p50_s"]["regressed"] is True
+    # the same move in the BETTER direction is not a regression
+    better = _perturbed(base, step_p50=0.01)
+    assert "step_time_p50_s" not in diff_reports(
+        base, better, 5.0)["regressions"]
+    # under the threshold: no flag
+    slight = _perturbed(base, step_p50=0.134)     # ~+3% off 0.13
+    assert "step_time_p50_s" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+
+
+def test_diff_zero_baseline_worsening_still_regresses(three_hosts):
+    """A ratio metric with a 0 baseline has no percentage, but ANY
+    worsening from it must flag (compile_cum_s 0.0 under a warm
+    persistent cache -> recompiles in the candidate)."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    for sec in base["hosts"].values():
+        sec["compile"]["cum_s"] = 0.0
+    worse = copy.deepcopy(base)
+    for sec in worse["hosts"].values():
+        sec["compile"]["cum_s"] = 40.0
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "compile_cum_s" in d["regressions"]
+    assert d["metrics"]["compile_cum_s"]["pct"] is None
+    # and the better direction from 0 never flags
+    assert "compile_cum_s" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+
+
+def test_diff_skips_metrics_missing_on_either_side(three_hosts):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    # the fixture's serve report has no decode_tokens_per_sec: skipped,
+    # not silently dropped
+    d = diff_reports(base, base, 5.0)
+    assert "serve_decode_tokens_per_sec" in d["skipped"]
+    withit = _perturbed(base, decode_tps=100.0)
+    d2 = diff_reports(withit, _perturbed(base, decode_tps=50.0), 5.0)
+    assert "serve_decode_tokens_per_sec" in d2["regressions"]
+
+
+def test_cli_diff_exit_codes_and_text(three_hosts, tmp_path):
+    """The one-command triage contract: 0 clean, 2 past threshold,
+    1 unreadable input; --text renders the regression."""
+    base = build_report(three_hosts)
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(_perturbed(base, step_p50=0.30)))
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, _OBSCTL, "diff", *argv],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=_REPO)
+
+    clean = run(str(a), str(a))
+    assert clean.returncode == 0, clean.stderr
+    doc = json.loads(clean.stdout)
+    assert doc["regressions"] == []
+
+    bad = run(str(a), str(b), "--text")
+    assert bad.returncode == 2
+    assert "REGRESSED" in bad.stdout and "step_time_p50_s" in bad.stderr
+
+    # raising the threshold past the delta silences the gate
+    assert run(str(a), str(b), "--threshold-pct", "500").returncode == 0
+
+    missing = run(str(a), str(tmp_path / "nope.json"))
+    assert missing.returncode == 1
+
+    invalid = tmp_path / "invalid.json"
+    invalid.write_text(json.dumps({"not": "a report"}))
+    assert run(str(a), str(invalid)).returncode == 1
+
+
+def test_cli_diff_runs_without_jax(three_hosts, tmp_path):
+    """diff stays on the stdlib-only side of the obs contract."""
+    base = build_report(three_hosts)
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps(base))
+    code = ("import sys, runpy; sys.modules['jax'] = None; "
+            "sys.argv = ['x', 'diff', %r, %r]; "
+            "runpy.run_path(%r, run_name='__main__')"
+            % (str(a), str(a), _OBSCTL))
+    proc = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True)
+    assert proc.returncode == 0, proc.stdout

@@ -157,7 +157,10 @@ def test_produced_train_and_serve_artifacts_validate(tmp_path):
                           num_slots=2, block_size=8, num_blocks=17,
                           prefill_chunk=8, max_model_len=32)
         eng.submit(np.arange(1, 6, dtype=np.int32), 4)
-        eng.submit(np.arange(2, 10, dtype=np.int32), 3)
+        # one sampled request so the produced stream carries the
+        # ISSUE 5 serve fields (submit.sampled True alongside False)
+        eng.submit(np.arange(2, 10, dtype=np.int32), 3,
+                   temperature=0.8, top_k=8, seed=1)
         eng.run()
         obs.flush()
         events = [e for _, e, err in obs.iter_events(
@@ -167,11 +170,40 @@ def test_produced_train_and_serve_artifacts_validate(tmp_path):
     types = {e["type"] for e in events}
     # both subsystems actually emitted (an empty gate proves nothing)
     assert {"metric", "span", "serve"} <= types
-    serve_events = {e.get("event") for e in events if e["type"] == "serve"}
-    assert {"submit", "first_token", "finish", "report"} <= serve_events
+    serve = [e for e in events if e["type"] == "serve"]
+    serve_events = {e.get("event") for e in serve}
+    assert {"submit", "first_token", "finish", "report",
+            "bucket_switch"} <= serve_events
+    # the typed optional fields ride the real stream: every submit
+    # carries the sampling flag (both modes), every bucket_switch the
+    # bucket width — regenerated-from-live fixtures, not hand-built
+    submits = [e for e in serve if e["event"] == "submit"]
+    assert {e["sampled"] for e in submits} == {True, False}
+    assert all(isinstance(e["gather_bucket"], int) for e in serve
+               if e["event"] == "bucket_switch")
     proc = _run(str(out))
     assert proc.returncode == 0, proc.stdout
     assert proc.stdout.count("OK") == 2          # events.jsonl + trace.json
+
+
+def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
+    """gather_bucket/sampled are optional on `serve` events but TYPED
+    when present — a drifted emitter (string bucket, int flag) fails
+    the gate instead of poisoning downstream bucket accounting."""
+    bad = tmp_path / "events.jsonl"
+    rows = [
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "bucket_switch", "gather_bucket": 128},       # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "bucket_switch", "gather_bucket": "wide"},    # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "submit", "request": 0, "sampled": 1},        # drift
+    ]
+    bad.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    proc = _run(str(bad))
+    assert proc.returncode == 1
+    assert "optional field 'gather_bucket'" in proc.stdout
+    assert "optional field 'sampled'" in proc.stdout
 
 
 def test_validator_accepts_anomaly_and_flight_artifacts(tmp_path):
