@@ -531,7 +531,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
         return ["data_pipeline_microbench"]
     if getattr(args, "serve", False):
         return ["serve_continuous_vs_static_speedup",
-                "serve_bucketed_gather_decode_speedup"]
+                "serve_bucketed_gather_decode_speedup",
+                "serve_speculative_decode_speedup"]
     if args.llama_train:
         return ["llama_1b_train_samples_per_sec_per_chip"]
     if args.mixtral_train:
@@ -810,7 +811,9 @@ def main() -> None:
                              "p50/p99, aggregate tokens/sec, KV-pool "
                              "utilization, compile flatness) + the "
                              "bucketed-gather decode speedup on a "
-                             "short-context trace")
+                             "short-context trace + the speculative "
+                             "draft/verify decode speedup on a high-"
+                             "acceptance trace")
     parser.add_argument("--llama-train", action="store_true",
                         dest="llama_train",
                         help="TinyLlama-1.1B training throughput "

@@ -784,6 +784,26 @@ def beam_search_generate(model, params, input_ids, attention_mask=None,
 # ---------------------------------------------------------------------------
 
 
+def speculative_accept_greedy(t_pred, drafts):
+    """GREEDY speculative acceptance for a batch of verify windows:
+    ``t_pred`` [B, k+1] is the target's argmax prediction at every
+    window position, ``drafts`` [B, k] the draft's proposals. Returns
+    ``(n_acc, bonus)`` — the longest prefix of drafts matching the
+    target's own choices, and the target's choice at the first miss
+    (the whole window matching makes the bonus the target's k+1-th
+    token). Emitting ``drafts[:n_acc] + [bonus]`` is therefore
+    token-for-token the target's greedy continuation — the exactness
+    contract both :func:`generate_speculative` and the serve engine's
+    speculative decode path are gated on."""
+    k = drafts.shape[1]
+    match = (drafts == t_pred[:, :k]).astype(jnp.int32)
+    n_acc = jnp.argmin(jnp.concatenate(
+        [match, jnp.zeros((match.shape[0], 1), jnp.int32)], axis=1),
+        axis=1)                                            # first miss
+    bonus = jnp.take_along_axis(t_pred, n_acc[:, None], axis=1)[:, 0]
+    return n_acc, bonus
+
+
 def _speculative_accept(p, q, drafts, key):
     """Speculative SAMPLING acceptance for one row's verify window
     (Leviathan et al. 2023): draft token ``d_i ~ q_i`` is accepted with
@@ -998,12 +1018,7 @@ def _speculative_jit(model, params, draft_model, draft_params, input_ids,
             # generate_causal
             t_pred = jnp.argmax(lg.astype(jnp.float32),
                                 -1).astype(jnp.int32)          # [B, k+1]
-            match = (drafts == t_pred[:, :k]).astype(jnp.int32)
-            n_acc = jnp.argmin(jnp.concatenate(
-                [match, jnp.zeros((B, 1), jnp.int32)], axis=1),
-                axis=1)                                        # first miss
-            bonus = jnp.take_along_axis(t_pred, n_acc[:, None],
-                                        axis=1)[:, 0]          # [B]
+            n_acc, bonus = speculative_accept_greedy(t_pred, drafts)
         else:
             # sampling: Leviathan rejection acceptance — the emitted
             # marginal is exactly the target's warped distribution
@@ -1287,11 +1302,7 @@ def _speculative_seq2seq_jit(model, params, draft_model, draft_params,
         lg, t_cache2 = t_step(t_cache, verify_in)
         if temperature == 0.0:
             t_pred = jnp.argmax(lg, -1).astype(jnp.int32)      # [B, k+1]
-            match = (drafts == t_pred[:, :k]).astype(jnp.int32)
-            n_acc = jnp.argmin(jnp.concatenate(
-                [match, jnp.zeros((B, 1), jnp.int32)], axis=1), axis=1)
-            bonus = jnp.take_along_axis(t_pred, n_acc[:, None],
-                                        axis=1)[:, 0]
+            n_acc, bonus = speculative_accept_greedy(t_pred, drafts)
         else:
             p_probs = jax.nn.softmax(lg / temperature, axis=-1)
             row_keys = jax.vmap(

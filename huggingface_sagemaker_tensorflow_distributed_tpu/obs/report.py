@@ -310,6 +310,10 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     "serve_e2e_p99_s": (+1, "ratio"),
     "serve_decode_tokens_per_sec": (-1, "ratio"),
     "serve_preemptions": (+1, "count"),
+    # speculative serving: LOWER acceptance is worse (a draft/target
+    # drift or a broken verify path shows up here first); ratio kind so
+    # the zero-baseline worsening rule applies like any other ratio
+    "serve_acceptance_rate": (-1, "ratio"),
 }
 
 
@@ -339,7 +343,8 @@ def _report_scalars(report: dict) -> dict:
         "anomalies": len(report.get("anomaly_index", [])),
     }
     for key in ("ttft_p50_s", "ttft_p99_s", "e2e_p50_s", "e2e_p99_s",
-                "decode_tokens_per_sec", "preemptions"):
+                "decode_tokens_per_sec", "preemptions",
+                "acceptance_rate"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out
@@ -476,6 +481,9 @@ def render_text(report: dict) -> str:
         if serve.get("gather_read_waste_peak") is not None:
             parts.append("gather waste peak "
                          f"{serve['gather_read_waste_peak']}")
+        if serve.get("acceptance_rate") is not None:
+            parts.append(f"spec acceptance {serve['acceptance_rate']} "
+                         f"(k={serve.get('speculate_k')})")
         lines.append("serve: " + ", ".join(parts))
     errors = report.get("errors", [])
     if errors:

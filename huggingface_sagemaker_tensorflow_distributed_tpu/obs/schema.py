@@ -77,10 +77,16 @@ REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
 
 # optional per-type fields that are TYPE-CHECKED when present (absence
 # is fine — they ride specific event subtypes): the serve engine's
-# decode gather-width bucket and the per-request sampling flag
+# decode gather-width bucket, the per-request sampling flag, and the
+# speculative-decode acceptance accounting (finish events carry the
+# per-request figures; the final report event the aggregates)
 OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
     "serve": {"gather_bucket": (int,), "sampled": (bool,),
-              "request": (int,)},
+              "request": (int,), "speculate_k": (int,),
+              "draft_proposed": (int,), "draft_accepted": (int,),
+              "acceptance_rate": _NUM,
+              "verify_read_waste_peak": _NUM,
+              "verify_read_waste_mean": _NUM},
 }
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)

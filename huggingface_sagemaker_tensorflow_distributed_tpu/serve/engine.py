@@ -1,8 +1,9 @@
 """The serving engine: continuous batching over a paged KV cache with
-prefill/decode disaggregation and a width-bucketed decode fast path.
+prefill/decode disaggregation, a width-bucketed decode fast path, and
+optional speculative decoding.
 
-Architecture (ISSUE 3 tentpole + ISSUE 5 fast path; vLLM + Orca +
-Sarathi lineage):
+Architecture (ISSUE 3 tentpole + ISSUE 5 fast path + ISSUE 6
+speculation; vLLM + Orca + Sarathi + Leviathan lineage):
 
 - **Paged KV** — one preallocated pool per KV leaf of the model's flax
   ``"cache"`` collection, ``[num_blocks, block_size, heads, head_dim]``.
@@ -43,6 +44,16 @@ Sarathi lineage):
   every idle decode slot buys one more chunk, packed into as few
   dispatches as possible — which is what cuts TTFT under bursty
   arrivals.
+- **Speculative decoding** (``speculate_k``/``draft``) — per iteration
+  a draft model (its own paged pools over the SAME block tables)
+  proposes ``k`` tokens per running slot, then ONE width-(k+1) target
+  verify — structurally just a wider bucketed decode, so it composes
+  with the gather ladder — scores every window; the accepted prefix +
+  bonus token commit, and rejected tokens roll back by an O(1)
+  ``context_lens`` rewind (stale K/V hides behind the context-derived
+  masks). Acceptance-rate × (k+1) decode tokens land per step with the
+  output distribution unchanged (greedy: token-exact; sampled:
+  Leviathan rejection acceptance).
 
 Decoding is greedy by default and token-for-token identical to
 per-request ``generate_causal`` — the exactness gate
@@ -75,7 +86,11 @@ from jax import lax
 
 from huggingface_sagemaker_tensorflow_distributed_tpu import obs
 from huggingface_sagemaker_tensorflow_distributed_tpu.models.generate import (
+    _speculative_accept,
     sample_per_slot,
+    self_draft,
+    speculative_accept_greedy,
+    warp_logits_per_slot,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
     gather_paged_kv,
@@ -90,6 +105,8 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (
 )
 
 ENV_GATHER_BUCKETS = "HSTD_SERVE_GATHER_BUCKETS"
+ENV_SPECULATE_K = "HSTD_SERVE_SPECULATE_K"
+ENV_DRAFT_LAYERS = "HSTD_SERVE_DRAFT_LAYERS"
 
 
 def parse_gather_buckets(spec: Union[str, Sequence[int], None],
@@ -320,6 +337,157 @@ def _prefill_chunk_jit(donate: bool):
                    donate_argnums=(2,) if donate else ())
 
 
+def _scatter_window(pools, plan: CachePlan, cache_leaves, block_tables,
+                    context_lens, active, k: int):
+    """Scatter a just-computed (k+1)-token window's K/V — written by a
+    model apply into an assembled (contiguous, bucket-width) cache at
+    slots ``context_lens .. context_lens + k`` per row — back into the
+    paged pools. Inactive rows route to the reserved null block 0 so
+    the write path needs no masking (the plain decode step's
+    convention, widened to the window)."""
+    S = context_lens.shape[0]
+    safe_tables = jnp.where(active[:, None], block_tables, 0)
+    safe_start = jnp.where(active, context_lens, 0)
+    flat_pos = (safe_start[:, None]
+                + jnp.arange(k + 1, dtype=jnp.int32)[None]).reshape(-1)
+    tables_tok = jnp.repeat(safe_tables, k + 1, axis=0)   # [S*(k+1), nb]
+    new_pools = list(pools)
+    for leaf, kind in zip(cache_leaves, plan.kinds):
+        if kind[0] != "kv":
+            continue
+        h, d = leaf.shape[1], leaf.shape[3]
+        written = jax.vmap(
+            lambda row, s: lax.dynamic_slice(row, (0, s, 0), (h, k + 1, d))
+        )(leaf, safe_start)                               # [S, H, k+1, D]
+        written = written.transpose(0, 2, 1, 3).reshape(S * (k + 1), h, d)
+        new_pools[kind[1]] = scatter_paged_kv(
+            new_pools[kind[1]], tables_tok, flat_pos, written)
+    return new_pools
+
+
+def _spec_decode_step(model, params, draft_model, draft_params, t_pools,
+                      d_pools, tokens, block_tables, context_lens, active,
+                      temps, top_ks, top_ps, keys, folds, t_plan: CachePlan,
+                      d_plan: CachePlan, width: int, k: int, sampled: bool):
+    """One SPECULATIVE decode iteration over all slots (static [S]
+    shapes): the draft proposes ``k`` tokens per slot autoregressively
+    against its own paged pools, then ONE width-(k+1) verify dispatch of
+    the target scores every window position — structurally just a wider
+    bucketed decode, so it rides the same ``width`` gather ladder. Per
+    row the accepted prefix + bonus token come back for the host to
+    commit; rejected draft tokens leave only stale K/V past the
+    committed context, which the host rewinds in O(1) by NOT advancing
+    ``context_lens`` over them (validity masks are context-derived, so
+    stale slots are invisible and the next window overwrites them).
+
+    ``tokens`` is each slot's newest COMMITTED token (its K/V lands at
+    ``context_lens`` during the verify, exactly like the plain step);
+    ``folds`` is the window's starting request-global token index — the
+    per-row PRNG key for the whole window derives from (request seed,
+    window start) alone, which is what keeps sampled speculative
+    streams bitwise-reproducible across recompute preemption (windows
+    re-start at the same committed index, so the same keys re-derive).
+    Greedy rows accept by longest argmax-matching prefix
+    (:func:`~..models.generate.speculative_accept_greedy` — token-exact
+    vs ``generate_causal``); sampled rows use Leviathan rejection
+    acceptance on the per-slot WARPED distributions, so the emitted
+    marginal is the target's.
+
+    Returns ``(drafts [S, k], n_acc [S], bonus [S], t_pools, d_pools)``.
+    Callers guarantee ``context_lens + k + 1 <= width`` per active
+    slot."""
+    S = tokens.shape[0]
+    pos_grid = jnp.arange(width)[None, :]
+    win_pos = (context_lens[:, None]
+               + jnp.arange(k + 1, dtype=jnp.int32)[None])   # [S, k+1]
+    if sampled:
+        # window key = f(request seed, window start): split into the
+        # draft-proposal stream and the acceptance stream
+        wkeys = jax.vmap(jax.random.fold_in)(keys, folds)
+        pair = jax.vmap(lambda kk: jax.random.split(kk, 2))(wkeys)
+        draft_keys, accept_keys = pair[:, 0], pair[:, 1]
+    else:
+        draft_keys = keys
+
+    # -- draft: k+1 single-token steps over ONE pre-assembled bucket
+    #    cache (the step writes stay inside the carried pytree — no
+    #    per-step pool gather/scatter; the final carry holds the whole
+    #    window's K/V, scattered back once below). Step k's output is
+    #    discarded: it only exists so the final carry contains
+    #    d_{k-1}'s K/V, which the NEXT window's draft needs resident
+    #    when the full window is accepted.
+    d_cache = _assemble_cache(d_plan, d_pools, block_tables, context_lens,
+                              width=width)
+
+    def dstep(carry, t):
+        tok, cache = carry
+        valid = (pos_grid <= (context_lens + t)[:, None]).astype(jnp.int32)
+        lg, mut = draft_model.apply(
+            {"params": draft_params, "cache": cache}, tok[:, None], valid,
+            position_ids=(context_lens + t)[:, None], decode=True,
+            deterministic=True, mutable=["cache"])
+        lg = lg[:, -1, :].astype(jnp.float32)
+        if sampled:
+            nxt = sample_per_slot(lg, temps, top_ks, top_ps, draft_keys,
+                                  jnp.full((S,), t, jnp.int32))
+            qp = jax.nn.softmax(
+                warp_logits_per_slot(lg, temps, top_ks, top_ps), axis=-1)
+            return (nxt, mut["cache"]), (nxt, qp)
+        nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        return (nxt, mut["cache"]), nxt
+
+    (_, d_final), ys = lax.scan(dstep, (tokens, d_cache),
+                                jnp.arange(k + 1))
+    if sampled:
+        drafts = ys[0][:k].T                                 # [S, k]
+        q_probs = jnp.swapaxes(ys[1], 0, 1)[:, :k]           # [S, k, V]
+    else:
+        drafts = ys[:k].T
+    new_d_pools = _scatter_window(d_pools, d_plan,
+                                  jax.tree_util.tree_leaves(d_final),
+                                  block_tables, context_lens, active, k)
+
+    # -- verify: ONE (k+1)-wide target pass scores the whole window and
+    #    writes its K/V (accepted slots become resident; rejected ones
+    #    are the stale tail the host's context rewind hides)
+    verify_in = jnp.concatenate([tokens[:, None], drafts], axis=1)
+    t_cache = _assemble_cache(t_plan, t_pools, block_tables, context_lens,
+                              width=width)
+    valid = (pos_grid <= (context_lens + k)[:, None]).astype(jnp.int32)
+    logits, mut = model.apply(
+        {"params": params, "cache": t_cache}, verify_in, valid,
+        position_ids=win_pos, decode=True, deterministic=True,
+        mutable=["cache"])
+    lg = logits.astype(jnp.float32)                          # [S, k+1, V]
+    t_pred = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    n_acc, bonus = speculative_accept_greedy(t_pred, drafts)
+    if sampled:
+        p_probs = jax.nn.softmax(jax.vmap(
+            lambda x: warp_logits_per_slot(x, temps, top_ks, top_ps),
+            in_axes=1, out_axes=1)(lg), axis=-1)
+        n_acc_s, nxt_s = jax.vmap(_speculative_accept)(
+            p_probs, q_probs, drafts, accept_keys)
+        on = temps > 0
+        n_acc = jnp.where(on, n_acc_s, n_acc)
+        bonus = jnp.where(on, nxt_s, bonus)
+    new_t_pools = _scatter_window(t_pools, t_plan,
+                                  jax.tree_util.tree_leaves(mut["cache"]),
+                                  block_tables, context_lens, active, k)
+    return drafts, n_acc, bonus, new_t_pools, new_d_pools
+
+
+@functools.lru_cache(maxsize=2)
+def _spec_step_jit(donate: bool):
+    """Process-wide jitted speculative step (one per donation mode):
+    ``model``/``draft_model``/plans/``width``/``k``/``sampled`` are
+    static, so each gather bucket (per sampling mode actually used)
+    compiles exactly once and a rebuilt engine over the same
+    model/geometry reuses the executables."""
+    return jax.jit(_spec_decode_step,
+                   static_argnums=(0, 2, 15, 16, 17, 18, 19),
+                   donate_argnums=(4, 5) if donate else ())
+
+
 class EngineStats(NamedTuple):
     decode_steps: int
     prefill_chunks: int
@@ -333,6 +501,12 @@ class EngineStats(NamedTuple):
     kv_utilization: float
     gather_waste_peak: float
     gather_waste_mean: float
+    draft_proposed: int = 0
+    draft_accepted: int = 0
+    acceptance_rate: Optional[float] = None
+    spec_windows: int = 0
+    verify_waste_peak: float = 0.0
+    verify_waste_mean: float = 0.0
 
 
 class ServeEngine:
@@ -348,7 +522,23 @@ class ServeEngine:
     ``HSTD_SERVE_GATHER_BUCKETS``, default quarter + full width; pass
     ``[max_model_len]`` or ``"full"`` to force full-width gather).
     ``prefill_batch`` caps how many prefilling slots' chunks one
-    prefill dispatch packs (clamped to ``num_slots``)."""
+    prefill dispatch packs (clamped to ``num_slots``).
+
+    ``speculate_k > 0`` turns on SPECULATIVE decode (None reads
+    ``HSTD_SERVE_SPECULATE_K``, default off): per iteration a draft
+    model proposes ``k`` tokens per running slot and one width-(k+1)
+    verify dispatch of the target scores them all — acceptance-rate ×
+    (k+1) tokens land per decode step without changing the output
+    (greedy stays token-exact vs ``generate_causal``; sampled rows keep
+    the Leviathan rejection acceptance, so the emitted distribution is
+    the target's). ``draft`` selects the proposer: a
+    ``(draft_model, draft_params)`` tuple, an int = build a layer-skip
+    self-draft from the target's own first N layers
+    (``models.generate.self_draft`` — no second checkpoint), or None =
+    ``HSTD_SERVE_DRAFT_LAYERS`` falling back to a quarter of the
+    target's layers. Requests additionally reserve the verify window:
+    ``prompt + max_new_tokens + speculate_k`` must fit
+    ``max_model_len``."""
 
     #: consecutive iterations a smaller bucket must suffice before the
     #: engine shrinks to it — bounds bucket churn when the max resident
@@ -360,7 +550,9 @@ class ServeEngine:
                  prefill_chunk: int = 16,
                  max_model_len: Optional[int] = None,
                  gather_buckets: Union[str, Sequence[int], None] = None,
-                 prefill_batch: int = 4):
+                 prefill_batch: int = 4,
+                 speculate_k: Optional[int] = None,
+                 draft=None):
         cfg = model.config
         if getattr(cfg, "num_experts", 0):
             raise ValueError(
@@ -391,14 +583,31 @@ class ServeEngine:
                 f"max_model_len {self.max_model_len} exceeds the "
                 f"model's max_position_embeddings {max_pos}")
         self.num_slots = int(num_slots)
+        if speculate_k is None:
+            speculate_k = int(os.environ.get(ENV_SPECULATE_K, "0") or 0)
+        self.speculate_k = int(speculate_k)
+        if self.speculate_k < 0:
+            raise ValueError(f"speculate_k must be >= 0, "
+                             f"got {self.speculate_k}")
         self.blocks = BlockManager(num_blocks, block_size)
         self.sched = Scheduler(num_slots, self.blocks, prefill_chunk,
-                               self.max_model_len)
+                               self.max_model_len,
+                               decode_lookahead=self.speculate_k + 1)
         self.max_blocks_per_seq = self.max_model_len // block_size
         if gather_buckets is None:
             gather_buckets = os.environ.get(ENV_GATHER_BUCKETS)
         self.gather_buckets = parse_gather_buckets(
             gather_buckets, self.max_model_len, block_size)
+        if self.speculate_k:
+            if self.speculate_k + 1 > self.max_model_len:
+                raise ValueError(
+                    f"speculate_k {self.speculate_k} verify window does "
+                    f"not fit max_model_len {self.max_model_len}")
+            # buckets too narrow for even an empty-context window can
+            # never be selected — drop them so warmup compiles only
+            # dispatchable variants (full width always remains)
+            self.gather_buckets = [b for b in self.gather_buckets
+                                   if b >= self.speculate_k + 1]
         self.prefill_batch = max(1, min(int(prefill_batch), self.num_slots))
 
         plan, pool_shapes = build_cache_plan(model, params,
@@ -406,6 +615,32 @@ class ServeEngine:
         self._plan = plan
         self._pools = [jnp.zeros((num_blocks, block_size, h, d), dtype)
                        for h, d, dtype in pool_shapes]
+        # speculative mode: the draft model's paged pools ride the SAME
+        # block tables/allocator as the target's — one allocation
+        # domain, two KV address spaces (per-block bytes grow by the
+        # draft's layer share; the draft's context is the target's)
+        self.draft_model = self.draft_params = None
+        if self.speculate_k:
+            if isinstance(draft, tuple):
+                self.draft_model, self.draft_params = draft
+            else:
+                layers = draft
+                if layers is None:
+                    layers = int(os.environ.get(ENV_DRAFT_LAYERS, "0")
+                                 or 0) or max(1, cfg.num_layers // 4)
+                self.draft_model, self.draft_params = self_draft(
+                    model, params, int(layers))
+            if self.draft_model.config.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    "draft and target must share a vocabulary (got "
+                    f"{self.draft_model.config.vocab_size} vs "
+                    f"{cfg.vocab_size})")
+            d_plan, d_pool_shapes = build_cache_plan(
+                self.draft_model, self.draft_params, self.max_model_len)
+            self._d_plan = d_plan
+            self._d_pools = [jnp.zeros((num_blocks, block_size, h, d),
+                                       dtype)
+                             for h, d, dtype in d_pool_shapes]
         # the jitted step functions are MODULE-level and keyed on
         # (model, plan, width, sampled) static args: a second engine
         # over the same model/geometry — the bench's measured pass, a
@@ -414,6 +649,7 @@ class ServeEngine:
         donate = jax.default_backend() != "cpu"
         self._decode_fn = _decode_step_jit(donate)
         self._prefill_fn = _prefill_chunk_jit(donate)
+        self._spec_fn = _spec_step_jit(donate)
         self.finished: dict[int, Request] = {}
         self._keys: dict[int, np.ndarray] = {}   # rid -> base PRNG key
         self.decode_steps = 0
@@ -425,9 +661,12 @@ class ServeEngine:
         self.iterations = 0
         self.peak_waiting = 0
         self.bucket_switches = 0
+        self.draft_proposed = 0
+        self.draft_accepted = 0
+        self.spec_windows = 0       # active (slot, iteration) pairs
         self._bucket = self.gather_buckets[0]
         self._shrink_streak = 0
-        self._warm = False
+        self._warmed_modes: set = set()
 
     # -- public API ----------------------------------------------------------
 
@@ -459,44 +698,81 @@ class ServeEngine:
         return np.concatenate(
             [folded, np.asarray(req.output, np.int32)]).astype(np.int32)
 
-    def warmup(self) -> None:
-        """Compile the prefill step and EVERY bucket's decode step on
-        null work so the serving loop itself never traces: the
-        compile-tracker event count stays flat across steady state (the
-        bench asserts decode compiles ≤ #buckets). The sampling-mode
-        variants compile lazily on the first sampled batch."""
-        if self._warm:
+    @property
+    def speculative(self) -> bool:
+        return self.speculate_k > 0
+
+    def warmup(self, sampled: bool = False) -> None:
+        """Compile the prefill step and EVERY bucket's decode (or
+        speculative draft/verify) step on null work so the serving loop
+        itself never traces: the compile-tracker event count stays flat
+        across steady state (the bench asserts decode compiles ≤
+        #buckets). With ``sampled=True`` the per-slot-sampling variants
+        of every step are ALSO precompiled — without it they compile
+        lazily on the first sampled batch (one mid-serve stall per
+        bucket), which latency-sensitive sampled traffic should not
+        pay. Idempotent per mode; ``warmup(sampled=True)`` after a
+        plain warmup compiles only the sampled variants."""
+        modes = [False] + ([True] if sampled else [])
+        modes = [m for m in modes if m not in self._warmed_modes]
+        if not modes:
             return
         with obs.span("serve/warmup"):
             C = self.sched.prefill_chunk
             nb = self.max_blocks_per_seq
-            # both prefill dispatch shapes: the lone-request [1, C]
-            # variant and the batched [prefill_batch, C] one
-            for G in sorted({1, self.prefill_batch}):
-                zf = np.zeros((G,), np.float32)
-                zi = np.zeros((G,), np.int32)
-                tok, self._pools = self._prefill_fn(
-                    self.model, self.params, self._pools,
-                    np.zeros((G, C), np.int32),
-                    np.zeros((G, nb), np.int32),
-                    zi, np.full((G,), -1, np.int32), zf, zi, zf,
-                    np.zeros((G, 2), np.uint32), zi, self._plan, False)
             S = self.num_slots
             sf = np.zeros((S,), np.float32)
             si = np.zeros((S,), np.int32)
-            for bucket in self.gather_buckets:
-                tok, self._pools = self._decode_fn(
-                    self.model, self.params, self._pools, si,
-                    np.zeros((S, nb), np.int32), si,
-                    np.zeros((S,), bool), sf, si, sf,
-                    np.zeros((S, 2), np.uint32), si, self._plan,
-                    bucket, False)
+            for mode in modes:
+                # both prefill dispatch shapes: the lone-request [1, C]
+                # variant and the batched [prefill_batch, C] one (the
+                # draft's prefill rides the target's greedy variant
+                # only — drafts never sample at prefill)
+                for G in sorted({1, self.prefill_batch}):
+                    zf = np.zeros((G,), np.float32)
+                    zi = np.zeros((G,), np.int32)
+                    tok, self._pools = self._prefill_fn(
+                        self.model, self.params, self._pools,
+                        np.zeros((G, C), np.int32),
+                        np.zeros((G, nb), np.int32),
+                        zi, np.full((G,), -1, np.int32), zf, zi, zf,
+                        np.zeros((G, 2), np.uint32), zi, self._plan,
+                        mode)
+                    if self.speculative and not mode:
+                        tok, self._d_pools = self._prefill_fn(
+                            self.draft_model, self.draft_params,
+                            self._d_pools,
+                            np.zeros((G, C), np.int32),
+                            np.zeros((G, nb), np.int32),
+                            zi, np.full((G,), -1, np.int32), zf, zi, zf,
+                            np.zeros((G, 2), np.uint32), zi,
+                            self._d_plan, False)
+                for bucket in self.gather_buckets:
+                    if self.speculative:
+                        (_, _, tok, self._pools,
+                         self._d_pools) = self._spec_fn(
+                            self.model, self.params, self.draft_model,
+                            self.draft_params, self._pools,
+                            self._d_pools, si,
+                            np.zeros((S, nb), np.int32), si,
+                            np.zeros((S,), bool), sf, si, sf,
+                            np.zeros((S, 2), np.uint32), si, self._plan,
+                            self._d_plan, bucket, self.speculate_k,
+                            mode)
+                    else:
+                        tok, self._pools = self._decode_fn(
+                            self.model, self.params, self._pools, si,
+                            np.zeros((S, nb), np.int32), si,
+                            np.zeros((S,), bool), sf, si, sf,
+                            np.zeros((S, 2), np.uint32), si, self._plan,
+                            bucket, mode)
             jax.block_until_ready(tok)
-        # announce the starting bucket so every instrumented run has a
-        # bucket baseline to diff switches against
-        obs.serve("bucket_switch", gather_bucket=self._bucket,
-                  prev_bucket=None, max_context=0)
-        self._warm = True
+        if not self._warmed_modes:
+            # announce the starting bucket so every instrumented run
+            # has a bucket baseline to diff switches against
+            obs.serve("bucket_switch", gather_bucket=self._bucket,
+                      prev_bucket=None, max_context=0)
+        self._warmed_modes.update(modes)
 
     def run(self) -> dict[int, Request]:
         """Drive the loop until every submitted request finishes;
@@ -548,6 +824,27 @@ class ServeEngine:
             percentile,
         )
 
+        if self.speculative:
+            out["speculate_k"] = self.speculate_k
+            out["draft_proposed"] = self.draft_proposed
+            out["draft_accepted"] = self.draft_accepted
+            if self.draft_proposed:
+                out["acceptance_rate"] = round(
+                    self.draft_accepted / self.draft_proposed, 4)
+            # the PER-REQUEST acceptance distribution: the aggregate
+            # hides a single request speculating badly (a pathological
+            # prompt for the draft) — p50/min name it
+            rates = sorted(r.spec_accepted / r.spec_proposed
+                           for r in reqs if r.spec_proposed)
+            if rates:
+                out["acceptance_rate_p50"] = round(
+                    percentile(rates, 0.50), 4)
+                out["acceptance_rate_min"] = round(rates[0], 4)
+            out["verify_read_waste_peak"] = round(
+                self.blocks.peak_verify_waste, 4)
+            out["verify_read_waste_mean"] = round(
+                self.blocks.verify_waste(), 4)
+
         for label, vals in (("ttft", ttfts), ("e2e", e2es)):
             if not vals:
                 continue
@@ -571,7 +868,14 @@ class ServeEngine:
             / max(self.blocks.num_blocks - 1, 1),
             kv_utilization=self.blocks.utilization(),
             gather_waste_peak=self.blocks.peak_gather_waste,
-            gather_waste_mean=self.blocks.gather_waste())
+            gather_waste_mean=self.blocks.gather_waste(),
+            draft_proposed=self.draft_proposed,
+            draft_accepted=self.draft_accepted,
+            acceptance_rate=(self.draft_accepted / self.draft_proposed
+                             if self.draft_proposed else None),
+            spec_windows=self.spec_windows,
+            verify_waste_peak=self.blocks.peak_verify_waste,
+            verify_waste_mean=self.blocks.verify_waste())
 
     # -- one engine iteration ------------------------------------------------
 
@@ -686,6 +990,14 @@ class ServeEngine:
                 self.model, self.params, self._pools, chunks, tables,
                 start, rel, temps, top_ks, top_ps, keys, folds,
                 self._plan, sampled)
+            if self.speculative:
+                # the draft's pools must hold the prompt KV too — same
+                # chunks/tables, its own address space; the returned
+                # token is discarded (the draft never emits)
+                _, self._d_pools = self._prefill_fn(
+                    self.draft_model, self.draft_params, self._d_pools,
+                    chunks, tables, start, rel, temps, top_ks, top_ps,
+                    keys, folds, self._d_plan, False)
         for slot in slots:
             slot.prefill_pos += C
         self.prefill_chunks += len(slots)
@@ -695,11 +1007,28 @@ class ServeEngine:
             # makes TTFT an honest end-to-end wall time
             tok_host = np.asarray(jax.device_get(tok))
             for i, slot in finals:
+                req = slot.request
                 self.sched.finish_prefill(slot)
-                self._append(slot, int(tok_host[i]))
+                if self.speculative and self._generated(req) > 0:
+                    # preemption-resumed speculative request: its next
+                    # token's index is mid-stream, and mid-stream
+                    # tokens come from verify windows — emitting the
+                    # prefill sample here would consume a different
+                    # RNG draw than the uninterrupted run's window did
+                    # (breaking bitwise seed-reproducibility across
+                    # preemption). Hand the slot to the window loop
+                    # instead: its newest committed token is the
+                    # folded prompt's last id, whose K/V the next
+                    # window re-writes at context_len (same value the
+                    # prefill just wrote — an idempotent overwrite)
+                    slot.context_len -= 1
+                else:
+                    self._append(slot, int(tok_host[i]))
         return G
 
     def _decode_all(self) -> None:
+        if self.speculative:
+            return self._decode_all_spec()
         ds = self.sched.decode_slots()
         if not ds:
             return
@@ -746,6 +1075,92 @@ class ServeEngine:
             slot.context_len += 1        # the fed token's K/V landed
             self._append(slot, int(nxt[slot.index]))
 
+    def _decode_all_spec(self) -> None:
+        """One speculative iteration: draft-k propose + width-(k+1)
+        verify in a single dispatch, then the host commits per slot —
+        accepted prefix + bonus appended, ``context_len`` advanced over
+        exactly the committed tokens (the O(1) rewind: rejected draft
+        K/V past it is stale, invisible to context-derived masks, and
+        overwritten by the next window), and the block-table tail past
+        the committed context returns to the free list."""
+        ds = self.sched.decode_slots()
+        if not ds:
+            return
+        k = self.speculate_k
+        bucket = self._select_bucket(self.sched.max_decode_context())
+        S = self.num_slots
+        tokens = np.zeros((S,), np.int32)
+        tables = np.zeros((S, self.max_blocks_per_seq), np.int32)
+        ctx = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        temps = np.zeros((S,), np.float32)
+        top_ks = np.zeros((S,), np.int32)
+        top_ps = np.zeros((S,), np.float32)
+        keys = np.zeros((S, 2), np.uint32)
+        folds = np.zeros((S,), np.int32)
+        sampled = False
+        for slot in ds:
+            req = slot.request
+            i = slot.index
+            # newest committed token: the last generated one, or the
+            # prompt tail when no generation is resident in `output`
+            # (fresh post-preemption resume)
+            tokens[i] = req.output[-1] if req.output else req.prompt[-1]
+            tables[i, :len(slot.table)] = slot.table
+            ctx[i] = slot.context_len
+            active[i] = True
+            if req.sampled:
+                sampled = True
+                temps[i] = req.temperature
+                top_ks[i] = req.top_k
+                top_ps[i] = req.top_p
+                keys[i] = self._keys[req.rid]
+                folds[i] = self._generated(req)   # window start index
+        self.blocks.note_gather(
+            [s.context_len + k + 1 for s in ds], bucket)
+        t0 = time.perf_counter()
+        with obs.span("serve/spec_decode_step",
+                      {"active": len(ds), "gather_bucket": bucket,
+                       "speculate_k": k} if obs.has_sink() else None):
+            drafts, n_acc, bonus, self._pools, self._d_pools = \
+                self._spec_fn(
+                    self.model, self.params, self.draft_model,
+                    self.draft_params, self._pools, self._d_pools,
+                    tokens, tables, ctx, active, temps, top_ks, top_ps,
+                    keys, folds, self._plan, self._d_plan, bucket, k,
+                    sampled)
+            drafts = np.asarray(jax.device_get(drafts))
+            n_acc = np.asarray(jax.device_get(n_acc))
+            bonus = np.asarray(jax.device_get(bonus))
+        self.decode_time_s += time.perf_counter() - t0
+        self.decode_steps += 1
+        self.spec_windows += len(ds)
+        committed = []
+        for slot in ds:
+            req = slot.request
+            i = slot.index
+            acc = int(n_acc[i])
+            self.draft_proposed += k
+            self.draft_accepted += acc
+            req.spec_proposed += k
+            req.spec_accepted += acc
+            window = [int(drafts[i, j]) for j in range(acc)]
+            window.append(int(bonus[i]))
+            j = 0
+            for tok in window:
+                j += 1
+                slot.context_len += 1    # this token's K/V is resident
+                self.decode_tokens += 1
+                self._append(slot, tok)
+                if req.rid in self.finished:
+                    break                # EOS / budget: drop the rest
+            committed.append(j)
+            if req.rid not in self.finished:
+                # rejected-tail blocks (reserved for the verify window,
+                # now holding only stale K/V) go back to the free list
+                self.blocks.trim(slot.table, slot.context_len)
+        self.blocks.note_verify(committed, k + 1)
+
     # -- helpers -------------------------------------------------------------
 
     def _generated(self, req: Request) -> int:
@@ -767,6 +1182,16 @@ class ServeEngine:
             self.sched.finish(slot)
             self.finished[req.rid] = req
             self._keys.pop(req.rid, None)
+            extra = {}
+            if self.speculative:
+                extra = {
+                    "speculate_k": self.speculate_k,
+                    "draft_proposed": req.spec_proposed,
+                    "draft_accepted": req.spec_accepted,
+                    "acceptance_rate": (
+                        round(req.spec_accepted / req.spec_proposed, 4)
+                        if req.spec_proposed else None),
+                }
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
-                      preemptions=req.preemptions)
+                      preemptions=req.preemptions, **extra)

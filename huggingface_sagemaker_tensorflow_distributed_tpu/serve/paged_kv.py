@@ -56,6 +56,12 @@ class BlockManager:
         self.peak_gather_waste = 0.0
         self._gather_read_tokens = 0
         self._gather_useful_tokens = 0
+        # width-(k+1) verify-window padding (speculative decode),
+        # counted SEPARATELY from bucket padding: latched by
+        # note_verify()
+        self.peak_verify_waste = 0.0
+        self._verify_window_tokens = 0
+        self._verify_useful_tokens = 0
 
     # -- capacity arithmetic -------------------------------------------------
 
@@ -117,6 +123,33 @@ class BlockManager:
         if self._gather_read_tokens == 0:
             return 0.0
         return 1.0 - self._gather_useful_tokens / self._gather_read_tokens
+
+    def note_verify(self, committed, window: int) -> float:
+        """Record one speculative VERIFY dispatch's window padding: each
+        active slot computes ``window`` (= k+1) query positions but only
+        its ``committed`` tokens (accepted prefix + bonus, post EOS /
+        budget truncation) were useful — the rejected tail is the
+        width-(k+1) analogue of bucket padding, and it is accounted
+        SEPARATELY from :meth:`note_gather` (which this dispatch also
+        feeds, for its KV read) so the serve report can tell "we read
+        too wide" from "we speculated too deep". Returns the dispatch's
+        waste fraction (0.0 for an empty step)."""
+        total = len(committed) * int(window)
+        if total == 0:
+            return 0.0
+        useful = sum(min(int(c), int(window)) for c in committed)
+        waste = 1.0 - useful / total
+        self.peak_verify_waste = max(self.peak_verify_waste, waste)
+        self._verify_window_tokens += total
+        self._verify_useful_tokens += useful
+        return waste
+
+    def verify_waste(self) -> float:
+        """Token-weighted mean verify-window waste across every
+        :meth:`note_verify`-recorded dispatch (0.0 before any)."""
+        if self._verify_window_tokens == 0:
+            return 0.0
+        return 1.0 - self._verify_useful_tokens / self._verify_window_tokens
 
     # -- alloc/free ----------------------------------------------------------
 

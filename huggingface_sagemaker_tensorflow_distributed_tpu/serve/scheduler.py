@@ -64,6 +64,11 @@ class Request:
     top_k: int = 0
     top_p: float = 0.0
     seed: int = 0
+    # speculative-decode accounting (serve engine): draft tokens
+    # proposed for / accepted by this request's verify windows — the
+    # per-request acceptance rate the finish telemetry event carries
+    spec_proposed: int = 0
+    spec_accepted: int = 0
     # recompute preemption folds generated tokens back into the prompt;
     # this keeps the ORIGINAL prompt length so output accounting and
     # first-token semantics survive a preemption
@@ -127,11 +132,14 @@ class Scheduler:
     class owns WHO runs."""
 
     def __init__(self, num_slots: int, blocks: BlockManager,
-                 prefill_chunk: int, max_model_len: int):
+                 prefill_chunk: int, max_model_len: int,
+                 decode_lookahead: int = 1):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        if decode_lookahead < 1:
+            raise ValueError("decode_lookahead must be >= 1")
         if max_model_len % blocks.block_size:
             raise ValueError(
                 f"max_model_len {max_model_len} must be a multiple of "
@@ -148,6 +156,13 @@ class Scheduler:
         self.blocks = blocks
         self.prefill_chunk = int(prefill_chunk)
         self.max_model_len = int(max_model_len)
+        # tokens a decode dispatch may WRITE past each slot's resident
+        # context: 1 for plain decode, speculate_k + 1 for a
+        # speculative engine's draft/verify window — every decode-side
+        # capacity decision (submit-time worst case, per-iteration
+        # block growth, gather-bucket need) reserves this span so a
+        # verify dispatch can never address past its block table
+        self.decode_lookahead = int(decode_lookahead)
         self.waiting: list[Request] = []
         self._admit_seq = itertools.count()
         self._prefill_rr = 0
@@ -157,10 +172,13 @@ class Scheduler:
 
     def submit(self, request: Request) -> None:
         total = len(request.prompt) + request.max_new_tokens
-        if total > self.max_model_len:
+        if total + self.decode_lookahead - 1 > self.max_model_len:
+            extra = ("" if self.decode_lookahead == 1 else
+                     f" + verify-window lookahead "
+                     f"{self.decode_lookahead - 1}")
             raise ValueError(
                 f"request {request.rid}: prompt {len(request.prompt)} + "
-                f"max_new_tokens {request.max_new_tokens} exceeds "
+                f"max_new_tokens {request.max_new_tokens}{extra} exceeds "
                 f"max_model_len {self.max_model_len}")
         # worst-case lifetime block need: admission reserves the padded
         # prompt, decode grows to `total`, and a preemption at
@@ -169,7 +187,8 @@ class Scheduler:
         # the WHOLE pool can never run — admit() would park it at the
         # queue head forever (or a lone decode slot would preempt
         # itself in a loop), so reject at submit instead of livelocking.
-        worst = max(self.padded_prompt_len(request), total,
+        worst = max(self.padded_prompt_len(request),
+                    total + self.decode_lookahead - 1,
                     -(-(total - 1) // self.prefill_chunk)
                     * self.prefill_chunk)
         need = self.blocks.blocks_for(worst)
@@ -267,30 +286,35 @@ class Scheduler:
                 if s.request is not None and s.request.state == DECODE]
 
     def max_decode_context(self) -> int:
-        """The iteration's max resident decode context INCLUDING the
-        slot being written this step (a decode dispatch must address
-        ``context_len + 1`` KV positions per slot) — the quantity the
-        engine's gather-bucket choice covers. 0 with no decode work."""
-        return max((s.context_len + 1 for s in self.decode_slots()),
-                   default=0)
+        """The iteration's max decode context INCLUDING every position a
+        dispatch may write this step (``context_len + decode_lookahead``:
+        one slot for plain decode, the whole draft/verify window for a
+        speculative engine) — the quantity the engine's gather-bucket
+        choice covers. 0 with no decode work."""
+        return max((s.context_len + self.decode_lookahead
+                    for s in self.decode_slots()), default=0)
 
     def ensure_decode_capacity(self) -> list[Request]:
-        """Guarantee every DECODE slot owns a block for its next token,
+        """Guarantee every DECODE slot owns blocks for every position
+        the next dispatch may write (``context_len + decode_lookahead``),
         preempting youngest-first when the pool runs dry. Returns the
         requests preempted this call. Termination: each preemption
         frees ≥ 1 block and empties a slot, and a lone decode slot can
-        always be satisfied by the blocks everyone else released."""
+        always be satisfied by the blocks everyone else released (its
+        worst-case span was bounded at submit)."""
         preempted = []
         while True:
             ds = self.decode_slots()
             if not ds:
                 return preempted
             short = [s for s in ds
-                     if self.blocks.blocks_for(s.context_len + 1)
+                     if self.blocks.blocks_for(
+                         s.context_len + self.decode_lookahead)
                      > len(s.table)]
             try:
                 for slot in short:
-                    self.blocks.grow(slot.table, slot.context_len + 1)
+                    self.blocks.grow(slot.table,
+                                     slot.context_len + self.decode_lookahead)
                 return preempted
             except PoolExhausted:
                 victim = max(ds, key=lambda s: s.admit_seq)

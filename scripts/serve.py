@@ -144,6 +144,14 @@ def main() -> None:
                              "'64,256' ('full' disables bucketing; "
                              "default: HSTD_SERVE_GATHER_BUCKETS or "
                              "quarter+full width)")
+    parser.add_argument("--speculate_k", type=int, default=None,
+                        help="speculative decode: draft tokens per "
+                             "verify window (default: "
+                             "HSTD_SERVE_SPECULATE_K or 0 = off)")
+    parser.add_argument("--draft_layers", type=int, default=None,
+                        help="layer-skip self-draft depth (default: "
+                             "HSTD_SERVE_DRAFT_LAYERS or a quarter of "
+                             "the target's layers)")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="0 = greedy (the default); > 0 samples")
     parser.add_argument("--top_k", type=int, default=0)
@@ -170,9 +178,14 @@ def main() -> None:
                          prefill_chunk=args.prefill_chunk,
                          prefill_batch=args.prefill_batch,
                          max_model_len=max_len,
-                         gather_buckets=args.gather_buckets)
+                         gather_buckets=args.gather_buckets,
+                         speculate_k=args.speculate_k,
+                         draft=args.draft_layers)
     trace = load_trace(args, model.config.vocab_size - 1)
-    engine.warmup()
+    # precompile the sampled step variants too when the trace will
+    # sample, so no request pays a mid-serve compile
+    engine.warmup(sampled=any(kw.get("temperature", 0) > 0
+                              for _, _, kw in trace))
     reqs = [engine.submit(p, m, **kw) for p, m, kw in trace]
     t0 = time.perf_counter()
     engine.run()
@@ -182,12 +195,17 @@ def main() -> None:
     for req in reqs:
         ids = engine.output_ids(req)
         total += len(ids)
-        print(json.dumps({
+        row = {
             "request": req.rid, "prompt_len": req.orig_prompt_len,
             "output_ids": [int(t) for t in ids],
             "ttft_s": round(req.ttft_s, 4) if req.ttft_s else None,
             "sampled": req.sampled, "seed": req.seed,
-            "preemptions": req.preemptions}))
+            "preemptions": req.preemptions}
+        if engine.speculative:
+            row["acceptance_rate"] = (
+                round(req.spec_accepted / req.spec_proposed, 4)
+                if req.spec_proposed else None)
+        print(json.dumps(row))
     stats = engine.stats()
     # SLO summary from the engine's own accounting (the same figures
     # its final `serve` report telemetry event carries): TTFT + e2e
@@ -216,6 +234,11 @@ def main() -> None:
         "bucket_switches": stats.bucket_switches,
         "gather_read_waste_peak": round(stats.gather_waste_peak, 3),
         "gather_read_waste_mean": round(stats.gather_waste_mean, 3),
+        "speculate_k": engine.speculate_k or None,
+        "acceptance_rate": (round(stats.acceptance_rate, 4)
+                            if stats.acceptance_rate is not None else None),
+        "verify_read_waste_mean": (round(stats.verify_waste_mean, 3)
+                                   if engine.speculative else None),
         "kv_peak_utilization": round(stats.kv_peak_utilization, 3)}))
     obs.flush()
 

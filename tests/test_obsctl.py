@@ -85,7 +85,8 @@ def three_hosts(tmp_path):
                               kv_peak_utilization=0.83,
                               ttft_p50_s=0.02, ttft_p95_s=0.05,
                               ttft_p99_s=0.07, e2e_p50_s=0.4,
-                              e2e_p95_s=0.9, e2e_p99_s=1.2))
+                              e2e_p95_s=0.9, e2e_p99_s=1.2,
+                              speculate_k=4, acceptance_rate=0.72))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -342,6 +343,33 @@ def test_diff_zero_baseline_worsening_still_regresses(three_hosts):
     # and the better direction from 0 never flags
     assert "compile_cum_s" not in diff_reports(
         worse, base, 5.0)["regressions"]
+
+
+def test_diff_acceptance_rate_is_a_ratio_metric(three_hosts):
+    """ISSUE 6: `serve/acceptance_rate` diffs as a ratio metric whose
+    worse direction is DOWN (a draft/target drift or broken verify
+    path collapses acceptance first), with the standard zero-baseline
+    and threshold rules."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["acceptance_rate"] == pytest.approx(0.72)
+    worse = copy.deepcopy(base)
+    worse["serve"]["acceptance_rate"] = 0.31
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_acceptance_rate" in d["regressions"]
+    assert d["metrics"]["serve_acceptance_rate"]["worse_direction"] == "down"
+    # the better direction never flags; a sub-threshold dip neither
+    assert "serve_acceptance_rate" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["acceptance_rate"] = 0.70      # ~-2.8%
+    assert "serve_acceptance_rate" not in diff_reports(
+        base, slight, 5.0)["regressions"]
 
 
 def test_diff_skips_metrics_missing_on_either_side(three_hosts):

@@ -531,6 +531,187 @@ def test_request_rejects_bad_sampling_params():
         Request(prompt=np.arange(1, 4), max_new_tokens=2, top_k=-2)
 
 
+# -- ISSUE 6: speculative decoding inside the engine -------------------------
+
+@pytest.fixture(scope="module")
+def spec_draft():
+    """An INDEPENDENTLY-initialized 1-layer draft over the gpt2_setup
+    vocabulary: disagrees with the target often enough that rejection /
+    rewind paths are genuinely exercised (a self-draft of a tiny
+    random-init model is near-perfect — upper blocks are ~identity)."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+
+    cfg = Gpt2Config(vocab_size=128, hidden_size=32, num_layers=1,
+                     num_heads=2, intermediate_size=64,
+                     max_position_embeddings=128, hidden_dropout=0.0,
+                     embd_dropout=0.0, attention_dropout=0.0,
+                     eos_token_id=127, pad_token_id=0, dtype=jnp.float32)
+    model = Gpt2LMHeadModel(cfg)
+    return model, init_params(model, cfg, seed=5)
+
+
+def test_speculative_engine_exact_across_bucket_boundaries(gpt2_setup,
+                                                           spec_draft):
+    """The tentpole exactness gate, speculative edition: greedy
+    draft-k/verify serving stays token-for-token generate_causal with
+    resident contexts crossing every bucket boundary (prompts 15/16/17
+    against a 16-wide first bucket) and an adversarial draft forcing
+    real rejections (acceptance < 1) — the context-rewind path is load-
+    bearing, not idle."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(6)
+    trace = [(rng.randint(1, 120, (p,)).astype(np.int32), 6)
+             for p in (15, 16, 17)]
+    eng = _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                               num_slots=3, block_size=4, num_blocks=40,
+                               prefill_chunk=8, max_model_len=64,
+                               gather_buckets=[16, 32],
+                               speculate_k=2, draft=spec_draft)
+    assert eng.gather_buckets == [16, 32, 64]
+    stats = eng.stats()
+    assert stats.draft_proposed > 0
+    assert 0 <= stats.acceptance_rate < 1     # rejections actually hit
+    assert stats.spec_windows > 0
+    assert 0 < stats.verify_waste_mean < 1    # rejected tails accounted
+    # no block leaked through the window-reserve/commit/trim cycle
+    assert eng.blocks.num_free == eng.blocks.num_blocks - 1
+
+
+def test_speculative_engine_exact_under_preemption_rewind_leak_free(
+        gpt2_setup, spec_draft):
+    """Forced recompute preemption + rejection storms: outputs stay
+    exact, and every block comes back to the free list (no lost /
+    double-freed blocks across grow-for-window -> reject -> trim ->
+    preempt cycles)."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(1)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 14)
+             for _ in range(5)]
+    eng = _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                               num_slots=4, block_size=4, num_blocks=11,
+                               prefill_chunk=8, max_model_len=32,
+                               speculate_k=2, draft=spec_draft)
+    assert eng.stats().preemptions > 0
+    assert eng.blocks.num_free == eng.blocks.num_blocks - 1
+
+
+def test_sampled_speculative_serve_seed_deterministic_across_preemption(
+        gpt2_setup, spec_draft):
+    """Extends the ISSUE 5 seeded-determinism gate to speculative mode:
+    the whole verify window's randomness derives from (request seed,
+    window-start token index), so sampled speculative streams are
+    bitwise seed-reproducible INCLUDING across recompute preemption
+    (windows re-start at the same committed index), reseeding changes
+    only its own stream, and a greedy rider stays generate_causal."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(9)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 14)
+             for _ in range(4)]
+    kws = [dict(temperature=0.9, top_k=20, top_p=0.9, seed=s)
+           for s in (1, 2, 3)] + [dict()]        # request 3 stays greedy
+
+    def run(num_blocks, kws):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+            ServeEngine,
+        )
+
+        eng = ServeEngine(model, params, num_slots=3, block_size=4,
+                          num_blocks=num_blocks, prefill_chunk=8,
+                          max_model_len=32, speculate_k=2,
+                          draft=spec_draft)
+        reqs = [eng.submit(p, m, **kw) for (p, m), kw in zip(trace, kws)]
+        eng.run()
+        return [[int(t) for t in eng.output_ids(r)] for r in reqs], eng
+
+    base, eng = run(40, kws)
+    assert eng.stats().draft_proposed > 0
+    again, _ = run(40, kws)
+    assert again == base                        # bitwise reproducible
+    tight, teng = run(11, kws)                  # tight pool: preemption
+    assert teng.stats().preemptions > 0
+    assert tight == base                        # preemption-invariant
+    reseeded, _ = run(40, [dict(kws[0], seed=99)] + kws[1:])
+    assert reseeded[0] != base[0]               # the seed matters
+    assert reseeded[1:] == base[1:]             # ...only for its stream
+    p, m = trace[3]
+    assert base[3] == _reference(model, params, p, m, cfg.eos_token_id)
+
+
+def test_speculative_engine_knobs_and_rejections(gpt2_setup, spec_draft,
+                                                 monkeypatch):
+    """Constructor/env contract: env-driven speculate_k, ladder pruning
+    of sub-window buckets, window-aware submit rejection, bad-knob
+    errors. Host-side only — nothing here dispatches."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ENV_SPECULATE_K,
+        ServeEngine,
+    )
+
+    cfg, model, params = gpt2_setup
+    kw = dict(num_slots=2, block_size=4, num_blocks=20, prefill_chunk=8,
+              max_model_len=32)
+    monkeypatch.setenv(ENV_SPECULATE_K, "2")
+    eng = ServeEngine(model, params, draft=spec_draft, **kw)
+    assert eng.speculate_k == 2 and eng.speculative
+    monkeypatch.delenv(ENV_SPECULATE_K)
+    # the engine-level window reservation: prompt + max_new + k must
+    # fit max_model_len (the verify window writes k past the last
+    # committed position)
+    with pytest.raises(ValueError, match="verify-window"):
+        eng.submit(np.arange(1, 9), 24)       # 8 + 24 + 2 > 32
+    eng.submit(np.arange(1, 9), 22)           # 8 + 22 + 2 == 32: fits
+    # buckets narrower than the window can never be selected: pruned
+    sp = ServeEngine(model, params, speculate_k=7, draft=spec_draft,
+                     gather_buckets=[4, 16], **kw)
+    assert sp.gather_buckets == [16, 32]
+    with pytest.raises(ValueError, match="speculate_k"):
+        ServeEngine(model, params, speculate_k=-1, **kw)
+    with pytest.raises(ValueError, match="vocabulary"):
+        import dataclasses
+
+        other_cfg = dataclasses.replace(spec_draft[0].config,
+                                        vocab_size=64)
+        other = type(spec_draft[0])(other_cfg)
+        ServeEngine(model, params, speculate_k=2,
+                    draft=(other, spec_draft[1]), **kw)
+
+
+def test_warmup_sampled_precompiles_sampled_variants(gpt2_setup, tmp_path):
+    """The ROADMAP `warmup(sampled=True)` knob: after it, sampled
+    traffic triggers ZERO mid-serve compiles (without it the sampled
+    step variants compile lazily on the first sampled batch)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    cfg, model, params = gpt2_setup
+    obs.reset(out_dir=str(tmp_path / "telemetry"), enabled=True)
+    try:
+        eng = ServeEngine(model, params, num_slots=3, block_size=4,
+                          num_blocks=40, prefill_chunk=8,
+                          max_model_len=64)
+        eng.warmup(sampled=True)
+        tracker = obs.compile_tracker()
+        count0 = tracker.count
+        rng = np.random.RandomState(12)
+        for s in range(3):
+            eng.submit(rng.randint(1, 120, (9,)).astype(np.int32), 8,
+                       temperature=0.8, top_k=10, seed=s)
+        eng.run()
+        assert tracker.count == count0, \
+            "sampled serving recompiled after warmup(sampled=True)"
+    finally:
+        obs.reset()
+
+
 def test_block_manager_gather_waste_accounting():
     """note_gather latches the PEAK bucket-padded read waste and keeps
     a token-weighted mean — the decode-side counterpart of allocation
@@ -544,3 +725,39 @@ def test_block_manager_gather_waste_accounting():
     assert bm.peak_gather_waste == pytest.approx(1 - 12 / 32)
     assert bm.gather_waste() == pytest.approx(1 - 27 / 48)
     assert bm.note_gather([], 16) == 0.0        # empty step: no-op
+
+
+def test_block_manager_verify_waste_is_separate_from_gather_waste():
+    """note_verify accounts width-(k+1) window padding (rejected draft
+    tails) in ITS OWN accumulators — a speculative engine can have high
+    verify waste with low bucket-read waste and vice versa, and the
+    report must tell them apart."""
+    bm = BlockManager(num_blocks=9, block_size=4)
+    assert bm.verify_waste() == 0.0 and bm.peak_verify_waste == 0.0
+    # 2 windows of width 5 committing 5 and 2 tokens -> 1 - 7/10
+    assert bm.note_verify([5, 2], 5) == pytest.approx(1 - 7 / 10)
+    # a fully-accepted step: zero waste, peak latched from before
+    assert bm.note_verify([5, 5], 5) == 0.0
+    assert bm.peak_verify_waste == pytest.approx(1 - 7 / 10)
+    assert bm.verify_waste() == pytest.approx(1 - 17 / 20)
+    assert bm.note_verify([], 5) == 0.0         # empty step: no-op
+    # gather-side accumulators untouched
+    assert bm.gather_waste() == 0.0 and bm.peak_gather_waste == 0.0
+
+
+def test_scheduler_lookahead_reserves_verify_window():
+    """decode_lookahead generalizes the +1 decode reservation: submit
+    rejects requests whose window would overflow max_model_len, and
+    ensure_decode_capacity grows tables to context + lookahead."""
+    bm = BlockManager(num_blocks=20, block_size=4)
+    s = Scheduler(1, bm, 4, 32, decode_lookahead=4)     # k = 3
+    with pytest.raises(ValueError, match="verify-window"):
+        s.submit(Request(prompt=np.arange(1, 9), max_new_tokens=22))
+    s.submit(Request(prompt=np.arange(1, 9), max_new_tokens=21))
+    s.admit()
+    slot = s.slots[0]
+    s.finish_prefill(slot)
+    assert s.max_decode_context() == 8 + 4
+    s.ensure_decode_capacity()
+    # table covers context + lookahead = 12 tokens -> 3 blocks
+    assert len(slot.table) == 3

@@ -147,15 +147,20 @@ def test_produced_train_and_serve_artifacts_validate(tmp_path):
         Trainer(cfg, model, params, mesh).fit(
             ShardedBatcher(_data(n=32), 16, mesh, shuffle=False, seed=0))
 
-        gcfg = Gpt2Config(vocab_size=128, hidden_size=32, num_layers=1,
+        gcfg = Gpt2Config(vocab_size=128, hidden_size=32, num_layers=2,
                           num_heads=2, intermediate_size=64,
                           max_position_embeddings=64, hidden_dropout=0.0,
                           embd_dropout=0.0, attention_dropout=0.0,
                           eos_token_id=127, pad_token_id=0)
         gmodel = Gpt2LMHeadModel(gcfg)
+        # a SPECULATIVE engine (ISSUE 6): the produced stream must
+        # carry the acceptance-rate fields on finish/report events —
+        # fixtures regenerated from a real speculative run, not
+        # hand-built
         eng = ServeEngine(gmodel, init_params(gmodel, gcfg, seed=0),
                           num_slots=2, block_size=8, num_blocks=17,
-                          prefill_chunk=8, max_model_len=32)
+                          prefill_chunk=8, max_model_len=32,
+                          speculate_k=2, draft=1)
         eng.submit(np.arange(1, 6, dtype=np.int32), 4)
         # one sampled request so the produced stream carries the
         # ISSUE 5 serve fields (submit.sampled True alongside False)
@@ -181,6 +186,16 @@ def test_produced_train_and_serve_artifacts_validate(tmp_path):
     assert {e["sampled"] for e in submits} == {True, False}
     assert all(isinstance(e["gather_bucket"], int) for e in serve
                if e["event"] == "bucket_switch")
+    # the ISSUE 6 acceptance telemetry rides the live stream typed:
+    # every finish carries the per-request rate, the report the
+    # aggregate + speculate_k
+    finishes = [e for e in serve if e["event"] == "finish"]
+    assert finishes and all(
+        isinstance(e["acceptance_rate"], (int, float))
+        and isinstance(e["draft_proposed"], int) for e in finishes)
+    report = [e for e in serve if e["event"] == "report"][-1]
+    assert report["speculate_k"] == 2
+    assert isinstance(report["acceptance_rate"], (int, float))
     proc = _run(str(out))
     assert proc.returncode == 0, proc.stdout
     assert proc.stdout.count("OK") == 2          # events.jsonl + trace.json
@@ -198,12 +213,20 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
          "event": "bucket_switch", "gather_bucket": "wide"},    # drift
         {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
          "event": "submit", "request": 0, "sampled": 1},        # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "finish", "request": 0,
+         "acceptance_rate": 0.75, "draft_proposed": 8},         # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "finish", "request": 1,
+         "acceptance_rate": "high", "speculate_k": 2.5},        # drift
     ]
     bad.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
     proc = _run(str(bad))
     assert proc.returncode == 1
     assert "optional field 'gather_bucket'" in proc.stdout
     assert "optional field 'sampled'" in proc.stdout
+    assert "optional field 'acceptance_rate'" in proc.stdout
+    assert "optional field 'speculate_k'" in proc.stdout
 
 
 def test_validator_accepts_anomaly_and_flight_artifacts(tmp_path):
