@@ -314,6 +314,10 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # drift or a broken verify path shows up here first); ratio kind so
     # the zero-baseline worsening rule applies like any other ratio
     "serve_acceptance_rate": (-1, "ratio"),
+    # prefix caching: LOWER hit rate is worse (a broken chain hash, an
+    # over-eager eviction, or a trace drifting off its template all
+    # show up as the cache silently going cold — TTFT follows)
+    "serve_cache_hit_rate": (-1, "ratio"),
 }
 
 
@@ -344,7 +348,7 @@ def _report_scalars(report: dict) -> dict:
     }
     for key in ("ttft_p50_s", "ttft_p99_s", "e2e_p50_s", "e2e_p99_s",
                 "decode_tokens_per_sec", "preemptions",
-                "acceptance_rate"):
+                "acceptance_rate", "cache_hit_rate"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out
@@ -484,6 +488,11 @@ def render_text(report: dict) -> str:
         if serve.get("acceptance_rate") is not None:
             parts.append(f"spec acceptance {serve['acceptance_rate']} "
                          f"(k={serve.get('speculate_k')})")
+        if serve.get("cache_hit_rate") is not None:
+            parts.append(
+                f"prefix-cache hit rate {serve['cache_hit_rate']}"
+                + (f" ({serve['blocks_shared_peak']} blocks shared peak)"
+                   if serve.get("blocks_shared_peak") is not None else ""))
         lines.append("serve: " + ", ".join(parts))
     errors = report.get("errors", [])
     if errors:

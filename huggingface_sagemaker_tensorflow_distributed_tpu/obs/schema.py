@@ -77,16 +77,26 @@ REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
 
 # optional per-type fields that are TYPE-CHECKED when present (absence
 # is fine — they ride specific event subtypes): the serve engine's
-# decode gather-width bucket, the per-request sampling flag, and the
-# speculative-decode acceptance accounting (finish events carry the
-# per-request figures; the final report event the aggregates)
+# decode gather-width bucket, the per-request sampling flag, the
+# speculative-decode acceptance accounting, and the prefix-cache
+# accounting (admit/finish events carry the per-request figures —
+# prompt tokens served from shared KV blocks and the hit rate; the
+# final report event the aggregates + block-sharing peaks)
 OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
     "serve": {"gather_bucket": (int,), "sampled": (bool,),
               "request": (int,), "speculate_k": (int,),
               "draft_proposed": (int,), "draft_accepted": (int,),
               "acceptance_rate": _NUM,
               "verify_read_waste_peak": _NUM,
-              "verify_read_waste_mean": _NUM},
+              "verify_read_waste_mean": _NUM,
+              "prefix_cache": (bool,),
+              "prefix_cached_tokens": (int,),
+              "cache_hit_rate": _NUM,
+              "blocks_shared_peak": (int,),
+              "blocks_saved_peak": (int,),
+              "cow_copies": (int,),
+              "prefix_evictions": (int,),
+              "shared_read_frac": _NUM},
 }
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)

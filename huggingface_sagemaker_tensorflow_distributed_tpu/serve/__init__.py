@@ -26,7 +26,8 @@ def __getattr__(name):
     # ServeEngine pulls in jax; keep `import ...serve` cheap for
     # host-only consumers (scheduler/block-manager tests)
     if name in ("ServeEngine", "EngineStats", "CachePlan",
-                "build_cache_plan", "parse_gather_buckets"):
+                "build_cache_plan", "parse_gather_buckets",
+                "parse_prefix_cache"):
         from huggingface_sagemaker_tensorflow_distributed_tpu.serve import (
             engine,
         )

@@ -44,6 +44,16 @@ speculation; vLLM + Orca + Sarathi + Leviathan lineage):
   every idle decode slot buys one more chunk, packed into as few
   dispatches as possible — which is what cuts TTFT under bursty
   arrivals.
+- **Copy-on-write prefix caching** (``prefix_cache``) — full
+  block-aligned prompt-prefix chunks are indexed by a rolling hash
+  chain (:class:`~.paged_kv.BlockManager`), so requests sharing a
+  templated system prompt map their prefix onto SHARED refcounted KV
+  blocks: prefill for the cached span is skipped entirely (a
+  block-table write), admission charges only private blocks, and
+  zero-ref cached blocks persist in an LRU until pool pressure evicts
+  them. Writes into still-shared blocks privatize first via a
+  device-side block copy (COW) — output stays token-exact vs cold
+  start.
 - **Speculative decoding** (``speculate_k``/``draft``) — per iteration
   a draft model (its own paged pools over the SAME block tables)
   proposes ``k`` tokens per running slot, then ONE width-(k+1) target
@@ -107,6 +117,24 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (
 ENV_GATHER_BUCKETS = "HSTD_SERVE_GATHER_BUCKETS"
 ENV_SPECULATE_K = "HSTD_SERVE_SPECULATE_K"
 ENV_DRAFT_LAYERS = "HSTD_SERVE_DRAFT_LAYERS"
+ENV_PREFIX_CACHE = "HSTD_SERVE_PREFIX_CACHE"
+
+
+def parse_prefix_cache(spec: Union[str, bool, None]) -> bool:
+    """The ``prefix_cache`` knob: None reads ``HSTD_SERVE_PREFIX_CACHE``
+    (default ON — templated traffic is the common case); accepts
+    bool or the CLI/env spellings on/off/1/0/true/false."""
+    if spec is None:
+        spec = os.environ.get(ENV_PREFIX_CACHE, "on")
+    if isinstance(spec, bool):
+        return spec
+    s = str(spec).strip().lower()
+    if s in ("on", "1", "true", "yes", ""):
+        return True
+    if s in ("off", "0", "false", "no"):
+        return False
+    raise ValueError(f"unparseable {ENV_PREFIX_CACHE} value {spec!r}: "
+                     "expected on/off")
 
 
 def parse_gather_buckets(spec: Union[str, Sequence[int], None],
@@ -337,6 +365,20 @@ def _prefill_chunk_jit(donate: bool):
                    donate_argnums=(2,) if donate else ())
 
 
+def _copy_block(pools, src, dst):
+    """Copy-on-write device op: duplicate physical block ``src`` into
+    ``dst`` across every pool of one model's KV address space. Scalar
+    src/dst are traced, so ONE compile covers every COW a pool
+    geometry ever performs (fixed shape — the compile-flatness gates
+    stay honest on the cache-hit path)."""
+    return [p.at[dst].set(p[src]) for p in pools]
+
+
+@functools.lru_cache(maxsize=2)
+def _copy_block_jit(donate: bool):
+    return jax.jit(_copy_block, donate_argnums=(0,) if donate else ())
+
+
 def _scatter_window(pools, plan: CachePlan, cache_leaves, block_tables,
                     context_lens, active, k: int):
     """Scatter a just-computed (k+1)-token window's K/V — written by a
@@ -507,6 +549,16 @@ class EngineStats(NamedTuple):
     spec_windows: int = 0
     verify_waste_peak: float = 0.0
     verify_waste_mean: float = 0.0
+    # prefix caching (ISSUE 8)
+    prefix_cache: bool = False
+    prefix_cached_tokens: int = 0
+    cache_hit_rate: Optional[float] = None
+    blocks_shared_peak: int = 0
+    blocks_saved_peak: int = 0
+    cow_copies: int = 0
+    prefix_evictions: int = 0
+    shared_read_frac: float = 0.0
+    peak_resident_requests: int = 0
 
 
 class ServeEngine:
@@ -538,7 +590,24 @@ class ServeEngine:
     ``HSTD_SERVE_DRAFT_LAYERS`` falling back to a quarter of the
     target's layers. Requests additionally reserve the verify window:
     ``prompt + max_new_tokens + speculate_k`` must fit
-    ``max_model_len``."""
+    ``max_model_len``.
+
+    ``prefix_cache`` (None reads ``HSTD_SERVE_PREFIX_CACHE``, default
+    on) turns on copy-on-write prefix caching: full block-aligned
+    prompt-prefix chunks are indexed by a rolling hash chain, identical
+    prefixes across requests map onto SHARED read-only KV blocks
+    (refcounted, charged to the pool once), and prefill for a cache hit
+    starts at the first uncached chunk — TTFT for templated traffic
+    collapses toward the tail's prefill plus a block-table write, and
+    effective KV capacity multiplies by the dedup factor. Blocks of
+    finished requests stay cached (zero-ref LRU) until pool pressure
+    evicts them, oldest first. Output is token-exact vs a cold start:
+    cached KV is bitwise what this request's own prefill would have
+    produced, and a scatter into a still-shared block (the chunk-grid
+    overlap at admission) is privatized by a device-side block copy
+    first (:func:`_copy_block`). ``prefix_cache='off'`` is
+    byte-for-byte the refcount-free engine's behavior — same tokens,
+    same compile count."""
 
     #: consecutive iterations a smaller bucket must suffice before the
     #: engine shrinks to it — bounds bucket churn when the max resident
@@ -552,7 +621,8 @@ class ServeEngine:
                  gather_buckets: Union[str, Sequence[int], None] = None,
                  prefill_batch: int = 4,
                  speculate_k: Optional[int] = None,
-                 draft=None):
+                 draft=None,
+                 prefix_cache: Union[str, bool, None] = None):
         cfg = model.config
         if getattr(cfg, "num_experts", 0):
             raise ValueError(
@@ -589,10 +659,12 @@ class ServeEngine:
         if self.speculate_k < 0:
             raise ValueError(f"speculate_k must be >= 0, "
                              f"got {self.speculate_k}")
+        self.prefix_cache = parse_prefix_cache(prefix_cache)
         self.blocks = BlockManager(num_blocks, block_size)
         self.sched = Scheduler(num_slots, self.blocks, prefill_chunk,
                                self.max_model_len,
-                               decode_lookahead=self.speculate_k + 1)
+                               decode_lookahead=self.speculate_k + 1,
+                               prefix_cache=self.prefix_cache)
         self.max_blocks_per_seq = self.max_model_len // block_size
         if gather_buckets is None:
             gather_buckets = os.environ.get(ENV_GATHER_BUCKETS)
@@ -650,6 +722,7 @@ class ServeEngine:
         self._decode_fn = _decode_step_jit(donate)
         self._prefill_fn = _prefill_chunk_jit(donate)
         self._spec_fn = _spec_step_jit(donate)
+        self._copy_fn = _copy_block_jit(donate)
         self.finished: dict[int, Request] = {}
         self._keys: dict[int, np.ndarray] = {}   # rid -> base PRNG key
         self.decode_steps = 0
@@ -664,6 +737,7 @@ class ServeEngine:
         self.draft_proposed = 0
         self.draft_accepted = 0
         self.spec_windows = 0       # active (slot, iteration) pairs
+        self.peak_resident = 0      # max concurrently-occupied slots
         self._bucket = self.gather_buckets[0]
         self._shrink_streak = 0
         self._warmed_modes: set = set()
@@ -766,6 +840,16 @@ class ServeEngine:
                             np.zeros((S,), bool), sf, si, sf,
                             np.zeros((S, 2), np.uint32), si, self._plan,
                             bucket, mode)
+            if self.prefix_cache and not self._warmed_modes:
+                # precompile the COW block copy (null-block self-copy:
+                # a no-op) so a cache hit that must privatize never
+                # traces mid-serve — the "hit path adds zero new
+                # compiled variants" contract
+                self._pools = self._copy_fn(self._pools,
+                                            np.int32(0), np.int32(0))
+                if self.speculative:
+                    self._d_pools = self._copy_fn(self._d_pools,
+                                                  np.int32(0), np.int32(0))
             jax.block_until_ready(tok)
         if not self._warmed_modes:
             # announce the starting bucket so every instrumented run
@@ -824,6 +908,21 @@ class ServeEngine:
             percentile,
         )
 
+        if self.prefix_cache:
+            cached = sum(r.prefix_cached_tokens for r in reqs)
+            admitted = sum(r.prefix_prompt_tokens for r in reqs)
+            out["prefix_cache"] = True
+            out["prefix_cached_tokens"] = cached
+            out["cache_hit_rate"] = (round(cached / admitted, 4)
+                                     if admitted else 0.0)
+            out["blocks_shared_peak"] = self.blocks.peak_shared_blocks
+            out["blocks_saved_peak"] = self.blocks.peak_blocks_saved
+            out["cow_copies"] = self.blocks.cow_copies
+            out["prefix_evictions"] = self.blocks.prefix_evictions
+            out["shared_read_frac"] = round(
+                self.blocks.shared_read_frac(), 4)
+        out["peak_resident_requests"] = self.peak_resident
+
         if self.speculative:
             out["speculate_k"] = self.speculate_k
             out["draft_proposed"] = self.draft_proposed
@@ -875,7 +974,30 @@ class ServeEngine:
                              if self.draft_proposed else None),
             spec_windows=self.spec_windows,
             verify_waste_peak=self.blocks.peak_verify_waste,
-            verify_waste_mean=self.blocks.verify_waste())
+            verify_waste_mean=self.blocks.verify_waste(),
+            prefix_cache=self.prefix_cache,
+            prefix_cached_tokens=sum(
+                r.prefix_cached_tokens for r in self.finished.values()),
+            cache_hit_rate=self._aggregate_hit_rate(),
+            blocks_shared_peak=self.blocks.peak_shared_blocks,
+            blocks_saved_peak=self.blocks.peak_blocks_saved,
+            cow_copies=self.blocks.cow_copies,
+            prefix_evictions=self.blocks.prefix_evictions,
+            shared_read_frac=self.blocks.shared_read_frac(),
+            peak_resident_requests=self.peak_resident)
+
+    def _aggregate_hit_rate(self) -> Optional[float]:
+        """Prompt tokens served from cache / prompt tokens admitted,
+        over every finished request (None with prefix caching off or
+        before any finish)."""
+        if not self.prefix_cache:
+            return None
+        admitted = sum(r.prefix_prompt_tokens
+                       for r in self.finished.values())
+        if not admitted:
+            return None
+        return (sum(r.prefix_cached_tokens
+                    for r in self.finished.values()) / admitted)
 
     # -- one engine iteration ------------------------------------------------
 
@@ -883,8 +1005,15 @@ class ServeEngine:
         """Admit → batched prefill under the token budget → one decode
         step over all slots at the iteration's gather bucket."""
         for slot in self.sched.admit():
+            self._apply_cow(slot)
+            extra = {}
+            if self.prefix_cache:
+                extra["prefix_cached_tokens"] = slot.prefill_pos
             obs.serve("admit", request=slot.request.rid, slot=slot.index,
-                      queue_depth=len(self.sched.waiting))
+                      queue_depth=len(self.sched.waiting), **extra)
+        self.peak_resident = max(
+            self.peak_resident,
+            sum(1 for s in self.sched.slots if not s.free))
         C = self.sched.prefill_chunk
         budget = self.sched.prefill_token_budget(
             len(self.sched.decode_slots()))
@@ -1059,6 +1188,13 @@ class ServeEngine:
                 keys[i] = self._keys[req.rid]
                 folds[i] = self._generated(req)
         self.blocks.note_gather([s.context_len + 1 for s in ds], bucket)
+        # blocks_saved() == 0 means no block is shared right now — the
+        # per-slot table walk would only accumulate zeros, so skip it
+        # (the common case for non-templated traffic with the cache on)
+        if self.prefix_cache and self.blocks.blocks_saved() > 0:
+            self.blocks.note_shared_reads(sum(
+                self.blocks.shared_read_tokens(s.table, s.context_len)
+                for s in ds))
         t0 = time.perf_counter()
         with obs.span("serve/decode_step",
                       {"active": len(ds), "gather_bucket": bucket}
@@ -1118,6 +1254,10 @@ class ServeEngine:
                 folds[i] = self._generated(req)   # window start index
         self.blocks.note_gather(
             [s.context_len + k + 1 for s in ds], bucket)
+        if self.prefix_cache and self.blocks.blocks_saved() > 0:
+            self.blocks.note_shared_reads(sum(
+                self.blocks.shared_read_tokens(s.table, s.context_len)
+                for s in ds))
         t0 = time.perf_counter()
         with obs.span("serve/spec_decode_step",
                       {"active": len(ds), "gather_bucket": bucket,
@@ -1163,6 +1303,19 @@ class ServeEngine:
 
     # -- helpers -------------------------------------------------------------
 
+    def _apply_cow(self, slot) -> None:
+        """Apply the admission's queued copy-on-write block copies to
+        EVERY pool addressed by the slot's table — the draft's pools
+        ride the same block tables as the target's, so both KV address
+        spaces must duplicate the privatized blocks."""
+        for src, dst in slot.pending_copies:
+            self._pools = self._copy_fn(self._pools, np.int32(src),
+                                        np.int32(dst))
+            if self.speculative:
+                self._d_pools = self._copy_fn(self._d_pools,
+                                              np.int32(src), np.int32(dst))
+        slot.pending_copies = []
+
     def _generated(self, req: Request) -> int:
         return (len(req.prompt) - req.orig_prompt_len) + len(req.output)
 
@@ -1192,6 +1345,11 @@ class ServeEngine:
                         round(req.spec_accepted / req.spec_proposed, 4)
                         if req.spec_proposed else None),
                 }
+            if self.prefix_cache:
+                extra["prefix_cached_tokens"] = req.prefix_cached_tokens
+                extra["cache_hit_rate"] = (
+                    round(req.cache_hit_rate, 4)
+                    if req.cache_hit_rate is not None else None)
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
                       preemptions=req.preemptions, **extra)

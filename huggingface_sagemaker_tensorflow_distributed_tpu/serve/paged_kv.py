@@ -21,12 +21,49 @@ plain host Python where it is unit-testable without a backend:
   bucket-padded read waste (peak + token-weighted mean) so the serve
   report can show what width bucketing saves.
 
-The engine frees a finished/preempted request's blocks immediately;
-there is no refcounting/copy-on-write (no beam forking through the
-serve path yet), so a block is owned by exactly one request.
+Prefix caching (ISSUE 8) adds block-level SHARING on top: every block
+carries a refcount, and full ``block_size``-aligned prompt-prefix
+chunks are indexed by a rolling hash chain (block N's key includes
+blocks 0..N-1's tokens) so identical prompt prefixes across requests
+map onto the SAME physical blocks. Lifecycle:
+
+- :meth:`match_prefix` walks the chain for a new prompt, increfs every
+  hit, and returns the shared block ids — the engine points the
+  request's block table at them and skips their prefill compute.
+- :meth:`register_prefix` (at prefill completion) publishes a request's
+  full prompt blocks into the index; registered blocks are READ-ONLY.
+- :meth:`release` (replacing raw ``free``) decrefs; a zero-ref
+  REGISTERED block parks in an LRU of cached blocks — still reusable
+  by future lookups, reclaimed oldest-first by :meth:`allocate` only
+  under pool pressure. Unregistered zero-ref blocks return to the free
+  list immediately.
+- :meth:`privatize` is copy-on-write: a request about to scatter into
+  a block with refcount > 1 gets a fresh private copy (the caller
+  applies the returned (src, dst) device copies); a sole-owner
+  registered block is unpublished and written in place instead.
+
+Every entry stores its chunk's actual tokens and its parent key, and
+lookup verifies both per level — a hash collision degrades to a cache
+miss, never to serving another prompt's KV.
 """
 
 from __future__ import annotations
+
+from collections import OrderedDict
+from typing import NamedTuple, Optional, Sequence
+
+#: chain seed for block 0's key (any fixed odd 64-bit constant)
+_CHAIN_ROOT = 0x9E3779B97F4A7C15
+
+
+class CachedBlock(NamedTuple):
+    """One prefix-index entry: the physical block plus the exact chunk
+    tokens and parent chain key the lookup re-verifies (collision
+    safety — see module docstring)."""
+
+    block: int
+    parent: int
+    chunk: tuple
 
 
 class PoolExhausted(Exception):
@@ -50,6 +87,27 @@ class BlockManager:
         # LIFO free list: recently-freed (cache-warm) blocks are reused
         # first; block 0 excluded for good
         self._free = list(range(self.num_blocks - 1, 0, -1))
+        # per-block refcount: 0 = free or cached, >= 1 = held by that
+        # many block tables (prefix sharing makes > 1 possible)
+        self._ref = [0] * self.num_blocks
+        self._used = 0
+        # prefix cache: chain key -> CachedBlock, the reverse block ->
+        # key map, and the LRU of zero-ref registered blocks (oldest
+        # first — the eviction order under pool pressure)
+        self._index: dict[int, CachedBlock] = {}
+        self._block_key: dict[int, int] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # sharing accounting: how many block tables hold a ref BEYOND
+        # the first (the allocation the cache deduplicates), peak
+        # count of distinct ref>=2 blocks, COW copies performed, and
+        # decode reads served out of shared blocks
+        self._extra_refs = 0
+        self._shared_blocks = 0      # distinct blocks at ref >= 2, live
+        self.peak_shared_blocks = 0
+        self.peak_blocks_saved = 0
+        self.cow_copies = 0
+        self.prefix_evictions = 0
+        self._shared_read_tokens = 0
         self.peak_used = 0
         # bucket-padded READ waste (decode-side, orthogonal to the
         # allocation fragmentation below): latched by note_gather()
@@ -75,10 +133,21 @@ class BlockManager:
 
     @property
     def num_used(self) -> int:
-        return (self.num_blocks - 1) - len(self._free)
+        """Blocks held by at least one block table (refcount >= 1)."""
+        return self._used
+
+    @property
+    def num_cached(self) -> int:
+        """Zero-ref registered blocks parked in the reuse LRU — free
+        CAPACITY (evictable on demand) that is still a prefix-cache
+        hit until reclaimed."""
+        return len(self._lru)
 
     def can_allocate(self, n_blocks: int) -> bool:
-        return n_blocks <= len(self._free)
+        """Cached LRU blocks count as allocatable capacity: they are
+        evicted (oldest first) the moment a real allocation needs
+        them."""
+        return n_blocks <= len(self._free) + len(self._lru)
 
     def utilization(self) -> float:
         """Fraction of allocatable blocks currently held by requests."""
@@ -151,18 +220,40 @@ class BlockManager:
             return 0.0
         return 1.0 - self._verify_useful_tokens / self._verify_window_tokens
 
-    # -- alloc/free ----------------------------------------------------------
+    # -- alloc/release -------------------------------------------------------
 
     def allocate(self, n_blocks: int) -> list[int]:
-        """Pop ``n_blocks`` physical block ids; raises
-        :class:`PoolExhausted` (allocating nothing) when short."""
-        if n_blocks > len(self._free):
+        """Pop ``n_blocks`` physical block ids (each handed out at
+        refcount 1); raises :class:`PoolExhausted` (allocating nothing)
+        when short. The free list is consumed first; zero-ref cached
+        blocks are evicted from the LRU — oldest first, unpublishing
+        their prefix-index entries — only once the free list runs
+        dry."""
+        if n_blocks > len(self._free) + len(self._lru):
             raise PoolExhausted(
-                f"need {n_blocks} blocks, {len(self._free)} free "
+                f"need {n_blocks} blocks, {len(self._free)} free + "
+                f"{len(self._lru)} cached "
                 f"(pool {self.num_blocks - 1} allocatable)")
-        out = [self._free.pop() for _ in range(n_blocks)]
-        self.peak_used = max(self.peak_used, self.num_used)
+        out = []
+        for _ in range(n_blocks):
+            if not self._free:
+                self._evict_cached()
+            b = self._free.pop()
+            self._ref[b] = 1
+            out.append(b)
+        self._used += n_blocks
+        self.peak_used = max(self.peak_used, self._used)
         return out
+
+    def _evict_cached(self) -> None:
+        """Reclaim the least-recently-released cached block: drop its
+        index entry (future lookups of that prefix miss from this level
+        on) and put the block on the free list."""
+        b, _ = self._lru.popitem(last=False)
+        key = self._block_key.pop(b)
+        del self._index[key]
+        self.prefix_evictions += 1
+        self._free.append(b)
 
     def grow(self, table: list[int], n_tokens: int) -> list[int]:
         """Extend ``table`` (a request's block table) to cover
@@ -177,17 +268,215 @@ class BlockManager:
         return fresh
 
     def trim(self, table: list[int], n_tokens: int) -> None:
-        """Free table blocks beyond what ``n_tokens`` needs (chunked
+        """Release table blocks beyond what ``n_tokens`` needs (chunked
         prefill pads the prompt to a chunk multiple; the pad tail's
         blocks come back here once the real length is known)."""
         keep = self.blocks_for(n_tokens)
         while len(table) > keep:
-            self.free([table.pop()])
+            self.release([table.pop()])
 
-    def free(self, blocks: list[int]) -> None:
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per listed block. A block reaching
+        refcount 0 returns to the free list — unless it is registered
+        in the prefix index, in which case it parks in the cached-block
+        LRU (reusable by future :meth:`match_prefix` hits, reclaimable
+        by :meth:`allocate` under pressure). Releasing a block that is
+        not held (already free or cached) raises — the double-free
+        guard that keeps the free list corruption-proof."""
         for b in blocks:
             if not 1 <= b < self.num_blocks:
-                raise ValueError(f"freeing block {b} outside the pool")
-            if b in self._free:
-                raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+                raise ValueError(f"releasing block {b} outside the pool")
+            if self._ref[b] == 0:
+                raise ValueError(f"double free of block {b} (not held "
+                                 "by any table)")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._used -= 1
+                if b in self._block_key:
+                    self._lru[b] = None     # newest at the end
+                else:
+                    self._free.append(b)
+            else:
+                self._extra_refs -= 1
+                if self._ref[b] == 1:
+                    self._shared_blocks -= 1
+
+    #: legacy name — release() IS the free of the refcounted pool
+    free = release
+
+    # -- prefix cache --------------------------------------------------------
+
+    def chain_keys(self, tokens):
+        """Yield ``(chain_key, chunk_tokens)`` per FULL block-sized
+        chunk of ``tokens``, lazily — a consumer that stops at the
+        first index miss never hashes the rest of the prompt. Key N
+        hashes (key N-1, chunk N), so a key commits to the whole token
+        prefix through its block — the property that makes index
+        entries reusable even after their physical parent blocks were
+        evicted and re-prefilled elsewhere (the chain value is a pure
+        function of the tokens)."""
+        bs = self.block_size
+        h = _CHAIN_ROOT
+        for i in range(len(tokens) // bs):
+            chunk = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            h = hash((h, chunk))
+            yield h, chunk
+
+    def peek_prefix(self, tokens, max_blocks: Optional[int] = None
+                    ) -> tuple[list[int], int]:
+        """Read-only longest-cached-prefix probe: ``(block_ids,
+        n_revivals)`` where ``n_revivals`` counts matched blocks that
+        are currently zero-ref (parked in the LRU — committing the
+        match removes them from evictable capacity, so an admission
+        capacity check must charge for them). Verifies each level's
+        stored chunk AND parent key (collision => miss, never wrong
+        KV). Mutates NOTHING: a failed admission probe re-run every
+        engine iteration must not touch refcounts or perturb LRU
+        order. ``max_blocks`` caps the walk — the engine passes
+        ``(prompt_len - 1) // block_size`` so at least the final
+        prompt token is always recomputed (its logits seed
+        generation)."""
+        out: list[int] = []
+        revivals = 0
+        parent = _CHAIN_ROOT
+        for key, chunk in self.chain_keys(tokens):
+            if max_blocks is not None and len(out) >= max_blocks:
+                break
+            entry = self._index.get(key)
+            if entry is None or entry.chunk != chunk \
+                    or entry.parent != parent:
+                break
+            out.append(entry.block)
+            if self._ref[entry.block] == 0:
+                revivals += 1
+            parent = key
+        return out, revivals
+
+    def commit_match(self, blocks: Sequence[int]) -> None:
+        """Take one reference on every peeked block (reviving zero-ref
+        ones out of the LRU) — the write half of :meth:`peek_prefix`,
+        called once admission capacity is assured."""
+        for b in blocks:
+            if self._ref[b] == 0:
+                del self._lru[b]
+                self._used += 1
+            else:
+                self._extra_refs += 1
+                if self._ref[b] == 1:
+                    self._shared_blocks += 1
+            self._ref[b] += 1
+        if blocks:
+            self.peak_used = max(self.peak_used, self._used)
+            self.peak_shared_blocks = max(self.peak_shared_blocks,
+                                          self._shared_blocks)
+            self.peak_blocks_saved = max(self.peak_blocks_saved,
+                                         self._extra_refs)
+
+    def match_prefix(self, tokens, max_blocks: Optional[int] = None
+                     ) -> list[int]:
+        """Longest cached prefix of ``tokens`` in full blocks, with the
+        references taken: peek + commit in one call. The caller owns
+        the returned references (release them like any allocated
+        block)."""
+        out, _ = self.peek_prefix(tokens, max_blocks)
+        self.commit_match(out)
+        return out
+
+    def register_prefix(self, tokens, table: Sequence[int]) -> int:
+        """Publish the full-block prefix of ``tokens`` (whose KV lives
+        in ``table``'s leading blocks) into the index; returns how many
+        blocks were newly registered. Levels already present keep their
+        existing entry — the first writer wins, later identical blocks
+        stay private and flow back to the free list on release."""
+        registered = 0
+        parent = _CHAIN_ROOT
+        for i, (key, chunk) in enumerate(self.chain_keys(tokens)):
+            if i >= len(table):
+                break
+            if key not in self._index:
+                b = int(table[i])
+                if b not in self._block_key:
+                    self._index[key] = CachedBlock(b, parent, chunk)
+                    self._block_key[b] = key
+                    registered += 1
+            parent = key
+        return registered
+
+    def privatize(self, table: list[int], lo: int, hi: int
+                  ) -> list[tuple[int, int]]:
+        """Copy-on-write for table blocks ``[lo, hi)`` that a request
+        is about to scatter into: a block with refcount > 1 is swapped
+        for a freshly-allocated private copy — the returned
+        ``(src, dst)`` pairs are the device-side pool copies the CALLER
+        must apply (to every pool addressed by this table, target and
+        draft alike) before the write dispatch; a sole-owner block that
+        is merely registered is unpublished and written in place (no
+        copy — nobody else can be reading it). Raises
+        :class:`PoolExhausted` if a copy target cannot be allocated."""
+        copies: list[tuple[int, int]] = []
+        for i in range(lo, min(hi, len(table))):
+            b = table[i]
+            if self._ref[b] > 1:
+                [dst] = self.allocate(1)
+                self._ref[b] -= 1
+                self._extra_refs -= 1
+                if self._ref[b] == 1:
+                    self._shared_blocks -= 1
+                table[i] = dst
+                copies.append((b, dst))
+                self.cow_copies += 1
+            elif b in self._block_key:
+                key = self._block_key.pop(b)
+                del self._index[key]
+        return copies
+
+    def is_private(self, block: int) -> bool:
+        """True when exactly one table holds ``block`` and it is not
+        published in the prefix index — the only state a scatter may
+        write without :meth:`privatize`."""
+        return self._ref[block] == 1 and block not in self._block_key
+
+    def ensure_private(self, table: Sequence[int], lo: int, hi: int) -> None:
+        """Assert-style guard: every table block in ``[lo, hi)`` must be
+        writable. Decode/verify write spans are private by construction
+        (they sit past the cached prompt prefix); a shared block here
+        means allocator-state corruption, so fail loudly instead of
+        silently clobbering another request's KV."""
+        for i in range(lo, min(hi, len(table))):
+            if not self.is_private(table[i]):
+                raise RuntimeError(
+                    f"block {table[i]} (table index {i}) is shared or "
+                    f"registered but sits in a write span — allocator "
+                    f"state corrupted")
+
+    def blocks_saved(self) -> int:
+        """Block allocations the prefix cache is deduplicating RIGHT
+        NOW: total extra references beyond each shared block's first
+        (= blocks a cache-off run would additionally hold resident)."""
+        return self._extra_refs
+
+    def note_shared_reads(self, n_tokens: int) -> None:
+        """Account decode/verify KV reads served out of shared
+        (refcount >= 2) blocks — the read-side extension of the waste
+        accounting: these tokens are resident ONCE but read by several
+        requests' gathers."""
+        self._shared_read_tokens += int(n_tokens)
+
+    def shared_read_tokens(self, table: Sequence[int],
+                           context_len: int) -> int:
+        """How many of one slot's ``context_len`` resident tokens live
+        in shared blocks (the per-step input to
+        :meth:`note_shared_reads`)."""
+        bs = self.block_size
+        n = 0
+        for i in range(self.blocks_for(context_len)):
+            if i < len(table) and self._ref[table[i]] >= 2:
+                n += min(bs, context_len - i * bs)
+        return n
+
+    def shared_read_frac(self) -> float:
+        """Fraction of all useful gathered decode tokens that came out
+        of shared blocks (0.0 before any decode)."""
+        if self._gather_useful_tokens == 0:
+            return 0.0
+        return self._shared_read_tokens / self._gather_useful_tokens

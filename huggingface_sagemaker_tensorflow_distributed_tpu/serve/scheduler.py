@@ -16,6 +16,19 @@ with ``prompt + generated-so-far`` as its new prompt; under greedy
 decoding the recomputed continuation is exactly what it would have
 produced uninterrupted, so preemption changes latency, never tokens.
 
+Prefix caching (ISSUE 8): with ``prefix_cache=True`` admission first
+looks up the longest cached full-block prefix of the prompt
+(:meth:`~.paged_kv.BlockManager.match_prefix`), points the request's
+block table at the shared blocks, and charges the pool only for the
+PRIVATE remainder — shared blocks are paid for once, pool-wide, which
+is what multiplies effective KV capacity under templated traffic.
+Prefill then starts at the prefill-chunk grid point at/below the
+cached boundary (``prefill_pos`` > 0); chunk-grid overlap blocks the
+rewrite would scatter into are privatized (copy-on-write) AT ADMISSION,
+inside the same capacity check, so a prefill dispatch can never die on
+a COW allocation. Preemption of a prefix-sharing request releases only
+its references — other holders (and the cache) keep the shared blocks.
+
 Pure host-side Python over :class:`~.paged_kv.BlockManager` — all policy
 is unit-testable with no jax backend.
 """
@@ -69,6 +82,11 @@ class Request:
     # per-request acceptance rate the finish telemetry event carries
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # prefix-cache accounting: prompt tokens served out of shared KV
+    # blocks vs prompt tokens admitted, summed across (re-)admissions —
+    # the per-request cache_hit_rate the finish event carries
+    prefix_cached_tokens: int = 0
+    prefix_prompt_tokens: int = 0
     # recompute preemption folds generated tokens back into the prompt;
     # this keeps the ORIGINAL prompt length so output accounting and
     # first-token semantics survive a preemption
@@ -99,6 +117,14 @@ class Request:
             return None
         return self.first_token_t - self.submit_t
 
+    @property
+    def cache_hit_rate(self) -> Optional[float]:
+        """Fraction of admitted prompt tokens served from shared KV
+        blocks (None before any admission)."""
+        if self.prefix_prompt_tokens == 0:
+            return None
+        return self.prefix_cached_tokens / self.prefix_prompt_tokens
+
 
 class Slot:
     """One decode slot's device-side bookkeeping: the physical block
@@ -113,6 +139,10 @@ class Slot:
         self.context_len = 0
         self.prefill_pos = 0
         self.admit_seq = -1          # admission order, for victim choice
+        # copy-on-write pool copies admission queued for this slot:
+        # (src, dst) block pairs the ENGINE must apply to every pool
+        # before the slot's first prefill dispatch
+        self.pending_copies: list[tuple[int, int]] = []
 
     @property
     def free(self) -> bool:
@@ -124,6 +154,7 @@ class Slot:
         self.context_len = 0
         self.prefill_pos = 0
         self.admit_seq = -1
+        self.pending_copies = []
 
 
 class Scheduler:
@@ -133,7 +164,7 @@ class Scheduler:
 
     def __init__(self, num_slots: int, blocks: BlockManager,
                  prefill_chunk: int, max_model_len: int,
-                 decode_lookahead: int = 1):
+                 decode_lookahead: int = 1, prefix_cache: bool = False):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if prefill_chunk < 1:
@@ -163,6 +194,7 @@ class Scheduler:
         # block growth, gather-bucket need) reserves this span so a
         # verify dispatch can never address past its block table
         self.decode_lookahead = int(decode_lookahead)
+        self.prefix_cache = bool(prefix_cache)
         self.waiting: list[Request] = []
         self._admit_seq = itertools.count()
         self._prefill_rr = 0
@@ -214,10 +246,17 @@ class Scheduler:
 
     def admit(self) -> list[Slot]:
         """Move waiting requests into free slots while block capacity
-        for their (padded) prompt holds. Admission reserves the FULL
-        padded-prompt block span up front so prefill can never die
-        mid-prompt; the pad tail's blocks are trimmed back at prefill
-        completion. Returns the slots admitted this call."""
+        holds. Admission reserves the FULL padded-prompt block span up
+        front so prefill can never die mid-prompt; the pad tail's
+        blocks are trimmed back at prefill completion. With
+        ``prefix_cache`` the reservation is denominated in PRIVATE
+        blocks: the longest cached full-block prefix is mapped onto
+        shared blocks (charged to the pool once, whoever admitted them
+        first), prefill starts at the chunk-grid point at/below the
+        cached boundary, and the chunk-grid overlap — shared blocks
+        the first prefill chunk rewrites — is privatized (COW) here,
+        inside the same capacity check. Returns the slots admitted
+        this call."""
         admitted = []
         for slot in self.slots:
             if not self.waiting:
@@ -225,18 +264,58 @@ class Scheduler:
             if not slot.free:
                 continue
             req = self.waiting[0]
-            need = self.blocks.blocks_for(self.padded_prompt_len(req))
-            if not self.blocks.can_allocate(need):
+            table, start0, copies = self._reserve(req)
+            if table is None:
                 break                       # FIFO: no queue-jumping
             self.waiting.pop(0)
             slot.request = req
-            slot.table = self.blocks.allocate(need)
+            slot.table = table
             slot.context_len = 0
-            slot.prefill_pos = 0
+            slot.prefill_pos = start0
+            slot.pending_copies = copies
             slot.admit_seq = next(self._admit_seq)
             req.state = PREFILL
             admitted.append(slot)
         return admitted
+
+    def _reserve(self, req: Request):
+        """One request's admission reservation: ``(table, prefill_pos,
+        cow_copies)``, or ``(None, 0, [])`` when the pool cannot carry
+        it yet (every acquired reference rolled back)."""
+        bs = self.blocks.block_size
+        C = self.prefill_chunk
+        padded = self.padded_prompt_len(req)
+        total_need = self.blocks.blocks_for(padded)
+        if not self.prefix_cache:
+            if not self.blocks.can_allocate(total_need):
+                return None, 0, []
+            return self.blocks.allocate(total_need), 0, []
+        # the final prompt token is never served from cache — its
+        # logits seed generation, so its block stays recomputed. Peek
+        # first, commit only once capacity is assured: a failed probe
+        # re-runs EVERY engine iteration while this request heads the
+        # queue, and it must neither churn refcounts nor re-park LRU
+        # entries as freshly used (which would bias eviction toward
+        # everyone else's prefixes)
+        shared, revivals = self.blocks.peek_prefix(
+            req.prompt, max_blocks=(len(req.prompt) - 1) // bs)
+        cached = len(shared) * bs
+        # prefill resumes on the chunk grid; the overlap [start0,
+        # cached) gets rewritten (with identical values) and must be
+        # privately owned before the dispatch scatters into it
+        start0 = (cached // C) * C
+        overlap = cached // bs - start0 // bs
+        private_need = total_need - len(shared)
+        # committing the match pulls `revivals` blocks out of the
+        # evictable LRU, so they are charged alongside the private need
+        if not self.blocks.can_allocate(private_need + overlap + revivals):
+            return None, 0, []
+        self.blocks.commit_match(shared)
+        table = shared + self.blocks.allocate(private_need)
+        copies = self.blocks.privatize(table, start0 // bs, cached // bs)
+        req.prefix_cached_tokens += start0
+        req.prefix_prompt_tokens += len(req.prompt)
+        return table, start0, copies
 
     # -- prefill -------------------------------------------------------------
 
@@ -272,11 +351,21 @@ class Scheduler:
 
     def finish_prefill(self, slot: Slot) -> None:
         """Prefill consumed the whole padded prompt: context becomes the
-        REAL prompt length, pad-tail blocks return to the pool, and the
-        slot starts decoding."""
+        REAL prompt length, pad-tail blocks return to the pool, the
+        prompt's full blocks are published into the prefix index (their
+        KV is complete and final — registered blocks are read-only from
+        here on), and the slot starts decoding."""
         req = slot.request
         slot.context_len = len(req.prompt)
         self.blocks.trim(slot.table, slot.context_len)
+        if self.prefix_cache:
+            # a speculative engine's preemption-resume path REWRITES
+            # position p-1 (the folded prompt tail, re-fed through the
+            # verify window) — so with a verify lookahead the block
+            # containing it must never be published read-only
+            tokens = (req.prompt if self.decode_lookahead == 1
+                      else req.prompt[:len(req.prompt) - 1])
+            self.blocks.register_prefix(tokens, slot.table)
         req.state = DECODE
 
     # -- decode-side capacity ------------------------------------------------
@@ -315,6 +404,16 @@ class Scheduler:
                 for slot in short:
                     self.blocks.grow(slot.table,
                                      slot.context_len + self.decode_lookahead)
+                for slot in ds:
+                    # the next dispatch writes [context, context +
+                    # lookahead): that span is past the cached prompt
+                    # prefix, hence private by construction — enforced
+                    # here so a sharing bug fails loudly, not by
+                    # clobbering another request's (or the cache's) KV
+                    self.blocks.ensure_private(
+                        slot.table, slot.context_len // self.blocks.block_size,
+                        self.blocks.blocks_for(
+                            slot.context_len + self.decode_lookahead))
                 return preempted
             except PoolExhausted:
                 victim = max(ds, key=lambda s: s.admit_seq)
@@ -333,13 +432,13 @@ class Scheduler:
         req.state = WAITING
         req.preemptions += 1
         self.n_preemptions += 1
-        self.blocks.free(slot.table)
+        self.blocks.release(slot.table)
         slot.clear()
         self.waiting.insert(0, req)
 
     def finish(self, slot: Slot) -> Request:
         req = slot.request
         req.state = FINISHED
-        self.blocks.free(slot.table)
+        self.blocks.release(slot.table)
         slot.clear()
         return req

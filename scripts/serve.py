@@ -9,7 +9,11 @@ synthetic mixed-length trace (default — the zero-egress smoke path).
 ``--temperature/--top_k/--top_p/--sample_seed`` set the default
 sampling configuration (greedy when temperature is 0);
 ``--gather_buckets`` overrides the decode gather-width ladder
-(``HSTD_SERVE_GATHER_BUCKETS``; ``full`` disables bucketing). The model is
+(``HSTD_SERVE_GATHER_BUCKETS``; ``full`` disables bucketing);
+``--prefix_cache on|off`` (``HSTD_SERVE_PREFIX_CACHE``, default on)
+controls copy-on-write prompt-prefix KV sharing — per-request output
+rows carry ``prefix_cached_tokens`` and the summary line the aggregate
+cache hit rate + peak shared-block count. The model is
 a randomly-initialized GPT-2 shape by default (``--model_dir`` loads an
 exported causal-lm checkpoint the way ``scripts/predict.py`` does).
 
@@ -152,6 +156,11 @@ def main() -> None:
                         help="layer-skip self-draft depth (default: "
                              "HSTD_SERVE_DRAFT_LAYERS or a quarter of "
                              "the target's layers)")
+    parser.add_argument("--prefix_cache", default=None,
+                        choices=("on", "off"),
+                        help="copy-on-write prompt-prefix KV sharing "
+                             "across requests (default: "
+                             "HSTD_SERVE_PREFIX_CACHE or on)")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="0 = greedy (the default); > 0 samples")
     parser.add_argument("--top_k", type=int, default=0)
@@ -180,7 +189,8 @@ def main() -> None:
                          max_model_len=max_len,
                          gather_buckets=args.gather_buckets,
                          speculate_k=args.speculate_k,
-                         draft=args.draft_layers)
+                         draft=args.draft_layers,
+                         prefix_cache=args.prefix_cache)
     trace = load_trace(args, model.config.vocab_size - 1)
     # precompile the sampled step variants too when the trace will
     # sample, so no request pays a mid-serve compile
@@ -205,6 +215,8 @@ def main() -> None:
             row["acceptance_rate"] = (
                 round(req.spec_accepted / req.spec_proposed, 4)
                 if req.spec_proposed else None)
+        if engine.prefix_cache:
+            row["prefix_cached_tokens"] = req.prefix_cached_tokens
         print(json.dumps(row))
     stats = engine.stats()
     # SLO summary from the engine's own accounting (the same figures
@@ -239,6 +251,14 @@ def main() -> None:
                             if stats.acceptance_rate is not None else None),
         "verify_read_waste_mean": (round(stats.verify_waste_mean, 3)
                                    if engine.speculative else None),
+        "prefix_cache": engine.prefix_cache,
+        "cache_hit_rate": (round(stats.cache_hit_rate, 4)
+                           if stats.cache_hit_rate is not None else None),
+        "blocks_shared_peak": (stats.blocks_shared_peak
+                               if engine.prefix_cache else None),
+        "blocks_saved_peak": (stats.blocks_saved_peak
+                              if engine.prefix_cache else None),
+        "cow_copies": stats.cow_copies if engine.prefix_cache else None,
         "kv_peak_utilization": round(stats.kv_peak_utilization, 3)}))
     obs.flush()
 

@@ -86,7 +86,9 @@ def three_hosts(tmp_path):
                               ttft_p50_s=0.02, ttft_p95_s=0.05,
                               ttft_p99_s=0.07, e2e_p50_s=0.4,
                               e2e_p95_s=0.9, e2e_p99_s=1.2,
-                              speculate_k=4, acceptance_rate=0.72))
+                              speculate_k=4, acceptance_rate=0.72,
+                              prefix_cache=True, cache_hit_rate=0.9,
+                              blocks_shared_peak=40))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -369,6 +371,33 @@ def test_diff_acceptance_rate_is_a_ratio_metric(three_hosts):
     slight = copy.deepcopy(base)
     slight["serve"]["acceptance_rate"] = 0.70      # ~-2.8%
     assert "serve_acceptance_rate" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+
+
+def test_diff_cache_hit_rate_is_a_ratio_metric(three_hosts):
+    """ISSUE 8: `serve/cache_hit_rate` diffs as a ratio metric whose
+    worse direction is DOWN — a broken chain hash, over-eager eviction,
+    or a trace drifting off its template all read as the prefix cache
+    silently going cold (and TTFT regressing with it)."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["cache_hit_rate"] == pytest.approx(0.9)
+    worse = copy.deepcopy(base)
+    worse["serve"]["cache_hit_rate"] = 0.2
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_cache_hit_rate" in d["regressions"]
+    assert d["metrics"]["serve_cache_hit_rate"]["worse_direction"] == "down"
+    # better direction never flags; a sub-threshold dip neither
+    assert "serve_cache_hit_rate" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["cache_hit_rate"] = 0.88       # ~-2.2%
+    assert "serve_cache_hit_rate" not in diff_reports(
         base, slight, 5.0)["regressions"]
 
 

@@ -582,7 +582,11 @@ def test_speculative_engine_exact_across_bucket_boundaries(gpt2_setup,
     assert stats.spec_windows > 0
     assert 0 < stats.verify_waste_mean < 1    # rejected tails accounted
     # no block leaked through the window-reserve/commit/trim cycle
-    assert eng.blocks.num_free == eng.blocks.num_blocks - 1
+    # (prefix caching keeps finished prompts' blocks CACHED, not free —
+    # conservation counts both)
+    assert (eng.blocks.num_free + eng.blocks.num_cached
+            == eng.blocks.num_blocks - 1)
+    assert eng.blocks.num_used == 0
 
 
 def test_speculative_engine_exact_under_preemption_rewind_leak_free(
@@ -600,7 +604,9 @@ def test_speculative_engine_exact_under_preemption_rewind_leak_free(
                                prefill_chunk=8, max_model_len=32,
                                speculate_k=2, draft=spec_draft)
     assert eng.stats().preemptions > 0
-    assert eng.blocks.num_free == eng.blocks.num_blocks - 1
+    assert (eng.blocks.num_free + eng.blocks.num_cached
+            == eng.blocks.num_blocks - 1)
+    assert eng.blocks.num_used == 0
 
 
 def test_sampled_speculative_serve_seed_deterministic_across_preemption(
@@ -743,6 +749,319 @@ def test_block_manager_verify_waste_is_separate_from_gather_waste():
     assert bm.note_verify([], 5) == 0.0         # empty step: no-op
     # gather-side accumulators untouched
     assert bm.gather_waste() == 0.0 and bm.peak_gather_waste == 0.0
+
+
+# -- ISSUE 8: copy-on-write prefix caching -----------------------------------
+
+def test_block_manager_double_free_guard():
+    """The satellite hard-guard: release()/free()/trim() on a block id
+    that is no longer held raises instead of silently corrupting the
+    free list (fatal once refcounts share blocks across requests)."""
+    bm = BlockManager(num_blocks=9, block_size=4)
+    got = bm.allocate(2)
+    bm.release(got)
+    with pytest.raises(ValueError, match="double free"):
+        bm.release([got[0]])                     # already on the free list
+    with pytest.raises(ValueError, match="double free"):
+        bm.free([got[1]])                        # legacy alias, same guard
+    # trim routes through release: a table holding an already-released
+    # id must raise, not push the id onto the free list twice
+    stale = [bm.allocate(1)[0], got[0]]
+    with pytest.raises(ValueError, match="double free"):
+        bm.trim(stale, 0)
+    # a zero-ref CACHED block is not held either: releasing it again
+    # must raise, not corrupt the LRU/free accounting
+    t = bm.allocate(1)
+    bm.register_prefix(np.arange(1, 5), t)
+    bm.release(t)
+    assert bm.num_cached == 1
+    with pytest.raises(ValueError, match="double free"):
+        bm.release(t)
+
+
+def test_block_manager_prefix_match_register_lru_roundtrip():
+    """The prefix-index lifecycle: register publishes full prompt
+    blocks, match increfs them (chain-verified — a diverging prompt
+    misses from the divergence block on), release parks zero-ref
+    registered blocks in the LRU (reusable, counted as capacity), and
+    allocation pressure evicts oldest-first, after which the lookup
+    misses."""
+    bm = BlockManager(num_blocks=8, block_size=4)     # 7 allocatable
+    prompt = np.arange(1, 14)                         # 13 tokens, 3 full blocks
+    table = bm.allocate(4)                            # ceil(13/4)
+    bm.register_prefix(prompt, table)
+    # another request with the same prompt start shares all 3 full blocks
+    hit = bm.match_prefix(prompt)
+    assert hit == table[:3]
+    assert bm.blocks_saved() == 3                     # 3 dedup'd blocks
+    # a prompt diverging INSIDE block 1 matches only block 0
+    other = np.concatenate([prompt[:6], [99, 98, 97, 96]])
+    hit2 = bm.match_prefix(other)
+    assert hit2 == table[:1]
+    bm.release(hit2)
+    # a cap: the caller can bound the walk (engine leaves the final
+    # prompt token uncached)
+    assert bm.match_prefix(prompt, max_blocks=2) == table[:2]
+    bm.release(table[:2])
+    bm.release(hit)
+    bm.release(table)                                 # original owner done
+    assert bm.num_used == 0 and bm.num_cached == 3
+    assert bm.can_allocate(7)                         # cached = capacity
+    # pressure: allocating past the free list evicts oldest (block 0's
+    # chunk) — the chain then misses at level 0, so NOTHING matches
+    got = bm.allocate(5)
+    assert bm.num_cached == 2 and bm.prefix_evictions == 1
+    assert bm.match_prefix(prompt) == []
+    bm.release(got)
+
+
+def test_block_manager_privatize_cow_semantics():
+    """privatize(): refcount > 1 => fresh private copy (src/dst device
+    copy returned, source stays with the other holder); sole-owner
+    registered => unpublish + write in place (no copy)."""
+    bm = BlockManager(num_blocks=9, block_size=4)
+    prompt = np.arange(1, 9)                          # 2 full blocks
+    table = bm.allocate(2)
+    bm.register_prefix(prompt, table)
+    sharer = bm.match_prefix(prompt)                  # refs now 2/2
+    copies = bm.privatize(sharer, 0, 1)
+    assert len(copies) == 1 and copies[0][0] == table[0]
+    assert sharer[0] != table[0] and bm.cow_copies == 1
+    assert bm.is_private(sharer[0])
+    # the source block is still the registered original at ref 1
+    assert bm.match_prefix(prompt, max_blocks=1) == [table[0]]
+    bm.release([table[0]])
+    # sole-owner registered block: in-place unpublish, no copy
+    bm.release(sharer)                                # drop the sharer refs
+    bm.release([table[1]])                            # table now fully cached
+    mine = bm.match_prefix(prompt)                    # revive both at ref 1
+    assert bm.privatize(mine, 1, 2) == []
+    assert bm.is_private(mine[1])                     # unregistered now
+    assert bm.match_prefix(prompt, max_blocks=2) == [table[0]]
+    bm.release([table[0]])
+    bm.release(mine)
+    bm.release([table[0]])                            # the allocate() ref
+    assert bm.num_used == 0
+
+
+def test_block_conservation_under_random_schedule(rng):
+    """The satellite property test: across a randomized
+    submit/admit/prefill/decode/preempt/finish/share/COW schedule with
+    prefix caching on (small pool => LRU eviction pressure), every
+    step preserves ``num_free + num_used + num_cached ==
+    num_blocks - 1``, every table reference is backed by exactly its
+    refcount, and no table references a freed block."""
+    from collections import Counter
+
+    bm = BlockManager(num_blocks=20, block_size=4)
+    # chunk 8 vs block 4: a cached prefix of 12 tokens re-aligns to
+    # chunk 8, so admissions privatize (COW) the overlap block when the
+    # original holder is still resident
+    s = Scheduler(3, bm, 8, 32, prefix_cache=True)
+    prefixes = [rng.randint(1, 100, (12,)).astype(np.int32),
+                rng.randint(1, 100, (20,)).astype(np.int32)]
+
+    def check():
+        assert (bm.num_free + bm.num_used + bm.num_cached
+                == bm.num_blocks - 1)
+        held = Counter(b for slot in s.slots if not slot.free
+                       for b in slot.table)
+        refs = {b: bm._ref[b] for b in range(1, bm.num_blocks)
+                if bm._ref[b] > 0}
+        assert dict(held) == refs            # every ref is a table ref
+        free_set = set(bm._free)
+        assert not (set(held) & free_set)    # no table refs a freed block
+        assert 0 not in held                 # the null block is never owned
+
+    for step in range(300):
+        op = rng.randint(0, 5)
+        if op == 0 and len(s.waiting) < 4:
+            if rng.randint(0, 2):
+                pre = prefixes[rng.randint(0, len(prefixes))]
+                tail = rng.randint(1, 100,
+                                   (rng.randint(1, 6),)).astype(np.int32)
+                prompt = np.concatenate([pre, tail])
+            else:
+                prompt = rng.randint(
+                    1, 100, (rng.randint(1, 16),)).astype(np.int32)
+            try:
+                s.submit(Request(prompt=prompt,
+                                 max_new_tokens=int(rng.randint(1, 5))))
+            except ValueError:
+                pass                          # over-length: rejected
+        elif op == 1:
+            s.admit()
+        elif op == 2:                         # one prefill chunk everywhere
+            for slot in s.next_prefill_slots(3):
+                slot.prefill_pos += s.prefill_chunk
+                if slot.prefill_pos >= s.padded_prompt_len(slot.request):
+                    s.finish_prefill(slot)
+        elif op == 3:                         # one decode step
+            try:
+                s.ensure_decode_capacity()
+            except PoolExhausted:
+                pass
+            for slot in s.decode_slots():
+                req = slot.request
+                slot.context_len += 1
+                req.output.append(0)
+                if len(req.output) >= req.max_new_tokens:
+                    s.finish(slot)
+        elif op == 4:                         # forced preemption
+            ds = s.decode_slots()
+            if ds:
+                s.preempt(ds[int(rng.randint(0, len(ds)))])
+        check()
+    # drain: preempted/waiting requests release nothing further; every
+    # running request's blocks come back on finish
+    for slot in s.slots:
+        if not slot.free:
+            s.finish(slot)
+    check()
+    assert bm.num_used == 0
+
+
+def _prefix_trace(rng, prefix_len, tails, max_news, vocab=120):
+    """Requests sharing one random prefix with varied random tails."""
+    prefix = rng.randint(1, vocab, (prefix_len,)).astype(np.int32)
+    return [(np.concatenate([prefix,
+                             rng.randint(1, vocab, (t,)).astype(np.int32)])
+             if t else prefix.copy(), m)
+            for t, m in zip(tails, max_news)]
+
+
+def test_prefix_cache_serve_token_exact_with_forced_cow(gpt2_setup):
+    """The tentpole exactness gate: shared-prefix serving is
+    token-identical to cold start (greedy vs generate_causal), with
+    real sharing (later requests' prefill skips cached chunks) AND
+    forced copy-on-write — block_size 4 under chunk 8 re-aligns a
+    12-token cached prefix to chunk 8, so a request diverging from a
+    still-resident sharer mid-chunk must privatize the overlap block
+    before scattering into it."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(21)
+    # A long-running (max_new 14), then short riders sharing its
+    # 12-token prefix admitted AFTER A registered — while A still
+    # holds its blocks, so the overlap block's refcount is > 1
+    trace = _prefix_trace(rng, 12, tails=[3, 0, 2, 1, 2],
+                          max_news=[14, 2, 4, 3, 4])
+    eng = _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                               num_slots=2, block_size=4, num_blocks=40,
+                               prefill_chunk=8, max_model_len=32)
+    assert eng.prefix_cache
+    reqs = list(eng.finished.values())
+    assert sum(r.prefix_cached_tokens for r in reqs) > 0   # real hits
+    assert eng.blocks.cow_copies > 0                       # real COW
+    assert eng.stats().cache_hit_rate > 0
+    assert eng.stats().blocks_shared_peak > 0
+    # conservation after the run: everything free or cached, none held
+    assert eng.blocks.num_used == 0
+    assert (eng.blocks.num_free + eng.blocks.num_cached
+            == eng.blocks.num_blocks - 1)
+
+
+def test_prefix_cache_exact_under_preemption_of_sharing_request(gpt2_setup):
+    """Forced recompute preemption OF a prefix-sharing request: only
+    its private references release (other holders and the cache keep
+    the shared blocks), the resumed request re-hits the cache for its
+    folded prompt, and every stream stays token-exact."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(22)
+    trace = _prefix_trace(rng, 12, tails=[2, 3, 1, 2, 3],
+                          max_news=[12, 12, 12, 12, 12])
+    # 11 allocatable blocks of 4 for five 14-15 token prompts that each
+    # want 12 more: preemption is forced even WITH sharing
+    eng = _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                               num_slots=4, block_size=4, num_blocks=12,
+                               prefill_chunk=8, max_model_len=32)
+    assert eng.stats().preemptions > 0
+    assert sum(r.prefix_cached_tokens
+               for r in eng.finished.values()) > 0
+    assert eng.blocks.num_used == 0
+    assert (eng.blocks.num_free + eng.blocks.num_cached
+            == eng.blocks.num_blocks - 1)
+
+
+def test_prefix_cache_speculative_serve_exact(gpt2_setup, spec_draft):
+    """Prefix caching composes with speculative decode: the draft's
+    pools ride the same shared block tables (COW copies apply to both
+    address spaces), greedy stays token-exact, and the verify-window
+    trim never releases a shared block."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(23)
+    trace = _prefix_trace(rng, 12, tails=[3, 0, 2, 1], max_news=[12, 3, 5, 4])
+    eng = _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                               num_slots=2, block_size=4, num_blocks=40,
+                               prefill_chunk=8, max_model_len=32,
+                               speculate_k=2, draft=spec_draft)
+    assert sum(r.prefix_cached_tokens
+               for r in eng.finished.values()) > 0
+    assert eng.stats().draft_proposed > 0
+    assert eng.blocks.num_used == 0
+    assert (eng.blocks.num_free + eng.blocks.num_cached
+            == eng.blocks.num_blocks - 1)
+
+
+def test_prefix_cache_off_matches_on_and_stays_cold(gpt2_setup):
+    """The regression-tax gate: prefix_cache='off' serves the exact
+    same tokens as 'on' (and the cold reference), never touches the
+    index/LRU/COW machinery, and a sampled trace stays bitwise
+    seed-identical across on/off — the cache must be semantically
+    invisible either way."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(24)
+    trace = _prefix_trace(rng, 12, tails=[3, 1, 2, 2], max_news=[8, 6, 7, 5])
+    kws = [dict(), dict(temperature=0.9, top_k=20, top_p=0.9, seed=7),
+           dict(), dict(temperature=0.7, seed=3)]
+
+    def run(prefix_cache):
+        eng = ServeEngine(model, params, num_slots=3, block_size=4,
+                          num_blocks=40, prefill_chunk=8,
+                          max_model_len=32, prefix_cache=prefix_cache)
+        reqs = [eng.submit(p, m, **kw)
+                for (p, m), kw in zip(trace, kws)]
+        eng.run()
+        return [[int(t) for t in eng.output_ids(r)] for r in reqs], eng
+
+    on, eng_on = run("on")
+    off, eng_off = run("off")
+    assert on == off
+    assert not eng_off.prefix_cache
+    assert eng_off.blocks.num_cached == 0          # machinery inert
+    assert eng_off.blocks.cow_copies == 0
+    assert eng_off.blocks.peak_shared_blocks == 0
+    assert all(r.prefix_cached_tokens == 0
+               for r in eng_off.finished.values())
+    assert eng_off.stats().cache_hit_rate is None
+    # off: every block comes straight back to the free list (PR 6
+    # behavior byte-for-byte)
+    assert eng_off.blocks.num_free == eng_off.blocks.num_blocks - 1
+    # the greedy rows also equal the cold per-request reference
+    for (p, m), kw, out in zip(trace, kws, on):
+        if not kw:
+            assert out == _reference(model, params, p, m,
+                                     cfg.eos_token_id)
+
+
+def test_parse_prefix_cache_knob(monkeypatch):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ENV_PREFIX_CACHE,
+        parse_prefix_cache,
+    )
+
+    assert parse_prefix_cache(None) is True        # default on
+    assert parse_prefix_cache("off") is False
+    assert parse_prefix_cache("on") is True
+    assert parse_prefix_cache(False) is False
+    monkeypatch.setenv(ENV_PREFIX_CACHE, "off")
+    assert parse_prefix_cache(None) is False
+    monkeypatch.setenv(ENV_PREFIX_CACHE, "banana")
+    with pytest.raises(ValueError, match="unparseable"):
+        parse_prefix_cache(None)
 
 
 def test_scheduler_lookahead_reserves_verify_window():

@@ -21,7 +21,7 @@ def test_serve_bench_smoke(capsys, tmp_path):
 
     obs.reset(out_dir=str(tmp_path / "telemetry"), enabled=True)
     try:
-        mixed, bucketed, spec = bench_serve(smoke=True)
+        mixed, bucketed, spec, prefix = bench_serve(smoke=True)
     finally:
         obs.reset()
     detail = mixed["detail"]
@@ -60,14 +60,30 @@ def test_serve_bench_smoke(capsys, tmp_path):
     assert sdetail["acceptance_rate"] >= 0.9
     assert 1.0 <= sdetail["accepted_per_window"] <= sdetail["window_ceiling"]
     assert 0 <= sdetail["verify_read_waste_mean"] <= 1
+    # the ISSUE 8 prefix-cache line: structural gates enforced at smoke
+    # scale (on/off output identity, zero new compiled variants on the
+    # hit path, block conservation, a genuinely cache-friendly trace),
+    # the ≥2x TTFT ratio only on the full CPU trace (smoke is
+    # dispatch-bound)
+    pdetail = prefix["detail"]
+    assert pdetail["exact_match"] is True           # cache on == off
+    assert pdetail["block_conservation"] is True
+    assert pdetail["compiles_steady_on"] == 0       # hit path mints none
+    assert pdetail["compiles_steady_off"] == 0
+    assert prefix["value"] is not None              # gates structural
+    assert pdetail["ratio_gated"] is False          # smoke: no >=2x
+    assert pdetail["cache_hit_rate"] >= 0.5
+    assert pdetail["blocks_shared_peak"] > 0        # sharing really ran
+    assert pdetail["prefix_cached_tokens"] > 0
     # the stdout lines are the driver contract: parseable JSON, all
-    # three metrics present
+    # four metrics present
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
     metrics = [json.loads(ln)["metric"] for ln in lines]
-    assert metrics[-3:] == ["serve_continuous_vs_static_speedup",
+    assert metrics[-4:] == ["serve_continuous_vs_static_speedup",
                             "serve_bucketed_gather_decode_speedup",
-                            "serve_speculative_decode_speedup"]
+                            "serve_speculative_decode_speedup",
+                            "serve_prefix_cache_ttft_speedup"]
 
 
 @pytest.mark.slow
@@ -98,3 +114,26 @@ def test_serve_bench_full_speculative_trace(capsys):
     assert result["detail"]["ratio_gated"] is True
     assert result["detail"]["exact_match"] is True
     assert result["detail"]["acceptance_rate"] >= 0.9
+
+
+@pytest.mark.slow
+def test_serve_bench_full_prefix_trace(capsys):
+    """The full CPU repeated-prefix trace — the ISSUE 8 acceptance
+    surface where the ≥2x TTFT p50 ratio IS enforced in the line
+    (slow tier: two primed engines serve the whole templated trace
+    twice). Measured 4.2x on this container; the admission-depth win
+    (shared template charged once) is asserted directionally."""
+    from benchmarks.serve_bench import bench_serve_prefix
+
+    result = bench_serve_prefix(smoke=False)
+    assert result.get("error") is None
+    assert result["value"] is not None and result["value"] >= 2.0
+    detail = result["detail"]
+    assert detail["ratio_gated"] is True
+    assert detail["exact_match"] is True
+    assert detail["block_conservation"] is True
+    assert detail["cache_hit_rate"] >= 0.8
+    # effective KV capacity multiplied: the tight pool holds every
+    # slot's request with the cache on, a fraction of them without
+    assert (detail["admission_depth_cache_on"]
+            > detail["admission_depth_cache_off"])

@@ -219,6 +219,12 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
         {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
          "event": "finish", "request": 1,
          "acceptance_rate": "high", "speculate_k": 2.5},        # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "finish", "request": 2,
+         "prefix_cached_tokens": 96, "cache_hit_rate": 0.92},   # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "finish", "request": 3,
+         "prefix_cached_tokens": 96.5, "cache_hit_rate": "hot"},  # drift
     ]
     bad.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
     proc = _run(str(bad))
@@ -227,6 +233,8 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
     assert "optional field 'sampled'" in proc.stdout
     assert "optional field 'acceptance_rate'" in proc.stdout
     assert "optional field 'speculate_k'" in proc.stdout
+    assert "optional field 'prefix_cached_tokens'" in proc.stdout
+    assert "optional field 'cache_hit_rate'" in proc.stdout
 
 
 def test_validator_accepts_anomaly_and_flight_artifacts(tmp_path):
