@@ -67,11 +67,14 @@ Extra modes (each also prints one JSON line per run):
                        full-width decode tokens/sec on a short-context
                        trace (>=1.3x CPU gate, identical outputs,
                        compiles <= #buckets), the speculative-decode
-                       line (>=1.5x CPU gate), and the prefix-cache
+                       line (>=1.5x CPU gate), the prefix-cache
                        line: TTFT p50 with copy-on-write prefix
                        caching on vs off on a repeated-prefix trace
                        (>=2x CPU gate, identical outputs, block
-                       conservation).
+                       conservation), and the paged-kernel line:
+                       int8 vs fp KV pools on a decode-dominated
+                       trace (>=1.2x CPU gate, per-side exactness,
+                       per-step pool bytes <=0.6x asserted).
 
 Every metric line additionally carries a ``memory`` watermark field on
 accelerator backends (peak_bytes_in_use vs bytes_limit, ROADMAP "Memory
@@ -538,7 +541,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
         return ["serve_continuous_vs_static_speedup",
                 "serve_bucketed_gather_decode_speedup",
                 "serve_speculative_decode_speedup",
-                "serve_prefix_cache_ttft_speedup"]
+                "serve_prefix_cache_ttft_speedup",
+                "serve_paged_kernel_decode_speedup"]
     if args.llama_train:
         return ["llama_1b_train_samples_per_sec_per_chip"]
     if args.mixtral_train:

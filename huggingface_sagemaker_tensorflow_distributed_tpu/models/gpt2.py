@@ -191,6 +191,40 @@ class Gpt2Attention(nn.Module):
             # depths under speculative decode (models/generate.py)
             cache_index = self.variable("cache", "cache_index",
                                         lambda: jnp.zeros((B,), jnp.int32))
+            if self.has_variable("cache", "block_tables"):
+                # serve paged-pool decode: the cache vars hold BLOCK
+                # POOLS and a per-row block table (the engine's fused
+                # kernel path) — scatter the new K/V, then fused paged
+                # attention walks the tables directly (masking derives
+                # from the context lengths, not attn_mask)
+                from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+                    write_paged_kv,
+                )
+                from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+                    paged_attention,
+                )
+
+                if q.shape[2] != 1:
+                    raise ValueError(
+                        "paged decode is single-token (the fused kernel "
+                        f"takes one query per slot, got q_len {q.shape[2]})")
+                tables = self.get_variable("cache", "block_tables")
+                cur = cache_index.value                   # [B]
+                write_paged_kv(cached_k, cached_v,
+                               (k_scale, v_scale) if int8_kv else None,
+                               tables, k, v, cur)
+                cache_index.value = cur + 1
+                ctx = paged_attention(
+                    q[:, :, 0, :], cached_k.value, cached_v.value,
+                    tables, cur + 1, impl="pallas",
+                    k_scale_pool=k_scale.value if int8_kv else None,
+                    v_scale_pool=v_scale.value if int8_kv else None)
+                ctx = ctx.astype(hidden.dtype).reshape(B, 1, H)
+                out = _dense(cfg, H, "attn_out",
+                             std=cfg.initializer_range
+                             / (2 * cfg.num_layers) ** 0.5)(ctx)
+                return nn.Dropout(cfg.hidden_dropout)(
+                    out, deterministic=deterministic)
             if is_init:
                 from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
                     write_kv_cache,

@@ -405,6 +405,44 @@ def write_kv_cache(cached_k, cached_v, scales, k, v, cur, compute_dtype):
     return k, v
 
 
+def write_paged_kv(cached_k, cached_v, scales, block_tables, k, v, cur):
+    """The paged-pool counterpart of :func:`write_kv_cache` — the ONE
+    scatter-write protocol of the serve engine's fused decode path
+    (``ops/pallas_paged_attention.py``). The cache variables hold BLOCK
+    POOLS ``[num_blocks, block_size, H, D]`` instead of per-row dense
+    buffers; ``k``/``v`` are one decode step's values [B, H, 1, D],
+    written at logical position ``cur`` [B] of each row's
+    ``block_tables`` [B, blocks]. With ``scales`` (a ``(k_scale,
+    v_scale)`` pool-variable pair, [num_blocks, block_size, H, 1]
+    fp32), values store int8 via :func:`kv_quantize` — bitwise the SAME
+    quantization the dense int8 cache performs, which is what keeps
+    paged serving token-exact against ``generate_causal`` under
+    ``kv_cache_dtype='int8'``. Mutates the variables; the caller
+    attends via ``ops.attention.paged_attention`` (the read fuses the
+    dequant)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        scatter_paged_kv,
+    )
+
+    if scales is not None:
+        k_scale, v_scale = scales
+        qk, sk = kv_quantize(k)
+        qv, sv = kv_quantize(v)
+        cached_k.value = scatter_paged_kv(
+            cached_k.value, block_tables, cur, qk[:, :, 0, :])
+        cached_v.value = scatter_paged_kv(
+            cached_v.value, block_tables, cur, qv[:, :, 0, :])
+        k_scale.value = scatter_paged_kv(
+            k_scale.value, block_tables, cur, sk[:, :, 0, :])
+        v_scale.value = scatter_paged_kv(
+            v_scale.value, block_tables, cur, sv[:, :, 0, :])
+        return
+    cached_k.value = scatter_paged_kv(
+        cached_k.value, block_tables, cur, k[:, :, 0, :])
+    cached_v.value = scatter_paged_kv(
+        cached_v.value, block_tables, cur, v[:, :, 0, :])
+
+
 class LlamaAttention(nn.Module):
     """GQA self-attention with RoPE and an optional incremental KV cache
     (cached pre-repeat: [B, H_kv, max_len, D]; stored int8 + per-slot
@@ -461,6 +499,38 @@ class LlamaAttention(nn.Module):
             # and the step mask broadcasts per row
             cache_index = self.variable("cache", "cache_index",
                                         lambda: jnp.zeros((B,), jnp.int32))
+            if self.has_variable("cache", "block_tables"):
+                # serve paged-pool decode: the cache vars hold BLOCK
+                # POOLS and a per-row block table (the engine's fused
+                # kernel path). Scatter the new K/V (pre-repeat — the
+                # kernel groups queries per kv head natively), then
+                # fused paged attention walks the tables directly; the
+                # sliding window bands in-kernel from logical positions
+                # (serve contexts are contiguous, so slot == position)
+                from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+                    paged_attention,
+                )
+
+                if q.shape[2] != 1:
+                    raise ValueError(
+                        "paged decode is single-token (the fused kernel "
+                        f"takes one query per slot, got q_len {q.shape[2]})")
+                tables = self.get_variable("cache", "block_tables")
+                cur = cache_index.value                   # [B]
+                write_paged_kv(cached_k, cached_v,
+                               (k_scale, v_scale) if int8_kv else None,
+                               tables, k, v, cur)
+                cache_index.value = cur + 1
+                ctx = paged_attention(
+                    q[:, :, 0, :], cached_k.value, cached_v.value,
+                    tables, cur + 1, impl="pallas",
+                    window=(cfg.sliding_window if self.use_window
+                            else None),
+                    k_scale_pool=k_scale.value if int8_kv else None,
+                    v_scale_pool=v_scale.value if int8_kv else None)
+                ctx = ctx.astype(cfg.dtype)[:, None, :, :]  # [B, 1, H, D]
+                ctx = ctx.reshape(B, 1, cfg.num_heads * head_dim)
+                return _dense(cfg, cfg.hidden_size, "o_proj")(ctx)
             if is_init:
                 cur = cache_index.value                       # [B]
                 max_len = cached_k.value.shape[2]

@@ -318,6 +318,11 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # over-eager eviction, or a trace drifting off its template all
     # show up as the cache silently going cold — TTFT follows)
     "serve_cache_hit_rate": (-1, "ratio"),
+    # paged KV read traffic: MORE bytes per decode step is worse (a
+    # bucket-ladder regression, an fp pool where int8 was configured,
+    # or a widened verify window all show up here before tokens/sec
+    # moves on hardware with bandwidth to spare)
+    "serve_kv_bytes_read_per_step": (+1, "ratio"),
 }
 
 
@@ -348,7 +353,8 @@ def _report_scalars(report: dict) -> dict:
     }
     for key in ("ttft_p50_s", "ttft_p99_s", "e2e_p50_s", "e2e_p99_s",
                 "decode_tokens_per_sec", "preemptions",
-                "acceptance_rate", "cache_hit_rate"):
+                "acceptance_rate", "cache_hit_rate",
+                "kv_bytes_read_per_step"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out

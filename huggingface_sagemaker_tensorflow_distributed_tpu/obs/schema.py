@@ -96,7 +96,16 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               "blocks_saved_peak": (int,),
               "cow_copies": (int,),
               "prefix_evictions": (int,),
-              "shared_read_frac": _NUM},
+              "shared_read_frac": _NUM,
+              # paged-attention kernel + int8 KV pools (finish events
+              # and the final report carry the engine's decode-kernel
+              # and pool-storage modes; the report additionally the
+              # mean pool bytes one decode dispatch reads — the figure
+              # int8 pools halve)
+              "kernel": (str,),
+              "kv_dtype": (str,),
+              "kv_bytes_read": (int,),
+              "kv_bytes_read_per_step": _NUM},
 }
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)

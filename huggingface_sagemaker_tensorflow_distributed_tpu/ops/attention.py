@@ -197,21 +197,61 @@ def scatter_paged_kv(pool, block_tables, positions, values):
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
-                    scale=None, width: int | None = None):
+                    scale=None, width: int | None = None,
+                    impl: str = "xla", window: int | None = None,
+                    k_scale_pool=None, v_scale_pool=None):
     """Single-token decode attention against a paged KV pool.
 
     ``q`` [slots, heads, head_dim] (the step's one query per slot);
     pools/[block_tables] as in :func:`gather_paged_kv`;
-    ``context_lens`` [slots] counts valid tokens per slot. Keys at
-    logical positions >= context_len (stale block tails, null-block
-    junk) are masked additively — the −1e9 convention keeps the softmax
-    NaN-free even for empty (context 0) slots. ``width`` (static)
-    restricts the gather to a context-width bucket — callers guarantee
-    ``context_lens <= width``. Returns [slots, heads, head_dim]."""
+    ``context_lens`` [slots] counts valid tokens per slot (the query's
+    own K/V included — the query position is ``context_len - 1``).
+    Keys at logical positions >= context_len (stale block tails,
+    null-block junk) are masked additively — the −1e9 convention keeps
+    the softmax NaN-free even for empty (context 0) slots. ``width``
+    (static) restricts the gather to a context-width bucket — callers
+    guarantee ``context_lens <= width``.
+
+    ``impl='xla'`` (the reference and CPU-native path) gathers a dense
+    view then attends; ``impl='pallas'`` runs the fused decode kernel
+    (``ops/pallas_paged_attention.py``) that walks the block tables
+    directly — no dense intermediate, interpret-mode off-TPU (context-0
+    rows return zeros there instead of masked-junk softmax; callers
+    discard them either way). GQA is native to both: ``q`` may carry a
+    multiple of the pools' kv heads. ``window`` applies Mistral's
+    sliding band (key kept iff ``0 <= q_pos - k_pos < window``).
+    ``k_scale_pool``/``v_scale_pool`` ([blocks, block_size, heads, 1]
+    fp32) mark int8 pools: the XLA path dequantizes the gathered view,
+    the kernel dequantizes in-tile. Returns [slots, heads, head_dim]."""
+    if impl == "pallas":
+        from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_paged_attention import (
+            paged_decode_attention,
+        )
+        return paged_decode_attention(
+            q, k_pool, v_pool, block_tables, context_lens, scale=scale,
+            width=width, window=window, k_scale_pool=k_scale_pool,
+            v_scale_pool=v_scale_pool)
+    if impl != "xla":
+        raise ValueError(f"unknown paged_attention impl {impl!r} "
+                         "(xla | pallas)")
     k = gather_paged_kv(k_pool, block_tables, width=width)
     v = gather_paged_kv(v_pool, block_tables, width=width)
+    if k_scale_pool is not None:
+        ks = gather_paged_kv(k_scale_pool, block_tables, width=width)
+        vs = gather_paged_kv(v_scale_pool, block_tables, width=width)
+        k = (k.astype(jnp.float32) * ks).astype(q.dtype)
+        v = (v.astype(jnp.float32) * vs).astype(q.dtype)
+    if k.shape[1] != q.shape[1]:
+        # GQA: repeat the gathered kv heads to the query's head count
+        # (the kernel path groups queries instead — no repeat exists)
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     max_ctx = k.shape[2]
-    valid = jnp.arange(max_ctx)[None, :] < context_lens[:, None]
+    pos = jnp.arange(max_ctx)[None, :]
+    valid = pos < context_lens[:, None]
+    if window is not None:
+        valid = valid & (pos > context_lens[:, None] - 1 - window)
     mask = jnp.where(valid, 0.0, -1e9)[:, None, None, :]
     return xla_attention(q[:, :, None, :], k, v, mask=mask,
                          scale=scale)[:, :, 0, :]

@@ -54,6 +54,24 @@ speculation; vLLM + Orca + Sarathi + Leviathan lineage):
   them. Writes into still-shared blocks privatize first via a
   device-side block copy (COW) — output stays token-exact vs cold
   start.
+- **Fused paged-attention kernel** (``kernel='pallas'``) — the decode
+  step's gather→dense-attend HBM round trip collapses into ONE fused
+  read: the model's paged decode branch scatters each slot's new K/V
+  straight into the pools and attends via the Pallas kernel
+  (``ops/pallas_paged_attention.py``), which walks the block tables
+  inside the attention read — no ``[S, H, width, D]`` intermediate.
+  Rides the same bucket ladder (one compile per bucket); interpret
+  mode off-TPU, so CPU runs are correct but slow (tests), and the
+  default stays ``kernel='xla'`` (the gather reference path).
+- **int8 KV pools** (``kv_cache_dtype='int8'``) — pools store K/V as
+  symmetric per-(position, head) int8 with fp32 scales riding parallel
+  scale pools (written by the model's own ``kv_quantize`` protocol at
+  scatter time, dequantized on read — in-tile under the kernel), which
+  halves KV bytes per decode step end to end. Output is token-exact vs
+  ``generate_causal`` on the SAME int8-cache config (quantization is
+  deterministic, so recompute preemption and prefix sharing reproduce
+  bitwise-identical pools); ``kv_pool_bytes`` sizes the pool by a
+  memory budget, so int8 admits ~2x the requests of fp on equal bytes.
 - **Speculative decoding** (``speculate_k``/``draft``) — per iteration
   a draft model (its own paged pools over the SAME block tables)
   proposes ``k`` tokens per running slot, then ONE width-(k+1) target
@@ -118,6 +136,38 @@ ENV_GATHER_BUCKETS = "HSTD_SERVE_GATHER_BUCKETS"
 ENV_SPECULATE_K = "HSTD_SERVE_SPECULATE_K"
 ENV_DRAFT_LAYERS = "HSTD_SERVE_DRAFT_LAYERS"
 ENV_PREFIX_CACHE = "HSTD_SERVE_PREFIX_CACHE"
+ENV_KERNEL = "HSTD_SERVE_KERNEL"
+ENV_KV_DTYPE = "HSTD_SERVE_KV_DTYPE"
+
+
+def parse_kernel(spec: Union[str, None]) -> str:
+    """The decode-kernel knob: ``xla`` (gather + dense attention — the
+    reference path, CPU-native) or ``pallas`` (the fused paged-decode
+    kernel, ``ops/pallas_paged_attention.py`` — interpret-mode off
+    TPU). None reads ``HSTD_SERVE_KERNEL``, default ``xla``."""
+    if spec is None:
+        spec = os.environ.get(ENV_KERNEL, "xla")
+    s = str(spec).strip().lower() or "xla"
+    if s not in ("xla", "pallas"):
+        raise ValueError(f"unparseable {ENV_KERNEL} value {spec!r}: "
+                         "expected xla | pallas")
+    return s
+
+
+def parse_kv_dtype(spec: Union[str, None], model_default: str) -> str:
+    """The pool-storage knob: ``fp`` or ``int8`` (int8 halves KV bytes
+    per decode step; scales ride parallel fp32 pools). None reads
+    ``HSTD_SERVE_KV_DTYPE``, falling back to the model config's own
+    ``kv_cache_dtype``."""
+    if spec is None:
+        spec = os.environ.get(ENV_KV_DTYPE) or None
+    if spec is None:
+        return model_default
+    s = str(spec).strip().lower()
+    if s not in ("fp", "int8"):
+        raise ValueError(f"unparseable {ENV_KV_DTYPE} value {spec!r}: "
+                         "expected fp | int8")
+    return s
 
 
 def parse_prefix_cache(spec: Union[str, bool, None]) -> bool:
@@ -176,12 +226,20 @@ def parse_gather_buckets(spec: Union[str, Sequence[int], None],
 class CachePlan(NamedTuple):
     """Static (hashable — it rides jit static_argnames) description of
     the model's flax cache pytree: the treedef plus, per flattened leaf,
-    what it is — ``("kv", pool_index)`` for cached_key/cached_value,
-    ``("index",)`` for the per-row write indices, ``("scalar",)`` for
-    model-level counters (unused under explicit position_ids)."""
+    what it is — ``("kv", pool_index)`` for cached_key/cached_value
+    (and, under ``kv_cache_dtype='int8'``, the ``cached_*_scale``
+    fp32 scale planes, which ride parallel scale POOLS through the
+    same gather/scatter/COW machinery), ``("index",)`` for the per-row
+    write indices, ``("scalar",)`` for model-level counters (unused
+    under explicit position_ids). ``paths`` holds each leaf's key path
+    so the PAGED cache (kernel mode) can be built as a nested dict with
+    a ``block_tables`` sibling injected per attention scope — and the
+    mutated pools re-extracted by NAME, immune to the flatten-order
+    shift the extra leaf causes."""
 
     treedef: Any
     kinds: tuple
+    paths: tuple
 
 
 # (model, max_ctx) -> (plan, pool_shapes): the cache structure is a
@@ -208,10 +266,13 @@ def build_cache_plan(model, params, max_ctx: int) -> tuple[CachePlan, list]:
 
     shapes = jax.eval_shape(init_cache, params)
     flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
-    kinds, pool_shapes = [], []
+    kinds, pool_shapes, paths = [], [], []
     for path, leaf in flat:
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        if name in ("cached_key", "cached_value"):
+        names = tuple(p.key if hasattr(p, "key") else str(p)
+                      for p in path)
+        name = names[-1]
+        if name in ("cached_key", "cached_value",
+                    "cached_key_scale", "cached_value_scale"):
             b, h, s, d = leaf.shape
             if s != max_ctx:
                 raise ValueError(
@@ -227,9 +288,10 @@ def build_cache_plan(model, params, max_ctx: int) -> tuple[CachePlan, list]:
         else:
             raise ValueError(
                 f"unsupported cache leaf {name!r}: the serve engine "
-                "speaks the fp cached_key/cached_value protocol only "
-                "(set kv_cache_dtype='fp')")
-    result = CachePlan(treedef, tuple(kinds)), pool_shapes
+                "speaks the cached_key/cached_value (+ int8 scale) "
+                "protocol only")
+        paths.append(names)
+    result = CachePlan(treedef, tuple(kinds), tuple(paths)), pool_shapes
     _PLAN_CACHE[key] = result
     return result
 
@@ -290,6 +352,66 @@ def _decode_step(model, params, pools, tokens, block_tables, context_lens,
             leaf, pos[:, None, None, None], axis=2)[:, :, 0, :]  # [S, H, D]
         new_pools[kind[1]] = scatter_paged_kv(
             new_pools[kind[1]], safe_tables, pos, written)
+    return next_tok, new_pools
+
+
+def _paged_cache(plan: CachePlan, pools, block_tables, context_lens):
+    """The model-facing PAGED cache pytree (kernel mode): every KV leaf
+    is its whole block pool (no gather — the fused kernel walks the
+    tables in-attention), write indices are the context lengths, and a
+    ``block_tables`` leaf rides next to each attention scope's
+    ``cache_index`` (the marker the model's paged decode branch keys
+    on). Built as a nested dict from the plan's recorded paths — the
+    treedef can't be reused because of the injected sibling."""
+    root: dict = {}
+    for path, kind in zip(plan.paths, plan.kinds):
+        node = root
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        if kind[0] == "kv":
+            node[path[-1]] = pools[kind[1]]
+        elif kind[0] == "index":
+            node[path[-1]] = context_lens.astype(jnp.int32)
+            node["block_tables"] = block_tables
+        else:
+            node[path[-1]] = jnp.zeros((), jnp.int32)
+    return root
+
+
+def _paged_decode_step(model, params, pools, tokens, block_tables,
+                       context_lens, active, temps, top_ks, top_ps, keys,
+                       folds, plan: CachePlan, width: int, sampled: bool):
+    """One FUSED decode iteration over all slots (kernel mode): the
+    model's paged decode branch scatters each slot's new K/V straight
+    into the pools and attends via the Pallas paged kernel — no dense
+    [S, H, width, D] intermediate is ever materialized. ``width``
+    restricts the block-table walk to the iteration's gather bucket
+    (same ladder, same compile-per-bucket contract as the XLA path);
+    inactive slots route writes to null block 0 at context 0."""
+    bs = pools[0].shape[1]
+    tables = block_tables[:, :width // bs]
+    safe_tables = jnp.where(active[:, None], tables, 0)
+    ctx = jnp.where(active, context_lens, 0)
+    cache = _paged_cache(plan, pools, safe_tables, ctx)
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, tokens[:, None], None,
+        position_ids=ctx[:, None], decode=True, deterministic=True,
+        mutable=["cache"])
+    last = logits[:, -1, :].astype(jnp.float32)
+    if sampled:
+        next_tok = sample_per_slot(last, temps, top_ks, top_ps, keys, folds)
+    else:
+        next_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    # the model scattered into the pools in place (cache mutation);
+    # re-extract them BY PATH — the block_tables sibling shifts the
+    # flatten order, so positional zip against plan.kinds would skew
+    flat, _ = jax.tree_util.tree_flatten_with_path(mut["cache"])
+    by_path = {tuple(p.key if hasattr(p, "key") else str(p)
+                     for p in path): leaf for path, leaf in flat}
+    new_pools = list(pools)
+    for path, kind in zip(plan.paths, plan.kinds):
+        if kind[0] == "kv":
+            new_pools[kind[1]] = by_path[path]
     return next_tok, new_pools
 
 
@@ -362,6 +484,15 @@ def _decode_step_jit(donate: bool):
 @functools.lru_cache(maxsize=2)
 def _prefill_chunk_jit(donate: bool):
     return jax.jit(_prefill_chunk, static_argnums=(0, 12, 13),
+                   donate_argnums=(2,) if donate else ())
+
+
+@functools.lru_cache(maxsize=2)
+def _paged_decode_step_jit(donate: bool):
+    """Process-wide jitted FUSED decode step (kernel mode) — same
+    static/donation contract as :func:`_decode_step_jit`: one compile
+    per (model, plan, bucket, sampled)."""
+    return jax.jit(_paged_decode_step, static_argnums=(0, 12, 13, 14),
                    donate_argnums=(2,) if donate else ())
 
 
@@ -559,6 +690,11 @@ class EngineStats(NamedTuple):
     prefix_evictions: int = 0
     shared_read_frac: float = 0.0
     peak_resident_requests: int = 0
+    # paged-attention kernel + int8 pools (ISSUE 9)
+    kernel: str = "xla"
+    kv_dtype: str = "fp"
+    kv_bytes_read: int = 0
+    kv_token_bytes: int = 0
 
 
 class ServeEngine:
@@ -607,7 +743,21 @@ class ServeEngine:
     overlap at admission) is privatized by a device-side block copy
     first (:func:`_copy_block`). ``prefix_cache='off'`` is
     byte-for-byte the refcount-free engine's behavior — same tokens,
-    same compile count."""
+    same compile count.
+
+    ``kernel`` (None reads ``HSTD_SERVE_KERNEL``, default ``xla``)
+    selects the decode-attention path: ``xla`` gathers a dense view
+    then attends (reference, CPU-native), ``pallas`` runs the fused
+    paged-decode kernel — gather folded into the attention read, int8
+    dequant in-tile, sliding-window band tiles skipped. Speculative
+    engines keep draft/verify on the assembled path either way (the
+    kernel is single-token). ``kv_cache_dtype`` (None reads
+    ``HSTD_SERVE_KV_DTYPE``, default = the model config's own value)
+    selects pool storage; ``int8`` rebuilds the serving module around
+    ``kv_cache_dtype='int8'`` (params untouched) and the exactness
+    contract moves to ``generate_causal`` on that same config.
+    ``kv_pool_bytes`` sizes ``num_blocks`` from a KV memory budget
+    (``1 + budget // block_bytes``) instead of a block count."""
 
     #: consecutive iterations a smaller bucket must suffice before the
     #: engine shrinks to it — bounds bucket churn when the max resident
@@ -622,7 +772,10 @@ class ServeEngine:
                  prefill_batch: int = 4,
                  speculate_k: Optional[int] = None,
                  draft=None,
-                 prefix_cache: Union[str, bool, None] = None):
+                 prefix_cache: Union[str, bool, None] = None,
+                 kernel: Union[str, None] = None,
+                 kv_cache_dtype: Union[str, None] = None,
+                 kv_pool_bytes: Optional[int] = None):
         cfg = model.config
         if getattr(cfg, "num_experts", 0):
             raise ValueError(
@@ -630,16 +783,25 @@ class ServeEngine:
                 "capacity depends on the apply's sequence length, so "
                 "chunked prefill could drop token->expert assignments "
                 "the one-shot path never drops")
-        if getattr(cfg, "kv_cache_dtype", "fp") != "fp":
-            raise ValueError("ServeEngine requires kv_cache_dtype='fp' "
-                             "(paged int8 scales are not wired)")
-        if getattr(cfg, "sliding_window", None) is not None:
-            raise ValueError("ServeEngine does not support sliding-"
-                             "window configs (windowed block eviction "
-                             "is not implemented)")
         if getattr(cfg, "pipeline_stages", 0):
             raise ValueError("ServeEngine needs the dense stack "
                              "(pipeline_stages=0)")
+        self.kernel = parse_kernel(kernel)
+        self.kv_cache_dtype = parse_kv_dtype(
+            kv_cache_dtype, getattr(cfg, "kv_cache_dtype", "fp"))
+        if self.kv_cache_dtype != getattr(cfg, "kv_cache_dtype", "fp"):
+            # the knob overrides the model's own cache storage: rebuild
+            # the serving module around the adjusted config (params are
+            # untouched — KV quantization is activation-side)
+            if not hasattr(cfg, "kv_cache_dtype"):
+                raise ValueError(
+                    f"kv_cache_dtype={self.kv_cache_dtype!r} requested "
+                    f"but {type(model).__name__} has no int8 KV cache "
+                    "protocol")
+            import dataclasses
+            cfg = dataclasses.replace(cfg,
+                                      kv_cache_dtype=self.kv_cache_dtype)
+            model = type(model)(cfg)
         self.model, self.params = model, params
         self.eos_token_id = int(cfg.eos_token_id)
         self.pad_token_id = min(int(cfg.pad_token_id), cfg.vocab_size - 1)
@@ -660,7 +822,25 @@ class ServeEngine:
             raise ValueError(f"speculate_k must be >= 0, "
                              f"got {self.speculate_k}")
         self.prefix_cache = parse_prefix_cache(prefix_cache)
-        self.blocks = BlockManager(num_blocks, block_size)
+        plan, pool_shapes = build_cache_plan(model, params,
+                                             self.max_model_len)
+        self._plan = plan
+        # bytes one resident token costs across every pool (int8 KV +
+        # its fp32 scale plane included) — the figure that sizes a
+        # byte-budgeted pool and denominates kv_bytes_read telemetry
+        token_bytes = sum(h * d * np.dtype(dtype).itemsize
+                          for h, d, dtype in pool_shapes)
+        if kv_pool_bytes is not None:
+            # size the pool by a KV MEMORY budget instead of a block
+            # count: int8 pools (~half the bytes/token) get ~2x the
+            # blocks — and through the scheduler's block-denominated
+            # admission math, ~2x the resident requests — for the same
+            # budget. The budget covers the TARGET pools; a speculative
+            # draft's pools ride on top (its layer share).
+            block_bytes = block_size * max(token_bytes, 1)
+            num_blocks = max(2, 1 + int(kv_pool_bytes) // block_bytes)
+        self.blocks = BlockManager(num_blocks, block_size,
+                                   token_bytes=token_bytes)
         self.sched = Scheduler(num_slots, self.blocks, prefill_chunk,
                                self.max_model_len,
                                decode_lookahead=self.speculate_k + 1,
@@ -682,9 +862,6 @@ class ServeEngine:
                                    if b >= self.speculate_k + 1]
         self.prefill_batch = max(1, min(int(prefill_batch), self.num_slots))
 
-        plan, pool_shapes = build_cache_plan(model, params,
-                                             self.max_model_len)
-        self._plan = plan
         self._pools = [jnp.zeros((num_blocks, block_size, h, d), dtype)
                        for h, d, dtype in pool_shapes]
         # speculative mode: the draft model's paged pools ride the SAME
@@ -719,7 +896,9 @@ class ServeEngine:
         # restarted server — reuses the compiled executables instead of
         # retracing
         donate = jax.default_backend() != "cpu"
-        self._decode_fn = _decode_step_jit(donate)
+        self._decode_fn = (_paged_decode_step_jit(donate)
+                           if self.kernel == "pallas"
+                           else _decode_step_jit(donate))
         self._prefill_fn = _prefill_chunk_jit(donate)
         self._spec_fn = _spec_step_jit(donate)
         self._copy_fn = _copy_block_jit(donate)
@@ -736,6 +915,7 @@ class ServeEngine:
         self.bucket_switches = 0
         self.draft_proposed = 0
         self.draft_accepted = 0
+        self.kv_bytes_read = 0      # pool bytes decode dispatches read
         self.spec_windows = 0       # active (slot, iteration) pairs
         self.peak_resident = 0      # max concurrently-occupied slots
         self._bucket = self.gather_buckets[0]
@@ -904,6 +1084,11 @@ class ServeEngine:
         if self.decode_time_s > 0:
             out["decode_tokens_per_sec"] = round(
                 self.decode_tokens / self.decode_time_s, 1)
+        out["kernel"] = self.kernel
+        out["kv_dtype"] = self.kv_cache_dtype
+        if self.decode_steps:
+            out["kv_bytes_read_per_step"] = round(
+                self.kv_bytes_read / self.decode_steps, 1)
         from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
             percentile,
         )
@@ -984,7 +1169,11 @@ class ServeEngine:
             cow_copies=self.blocks.cow_copies,
             prefix_evictions=self.blocks.prefix_evictions,
             shared_read_frac=self.blocks.shared_read_frac(),
-            peak_resident_requests=self.peak_resident)
+            peak_resident_requests=self.peak_resident,
+            kernel=self.kernel,
+            kv_dtype=self.kv_cache_dtype,
+            kv_bytes_read=self.kv_bytes_read,
+            kv_token_bytes=self.blocks.token_bytes)
 
     def _aggregate_hit_rate(self) -> Optional[float]:
         """Prompt tokens served from cache / prompt tokens admitted,
@@ -1188,6 +1377,14 @@ class ServeEngine:
                 keys[i] = self._keys[req.rid]
                 folds[i] = self._generated(req)
         self.blocks.note_gather([s.context_len + 1 for s in ds], bucket)
+        # the step's KV read traffic in POOL bytes (every slot row of
+        # the dispatch × the bucket width × bytes/token across pools —
+        # int8 pools halve this, which is the point): one scalar per
+        # decode step, aggregated into the SLO report
+        step_bytes = self.num_slots * bucket * self.blocks.token_bytes
+        self.kv_bytes_read += step_bytes
+        if obs.has_sink():
+            obs.scalar("serve/kv_bytes_read", step_bytes, self.iterations)
         # blocks_saved() == 0 means no block is shared right now — the
         # per-slot table walk would only accumulate zeros, so skip it
         # (the common case for non-templated traffic with the cache on)
@@ -1254,6 +1451,13 @@ class ServeEngine:
                 folds[i] = self._generated(req)   # window start index
         self.blocks.note_gather(
             [s.context_len + k + 1 for s in ds], bucket)
+        # draft (k+1 steps) + verify each read a bucket-wide assembled
+        # cache: the target-pool read is what the fp-vs-int8 comparison
+        # isolates, so account the verify read (one bucket per slot row)
+        step_bytes = self.num_slots * bucket * self.blocks.token_bytes
+        self.kv_bytes_read += step_bytes
+        if obs.has_sink():
+            obs.scalar("serve/kv_bytes_read", step_bytes, self.iterations)
         if self.prefix_cache and self.blocks.blocks_saved() > 0:
             self.blocks.note_shared_reads(sum(
                 self.blocks.shared_read_tokens(s.table, s.context_len)
@@ -1350,6 +1554,8 @@ class ServeEngine:
                 extra["cache_hit_rate"] = (
                     round(req.cache_hit_rate, 4)
                     if req.cache_hit_rate is not None else None)
+            extra["kernel"] = self.kernel
+            extra["kv_dtype"] = self.kv_cache_dtype
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
                       preemptions=req.preemptions, **extra)

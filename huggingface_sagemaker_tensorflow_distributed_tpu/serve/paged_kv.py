@@ -76,7 +76,8 @@ class BlockManager:
     token slots each. Block 0 is the reserved null block and is never
     handed out."""
 
-    def __init__(self, num_blocks: int, block_size: int):
+    def __init__(self, num_blocks: int, block_size: int,
+                 token_bytes: int = 0):
         if num_blocks < 2:
             raise ValueError(f"need >= 2 blocks (block 0 is the reserved "
                              f"null block), got {num_blocks}")
@@ -84,6 +85,14 @@ class BlockManager:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        # bytes one resident token costs across every pool this manager
+        # allocates for (int8 KV halves it vs fp; the fp32 scale planes
+        # ride along) — the KV-element-size parameterization that lets
+        # capacity be reasoned about (and pools be sized) in BYTES:
+        # ``ServeEngine(kv_pool_bytes=...)`` divides a memory budget by
+        # ``block_bytes``, so int8 pools hold ~2x the blocks — and
+        # admit ~2x the requests — of fp pools on the same budget
+        self.token_bytes = int(token_bytes)
         # LIFO free list: recently-freed (cache-warm) blocks are reused
         # first; block 0 excluded for good
         self._free = list(range(self.num_blocks - 1, 0, -1))
@@ -126,6 +135,17 @@ class BlockManager:
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks needed to hold ``n_tokens`` context tokens."""
         return -(-max(int(n_tokens), 0) // self.block_size)
+
+    @property
+    def block_bytes(self) -> int:
+        """Pool bytes one block occupies (0 when the manager was built
+        without a ``token_bytes`` figure)."""
+        return self.block_size * self.token_bytes
+
+    def bytes_for(self, n_tokens: int) -> int:
+        """Pool bytes ``n_tokens`` of resident context occupies
+        (block-granular — the allocation, not the useful payload)."""
+        return self.blocks_for(n_tokens) * self.block_bytes
 
     @property
     def num_free(self) -> int:

@@ -161,6 +161,17 @@ def main() -> None:
                         help="copy-on-write prompt-prefix KV sharing "
                              "across requests (default: "
                              "HSTD_SERVE_PREFIX_CACHE or on)")
+    parser.add_argument("--kernel", default=None,
+                        choices=("xla", "pallas"),
+                        help="decode attention path: xla = gather + "
+                             "dense (reference), pallas = fused paged "
+                             "kernel (interpret mode off-TPU; default: "
+                             "HSTD_SERVE_KERNEL or xla)")
+    parser.add_argument("--kv_cache_dtype", default=None,
+                        choices=("fp", "int8"),
+                        help="KV pool storage; int8 halves pool bytes "
+                             "per decode step (default: "
+                             "HSTD_SERVE_KV_DTYPE or the model config)")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="0 = greedy (the default); > 0 samples")
     parser.add_argument("--top_k", type=int, default=0)
@@ -190,7 +201,9 @@ def main() -> None:
                          gather_buckets=args.gather_buckets,
                          speculate_k=args.speculate_k,
                          draft=args.draft_layers,
-                         prefix_cache=args.prefix_cache)
+                         prefix_cache=args.prefix_cache,
+                         kernel=args.kernel,
+                         kv_cache_dtype=args.kv_cache_dtype)
     trace = load_trace(args, model.config.vocab_size - 1)
     # precompile the sampled step variants too when the trace will
     # sample, so no request pays a mid-serve compile
@@ -259,6 +272,11 @@ def main() -> None:
         "blocks_saved_peak": (stats.blocks_saved_peak
                               if engine.prefix_cache else None),
         "cow_copies": stats.cow_copies if engine.prefix_cache else None,
+        "kernel": stats.kernel,
+        "kv_dtype": stats.kv_dtype,
+        "kv_bytes_read_per_step": (round(
+            stats.kv_bytes_read / stats.decode_steps, 1)
+            if stats.decode_steps else None),
         "kv_peak_utilization": round(stats.kv_peak_utilization, 3)}))
     obs.flush()
 

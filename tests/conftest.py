@@ -193,6 +193,16 @@ _SLOW_TESTS = {
     "test_beam_causal.py",       # whole module: HF beam parity compiles
     "test_sharded_generation.py",  # whole module: tp-mesh decode compiles
     "test_speculative_seq2seq.py",  # whole module: T5 spec-decode compiles
+    # ISSUE 9 paged-kernel tier: the interpret-mode parity MATRIX and
+    # the deeper combo/capacity runs are slow (the 41s spec+prefix+int8
+    # composition included — tier-1 was at 798s/870s with it); the core
+    # engine exactness gates (pallas kernel engaged, int8 under forced
+    # preemption, sliding-window Llama) stay tier-1 per the PR 3/5/7
+    # acceptance-gate precedent
+    "test_paged_kernel.py::test_paged_kernel_matrix_matches_xla",
+    "test_serve.py::test_kv_pool_bytes_doubles_int8_admission",
+    "test_serve.py::test_engine_sliding_window_pallas_int8_llama",
+    "test_serve.py::test_engine_int8_composes_with_speculative_and_prefix",
 }
 
 

@@ -1,0 +1,251 @@
+"""Pallas fused paged-attention decode kernel
+(``ops/pallas_paged_attention.py``) vs the XLA gather reference
+(``ops.attention.paged_attention(impl='xla')``), run in interpret mode
+on CPU — the hardware-free correctness story the ISSUE 9 acceptance
+names: width buckets × GQA groupings × fp/int8 pools × sliding-window
+bands. Plus the int8 scatter/gather scale-path contracts the pools are
+built on: quantize→scatter→gather/dequant roundtrip error bounds, the
+null-block-0 zero-scale convention, and COW copying the int8 block AND
+its scale rows atomically."""
+
+import numpy as np
+import pytest
+
+
+def _pools(rng, N, bs, Hkv, D):
+    import jax.numpy as jnp
+
+    pk = jnp.asarray(rng.randn(N, bs, Hkv, D).astype(np.float32))
+    pv = jnp.asarray(rng.randn(N, bs, Hkv, D).astype(np.float32))
+    return pk, pv
+
+
+def _quantized(rng, pool):
+    """An int8 pool + positive scale plane whose dequantized value is
+    the reference fp pool for parity checks."""
+    import jax.numpy as jnp
+
+    scale = jnp.asarray(
+        0.05 + np.abs(rng.randn(*pool.shape[:3], 1)).astype(np.float32))
+    q = jnp.clip(jnp.round(pool / scale), -127, 127).astype(jnp.int8)
+    return q, scale, q.astype(jnp.float32) * scale
+
+
+def _xla_ref(q, pk, pv, tables, ctx, width=None, window=None):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        paged_attention,
+    )
+
+    return paged_attention(q, pk, pv, tables, ctx, width=width,
+                           impl="xla", window=window)
+
+
+def _kernel(q, pk, pv, tables, ctx, width=None, window=None, ks=None,
+            vs=None):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.pallas_paged_attention import (
+        paged_decode_attention,
+    )
+
+    return paged_decode_attention(q, pk, pv, tables, ctx, width=width,
+                                  window=window, k_scale_pool=ks,
+                                  v_scale_pool=vs)
+
+
+def _assert_close(got, want, ctx):
+    """Active rows match to tolerance; the kernel's context-0 rows are
+    exact zeros (the XLA path emits masked-junk softmax there — both
+    discarded by callers)."""
+    act = np.asarray(ctx) > 0
+    np.testing.assert_allclose(np.asarray(got)[act], np.asarray(want)[act],
+                               rtol=1e-5, atol=1e-5)
+    assert np.all(np.asarray(got)[~act] == 0.0)
+
+
+def test_paged_kernel_smoke_matches_xla():
+    """Tier-1 smoke: one small fp GQA case through the kernel (tiny
+    width, one bucket) — the full matrix runs under the slow tier."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    S, Hq, Hkv, D, bs, nb = 3, 4, 2, 8, 4, 4
+    pk, pv = _pools(rng, 1 + S * nb, bs, Hkv, D)
+    tables = jnp.asarray(rng.permutation(np.arange(1, 1 + S * nb))
+                         .reshape(S, nb).astype(np.int32))
+    q = jnp.asarray(rng.randn(S, Hq, D).astype(np.float32))
+    ctx = jnp.asarray(np.array([5, 16, 0], np.int32))
+    got = _kernel(q, pk, pv, tables, ctx, width=16)
+    want = _xla_ref(q, pk, pv, tables, ctx, width=16)
+    _assert_close(got, want, ctx)
+
+
+@pytest.mark.parametrize("group", [1, 4])
+def test_paged_kernel_matrix_matches_xla(group):
+    """The acceptance matrix: every (width bucket × sliding window)
+    combination, fp AND int8 pools, at GQA group sizes 1 (MHA) and 4 —
+    kernel output == XLA gather path to tolerance on active rows."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    Hkv, D, bs, nb = 2, 16, 4, 8
+    Hq = Hkv * group
+    S = 5
+    N = 1 + S * nb
+    pk, pv = _pools(rng, N, bs, Hkv, D)
+    qk, ks, dk = _quantized(rng, pk)
+    qv, vs, dv = _quantized(rng, pv)
+    tables = jnp.asarray(rng.permutation(np.arange(1, N))
+                         .reshape(S, nb).astype(np.int32))
+    q = jnp.asarray(rng.randn(S, Hq, D).astype(np.float32))
+    base = np.array([1, 7, 13, 32, 0], np.int32)
+    for width in (None, 8, 16):
+        W = width or bs * nb
+        ctx = jnp.asarray(np.minimum(base, W))
+        for window in (None, 3, 11):
+            got = _kernel(q, pk, pv, tables, ctx, width=width,
+                          window=window)
+            want = _xla_ref(q, pk, pv, tables, ctx, width=width,
+                            window=window)
+            _assert_close(got, want, ctx)
+            # int8 pools: in-kernel dequant == dequantize-then-attend
+            got8 = _kernel(q, qk, qv, tables, ctx, width=width,
+                           window=window, ks=ks, vs=vs)
+            want8 = _xla_ref(q, dk, dv, tables, ctx, width=width,
+                             window=window)
+            _assert_close(got8, want8, ctx)
+
+
+def test_paged_kernel_validates_inputs():
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        paged_attention,
+    )
+
+    rng = np.random.RandomState(2)
+    pk, pv = _pools(rng, 9, 4, 2, 8)
+    tables = jnp.zeros((2, 2), jnp.int32)
+    ctx = jnp.zeros((2,), jnp.int32)
+    q3 = jnp.asarray(rng.randn(2, 3, 8).astype(np.float32))
+    with pytest.raises(ValueError, match="multiple of pool kv heads"):
+        _kernel(q3, pk, pv, tables, ctx)
+    q = jnp.asarray(rng.randn(2, 4, 8).astype(np.float32))
+    with pytest.raises(ValueError, match="multiple"):
+        _kernel(q, pk, pv, tables, ctx, width=6)
+    with pytest.raises(ValueError, match="block table holds"):
+        _kernel(q, pk, pv, tables, ctx, width=16)
+    with pytest.raises(ValueError, match="BOTH"):
+        _kernel(q, pk, pv, tables, ctx, ks=jnp.zeros((9, 4, 2, 1)))
+    with pytest.raises(ValueError, match="unknown paged_attention impl"):
+        paged_attention(q, pk, pv, tables, ctx, impl="cuda")
+
+
+# -- int8 scatter/gather scale path (the pools the kernel reads) -------------
+
+def test_int8_scatter_gather_roundtrip_error_bound():
+    """quantize → scatter (values + scales) → gather/dequant recovers
+    the original K/V within the symmetric-int8 bound (scale/2 per
+    element), and EXACTLY at zero."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        kv_quantize,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        gather_paged_kv,
+        scatter_paged_kv,
+    )
+
+    rng = np.random.RandomState(3)
+    B, H, D, bs, nb = 2, 3, 8, 4, 2
+    N = 1 + B * nb
+    pool = jnp.zeros((N, bs, H, D), jnp.int8)
+    scale_pool = jnp.zeros((N, bs, H, 1), jnp.float32)
+    tables = jnp.asarray(np.arange(1, N).reshape(B, nb).astype(np.int32))
+    vals = rng.randn(B, H, bs * nb, D).astype(np.float32) * 3.0
+    vals[0, :, 2] = 0.0                        # a zero row stays exact
+    for p in range(bs * nb):
+        x = jnp.asarray(vals[:, :, p:p + 1, :])     # [B, H, 1, D]
+        qx, sx = kv_quantize(x)
+        pos = jnp.full((B,), p, jnp.int32)
+        pool = scatter_paged_kv(pool, tables, pos, qx[:, :, 0, :])
+        scale_pool = scatter_paged_kv(scale_pool, tables, pos,
+                                      sx[:, :, 0, :])
+    got = (np.asarray(gather_paged_kv(pool, tables)).astype(np.float32)
+           * np.asarray(gather_paged_kv(scale_pool, tables)))
+    scales = np.abs(vals).max(axis=-1, keepdims=True) / 127.0
+    assert np.all(np.abs(got - vals) <= scales / 2 + 1e-7)
+    np.testing.assert_array_equal(got[0, :, 2], 0.0)
+
+
+def test_null_block_zero_scale_convention():
+    """Block 0 (the null block inactive slots scatter to) starts at
+    int8 0 with scale 0: a gather that reads it dequantizes to EXACT
+    zeros, never junk — and writes routed there never touch real
+    blocks."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
+        gather_paged_kv,
+        scatter_paged_kv,
+    )
+
+    pool = jnp.zeros((4, 2, 2, 4), jnp.int8)
+    scale_pool = jnp.zeros((4, 2, 2, 1), jnp.float32)
+    real = pool.at[2].set(7)
+    # an inactive slot's write routed to the null table row
+    null_tables = jnp.zeros((1, 2), jnp.int32)
+    written = scatter_paged_kv(real, null_tables,
+                               jnp.zeros((1,), jnp.int32),
+                               jnp.full((1, 2, 4), 5, jnp.int8))
+    assert np.all(np.asarray(written[2]) == 7)          # real untouched
+    deq = (np.asarray(gather_paged_kv(pool, null_tables))
+           .astype(np.float32)
+           * np.asarray(gather_paged_kv(scale_pool, null_tables)))
+    np.testing.assert_array_equal(deq, 0.0)
+
+
+def test_cow_copies_int8_block_and_scale_rows_atomically():
+    """The engine's COW device copy must duplicate EVERY pool a block
+    addresses — under int8 that is the int8 K/V pools AND their fp32
+    scale pools in the same ``_apply_cow`` application, or a privatized
+    block would dequantize with another request's scales."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    cfg = Gpt2Config(vocab_size=64, hidden_size=16, num_layers=1,
+                     num_heads=2, intermediate_size=32,
+                     max_position_embeddings=64, hidden_dropout=0.0,
+                     embd_dropout=0.0, attention_dropout=0.0,
+                     eos_token_id=63, pad_token_id=0,
+                     kv_cache_dtype="int8")
+    model = Gpt2LMHeadModel(cfg)
+    params = init_params(model, cfg, seed=0)
+    eng = ServeEngine(model, params, num_slots=2, block_size=4,
+                      num_blocks=8, prefill_chunk=4, max_model_len=16,
+                      prefix_cache=True)
+    dtypes = {str(p.dtype) for p in eng._pools}
+    assert dtypes == {"int8", "float32"}       # values + scale planes
+    # poison block 1 across every pool, then COW-copy it to block 2
+    eng._pools = [p.at[1].set(3 if p.dtype == jnp.int8 else 0.5)
+                  for p in eng._pools]
+
+    class _Slot:
+        pending_copies = [(1, 2)]
+
+    slot = _Slot()
+    eng._apply_cow(slot)
+    assert slot.pending_copies == []
+    for p in eng._pools:
+        np.testing.assert_array_equal(np.asarray(p[2]), np.asarray(p[1]))

@@ -230,17 +230,23 @@ def _reference(model, params, prompt, max_new, eos):
     return [int(t) for t in ref]
 
 
-def _assert_engine_exact(model, params, trace, eos, **engine_kw):
+def _assert_engine_exact(model, params, trace, eos, ref_model=None,
+                         **engine_kw):
+    """``ref_model`` overrides the generate_causal oracle — an int8
+    engine's contract is generate_causal on the int8-cache config (int8
+    vs fp tokens legitimately differ; quantization is deterministic)."""
     from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
         ServeEngine,
     )
 
+    ref_model = ref_model if ref_model is not None else model
     eng = ServeEngine(model, params, **engine_kw)
     reqs = [eng.submit(p, m) for p, m in trace]
     eng.run()
     for (prompt, max_new), req in zip(trace, reqs):
         got = [int(t) for t in eng.output_ids(req)]
-        assert got == _reference(model, params, prompt, max_new, eos), \
+        assert got == _reference(ref_model, params, prompt, max_new,
+                                 eos), \
             f"request {req.rid} diverged (preemptions={req.preemptions})"
     return eng
 
@@ -315,6 +321,10 @@ def test_engine_exact_llama_gqa():
 
 
 def test_engine_rejects_unsupported_configs(gpt2_setup):
+    """The ISSUE 3 rejection surface after ISSUE 9: int8-KV and
+    sliding-window configs are now SERVED (their engines construct and
+    carry the right pool dtypes), and the rejections that remain are
+    genuine unsupported shapes plus unparseable knob values."""
     import dataclasses
 
     from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
@@ -323,10 +333,24 @@ def test_engine_rejects_unsupported_configs(gpt2_setup):
 
     cfg, model, params = gpt2_setup
     int8 = type(model)(dataclasses.replace(cfg, kv_cache_dtype="int8"))
-    with pytest.raises(ValueError, match="kv_cache_dtype"):
-        ServeEngine(int8, params, num_blocks=4)
+    eng = ServeEngine(int8, params, num_blocks=4, block_size=4,
+                      max_model_len=16, prefill_chunk=8)
+    assert eng.kv_cache_dtype == "int8"
+    assert {str(p.dtype) for p in eng._pools} == {"int8", "float32"}
+    # the knob form: an fp model rebuilt around int8 pool storage
+    eng = ServeEngine(model, params, num_blocks=4, block_size=4,
+                      max_model_len=16, prefill_chunk=8,
+                      kv_cache_dtype="int8")
+    assert eng.model.config.kv_cache_dtype == "int8"
     with pytest.raises(ValueError, match="max_position_embeddings"):
         ServeEngine(model, params, num_blocks=4, max_model_len=1024)
+    with pytest.raises(ValueError, match="HSTD_SERVE_KERNEL"):
+        ServeEngine(model, params, num_blocks=4, block_size=4,
+                    max_model_len=16, prefill_chunk=8, kernel="cuda")
+    with pytest.raises(ValueError, match="HSTD_SERVE_KV_DTYPE"):
+        ServeEngine(model, params, num_blocks=4, block_size=4,
+                    max_model_len=16, prefill_chunk=8,
+                    kv_cache_dtype="fp8")
 
 
 # -- telemetry ---------------------------------------------------------------
@@ -1080,3 +1104,218 @@ def test_scheduler_lookahead_reserves_verify_window():
     s.ensure_decode_capacity()
     # table covers context + lookahead = 12 tokens -> 3 blocks
     assert len(slot.table) == 3
+
+
+# -- ISSUE 9: fused paged-attention kernel + int8 KV pools -------------------
+
+def _int8_model(model, cfg):
+    import dataclasses
+
+    return type(model)(dataclasses.replace(cfg, kv_cache_dtype="int8"))
+
+
+def test_engine_exact_with_pallas_kernel(gpt2_setup):
+    """The ISSUE 9 tentpole gate: with the fused Pallas decode kernel
+    engaged (interpret mode on CPU), the engine stays token-for-token
+    generate_causal — across bucket boundaries, with the kv-bytes
+    telemetry flowing."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(11)
+    trace = [(rng.randint(1, 120, (p,)).astype(np.int32), m)
+             for p, m in [(5, 6), (15, 5), (9, 4)]]
+    eng = _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                               num_slots=3, block_size=4, num_blocks=40,
+                               prefill_chunk=8, max_model_len=64,
+                               gather_buckets=[16, 64], kernel="pallas")
+    assert eng.kernel == "pallas"
+    slo = eng.slo_summary()
+    assert slo["kernel"] == "pallas" and slo["kv_dtype"] == "fp"
+    assert slo["kv_bytes_read_per_step"] > 0
+    assert eng.stats().kv_bytes_read > 0
+
+
+def test_engine_exact_int8_pools_under_preemption(gpt2_setup):
+    """int8 KV pools (the removed rejection): engine output is
+    token-exact vs generate_causal on the SAME int8-cache config,
+    including under forced recompute preemption — quantization is
+    deterministic, so the re-prefilled pools are bitwise identical."""
+    cfg, model, params = gpt2_setup
+    int8 = _int8_model(model, cfg)
+    rng = np.random.RandomState(12)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 18)
+             for _ in range(4)]
+    eng = _assert_engine_exact(int8, params, trace, cfg.eos_token_id,
+                               num_slots=4, block_size=4, num_blocks=10,
+                               prefill_chunk=8, max_model_len=32)
+    assert eng.stats().preemptions > 0
+    assert eng.kv_cache_dtype == "int8"
+    # int8 + fp32-scale pools cost fewer bytes/token than fp pools
+    fp_eng = _assert_engine_exact(model, params, [trace[0]],
+                                  cfg.eos_token_id, num_slots=1,
+                                  block_size=4, num_blocks=10,
+                                  prefill_chunk=8, max_model_len=32)
+    assert eng.blocks.token_bytes < fp_eng.blocks.token_bytes
+
+
+def test_engine_int8_composes_with_speculative_and_prefix(gpt2_setup):
+    """int8 pools through BOTH riders: the draft/verify window path
+    (scale planes scatter with the window writes, rewind hides stale
+    scales with stale values) and prefix-cache sharing (shared blocks
+    carry int8 + scales; a primed template re-serves exactly)."""
+    cfg, model, params = gpt2_setup
+    int8 = _int8_model(model, cfg)
+    rng = np.random.RandomState(13)
+    trace = [(rng.randint(1, 120, (p,)).astype(np.int32), m)
+             for p, m in [(5, 8), (9, 6), (7, 7)]]
+    eng = _assert_engine_exact(int8, params, trace, cfg.eos_token_id,
+                               num_slots=2, block_size=4, num_blocks=60,
+                               prefill_chunk=8, max_model_len=64,
+                               speculate_k=3, draft=1)
+    assert {str(p.dtype) for p in eng._d_pools} == {"int8", "float32"}
+    assert eng.stats().draft_proposed > 0
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    shared = rng.randint(1, 120, (12,)).astype(np.int32)
+    tails = [(np.concatenate([shared,
+                              rng.randint(1, 120, (t,)).astype(np.int32)]),
+              5) for t in (3, 5, 2)]
+    eng2 = ServeEngine(int8, params, num_slots=3, block_size=4,
+                       num_blocks=40, prefill_chunk=8, max_model_len=64,
+                       prefix_cache=True)
+    eng2.submit(shared, 1)
+    eng2.run()                            # prime the template
+    reqs = [eng2.submit(p, m) for p, m in tails]
+    eng2.run()
+    for (p, m), r in zip(tails, reqs):
+        got = [int(t) for t in eng2.output_ids(r)]
+        assert got == _reference(int8, params, p, m, cfg.eos_token_id)
+    assert eng2.blocks.peak_shared_blocks > 0
+
+
+def test_engine_serves_sliding_window_llama():
+    """The removed sliding-window rejection: a Mistral-style windowed
+    GQA config serves token-exact vs its own generate_causal (the
+    window bands from logical positions on the gathered path)."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_position_embeddings=128, eos_token_id=127,
+                      pad_token_id=0, dtype=jnp.float32,
+                      sliding_window=12)
+    model = LlamaForCausalLM(cfg)
+    params = init_params(model, cfg, seed=0)
+    rng = np.random.RandomState(14)
+    # continuations push contexts PAST the window so banding engages
+    trace = [(rng.randint(3, 120, (p,)).astype(np.int32), m)
+             for p, m in [(6, 10), (11, 8)]]
+    _assert_engine_exact(model, params, trace, cfg.eos_token_id,
+                         num_slots=2, block_size=8, num_blocks=20,
+                         prefill_chunk=8, max_model_len=64)
+
+
+def test_engine_sliding_window_pallas_int8_llama():
+    """The full ISSUE 9 composition on the hardest config: windowed
+    GQA Llama served through the fused kernel over int8 pools — the
+    kernel's banded tile-skip, GQA grouping, and in-tile dequant all
+    engaged at once, still token-exact vs generate_causal on the
+    matching int8 config."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_position_embeddings=128, eos_token_id=127,
+                      pad_token_id=0, dtype=jnp.float32,
+                      sliding_window=12)
+    model = LlamaForCausalLM(cfg)
+    params = init_params(model, cfg, seed=0)
+    int8 = LlamaForCausalLM(dataclasses.replace(cfg,
+                                                kv_cache_dtype="int8"))
+    rng = np.random.RandomState(15)
+    trace = [(rng.randint(3, 120, (p,)).astype(np.int32), m)
+             for p, m in [(6, 10), (11, 8)]]
+    eng = _assert_engine_exact(int8, params, trace, cfg.eos_token_id,
+                               num_slots=2, block_size=8, num_blocks=20,
+                               prefill_chunk=8, max_model_len=64,
+                               kernel="pallas", gather_buckets=[24, 64])
+    assert eng.kernel == "pallas" and eng.kv_cache_dtype == "int8"
+
+
+def test_kv_pool_bytes_doubles_int8_admission(gpt2_setup):
+    """The capacity-accounting satellite: pools sized by the SAME byte
+    budget hold ~2x (with scale overhead, >=2x at D=16... exactly
+    token_bytes-proportionally) more blocks under int8 — and through
+    the scheduler's block-denominated admission math, more resident
+    requests — instead of inheriting fp-sized reservations."""
+    cfg, model, params = gpt2_setup
+    int8 = _int8_model(model, cfg)
+    rng = np.random.RandomState(16)
+    trace = [(rng.randint(1, 120, (8,)).astype(np.int32), 8)
+             for _ in range(6)]
+    budget = None
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    probe = ServeEngine(model, params, num_slots=6, block_size=4,
+                        num_blocks=8, prefill_chunk=8, max_model_len=32)
+    # budget = exactly 5 fp blocks' worth of pool bytes
+    budget = 5 * probe.blocks.block_bytes
+    fp_eng = _assert_engine_exact(model, params, trace,
+                                  cfg.eos_token_id, num_slots=6,
+                                  block_size=4, prefill_chunk=8,
+                                  max_model_len=32,
+                                  kv_pool_bytes=budget)
+    int8_eng = _assert_engine_exact(int8, params, trace,
+                                    cfg.eos_token_id, num_slots=6,
+                                    block_size=4, prefill_chunk=8,
+                                    max_model_len=32,
+                                    kv_pool_bytes=budget)
+    assert fp_eng.blocks.num_blocks == 6          # 1 + 5
+    assert int8_eng.blocks.num_blocks >= 2 * fp_eng.blocks.num_blocks - 1
+    assert (int8_eng.stats().peak_resident_requests
+            >= 2 * fp_eng.stats().peak_resident_requests)
+
+
+def test_parse_kernel_and_kv_dtype_knobs(monkeypatch):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ENV_KERNEL,
+        ENV_KV_DTYPE,
+        parse_kernel,
+        parse_kv_dtype,
+    )
+
+    assert parse_kernel(None) == "xla"
+    assert parse_kernel("PALLAS") == "pallas"
+    monkeypatch.setenv(ENV_KERNEL, "pallas")
+    assert parse_kernel(None) == "pallas"
+    with pytest.raises(ValueError, match="xla | pallas"):
+        parse_kernel("triton")
+    assert parse_kv_dtype(None, "fp") == "fp"
+    assert parse_kv_dtype(None, "int8") == "int8"
+    assert parse_kv_dtype("int8", "fp") == "int8"
+    monkeypatch.setenv(ENV_KV_DTYPE, "int8")
+    assert parse_kv_dtype(None, "fp") == "int8"
+    with pytest.raises(ValueError, match="fp | int8"):
+        parse_kv_dtype("fp16", "fp")

@@ -21,7 +21,7 @@ def test_serve_bench_smoke(capsys, tmp_path):
 
     obs.reset(out_dir=str(tmp_path / "telemetry"), enabled=True)
     try:
-        mixed, bucketed, spec, prefix = bench_serve(smoke=True)
+        mixed, bucketed, spec, prefix, paged = bench_serve(smoke=True)
     finally:
         obs.reset()
     detail = mixed["detail"]
@@ -75,15 +75,32 @@ def test_serve_bench_smoke(capsys, tmp_path):
     assert pdetail["cache_hit_rate"] >= 0.5
     assert pdetail["blocks_shared_peak"] > 0        # sharing really ran
     assert pdetail["prefix_cached_tokens"] > 0
+    # the ISSUE 9 paged-kernel line: structural gates enforced at smoke
+    # scale (each side token-exact vs its own generate_causal oracle,
+    # compile flatness, the EXACT per-step byte halving from the
+    # engine's kv_bytes_read accounting), the ≥1.2x ratio only on the
+    # full CPU trace (smoke is dispatch-bound)
+    kdetail = paged["detail"]
+    assert kdetail["exact_match_fp"] is True
+    assert kdetail["exact_match_int8"] is True
+    assert kdetail["compiles_steady_fp"] <= len(kdetail["gather_buckets"])
+    assert kdetail["compiles_steady_int8"] <= len(
+        kdetail["gather_buckets"])
+    assert paged["value"] is not None               # gates structural
+    assert kdetail["ratio_gated"] is False          # smoke: no >=1.2x
+    assert 0 < kdetail["kv_bytes_ratio"] <= 0.6     # bytes REALLY halve
+    assert (kdetail["kv_token_bytes_int8"]
+            < kdetail["kv_token_bytes_fp"])
     # the stdout lines are the driver contract: parseable JSON, all
-    # four metrics present
+    # five metrics present
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
     metrics = [json.loads(ln)["metric"] for ln in lines]
-    assert metrics[-4:] == ["serve_continuous_vs_static_speedup",
+    assert metrics[-5:] == ["serve_continuous_vs_static_speedup",
                             "serve_bucketed_gather_decode_speedup",
                             "serve_speculative_decode_speedup",
-                            "serve_prefix_cache_ttft_speedup"]
+                            "serve_prefix_cache_ttft_speedup",
+                            "serve_paged_kernel_decode_speedup"]
 
 
 @pytest.mark.slow
@@ -114,6 +131,24 @@ def test_serve_bench_full_speculative_trace(capsys):
     assert result["detail"]["ratio_gated"] is True
     assert result["detail"]["exact_match"] is True
     assert result["detail"]["acceptance_rate"] >= 0.9
+
+
+@pytest.mark.slow
+def test_serve_bench_full_paged_kernel_trace(capsys):
+    """The full CPU decode-dominated trace — the ISSUE 9 acceptance
+    surface where the ≥1.2x int8-vs-fp decode ratio IS enforced in the
+    line (measured 1.68x on this container; the per-step byte ratio
+    ~0.28 is arithmetic and gated always)."""
+    from benchmarks.serve_bench import bench_serve_paged_kernel
+
+    result = bench_serve_paged_kernel(smoke=False)
+    assert result.get("error") is None
+    assert result["value"] is not None and result["value"] >= 1.2
+    detail = result["detail"]
+    assert detail["ratio_gated"] is True
+    assert detail["exact_match_fp"] is True
+    assert detail["exact_match_int8"] is True
+    assert detail["kv_bytes_ratio"] <= 0.6
 
 
 @pytest.mark.slow

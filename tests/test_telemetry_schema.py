@@ -225,6 +225,13 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
         {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
          "event": "finish", "request": 3,
          "prefix_cached_tokens": 96.5, "cache_hit_rate": "hot"},  # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "finish", "request": 4,
+         "kernel": "pallas", "kv_dtype": "int8",
+         "kv_bytes_read": 4096},                                 # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "report", "kernel": 1, "kv_dtype": False,
+         "kv_bytes_read_per_step": "lots"},                      # drift
     ]
     bad.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
     proc = _run(str(bad))
@@ -235,6 +242,9 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
     assert "optional field 'speculate_k'" in proc.stdout
     assert "optional field 'prefix_cached_tokens'" in proc.stdout
     assert "optional field 'cache_hit_rate'" in proc.stdout
+    assert "optional field 'kernel'" in proc.stdout
+    assert "optional field 'kv_dtype'" in proc.stdout
+    assert "optional field 'kv_bytes_read_per_step'" in proc.stdout
 
 
 def test_validator_accepts_anomaly_and_flight_artifacts(tmp_path):
