@@ -66,6 +66,15 @@ comparison are measured on their second pass (first pass compiles).
 (``tests/test_serve_bench.py``) and skips the ratio acceptance (at
 smoke scale dispatch overhead dominates); the full CPU modes use
 models large enough that per-step compute dominates dispatch overhead.
+
+The mixed line's detail additionally carries the request-lifecycle
+phase decomposition (ISSUE 10, :func:`_phase_detail`): queue /
+prefill / decode / preempted / overhead time fractions + tail queue
+wait from the engine's own stamps, so a serving regression names the
+phase that moved. The tight-gated ratio lines (bucketed / speculative
+/ prefix / paged-kernel) pin their measured engines
+``timeline='off'`` — constant per-token tracing overhead would
+compress a device-bandwidth ratio toward 1.
 """
 
 from __future__ import annotations
@@ -192,7 +201,7 @@ def run_static(model, params, trace, batch_size: int, eos: int):
 def run_engine(model, params, trace, *, num_slots: int, block_size: int,
                num_blocks: int, prefill_chunk: int, max_model_len: int,
                gather_buckets=None, speculate_k: int = 0, draft=None,
-               kernel=None, kv_cache_dtype=None):
+               kernel=None, kv_cache_dtype=None, timeline=None):
     """Measured continuous-batching pass: engine warmup + one full
     throwaway pass (compiles everything), then the timed pass on a
     fresh engine reusing nothing but the params. Returns
@@ -214,7 +223,8 @@ def run_engine(model, params, trace, *, num_slots: int, block_size: int,
                            max_model_len=max_model_len,
                            gather_buckets=gather_buckets,
                            speculate_k=speculate_k, draft=draft,
-                           kernel=kernel, kv_cache_dtype=kv_cache_dtype)
+                           kernel=kernel, kv_cache_dtype=kv_cache_dtype,
+                           timeline=timeline)
 
     warm = build()
     for prompt, max_new in trace:
@@ -246,6 +256,27 @@ def _bench_env():
         memory_watermark = lambda: None  # noqa: E731
         anomaly_field = lambda: {"anomalies": 0}  # noqa: E731
     return on_tpu, anomaly_field, memory_watermark
+
+
+def _phase_detail(slo: dict) -> dict:
+    """The lifecycle phase decomposition (ISSUE 10) the MIXED line's
+    detail carries so a serving regression names the PHASE that moved,
+    not just the ratio: queue / prefill / decode / preempted / overhead
+    fractions of summed per-request e2e plus the tail queue wait,
+    straight from the engine's own ``slo_summary()`` (None when the
+    engine ran with ``HSTD_SERVE_TIMELINE=off``). The tight-gated
+    decode/TTFT RATIO lines deliberately run their measured engines
+    timeline-off instead: the stamps are constant per-token host
+    overhead, which compresses a device-bandwidth ratio toward 1 and
+    makes the gate load-sensitive."""
+    return {
+        "queue_time_frac": slo.get("queue_time_frac"),
+        "prefill_time_frac": slo.get("prefill_time_frac"),
+        "decode_time_frac": slo.get("decode_time_frac"),
+        "preempted_time_frac": slo.get("preempted_time_frac"),
+        "overhead_time_frac": slo.get("overhead_time_frac"),
+        "queue_wait_p99_s": slo.get("queue_wait_p99_s"),
+    }
 
 
 def _emit(result, anomaly_field, memory_watermark, speedup_key: str):
@@ -360,6 +391,7 @@ def bench_serve_mixed(smoke: bool = False) -> dict:
             "e2e_p95_s": slo.get("e2e_p95_s"),
             "e2e_p99_s": slo.get("e2e_p99_s"),
             "peak_waiting_depth": slo.get("peak_waiting_depth"),
+            **_phase_detail(slo),
             "kv_peak_utilization": round(stats.kv_peak_utilization, 3),
             "preemptions": stats.preemptions,
             "decode_steps": stats.decode_steps,
@@ -441,8 +473,12 @@ def bench_serve_bucketed(smoke: bool = False) -> dict:
     model, params, trace, _ = build_model_and_trace(
         cfg, 1, n_req, prompt_lo, prompt_hi, short_new, long_new,
         long_every)
+    # timeline off on BOTH sides: the ratio isolates KV read traffic,
+    # and the per-token tracing stamps are constant host overhead that
+    # would compress a device-bandwidth ratio toward 1 (Amdahl)
     kw = dict(num_slots=slots, block_size=block, num_blocks=num_blocks,
-              prefill_chunk=chunk, max_model_len=max_len)
+              prefill_chunk=chunk, max_model_len=max_len,
+              timeline="off")
 
     with obs.span("bench/serve_bucketed_full"):
         (f_wall, f_outs, _f_tokens, f_stats, f_delta,
@@ -605,7 +641,7 @@ def bench_serve_speculative(smoke: bool = False) -> dict:
         params_fn=lambda m, p: make_skip_exact_params(m, p, draft_layers))
     kw = dict(num_slots=slots, block_size=block, num_blocks=num_blocks,
               prefill_chunk=chunk, max_model_len=max_len,
-              gather_buckets=buckets)
+              gather_buckets=buckets, timeline="off")
 
     with obs.span("bench/serve_spec_plain"):
         (p_wall, p_outs, _p_tokens, p_stats, p_delta,
@@ -703,11 +739,14 @@ def run_prefix_engine(model, params, trace, prime_prompt, *,
     )
 
     def build():
+        # timeline off: this line gates a tight TTFT ratio, and the
+        # per-token tracing stamps would dilute it (same reasoning as
+        # the decode-tokens/sec ratio lines)
         return ServeEngine(model, params, num_slots=num_slots,
                            block_size=block_size, num_blocks=num_blocks,
                            prefill_chunk=prefill_chunk,
                            max_model_len=max_model_len,
-                           prefix_cache=prefix_cache)
+                           prefix_cache=prefix_cache, timeline="off")
 
     warm = build()
     warm.submit(prime_prompt, 1)
@@ -980,7 +1019,7 @@ def bench_serve_paged_kernel(smoke: bool = False) -> dict:
     trace = [(p, max_new) for p in prompts]
     kw = dict(num_slots=slots, block_size=block, num_blocks=num_blocks,
               prefill_chunk=chunk, max_model_len=max_len,
-              gather_buckets=buckets, kernel=kernel)
+              gather_buckets=buckets, kernel=kernel, timeline="off")
 
     def reference(dtype: str):
         """One batched greedy generate_causal pass on the matching

@@ -323,6 +323,15 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # or a widened verify window all show up here before tokens/sec
     # moves on hardware with bandwidth to spare)
     "serve_kv_bytes_read_per_step": (+1, "ratio"),
+    # lifecycle attribution (ISSUE 10): tail queue wait and the
+    # preempted-time share of total request latency, both worse UP —
+    # an admission-policy or pool-sizing regression shows up in THESE
+    # before the aggregate e2e percentiles move (and the zero-baseline
+    # rule matters here: a healthy run preempts nothing, so
+    # preempted_time_frac regressing from 0.0 must flag even though
+    # the percentage is undefined)
+    "serve_queue_wait_p99_s": (+1, "ratio"),
+    "serve_preempted_time_frac": (+1, "ratio"),
 }
 
 
@@ -354,7 +363,8 @@ def _report_scalars(report: dict) -> dict:
     for key in ("ttft_p50_s", "ttft_p99_s", "e2e_p50_s", "e2e_p99_s",
                 "decode_tokens_per_sec", "preemptions",
                 "acceptance_rate", "cache_hit_rate",
-                "kv_bytes_read_per_step"):
+                "kv_bytes_read_per_step", "queue_wait_p99_s",
+                "preempted_time_frac"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out

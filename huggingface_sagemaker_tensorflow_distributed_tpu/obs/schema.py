@@ -105,7 +105,44 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               "kernel": (str,),
               "kv_dtype": (str,),
               "kv_bytes_read": (int,),
-              "kv_bytes_read_per_step": _NUM},
+              "kv_bytes_read_per_step": _NUM,
+              # request-lifecycle tracing (ISSUE 10): the
+              # `request_timeline` event's five-way phase decomposition
+              # (queue + prefill + decode + preempted + overhead sums
+              # to e2e) + coalesced segment list, the per-iteration
+              # `iteration_ledger` fields, and the per-tenant grouping
+              # key — all host-side stamps, all typed when present so
+              # a drifted emitter can't poison `obsctl timeline|slo|
+              # tail` silently
+              "at": (str,),
+              "group": (str,),
+              "e2e_s": _NUM,
+              "ttft_s": _NUM,
+              "queue_s": _NUM,
+              "prefill_s": _NUM,
+              "decode_s": _NUM,
+              "preempted_s": _NUM,
+              "overhead_s": _NUM,
+              "segments": (list,),
+              "tokens": (int,),
+              "prompt_len": (int,),
+              "preemptions": (int,),
+              "blocked_iters": (int,),
+              "blocked_reason": (str,),
+              "iteration": (int,),
+              "dur_s": _NUM,
+              "prefill_chunks": (int,),
+              "prefill_dispatches": (int,),
+              "decode_slots": (int,),
+              "waiting": (int,),
+              "kv_used_frac": _NUM,
+              "queue_wait_p50_s": _NUM,
+              "queue_wait_p99_s": _NUM,
+              "queue_time_frac": _NUM,
+              "prefill_time_frac": _NUM,
+              "decode_time_frac": _NUM,
+              "preempted_time_frac": _NUM,
+              "overhead_time_frac": _NUM},
 }
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)

@@ -97,7 +97,19 @@ even across recompute preemption — the seeded-determinism gate.
 Telemetry: ``serve`` events (``obs/schema.py``) for request lifecycle
 (submit/admit/first_token/finish/preempt, submit carrying ``sampled``)
 plus ``bucket_switch`` events, spans around every prefill and decode
-dispatch, and pool-utilization/read-waste metrics.
+dispatch, and pool-utilization/read-waste metrics. With ``timeline``
+on (``HSTD_SERVE_TIMELINE``, default on — ISSUE 10) the engine
+additionally stamps each request's phase transitions host-side and
+emits a compact ``request_timeline`` event at finish/preempt-requeue
+(queue / prefill / decode / preempted / overhead decomposition that
+sums to e2e, plus a coalesced per-dispatch segment list: per-chunk
+prefill incl. cached-prefix skip, per-iteration decode runs keyed by
+gather bucket, speculative window acceptance, COW copies, admission
+-block attribution) and a per-iteration ``iteration_ledger`` event
+(phase mix, bucket, slots, tokens, pool pressure) — the inputs
+``obsctl timeline|slo|tail`` reconstruct. All stamps are host-side
+``perf_counter`` reads: the accounting mints zero compiled variants,
+and ``timeline='off'`` is byte-identical to the pre-tracing stream.
 """
 
 from __future__ import annotations
@@ -138,6 +150,7 @@ ENV_DRAFT_LAYERS = "HSTD_SERVE_DRAFT_LAYERS"
 ENV_PREFIX_CACHE = "HSTD_SERVE_PREFIX_CACHE"
 ENV_KERNEL = "HSTD_SERVE_KERNEL"
 ENV_KV_DTYPE = "HSTD_SERVE_KV_DTYPE"
+ENV_TIMELINE = "HSTD_SERVE_TIMELINE"
 
 
 def parse_kernel(spec: Union[str, None]) -> str:
@@ -170,12 +183,13 @@ def parse_kv_dtype(spec: Union[str, None], model_default: str) -> str:
     return s
 
 
-def parse_prefix_cache(spec: Union[str, bool, None]) -> bool:
-    """The ``prefix_cache`` knob: None reads ``HSTD_SERVE_PREFIX_CACHE``
-    (default ON — templated traffic is the common case); accepts
-    bool or the CLI/env spellings on/off/1/0/true/false."""
+def _parse_on_off(spec: Union[str, bool, None], env_var: str,
+                  default: str = "on") -> bool:
+    """Shared on/off knob parser: None reads ``env_var`` (falling back
+    to ``default``); accepts bool or the CLI/env spellings
+    on/off/1/0/true/false."""
     if spec is None:
-        spec = os.environ.get(ENV_PREFIX_CACHE, "on")
+        spec = os.environ.get(env_var, default)
     if isinstance(spec, bool):
         return spec
     s = str(spec).strip().lower()
@@ -183,8 +197,25 @@ def parse_prefix_cache(spec: Union[str, bool, None]) -> bool:
         return True
     if s in ("off", "0", "false", "no"):
         return False
-    raise ValueError(f"unparseable {ENV_PREFIX_CACHE} value {spec!r}: "
+    raise ValueError(f"unparseable {env_var} value {spec!r}: "
                      "expected on/off")
+
+
+def parse_prefix_cache(spec: Union[str, bool, None]) -> bool:
+    """The ``prefix_cache`` knob: None reads ``HSTD_SERVE_PREFIX_CACHE``
+    (default ON — templated traffic is the common case)."""
+    return _parse_on_off(spec, ENV_PREFIX_CACHE)
+
+
+def parse_timeline(spec: Union[str, bool, None]) -> bool:
+    """The ``timeline`` knob (ISSUE 10): per-request lifecycle tracing
+    — phase stamps, ``request_timeline`` events at finish/preempt, and
+    the per-iteration ``iteration_ledger`` event. None reads
+    ``HSTD_SERVE_TIMELINE`` (default ON — the stamps are host-side
+    ``perf_counter`` reads, so the serving hot path mints zero new
+    compiled variants either way); ``off`` makes the engine's telemetry
+    byte-identical to the pre-tracing stream."""
+    return _parse_on_off(spec, ENV_TIMELINE)
 
 
 def parse_gather_buckets(spec: Union[str, Sequence[int], None],
@@ -757,7 +788,13 @@ class ServeEngine:
     ``kv_cache_dtype='int8'`` (params untouched) and the exactness
     contract moves to ``generate_causal`` on that same config.
     ``kv_pool_bytes`` sizes ``num_blocks`` from a KV memory budget
-    (``1 + budget // block_bytes``) instead of a block count."""
+    (``1 + budget // block_bytes``) instead of a block count.
+
+    ``timeline`` (None reads ``HSTD_SERVE_TIMELINE``, default on)
+    turns on per-request lifecycle tracing: ``request_timeline`` +
+    ``iteration_ledger`` telemetry events from host-side phase stamps
+    (zero new compiled variants; ``off`` restores the pre-tracing
+    telemetry byte-for-byte)."""
 
     #: consecutive iterations a smaller bucket must suffice before the
     #: engine shrinks to it — bounds bucket churn when the max resident
@@ -775,7 +812,8 @@ class ServeEngine:
                  prefix_cache: Union[str, bool, None] = None,
                  kernel: Union[str, None] = None,
                  kv_cache_dtype: Union[str, None] = None,
-                 kv_pool_bytes: Optional[int] = None):
+                 kv_pool_bytes: Optional[int] = None,
+                 timeline: Union[str, bool, None] = None):
         cfg = model.config
         if getattr(cfg, "num_experts", 0):
             raise ValueError(
@@ -822,6 +860,7 @@ class ServeEngine:
             raise ValueError(f"speculate_k must be >= 0, "
                              f"got {self.speculate_k}")
         self.prefix_cache = parse_prefix_cache(prefix_cache)
+        self.timeline = parse_timeline(timeline)
         plan, pool_shapes = build_cache_plan(model, params,
                                              self.max_model_len)
         self._plan = plan
@@ -921,20 +960,30 @@ class ServeEngine:
         self._bucket = self.gather_buckets[0]
         self._shrink_streak = 0
         self._warmed_modes: set = set()
+        # lifecycle tracing (ISSUE 10): per-iteration dispatch-time
+        # accumulators the iteration_ledger event reads (reset each
+        # step; populated only with `timeline` on)
+        self._iter_prefill_s = 0.0
+        self._iter_decode_s = 0.0
+        self._iter_decode_slots = 0
 
     # -- public API ----------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0,
-               top_p: float = 0.0, seed: int = 0) -> Request:
+               top_p: float = 0.0, seed: int = 0,
+               group: str = "") -> Request:
         """Queue one request. ``temperature == 0`` (default) is greedy;
         ``temperature > 0`` samples with the given truncation knobs,
         seeded per request — same knob semantics as
-        ``models.generate.generate_causal``."""
+        ``models.generate.generate_causal``. ``group`` is an opaque
+        tag (tenant, route) the request's ``request_timeline`` event
+        carries so SLO attribution can aggregate per group."""
         req = Request(prompt=np.asarray(prompt, np.int32),
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_k=int(top_k),
-                      top_p=float(top_p), seed=int(seed))
+                      top_p=float(top_p), seed=int(seed),
+                      group=str(group))
         req.submit_t = time.perf_counter()
         self.sched.submit(req)
         if req.sampled:
@@ -1093,6 +1142,26 @@ class ServeEngine:
             percentile,
         )
 
+        if self.timeline:
+            # lifecycle decomposition aggregates (ISSUE 10): queue-wait
+            # percentiles and run-wide phase-time fractions over the
+            # finished requests — the live decision inputs SLO-aware
+            # admission needs, and the figures `obsctl diff` gates on
+            # (absent entirely with the timeline off, keeping the
+            # report event byte-identical to the pre-tracing stream)
+            qs = sorted(r.phase_s["queue"] for r in reqs)
+            out["queue_wait_p50_s"] = round(percentile(qs, 0.50), 6)
+            out["queue_wait_p99_s"] = round(percentile(qs, 0.99), 6)
+            tot = sum(e2es)
+            if tot > 0:
+                sums = {ph: sum(r.phase_s[ph] for r in reqs)
+                        for ph in ("queue", "prefill", "decode",
+                                   "preempted")}
+                for ph, v in sums.items():
+                    out[f"{ph}_time_frac"] = round(v / tot, 4)
+                out["overhead_time_frac"] = round(
+                    1.0 - sum(sums.values()) / tot, 4)
+
         if self.prefix_cache:
             cached = sum(r.prefix_cached_tokens for r in reqs)
             admitted = sum(r.prefix_prompt_tokens for r in reqs)
@@ -1192,14 +1261,41 @@ class ServeEngine:
 
     def step(self) -> None:
         """Admit → batched prefill under the token budget → one decode
-        step over all slots at the iteration's gather bucket."""
+        step over all slots at the iteration's gather bucket. With
+        ``timeline`` on, every phase transition is stamped host-side
+        (queue→prefill at admission, preemption intervals at eviction)
+        and one ``iteration_ledger`` event records the iteration's
+        phase mix — all ``perf_counter`` arithmetic, zero new compiled
+        variants."""
+        t_iter0 = time.perf_counter()
+        tokens0 = self.tokens_generated
+        chunks0, disp0 = self.prefill_chunks, self.prefill_dispatches
+        self._iter_prefill_s = 0.0
+        self._iter_decode_s = 0.0
+        self._iter_decode_slots = 0
         for slot in self.sched.admit():
+            n_cow = len(slot.pending_copies)
+            if self.timeline:
+                # stamp BEFORE the COW copies run: the queue/preempted
+                # interval ends at admission, and the copy dispatches
+                # land in overhead (the documented contract)
+                self._stamp_admit(slot, n_cow)
             self._apply_cow(slot)
             extra = {}
             if self.prefix_cache:
                 extra["prefix_cached_tokens"] = slot.prefill_pos
             obs.serve("admit", request=slot.request.rid, slot=slot.index,
                       queue_depth=len(self.sched.waiting), **extra)
+        if self.timeline and self.sched.waiting:
+            # admission-block attribution: FIFO means only the HEAD of
+            # the queue is ever capacity-blocked (everyone behind it is
+            # blocked BY it) — name why it is still waiting
+            head = self.sched.waiting[0]
+            head.blocked_iters += 1
+            head.blocked_reason = (
+                "no_free_slot"
+                if all(not s.free for s in self.sched.slots)
+                else "kv_capacity")
         self.peak_resident = max(
             self.peak_resident,
             sum(1 for s in self.sched.slots if not s.free))
@@ -1217,6 +1313,12 @@ class ServeEngine:
         for req in self.sched.ensure_decode_capacity():
             obs.serve("preempt", request=req.rid,
                       reason="kv_pool_exhausted")
+            if self.timeline:
+                # the preempted interval runs from here to re-admission;
+                # emit the partial timeline NOW so a request that never
+                # comes back (a killed run) still left its history
+                req.preempt_t = time.perf_counter()
+                self._emit_timeline(req, "preempt", req.preempt_t)
         self._decode_all()
         # per-iteration scheduler gauges (SLO telemetry): queue pressure
         # and slot occupancy as series, one sample per engine iteration
@@ -1230,6 +1332,24 @@ class ServeEngine:
                        self.iterations)
             obs.scalar("serve/gather_bucket", self._bucket,
                        self.iterations)
+            if self.timeline:
+                # the engine ledger: one line per iteration with the
+                # phase mix (prefill vs decode dispatch seconds inside
+                # the iteration wall), the bucket, the slot/token
+                # throughput, and pool pressure — what `obsctl tail`
+                # follows live
+                obs.serve(
+                    "iteration_ledger", iteration=self.iterations,
+                    dur_s=round(time.perf_counter() - t_iter0, 6),
+                    prefill_s=round(self._iter_prefill_s, 6),
+                    decode_s=round(self._iter_decode_s, 6),
+                    gather_bucket=self._bucket,
+                    prefill_chunks=self.prefill_chunks - chunks0,
+                    prefill_dispatches=self.prefill_dispatches - disp0,
+                    decode_slots=self._iter_decode_slots,
+                    tokens=self.tokens_generated - tokens0,
+                    waiting=waiting,
+                    kv_used_frac=round(self.blocks.utilization(), 4))
         self.iterations += 1
 
     def _select_bucket(self, need: int) -> int:
@@ -1302,6 +1422,7 @@ class ServeEngine:
                     top_ps[i] = req.top_p
                     keys[i] = self._keys[req.rid]
                     folds[i] = self._generated(req)
+        t0 = time.perf_counter()
         with obs.span("serve/prefill_chunk",
                       {"chunks": len(slots)} if obs.has_sink() else None):
             tok, self._pools = self._prefill_fn(
@@ -1316,6 +1437,14 @@ class ServeEngine:
                     self.draft_model, self.draft_params, self._d_pools,
                     chunks, tables, start, rel, temps, top_ks, top_ps,
                     keys, folds, self._d_plan, False)
+        if self.timeline:
+            # dispatch-enqueue wall time (an async backend's device
+            # wait surfaces at the next sync and lands in overhead —
+            # attribution stays disjoint, never double-counted)
+            dur = time.perf_counter() - t0
+            self._iter_prefill_s += dur
+            for slot in slots:
+                self._accrue_prefill(slot, t0, dur)
         for slot in slots:
             slot.prefill_pos += C
         self.prefill_chunks += len(slots)
@@ -1401,11 +1530,17 @@ class ServeEngine:
                 ctx, active, temps, top_ks, top_ps, keys, folds,
                 self._plan, bucket, sampled)
             nxt = np.asarray(jax.device_get(nxt))
-        self.decode_time_s += time.perf_counter() - t0
+        dur = time.perf_counter() - t0
+        self.decode_time_s += dur
         self.decode_steps += 1
         self.decode_tokens += len(ds)
+        if self.timeline:
+            self._iter_decode_s += dur
+            self._iter_decode_slots = len(ds)
         for slot in ds:
             slot.context_len += 1        # the fed token's K/V landed
+            if self.timeline:
+                self._accrue_decode(slot.request, t0, dur, bucket, 1)
             self._append(slot, int(nxt[slot.index]))
 
     def _decode_all_spec(self) -> None:
@@ -1476,9 +1611,13 @@ class ServeEngine:
             drafts = np.asarray(jax.device_get(drafts))
             n_acc = np.asarray(jax.device_get(n_acc))
             bonus = np.asarray(jax.device_get(bonus))
-        self.decode_time_s += time.perf_counter() - t0
+        dur = time.perf_counter() - t0
+        self.decode_time_s += dur
         self.decode_steps += 1
         self.spec_windows += len(ds)
+        if self.timeline:
+            self._iter_decode_s += dur
+            self._iter_decode_slots = len(ds)
         committed = []
         for slot in ds:
             req = slot.request
@@ -1488,6 +1627,11 @@ class ServeEngine:
             self.draft_accepted += acc
             req.spec_proposed += k
             req.spec_accepted += acc
+            if self.timeline:
+                # committed-token count lands below, one bump per
+                # append (the finish emission inside _append must see
+                # the segment current)
+                self._accrue_decode(req, t0, dur, bucket, 0, k, acc)
             window = [int(drafts[i, j]) for j in range(acc)]
             window.append(int(bonus[i]))
             j = 0
@@ -1495,6 +1639,8 @@ class ServeEngine:
                 j += 1
                 slot.context_len += 1    # this token's K/V is resident
                 self.decode_tokens += 1
+                if self.timeline:
+                    req.segments[-1]["tokens"] += 1
                 self._append(slot, tok)
                 if req.rid in self.finished:
                     break                # EOS / budget: drop the rest
@@ -1504,6 +1650,132 @@ class ServeEngine:
                 # now holding only stale K/V) go back to the free list
                 self.blocks.trim(slot.table, slot.context_len)
         self.blocks.note_verify(committed, k + 1)
+
+    # -- lifecycle tracing (ISSUE 10) ----------------------------------------
+    #
+    # All host-side perf_counter stamps: the decomposition the
+    # `request_timeline` event carries is CHECKABLE — queue + prefill +
+    # decode + preempted + overhead sums to the request's e2e (overhead
+    # is the derived remainder: host scheduling, COW copies, and the
+    # stall a resident request pays for dispatches it did not ride, e.g.
+    # a decoding slot waiting out another request's prefill chunk).
+    # Dispatch durations are attributed to EVERY request riding the
+    # dispatch (they run concurrently — this is per-request latency
+    # attribution, not a wall-clock partition across requests), and each
+    # request's attributed intervals are disjoint in wall time, so its
+    # phase sum can never exceed e2e (negative overhead = accounting
+    # bug, which `obs.timeline.check_decomposition` flags).
+
+    def _stamp_admit(self, slot, n_cow: int) -> None:
+        """Close the request's queue (first admission) or preempted
+        (re-admission) interval and record its segment — with the
+        cached-prefix skip, admission-block attribution, and COW-copy
+        count riding as extras."""
+        req = slot.request
+        now = time.perf_counter()
+        if req.preempt_t is not None:
+            phase, t_from = "preempted", req.preempt_t
+        else:
+            phase, t_from = "queue", req.submit_t
+        dt = max(now - t_from, 0.0)
+        req.phase_s[phase] += dt
+        seg = {"ph": phase, "t0": t_from - req.submit_t, "dur": dt}
+        if slot.prefill_pos:
+            # prefix-cache hit: prefill starts past the cached span
+            seg["cached_tokens"] = int(slot.prefill_pos)
+        if req.blocked_iters:
+            seg["blocked_iters"] = req.blocked_iters
+            seg["blocked_reason"] = req.blocked_reason
+            req.blocked_iters = 0
+        req.segments.append(seg)
+        req.preempt_t = None
+        req.cow_copies += n_cow
+
+    def _accrue_prefill(self, slot, t0: float, dur: float) -> None:
+        """Attribute one prefill dispatch's wall time to a riding slot;
+        consecutive chunks coalesce into one segment (dur accumulates
+        dispatch time only — host gaps between chunks stay overhead)."""
+        req = slot.request
+        req.phase_s["prefill"] += dur
+        last = req.segments[-1] if req.segments else None
+        if last is not None and last["ph"] == "prefill":
+            last["dur"] += dur
+            last["chunks"] += 1
+        else:
+            req.segments.append({"ph": "prefill",
+                                 "t0": t0 - req.submit_t, "dur": dur,
+                                 "from": int(slot.prefill_pos),
+                                 "chunks": 1})
+
+    def _accrue_decode(self, req: Request, t0: float, dur: float,
+                       bucket: int, tokens: int, proposed: int = 0,
+                       accepted: int = 0) -> None:
+        """Attribute one decode dispatch to a riding request.
+        Consecutive iterations at the SAME gather bucket coalesce into
+        one segment run (per-iteration granularity is preserved exactly
+        where it matters — a bucket switch starts a new run); a
+        speculative engine's runs additionally carry the window
+        acceptance counts."""
+        req.phase_s["decode"] += dur
+        last = req.segments[-1] if req.segments else None
+        if (last is not None and last["ph"] == "decode"
+                and last["bucket"] == bucket):
+            last["dur"] += dur
+            last["iters"] += 1
+            last["tokens"] += tokens
+            if self.speculative:
+                last["proposed"] += proposed
+                last["accepted"] += accepted
+        else:
+            seg = {"ph": "decode", "t0": t0 - req.submit_t, "dur": dur,
+                   "bucket": int(bucket), "iters": 1, "tokens": tokens}
+            if self.speculative:
+                seg["proposed"] = proposed
+                seg["accepted"] = accepted
+            req.segments.append(seg)
+
+    def _emit_timeline(self, req: Request, at: str,
+                       now: Optional[float] = None) -> None:
+        """One compact ``request_timeline`` event: the five-way phase
+        decomposition plus the coalesced segment list. Emitted at
+        finish (complete) and at preempt-requeue (partial, ``at`` says
+        which — consumers keep the LAST event per request)."""
+        if not (self.timeline and obs.has_sink()):
+            return
+        end = req.finish_t if at == "finish" else now
+        e2e = max(end - req.submit_t, 0.0)
+        q = req.phase_s["queue"]
+        pf = req.phase_s["prefill"]
+        dc = req.phase_s["decode"]
+        pe = req.phase_s["preempted"]
+        segs = [{k: (round(v, 6) if isinstance(v, float) else v)
+                 for k, v in s.items()} for s in req.segments]
+        fields = {
+            "request": req.rid, "at": at,
+            "e2e_s": round(e2e, 6),
+            "queue_s": round(q, 6),
+            "prefill_s": round(pf, 6),
+            "decode_s": round(dc, 6),
+            "preempted_s": round(pe, 6),
+            "overhead_s": round(e2e - (q + pf + dc + pe), 6),
+            "tokens": self._generated(req),
+            "prompt_len": req.orig_prompt_len,
+            "preemptions": req.preemptions,
+            "segments": segs,
+        }
+        if req.ttft_s is not None:
+            fields["ttft_s"] = round(req.ttft_s, 6)
+        if req.group:
+            fields["group"] = req.group
+        if req.cow_copies:
+            fields["cow_copies"] = req.cow_copies
+        if self.prefix_cache:
+            fields["prefix_cached_tokens"] = req.prefix_cached_tokens
+        # admission-block attribution rides the queue/preempted
+        # SEGMENTS (closed by _stamp_admit) — emission here happens
+        # only at finish or at the preempt instant, when the request
+        # was resident and blocked_iters is necessarily 0
+        obs.serve("request_timeline", **fields)
 
     # -- helpers -------------------------------------------------------------
 
@@ -1559,3 +1831,4 @@ class ServeEngine:
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
                       preemptions=req.preemptions, **extra)
+            self._emit_timeline(req, "finish")

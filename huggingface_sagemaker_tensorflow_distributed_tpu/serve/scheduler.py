@@ -87,6 +87,21 @@ class Request:
     # the per-request cache_hit_rate the finish event carries
     prefix_cached_tokens: int = 0
     prefix_prompt_tokens: int = 0
+    # lifecycle tracing (ISSUE 10): the engine stamps host-side phase
+    # accounting here when its `timeline` knob is on — wall seconds per
+    # phase (queue / prefill / decode / preempted; overhead is derived
+    # at emission) and the compact coalesced segment list the
+    # `request_timeline` telemetry event carries. `group` is an opaque
+    # caller-supplied key (tenant, route, experiment arm) the SLO
+    # attribution report aggregates by.
+    group: str = ""
+    phase_s: dict = field(default_factory=lambda: {
+        "queue": 0.0, "prefill": 0.0, "decode": 0.0, "preempted": 0.0})
+    segments: list = field(default_factory=list)
+    preempt_t: Optional[float] = None
+    blocked_iters: int = 0
+    blocked_reason: Optional[str] = None
+    cow_copies: int = 0
     # recompute preemption folds generated tokens back into the prompt;
     # this keeps the ORIGINAL prompt length so output accounting and
     # first-token semantics survive a preemption
@@ -106,6 +121,8 @@ class Request:
             raise ValueError("top_p must be in [0, 1]")
         if self.top_k < 0:
             raise ValueError("top_k must be >= 0")
+        if not isinstance(self.group, str):
+            raise ValueError("group must be a string")
 
     @property
     def sampled(self) -> bool:

@@ -14,6 +14,17 @@ Usage::
     # threshold in its worse direction (count metrics — anomalies,
     # compiles, preemptions — regress on ANY increase)
     python scripts/obsctl.py diff baseline.json candidate.json --threshold-pct 5
+    # per-request lifecycle Gantt rows from request_timeline events,
+    # plus a Chrome-trace export (load in Perfetto / chrome://tracing)
+    python scripts/obsctl.py timeline telemetry/ --trace serve_trace.json
+    # SLO attribution: which phase the tail requests burned their
+    # budget in (queue / prefill / decode / preempted / overhead),
+    # aggregated per request group (the per-tenant hook)
+    python scripts/obsctl.py slo telemetry/ --percentile 99 --text
+    # follow a LIVE events.jsonl: rolling waiting-depth / KV-pressure /
+    # decode tokens/sec / TTFT percentiles over a sliding window,
+    # reading only what was appended since the last poll
+    python scripts/obsctl.py tail telemetry/events.jsonl --window 64
 
 ``report`` merges every ``events.jsonl`` it finds under the given
 paths (a run dir, per-host dirs, or dirs of per-host subdirs) into one
@@ -112,6 +123,139 @@ def cmd_validate(args: argparse.Namespace) -> int:
     return check_main(args.paths)
 
 
+def _load_timelines(paths) -> "tuple[list[dict], int]":
+    """(records, rc): strictly load + fold request_timeline events;
+    rc 1 with stderr diagnostics on malformed/inconsistent input (a
+    timeline built from a half-trusted stream is worse than none)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.timeline import (
+        check_decomposition,
+        collect_timelines,
+        load_events,
+    )
+
+    events, errors = load_events(paths)
+    if errors:
+        for e in errors[:20]:
+            print(f"obsctl: {e}", file=sys.stderr)
+        if len(errors) > 20:
+            print(f"obsctl: ... and {len(errors) - 20} more",
+                  file=sys.stderr)
+        return [], 1
+    records = collect_timelines(events)
+    problems = [m for rec in records for m in check_decomposition(rec)]
+    if problems:
+        for p in problems[:20]:
+            print(f"obsctl: inconsistent timeline: {p}", file=sys.stderr)
+        return [], 1
+    return records, 0
+
+
+def cmd_timeline(args: argparse.Namespace) -> int:
+    """Per-request Gantt reconstruction + Chrome-trace export. Output
+    is deterministic (byte-identical across input orderings); exit 1 on
+    malformed input or no request_timeline events."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.timeline import (
+        gantt_text,
+        write_chrome_trace,
+    )
+
+    if args.width < 4:
+        print(f"obsctl: --width must be >= 4, got {args.width}",
+              file=sys.stderr)
+        return 1
+    records, rc = _load_timelines(args.paths)
+    if rc:
+        return rc
+    if not records:
+        print("obsctl: no request_timeline events (serve run with "
+              "HSTD_SERVE_TIMELINE=off, or not a serve run?)",
+              file=sys.stderr)
+        return 1
+    if args.trace:
+        write_chrome_trace(records, args.trace)
+        print(f"obsctl: wrote {args.trace}", file=sys.stderr)
+    if args.json:
+        json.dump(records, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(gantt_text(records, width=args.width))
+    return 0
+
+
+def cmd_slo(args: argparse.Namespace) -> int:
+    """SLO attribution: phase decomposition of the latency tail, per
+    group — same strict-input and determinism contract as timeline."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.timeline import (
+        render_slo_text,
+        slo_attribution,
+    )
+
+    if not 0 < args.percentile <= 100:
+        print(f"obsctl: --percentile must be in (0, 100], got "
+              f"{args.percentile}", file=sys.stderr)
+        return 1
+    records, rc = _load_timelines(args.paths)
+    if rc:
+        return rc
+    if not records:
+        print("obsctl: no request_timeline events to attribute",
+              file=sys.stderr)
+        return 1
+    doc = slo_attribution(records, pct=args.percentile / 100.0)
+    if args.text:
+        sys.stdout.write(render_slo_text(doc))
+    else:
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    return 0
+
+
+def cmd_tail(args: argparse.Namespace) -> int:
+    """Follow a live events.jsonl: each poll reads only the appended
+    suffix (the prefix is never re-read), updates the sliding-window
+    gauges, and prints one line per poll that saw new events. Exits
+    after ``--updates`` lines (0 = follow forever), or rc 1 the moment
+    a malformed complete line lands."""
+    import time as _time
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.timeline import (
+        TailFollower,
+        TailStats,
+    )
+
+    if args.window < 1:
+        print(f"obsctl: --window must be >= 1, got {args.window}",
+              file=sys.stderr)
+        return 1
+    if args.interval < 0:
+        print(f"obsctl: --interval must be >= 0, got {args.interval}",
+              file=sys.stderr)
+        return 1
+    if not os.path.isfile(args.path):
+        print(f"obsctl: no such file {args.path}", file=sys.stderr)
+        return 1
+    follower = TailFollower(args.path)
+    stats = TailStats(window=args.window)
+    updates = 0
+    try:
+        while True:
+            events, errors = follower.poll()
+            if errors:
+                for e in errors[:20]:
+                    print(f"obsctl: {e}", file=sys.stderr)
+                return 1
+            if events:
+                for e in events:
+                    stats.update(e)
+                print(stats.render(), flush=True)
+                updates += 1
+                if args.updates and updates >= args.updates:
+                    return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="obsctl", description=__doc__)
     sub = parser.add_subparsers(dest="command", required=True)
@@ -144,6 +288,48 @@ def main(argv: list[str] | None = None) -> int:
                               "(check_telemetry_schema)")
     val.add_argument("paths", nargs="+")
     val.set_defaults(func=cmd_validate)
+
+    tim = sub.add_parser("timeline",
+                         help="per-request lifecycle Gantt rows + "
+                              "Chrome-trace export from "
+                              "request_timeline events")
+    tim.add_argument("paths", nargs="+",
+                     help="telemetry dir(s) or event files")
+    tim.add_argument("--trace", default=None,
+                     help="also write a Chrome-trace JSON here "
+                          "(Perfetto / chrome://tracing)")
+    tim.add_argument("--json", action="store_true",
+                     help="raw timeline records as JSON instead of "
+                          "the Gantt rendering")
+    tim.add_argument("--width", type=int, default=48,
+                     help="Gantt row width in cells (default 48)")
+    tim.set_defaults(func=cmd_timeline)
+
+    slo = sub.add_parser("slo",
+                         help="SLO attribution: which phase the "
+                              "latency tail burned its budget in, "
+                              "per request group")
+    slo.add_argument("paths", nargs="+",
+                     help="telemetry dir(s) or event files")
+    slo.add_argument("--percentile", type=float, default=99.0,
+                     help="tail threshold percentile (default 99)")
+    slo.add_argument("--text", action="store_true",
+                     help="readable rendering instead of JSON")
+    slo.set_defaults(func=cmd_slo)
+
+    tail = sub.add_parser("tail",
+                          help="follow a live events.jsonl: rolling "
+                               "waiting-depth/KV-pressure/tokens-per-"
+                               "sec/TTFT over a sliding window")
+    tail.add_argument("path", help="an events.jsonl being appended to")
+    tail.add_argument("--window", type=int, default=64,
+                      help="sliding-window sample count (default 64)")
+    tail.add_argument("--interval", type=float, default=0.5,
+                      help="poll interval seconds (default 0.5)")
+    tail.add_argument("--updates", type=int, default=0,
+                      help="exit after N update lines (0 = follow "
+                           "forever)")
+    tail.set_defaults(func=cmd_tail)
 
     args = parser.parse_args(argv)
     return args.func(args)

@@ -29,6 +29,12 @@ One JSON line per finished request (ids, TTFT, decode tokens/sec), then
 one summary line (aggregate tokens/sec, TTFT percentiles, KV-pool peak
 utilization, preemptions). With ``HSTD_TELEMETRY_DIR`` set, the engine
 additionally streams ``serve`` lifecycle events + spans through ``obs``.
+``--timeline on`` (``HSTD_SERVE_TIMELINE``, default on) adds
+per-request lifecycle tracing: each output row carries its phase
+decomposition (queue/prefill/decode/preempted seconds), the summary the
+run-wide phase fractions + queue-wait p99, and the telemetry stream the
+``request_timeline``/``iteration_ledger`` events that ``obsctl
+timeline|slo|tail`` consume.
 """
 
 from __future__ import annotations
@@ -172,6 +178,13 @@ def main() -> None:
                         help="KV pool storage; int8 halves pool bytes "
                              "per decode step (default: "
                              "HSTD_SERVE_KV_DTYPE or the model config)")
+    parser.add_argument("--timeline", default=None,
+                        choices=("on", "off"),
+                        help="per-request lifecycle tracing "
+                             "(request_timeline/iteration_ledger "
+                             "events + phase decomposition in the "
+                             "summary; default: HSTD_SERVE_TIMELINE "
+                             "or on)")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="0 = greedy (the default); > 0 samples")
     parser.add_argument("--top_k", type=int, default=0)
@@ -203,7 +216,8 @@ def main() -> None:
                          draft=args.draft_layers,
                          prefix_cache=args.prefix_cache,
                          kernel=args.kernel,
-                         kv_cache_dtype=args.kv_cache_dtype)
+                         kv_cache_dtype=args.kv_cache_dtype,
+                         timeline=args.timeline)
     trace = load_trace(args, model.config.vocab_size - 1)
     # precompile the sampled step variants too when the trace will
     # sample, so no request pays a mid-serve compile
@@ -230,6 +244,11 @@ def main() -> None:
                 if req.spec_proposed else None)
         if engine.prefix_cache:
             row["prefix_cached_tokens"] = req.prefix_cached_tokens
+        if engine.timeline:
+            # the request's own phase decomposition (what its
+            # request_timeline telemetry event carries in full)
+            row["phase_s"] = {ph: round(v, 4)
+                              for ph, v in req.phase_s.items()}
         print(json.dumps(row))
     stats = engine.stats()
     # SLO summary from the engine's own accounting (the same figures
@@ -272,6 +291,13 @@ def main() -> None:
         "blocks_saved_peak": (stats.blocks_saved_peak
                               if engine.prefix_cache else None),
         "cow_copies": stats.cow_copies if engine.prefix_cache else None,
+        "timeline": engine.timeline,
+        "queue_wait_p99_s": slo.get("queue_wait_p99_s"),
+        "queue_time_frac": slo.get("queue_time_frac"),
+        "prefill_time_frac": slo.get("prefill_time_frac"),
+        "decode_time_frac": slo.get("decode_time_frac"),
+        "preempted_time_frac": slo.get("preempted_time_frac"),
+        "overhead_time_frac": slo.get("overhead_time_frac"),
         "kernel": stats.kernel,
         "kv_dtype": stats.kv_dtype,
         "kv_bytes_read_per_step": (round(

@@ -203,6 +203,11 @@ _SLOW_TESTS = {
     "test_serve.py::test_kv_pool_bytes_doubles_int8_admission",
     "test_serve.py::test_engine_sliding_window_pallas_int8_llama",
     "test_serve.py::test_engine_int8_composes_with_speculative_and_prefix",
+    # ISSUE 10 offset: the speculative x prefix-cache COMPOSITION gate
+    # (17s) moves out of tier-1 to pay for the new timeline gates —
+    # the CORE prefix-cache acceptance gates (forced COW, preemption
+    # of a sharing request) stay tier-1 per the PR 3/5/7/8 precedent
+    "test_serve.py::test_prefix_cache_speculative_serve_exact",
 }
 
 

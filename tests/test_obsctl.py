@@ -88,7 +88,12 @@ def three_hosts(tmp_path):
                               e2e_p95_s=0.9, e2e_p99_s=1.2,
                               speculate_k=4, acceptance_rate=0.72,
                               prefix_cache=True, cache_hit_rate=0.9,
-                              blocks_shared_peak=40))
+                              blocks_shared_peak=40,
+                              queue_wait_p50_s=0.1,
+                              queue_wait_p99_s=0.8,
+                              queue_time_frac=0.2,
+                              decode_time_frac=0.7,
+                              preempted_time_frac=0.05))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -399,6 +404,68 @@ def test_diff_cache_hit_rate_is_a_ratio_metric(three_hosts):
     slight["serve"]["cache_hit_rate"] = 0.88       # ~-2.2%
     assert "serve_cache_hit_rate" not in diff_reports(
         base, slight, 5.0)["regressions"]
+
+
+def test_diff_queue_wait_and_preempted_frac_are_up_worse(three_hosts):
+    """ISSUE 10: `serve_queue_wait_p99_s` and
+    `serve_preempted_time_frac` diff as time/ratio metrics whose worse
+    direction is UP — an admission-policy or pool-sizing regression
+    shows up in the lifecycle decomposition before the aggregate e2e
+    percentiles move. Standard threshold + zero-baseline rules."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["queue_wait_p99_s"] == pytest.approx(0.8)
+    worse = copy.deepcopy(base)
+    worse["serve"]["queue_wait_p99_s"] = 2.4
+    worse["serve"]["preempted_time_frac"] = 0.3
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_queue_wait_p99_s" in d["regressions"]
+    assert "serve_preempted_time_frac" in d["regressions"]
+    assert d["metrics"]["serve_queue_wait_p99_s"]["worse_direction"] \
+        == "up"
+    # the better direction never flags; a sub-threshold drift neither
+    assert not {"serve_queue_wait_p99_s", "serve_preempted_time_frac"} \
+        & set(diff_reports(worse, base, 5.0)["regressions"])
+    slight = copy.deepcopy(base)
+    slight["serve"]["queue_wait_p99_s"] = 0.82      # +2.5%
+    assert "serve_queue_wait_p99_s" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # zero baseline: a healthy run preempts nothing, so ANY preempted
+    # time appearing must flag even though the pct is undefined
+    zero = copy.deepcopy(base)
+    zero["serve"]["preempted_time_frac"] = 0.0
+    worse0 = copy.deepcopy(zero)
+    worse0["serve"]["preempted_time_frac"] = 0.08
+    d0 = diff_reports(zero, worse0, threshold_pct=5.0)
+    assert "serve_preempted_time_frac" in d0["regressions"]
+    assert d0["metrics"]["serve_preempted_time_frac"]["pct"] is None
+
+
+def test_diff_poisoned_lifecycle_metrics_skip_not_crash(three_hosts):
+    """Poisoned inputs for the new metrics: a mistyped (string/bool)
+    or missing value must land the metric in `skipped`, never crash
+    the diff or fabricate a regression."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["queue_wait_p99_s"] = "slow"
+    del poisoned["serve"]["preempted_time_frac"]
+    for a, b in ((base, poisoned), (poisoned, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_queue_wait_p99_s" in d["skipped"]
+        assert "serve_preempted_time_frac" in d["skipped"]
+        assert not {"serve_queue_wait_p99_s",
+                    "serve_preempted_time_frac"} & set(d["regressions"])
 
 
 def test_diff_skips_metrics_missing_on_either_side(three_hosts):

@@ -33,6 +33,16 @@ def test_serve_bench_smoke(capsys, tmp_path):
     assert detail["ttft_p99_s"] >= detail["ttft_p50_s"] > 0
     assert 0 < detail["kv_peak_utilization"] <= 1
     assert 0 <= detail["gather_read_waste_mean"] <= 1
+    # the ISSUE 10 phase decomposition rides the detail line: a bench
+    # regression names the PHASE, not just the ratio — fractions of
+    # summed request e2e that close to 1 within rounding
+    phases = [detail[f"{ph}_time_frac"] for ph in
+              ("queue", "prefill", "decode", "preempted", "overhead")]
+    assert all(isinstance(v, (int, float)) for v in phases)
+    assert all(-0.01 <= v <= 1.0 for v in phases)
+    assert sum(phases) == pytest.approx(1.0, abs=0.02)
+    assert detail["decode_time_frac"] > 0
+    assert detail["queue_wait_p99_s"] >= 0
 
     bdetail = bucketed["detail"]
     assert bdetail["exact_match"] is True           # bucketed == full

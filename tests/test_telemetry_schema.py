@@ -196,6 +196,31 @@ def test_produced_train_and_serve_artifacts_validate(tmp_path):
     report = [e for e in serve if e["event"] == "report"][-1]
     assert report["speculate_k"] == 2
     assert isinstance(report["acceptance_rate"], (int, float))
+    # the ISSUE 10 lifecycle tracing rides the live stream typed (the
+    # engine's timeline defaults ON): every finished request emitted a
+    # request_timeline whose decomposition fields and segment list are
+    # real, and every iteration an iteration_ledger — fixtures
+    # regenerated from this live speculative run, not hand-built
+    timelines = [e for e in serve if e["event"] == "request_timeline"]
+    assert timelines and {e["at"] for e in timelines} >= {"finish"}
+    assert len([e for e in timelines if e["at"] == "finish"]) \
+        == len(finishes)
+    for e in timelines:
+        assert isinstance(e["e2e_s"], (int, float))
+        assert isinstance(e["segments"], list) and e["segments"]
+        for ph in ("queue", "prefill", "decode", "preempted",
+                   "overhead"):
+            assert isinstance(e[f"{ph}_s"], (int, float))
+    ledgers = [e for e in serve if e["event"] == "iteration_ledger"]
+    assert ledgers and all(
+        isinstance(e["iteration"], int)
+        and isinstance(e["dur_s"], (int, float))
+        and isinstance(e["gather_bucket"], int)
+        and isinstance(e["kv_used_frac"], (int, float))
+        for e in ledgers)
+    # the report event carries the timeline-gated SLO aggregates
+    assert isinstance(report["queue_wait_p99_s"], (int, float))
+    assert isinstance(report["decode_time_frac"], (int, float))
     proc = _run(str(out))
     assert proc.returncode == 0, proc.stdout
     assert proc.stdout.count("OK") == 2          # events.jsonl + trace.json
@@ -232,6 +257,25 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
         {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
          "event": "report", "kernel": 1, "kv_dtype": False,
          "kv_bytes_read_per_step": "lots"},                      # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "request_timeline", "request": 5, "at": "finish",
+         "e2e_s": 1.5, "queue_s": 0.5, "prefill_s": 0.1,
+         "decode_s": 0.8, "preempted_s": 0.0, "overhead_s": 0.1,
+         "segments": [{"ph": "queue", "t0": 0.0, "dur": 0.5}],
+         "group": "tenant0", "blocked_iters": 3,
+         "blocked_reason": "kv_capacity"},                       # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "request_timeline", "request": 6, "at": 2,
+         "e2e_s": "slow", "queue_s": True, "segments": "none",
+         "group": 7, "blocked_reason": 1},                       # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "iteration_ledger", "iteration": 4, "dur_s": 0.02,
+         "gather_bucket": 64, "decode_slots": 3, "waiting": 1,
+         "kv_used_frac": 0.4},                                   # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "iteration_ledger", "iteration": 4.5,
+         "dur_s": "fast", "decode_slots": 3.1, "waiting": "deep",
+         "kv_used_frac": "full"},                                # drift
     ]
     bad.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
     proc = _run(str(bad))
@@ -245,6 +289,19 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
     assert "optional field 'kernel'" in proc.stdout
     assert "optional field 'kv_dtype'" in proc.stdout
     assert "optional field 'kv_bytes_read_per_step'" in proc.stdout
+    # ISSUE 10 lifecycle-tracing fields: typed when present, so a
+    # drifted emitter can't poison obsctl timeline/slo/tail silently
+    assert "optional field 'at'" in proc.stdout
+    assert "optional field 'e2e_s'" in proc.stdout
+    assert "optional field 'queue_s'" in proc.stdout
+    assert "optional field 'segments'" in proc.stdout
+    assert "optional field 'group'" in proc.stdout
+    assert "optional field 'blocked_reason'" in proc.stdout
+    assert "optional field 'iteration'" in proc.stdout
+    assert "optional field 'dur_s'" in proc.stdout
+    assert "optional field 'decode_slots'" in proc.stdout
+    assert "optional field 'waiting'" in proc.stdout
+    assert "optional field 'kv_used_frac'" in proc.stdout
 
 
 def test_validator_accepts_anomaly_and_flight_artifacts(tmp_path):
