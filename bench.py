@@ -542,7 +542,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
                 "serve_bucketed_gather_decode_speedup",
                 "serve_speculative_decode_speedup",
                 "serve_prefix_cache_ttft_speedup",
-                "serve_paged_kernel_decode_speedup"]
+                "serve_paged_kernel_decode_speedup",
+                "serve_overlap_decode_speedup"]
     if args.llama_train:
         return ["llama_1b_train_samples_per_sec_per_chip"]
     if args.mixtral_train:

@@ -332,6 +332,13 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # the percentage is undefined)
     "serve_queue_wait_p99_s": (+1, "ratio"),
     "serve_preempted_time_frac": (+1, "ratio"),
+    # host-overhead share of total request latency (ISSUE 12): the
+    # dispatch-ahead loop exists to shrink this, so it regressing UP
+    # is the first sign the overlap broke (a new sync point on the
+    # hot path, a flush storm) — and the shared zero-baseline rule
+    # applies: a fully-hidden-overhead run worsening from 0.0 must
+    # flag even though the percentage is undefined
+    "serve_overhead_time_frac": (+1, "ratio"),
 }
 
 
@@ -364,7 +371,7 @@ def _report_scalars(report: dict) -> dict:
                 "decode_tokens_per_sec", "preemptions",
                 "acceptance_rate", "cache_hit_rate",
                 "kv_bytes_read_per_step", "queue_wait_p99_s",
-                "preempted_time_frac"):
+                "preempted_time_frac", "overhead_time_frac"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out

@@ -106,6 +106,14 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               "kv_dtype": (str,),
               "kv_bytes_read": (int,),
               "kv_bytes_read_per_step": _NUM,
+              # dispatch-ahead serving loop (ISSUE 12): the report
+              # event carries the overlap mode + how many times the
+              # pipeline was force-drained (preemption / KV-pressure
+              # block math must act on committed state); absent
+              # entirely with HSTD_SERVE_OVERLAP=off, whose stream is
+              # byte-identical to the serial engine's
+              "overlap": (bool,),
+              "overlap_flushes": (int,),
               # request-lifecycle tracing (ISSUE 10): the
               # `request_timeline` event's five-way phase decomposition
               # (queue + prefill + decode + preempted + overhead sums

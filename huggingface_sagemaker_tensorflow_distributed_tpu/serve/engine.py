@@ -83,6 +83,19 @@ speculation; vLLM + Orca + Sarathi + Leviathan lineage):
   output distribution unchanged (greedy: token-exact; sampled:
   Leviathan rejection acceptance).
 
+- **Dispatch-ahead loop** (``overlap``, ISSUE 12) — the decode loop
+  pipelines one iteration deep: dispatch N feeds N−1's un-fetched
+  DEVICE tokens, ``device_get`` is deferred exactly one iteration,
+  and the whole host side of the loop (commit, stamps, admission,
+  bucket pick, block math, prefill staging) runs concurrently with
+  the in-flight device step — the Orca/vLLM-style answer to host
+  latency on the critical path. Token-value-dependent decisions are
+  re-derived one step late (budget finishes from counts, EOS by
+  discarding the wasted in-flight token) or drain the pipeline
+  (preemption/KV pressure; ``overlap_flushes``); emitted tokens are
+  identical to the serial loop's, which ``overlap='off'`` restores
+  byte-for-byte.
+
 Decoding is greedy by default and token-for-token identical to
 per-request ``generate_causal`` — the exactness gate
 ``tests/test_serve.py`` pins, including with bucketing enabled and
@@ -151,6 +164,7 @@ ENV_PREFIX_CACHE = "HSTD_SERVE_PREFIX_CACHE"
 ENV_KERNEL = "HSTD_SERVE_KERNEL"
 ENV_KV_DTYPE = "HSTD_SERVE_KV_DTYPE"
 ENV_TIMELINE = "HSTD_SERVE_TIMELINE"
+ENV_OVERLAP = "HSTD_SERVE_OVERLAP"
 
 
 def parse_kernel(spec: Union[str, None]) -> str:
@@ -216,6 +230,17 @@ def parse_timeline(spec: Union[str, bool, None]) -> bool:
     compiled variants either way); ``off`` makes the engine's telemetry
     byte-identical to the pre-tracing stream."""
     return _parse_on_off(spec, ENV_TIMELINE)
+
+
+def parse_overlap(spec: Union[str, bool, None]) -> bool:
+    """The ``overlap`` knob (ISSUE 12): dispatch-ahead decode — host
+    scheduling runs concurrently with the in-flight device iteration,
+    ``jax.device_get`` deferred by exactly one iteration. None reads
+    ``HSTD_SERVE_OVERLAP`` (default ON — emitted tokens are identical
+    either way); ``off`` restores the strictly serial
+    schedule→dispatch→fetch→commit loop byte-for-byte, telemetry
+    included."""
+    return _parse_on_off(spec, ENV_OVERLAP)
 
 
 def parse_gather_buckets(spec: Union[str, Sequence[int], None],
@@ -541,6 +566,39 @@ def _copy_block_jit(donate: bool):
     return jax.jit(_copy_block, donate_argnums=(0,) if donate else ())
 
 
+class _PendingDecode(NamedTuple):
+    """One in-flight PLAIN decode dispatch (dispatch-ahead pipeline,
+    ISSUE 12): the un-fetched device next-token array, the (slot,
+    request) pairs that rode it (captured at dispatch — a rider's slot
+    may be reassigned by the time a wasted token is discarded), the
+    bucket it ran at, and the dispatch-enqueue cost/stamp. The fetch is
+    deferred to the NEXT engine iteration: everything the host does in
+    between runs concurrently with this dispatch's device compute."""
+
+    nxt: Any
+    riders: tuple
+    bucket: int
+    dispatch_s: float
+    t_dispatch: float
+
+
+class _PendingSpec(NamedTuple):
+    """One in-flight SPECULATIVE window (dispatch-ahead, ISSUE 12).
+    Unlike the plain pipeline, a window's commit must complete before
+    the next window dispatches (the next window's input token and
+    context advance are data-dependent on the acceptance counts), so
+    the overlap window covers the NEXT iteration's admission, prefill
+    dispatches, and telemetry — not the next decode dispatch."""
+
+    drafts: Any
+    n_acc: Any
+    bonus: Any
+    riders: tuple
+    bucket: int
+    dispatch_s: float
+    t_dispatch: float
+
+
 def _scatter_window(pools, plan: CachePlan, cache_leaves, block_tables,
                     context_lens, active, k: int):
     """Scatter a just-computed (k+1)-token window's K/V — written by a
@@ -726,6 +784,9 @@ class EngineStats(NamedTuple):
     kv_dtype: str = "fp"
     kv_bytes_read: int = 0
     kv_token_bytes: int = 0
+    # dispatch-ahead pipeline (ISSUE 12)
+    overlap: bool = False
+    overlap_flushes: int = 0
 
 
 class ServeEngine:
@@ -794,7 +855,32 @@ class ServeEngine:
     turns on per-request lifecycle tracing: ``request_timeline`` +
     ``iteration_ledger`` telemetry events from host-side phase stamps
     (zero new compiled variants; ``off`` restores the pre-tracing
-    telemetry byte-for-byte)."""
+    telemetry byte-for-byte).
+
+    ``overlap`` (None reads ``HSTD_SERVE_OVERLAP``, default on) makes
+    the decode loop DISPATCH-AHEAD (ISSUE 12): iteration N is
+    dispatched before iteration N−1's tokens are fetched, and all the
+    host work of the loop — committing N−1's tokens, phase stamps,
+    admission, bucket pick, block math, prefill staging — runs
+    concurrently with N's device compute; ``jax.device_get`` is
+    deferred by exactly one iteration. The token feed for dispatch N
+    is N−1's un-fetched DEVICE output (merged with host-known tokens
+    for fresh-from-prefill slots by one warmed fixed-shape select —
+    the decode step itself compiles zero new variants per bucket).
+    Host decisions that depend on N−1's token values are re-derived
+    one step late without changing emitted tokens: a budget finish is
+    predicted from counts and excluded from dispatch N up front; an
+    EOS finish is discovered at commit, and the wasted in-flight token
+    is discarded (its stale K/V write is ordered before any
+    reallocation of the released blocks by the pool-chain data
+    dependency, so it can never clobber a later owner). Preemption /
+    KV-pressure DRAINS the pipeline first (``overlap_flushes``
+    latches every drain), so the recompute path always runs on
+    committed state. A speculative engine commits each window before
+    the next dispatch (acceptance counts are data-dependent) and
+    overlaps the next iteration's admission/prefill/telemetry
+    instead. ``overlap='off'`` restores the serial loop byte-for-byte
+    in telemetry."""
 
     #: consecutive iterations a smaller bucket must suffice before the
     #: engine shrinks to it — bounds bucket churn when the max resident
@@ -813,7 +899,8 @@ class ServeEngine:
                  kernel: Union[str, None] = None,
                  kv_cache_dtype: Union[str, None] = None,
                  kv_pool_bytes: Optional[int] = None,
-                 timeline: Union[str, bool, None] = None):
+                 timeline: Union[str, bool, None] = None,
+                 overlap: Union[str, bool, None] = None):
         cfg = model.config
         if getattr(cfg, "num_experts", 0):
             raise ValueError(
@@ -861,6 +948,7 @@ class ServeEngine:
                              f"got {self.speculate_k}")
         self.prefix_cache = parse_prefix_cache(prefix_cache)
         self.timeline = parse_timeline(timeline)
+        self.overlap = parse_overlap(overlap)
         plan, pool_shapes = build_cache_plan(model, params,
                                              self.max_model_len)
         self._plan = plan
@@ -960,6 +1048,13 @@ class ServeEngine:
         self._bucket = self.gather_buckets[0]
         self._shrink_streak = 0
         self._warmed_modes: set = set()
+        # dispatch-ahead pipeline state (ISSUE 12): the one in-flight
+        # decode dispatch (plain) or speculative window, and how many
+        # times the pipeline was force-drained (preemption/KV pressure
+        # must act on committed state)
+        self._pending: Optional[_PendingDecode] = None
+        self._pending_spec: Optional[_PendingSpec] = None
+        self.overlap_flushes = 0
         # lifecycle tracing (ISSUE 10): per-iteration dispatch-time
         # accumulators the iteration_ledger event reads (reset each
         # step; populated only with `timeline` on)
@@ -1069,6 +1164,15 @@ class ServeEngine:
                             np.zeros((S,), bool), sf, si, sf,
                             np.zeros((S, 2), np.uint32), si, self._plan,
                             bucket, mode)
+            if (self.overlap and not self.speculative
+                    and not self._warmed_modes):
+                # precompile the dispatch-ahead token-feed select (the
+                # host-known-token merge over the previous dispatch's
+                # un-fetched device output) — one fixed-shape [S]
+                # executable, so the pipelined loop mints zero compiled
+                # variants beyond the serial loop's own set
+                tok = jnp.where(np.zeros((S,), bool), tok,
+                                np.zeros((S,), np.int32))
             if self.prefix_cache and not self._warmed_modes:
                 # precompile the COW block copy (null-block self-copy:
                 # a no-op) so a cache hit that must privatize never
@@ -1095,7 +1199,8 @@ class ServeEngine:
         (`obs/report.py`) reads the serving story from a single line."""
         self.warmup()
         with obs.span("serve/run"):
-            while self.sched.has_work():
+            while (self.sched.has_work() or self._pending is not None
+                   or self._pending_spec is not None):
                 self.step()
         obs.scalar("serve/kv_peak_utilization",
                    self.blocks.peak_used / max(self.blocks.num_blocks - 1, 1))
@@ -1135,6 +1240,12 @@ class ServeEngine:
                 self.decode_tokens / self.decode_time_s, 1)
         out["kernel"] = self.kernel
         out["kv_dtype"] = self.kv_cache_dtype
+        if self.overlap:
+            # dispatch-ahead accounting (absent entirely with the
+            # overlap off — that stream stays byte-identical to the
+            # serial engine's)
+            out["overlap"] = True
+            out["overlap_flushes"] = self.overlap_flushes
         if self.decode_steps:
             out["kv_bytes_read_per_step"] = round(
                 self.kv_bytes_read / self.decode_steps, 1)
@@ -1242,7 +1353,9 @@ class ServeEngine:
             kernel=self.kernel,
             kv_dtype=self.kv_cache_dtype,
             kv_bytes_read=self.kv_bytes_read,
-            kv_token_bytes=self.blocks.token_bytes)
+            kv_token_bytes=self.blocks.token_bytes,
+            overlap=self.overlap,
+            overlap_flushes=self.overlap_flushes)
 
     def _aggregate_hit_rate(self) -> Optional[float]:
         """Prompt tokens served from cache / prompt tokens admitted,
@@ -1266,7 +1379,17 @@ class ServeEngine:
         (queue→prefill at admission, preemption intervals at eviction)
         and one ``iteration_ledger`` event records the iteration's
         phase mix — all ``perf_counter`` arithmetic, zero new compiled
-        variants."""
+        variants.
+
+        With ``overlap`` on (the default) the decode tail of the
+        iteration runs DISPATCH-AHEAD: the admission/prefill/stamping
+        above already executed concurrently with the previous
+        iteration's in-flight device step, and the plain families
+        dispatch iteration N before committing N−1's (already
+        computed) tokens — see :meth:`_dispatch_decode` /
+        :meth:`_commit_decode`. A speculative engine commits its
+        in-flight window first (:meth:`_commit_spec`) because the next
+        window's inputs are data-dependent on the acceptance counts."""
         t_iter0 = time.perf_counter()
         tokens0 = self.tokens_generated
         chunks0, disp0 = self.prefill_chunks, self.prefill_dispatches
@@ -1310,16 +1433,28 @@ class ServeEngine:
             if not dispatched_rows:
                 break
             budget -= dispatched_rows * C
-        for req in self.sched.ensure_decode_capacity():
-            obs.serve("preempt", request=req.rid,
-                      reason="kv_pool_exhausted")
-            if self.timeline:
-                # the preempted interval runs from here to re-admission;
-                # emit the partial timeline NOW so a request that never
-                # comes back (a killed run) still left its history
-                req.preempt_t = time.perf_counter()
-                self._emit_timeline(req, "preempt", req.preempt_t)
-        self._decode_all()
+        if not self.overlap:
+            self._capacity_phase()
+            self._decode_all()
+        elif self.speculative:
+            # the in-flight window overlapped the admission/prefill
+            # work above; it must land before the capacity math (the
+            # context advance is data-dependent) and the next dispatch
+            self._commit_spec(self._pending_spec)
+            self._pending_spec = None
+            self._capacity_phase()
+            self._pending_spec = self._dispatch_spec()
+        else:
+            # plain/bucketed/kernel families: flush the pipeline only
+            # when the capacity math could preempt (the recompute path
+            # must see committed state), dispatch N, then commit N−1's
+            # tokens while N runs on the device
+            if (self._pending is not None
+                    and not self._capacity_covered()):
+                self._flush("kv_pressure")
+            self._capacity_phase()
+            prev, self._pending = self._pending, self._dispatch_decode()
+            self._commit_decode(prev)
         # per-iteration scheduler gauges (SLO telemetry): queue pressure
         # and slot occupancy as series, one sample per engine iteration
         waiting = len(self.sched.waiting)
@@ -1351,6 +1486,45 @@ class ServeEngine:
                     waiting=waiting,
                     kv_used_frac=round(self.blocks.utilization(), 4))
         self.iterations += 1
+
+    def _capacity_phase(self) -> None:
+        """Decode-side block capacity for the next dispatch, preempting
+        when the pool runs dry (serial semantics — under overlap the
+        caller drained the pipeline first when this could preempt)."""
+        for req in self.sched.ensure_decode_capacity():
+            obs.serve("preempt", request=req.rid,
+                      reason="kv_pool_exhausted")
+            if self.timeline:
+                # the preempted interval runs from here to re-admission;
+                # emit the partial timeline NOW so a request that never
+                # comes back (a killed run) still left its history
+                req.preempt_t = time.perf_counter()
+                self._emit_timeline(req, "preempt", req.preempt_t)
+
+    def _capacity_covered(self) -> bool:
+        """True when every decode slot's next write span is coverable
+        without touching the preemption path — the cheap host-side
+        precheck that decides whether the dispatch-ahead pipeline must
+        drain before :meth:`_capacity_phase` runs. Conservative: a
+        False here only costs one lost overlap window."""
+        need = sum(
+            max(0, self.blocks.blocks_for(
+                s.context_len + self.sched.decode_lookahead)
+                - len(s.table))
+            for s in self.sched.decode_slots())
+        return self.blocks.can_allocate(need)
+
+    def _flush(self, reason: str) -> None:
+        """Drain the dispatch-ahead pipeline: fetch and commit the
+        in-flight iteration NOW (losing its overlap window) so the
+        caller's next decision acts on fully committed state. The
+        mandatory drains — preemption and KV-pressure block math — are
+        what ``overlap_flushes`` counts."""
+        if self._pending is None:
+            return
+        self.overlap_flushes += 1
+        prev, self._pending = self._pending, None
+        self._commit_decode(prev)
 
     def _select_bucket(self, need: int) -> int:
         """Smallest configured bucket covering ``need`` resident
@@ -1543,17 +1717,190 @@ class ServeEngine:
                 self._accrue_decode(slot.request, t0, dur, bucket, 1)
             self._append(slot, int(nxt[slot.index]))
 
+    def _dispatch_decode(self) -> Optional[_PendingDecode]:
+        """Dispatch-ahead plain decode (ISSUE 12): enqueue iteration N
+        WITHOUT waiting for iteration N−1's tokens. A rider of the
+        in-flight dispatch feeds its un-fetched DEVICE token (the
+        pipeline's data chain — the value never round-trips through
+        the host); slots whose newest token is host-known (fresh from
+        prefill, first step after a flush) merge in through the warmed
+        fixed-shape select. Slots that will BUDGET-finish when N−1
+        commits are excluded up front (a pure count — re-derived
+        exactly, no token value needed); an EOS finish is unknowable
+        here, so that rider runs one wasted row whose output the
+        commit discards — the stale K/V write is hidden by the
+        context masks and ordered before any block reuse by the pool
+        chain. Context lengths advance AT DISPATCH (the write lands
+        regardless of the token's value), which keeps bucket choice
+        and block math exact, not speculative.
+
+        The per-slot staging/accounting here deliberately MIRRORS
+        :meth:`_decode_all` instead of replacing it: the serial loop
+        stays an INDEPENDENT reference implementation, which is what
+        gives the overlap-on == overlap-off torture gates their teeth
+        (shared code would compare a path against itself). Accounting
+        changes must land in both."""
+        prev = self._pending
+        ds = []
+        for slot in self.sched.decode_slots():
+            eff = self._generated(slot.request) + slot.inflight
+            if eff >= slot.request.max_new_tokens:
+                continue         # finishes at the in-flight commit
+            ds.append(slot)
+        if not ds:
+            return None
+        bucket = self._select_bucket(
+            max(s.context_len + self.sched.decode_lookahead
+                for s in ds))
+        S = self.num_slots
+        vals = np.zeros((S,), np.int32)
+        use_dev = np.zeros((S,), bool)
+        tables = np.zeros((S, self.max_blocks_per_seq), np.int32)
+        ctx = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        temps = np.zeros((S,), np.float32)
+        top_ks = np.zeros((S,), np.int32)
+        top_ps = np.zeros((S,), np.float32)
+        keys = np.zeros((S, 2), np.uint32)
+        folds = np.zeros((S,), np.int32)
+        sampled = False
+        for slot in ds:
+            req = slot.request
+            i = slot.index
+            if slot.inflight:
+                use_dev[i] = True
+            else:
+                # a DECODE slot always has output resident (prefill
+                # appends the first token before the state flips) —
+                # same invariant the serial loop indexes on
+                vals[i] = req.output[-1]
+            tables[i, :len(slot.table)] = slot.table
+            ctx[i] = slot.context_len
+            active[i] = True
+            if req.sampled:
+                sampled = True
+                temps[i] = req.temperature
+                top_ks[i] = req.top_k
+                top_ps[i] = req.top_p
+                keys[i] = self._keys[req.rid]
+                # the in-flight token counts: token N's fold index is
+                # its request-global position, exactly the serial value
+                folds[i] = self._generated(req) + slot.inflight
+        self.blocks.note_gather([s.context_len + 1 for s in ds], bucket)
+        step_bytes = self.num_slots * bucket * self.blocks.token_bytes
+        self.kv_bytes_read += step_bytes
+        if obs.has_sink():
+            obs.scalar("serve/kv_bytes_read", step_bytes, self.iterations)
+        if self.prefix_cache and self.blocks.blocks_saved() > 0:
+            self.blocks.note_shared_reads(sum(
+                self.blocks.shared_read_tokens(s.table, s.context_len)
+                for s in ds))
+        if prev is None or not use_dev.any():
+            tokens = vals
+        elif all(s.inflight for s in ds):
+            # steady pipeline: every active slot rode the in-flight
+            # dispatch, so its token array IS the feed — no select op
+            # on the device chain at all (the common decode-bound case)
+            tokens = prev.nxt
+        else:
+            tokens = jnp.where(use_dev, prev.nxt, vals)
+        t0 = time.perf_counter()
+        with obs.span("serve/decode_step",
+                      {"active": len(ds), "gather_bucket": bucket}
+                      if obs.has_sink() else None):
+            nxt, self._pools = self._decode_fn(
+                self.model, self.params, self._pools, tokens, tables,
+                ctx, active, temps, top_ks, top_ps, keys, folds,
+                self._plan, bucket, sampled)
+        dispatch_s = time.perf_counter() - t0
+        if self.timeline:
+            # the enqueue cost lands in THIS iteration's ledger (the
+            # blocked fetch lands in the committing iteration's), so
+            # dur_s >= prefill_s + decode_s stays true per ledger line
+            self._iter_decode_s += dispatch_s
+        for slot in ds:
+            slot.context_len += 1        # the fed token's K/V lands
+            slot.inflight = 1
+        return _PendingDecode(nxt, tuple((s, s.request) for s in ds),
+                              bucket, dispatch_s, t0)
+
+    def _commit_decode(self, prev: Optional[_PendingDecode]) -> None:
+        """Land one in-flight plain decode iteration: the deferred
+        ``device_get`` — by now the device has computed through all
+        the host work since dispatch, so the blocked wait is only the
+        residual — then append/EOS-check per rider. Decode time
+        accounts dispatch enqueue + blocked fetch ONLY: the host work
+        in between ran concurrently with the device, which is the
+        measurable claim of the dispatch-ahead loop. A rider whose
+        request finished at the previous commit (EOS discovered one
+        step late) has its token discarded — a serial loop would
+        never have computed it, and discarding reproduces the serial
+        output exactly."""
+        if prev is None:
+            return
+        t0 = time.perf_counter()
+        nxt = np.asarray(prev.nxt)
+        t_end = time.perf_counter()
+        fetch_s = t_end - t0
+        # the ENGINE's decode-time accounting stays blocked-time only
+        # (dispatch enqueue + residual fetch wait): the host work in
+        # between ran concurrently, and hiding it is exactly what the
+        # bench's decode-tokens/sec ratio measures
+        self.decode_time_s += prev.dispatch_s + fetch_s
+        self.decode_steps += 1
+        # riders of the CURRENT in-flight dispatch keep their inflight
+        # mark (dispatch N ran before this commit of N−1 and re-marked
+        # them); everyone else's newest token is host-resident again
+        live = {id(s) for s, _ in (self._pending.riders
+                                   if self._pending is not None else ())}
+        committed = 0
+        for slot, req in prev.riders:
+            if id(slot) not in live:
+                slot.inflight = 0
+            if req.rid in self.finished or slot.request is not req:
+                continue         # wasted row past an EOS: discarded
+            committed += 1
+            self.decode_tokens += 1
+            if self.timeline:
+                # the REQUEST's decode interval is the whole
+                # dispatch→fetch window — the host work inside it ran
+                # concurrently with the device, so it is decode time,
+                # not overhead — clipped to the request's previous
+                # attributed end so intervals stay disjoint (the
+                # checkable-decomposition invariant): back-to-back
+                # overlapped iterations tile the decode-bound stretch
+                # with no overhead gaps, which is the decomposition's
+                # view of the de-overheaded loop
+                start = prev.t_dispatch
+                if req.decode_attr_end is not None:
+                    start = max(start, req.decode_attr_end)
+                self._accrue_decode(req, start, t_end - start,
+                                    prev.bucket, 1)
+                req.decode_attr_end = t_end
+            self._append(slot, int(nxt[slot.index]))
+        if self.timeline:
+            self._iter_decode_s += fetch_s
+            self._iter_decode_slots = committed
+
     def _decode_all_spec(self) -> None:
-        """One speculative iteration: draft-k propose + width-(k+1)
-        verify in a single dispatch, then the host commits per slot —
-        accepted prefix + bonus appended, ``context_len`` advanced over
-        exactly the committed tokens (the O(1) rewind: rejected draft
-        K/V past it is stale, invisible to context-derived masks, and
-        overwritten by the next window), and the block-table tail past
-        the committed context returns to the free list."""
+        """One SERIAL speculative iteration: dispatch + immediate
+        commit (the dispatch-ahead loop splits these across the
+        iteration boundary instead, overlapping the next iteration's
+        admission/prefill/telemetry with the in-flight window)."""
+        self._commit_spec(self._dispatch_spec())
+
+    def _dispatch_spec(self) -> Optional[_PendingSpec]:
+        """Enqueue one speculative draft-k propose + width-(k+1)
+        verify dispatch over all decode slots; the host-side commit
+        (:meth:`_commit_spec`) lands the accepted prefix + bonus per
+        slot — ``context_len`` advanced over exactly the committed
+        tokens (the O(1) rewind: rejected draft K/V past it is stale,
+        invisible to context-derived masks, and overwritten by the
+        next window), and the block-table tail past the committed
+        context returns to the free list."""
         ds = self.sched.decode_slots()
         if not ds:
-            return
+            return None
         k = self.speculate_k
         bucket = self._select_bucket(self.sched.max_decode_context())
         S = self.num_slots
@@ -1608,15 +1955,39 @@ class ServeEngine:
                     tokens, tables, ctx, active, temps, top_ks, top_ps,
                     keys, folds, self._plan, self._d_plan, bucket, k,
                     sampled)
-            drafts = np.asarray(jax.device_get(drafts))
-            n_acc = np.asarray(jax.device_get(n_acc))
-            bonus = np.asarray(jax.device_get(bonus))
-        dur = time.perf_counter() - t0
-        self.decode_time_s += dur
+        dispatch_s = time.perf_counter() - t0
+        if self.timeline:
+            # enqueue cost in the dispatching iteration's ledger (the
+            # fetch lands in the committing one's) — see the plain
+            # pipeline's convention
+            self._iter_decode_s += dispatch_s
+        return _PendingSpec(drafts, n_acc, bonus,
+                            tuple((s, s.request) for s in ds),
+                            bucket, dispatch_s, t0)
+
+    def _commit_spec(self, pending: Optional[_PendingSpec]) -> None:
+        """Land one speculative window: ONE fused tuple transfer for
+        (drafts, n_acc, bonus) — the three per-iteration host reads
+        collapse into a single ``device_get`` round trip — then the
+        per-slot commit. Serial mode calls this immediately after the
+        dispatch; the dispatch-ahead loop calls it one iteration
+        late, after the next iteration's admission/prefill work
+        overlapped the window's device compute."""
+        if pending is None:
+            return
+        ds = [slot for slot, _ in pending.riders]
+        k = self.speculate_k
+        bucket = pending.bucket
+        t0 = time.perf_counter()
+        drafts, n_acc, bonus = map(np.asarray, jax.device_get(
+            (pending.drafts, pending.n_acc, pending.bonus)))
+        t_end = time.perf_counter()
+        fetch_s = t_end - t0
+        self.decode_time_s += pending.dispatch_s + fetch_s
         self.decode_steps += 1
         self.spec_windows += len(ds)
         if self.timeline:
-            self._iter_decode_s += dur
+            self._iter_decode_s += fetch_s
             self._iter_decode_slots = len(ds)
         committed = []
         for slot in ds:
@@ -1630,8 +2001,18 @@ class ServeEngine:
             if self.timeline:
                 # committed-token count lands below, one bump per
                 # append (the finish emission inside _append must see
-                # the segment current)
-                self._accrue_decode(req, t0, dur, bucket, 0, k, acc)
+                # the segment current); the window's attributed
+                # interval is [dispatch, fetch-end] — the concurrent
+                # host work is decode time, not overhead — clipped
+                # against the request's previous interval (a no-op in
+                # serial mode, where commit precedes the next
+                # dispatch)
+                start = pending.t_dispatch
+                if req.decode_attr_end is not None:
+                    start = max(start, req.decode_attr_end)
+                self._accrue_decode(req, start, t_end - start,
+                                    bucket, 0, k, acc)
+                req.decode_attr_end = t_end
             window = [int(drafts[i, j]) for j in range(acc)]
             window.append(int(bonus[i]))
             j = 0

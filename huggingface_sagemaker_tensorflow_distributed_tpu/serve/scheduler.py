@@ -99,6 +99,15 @@ class Request:
         "queue": 0.0, "prefill": 0.0, "decode": 0.0, "preempted": 0.0})
     segments: list = field(default_factory=list)
     preempt_t: Optional[float] = None
+    # dispatch-ahead attribution cursor (ISSUE 12): where this
+    # request's last attributed decode interval ended. Under overlap a
+    # dispatch N is enqueued BEFORE iteration N−1's fetch lands, so
+    # the per-request decode window [dispatch, fetch] of consecutive
+    # iterations would overlap; clipping each window's start to this
+    # cursor keeps the attributed intervals disjoint (the checkable-
+    # decomposition invariant) while still counting the host work that
+    # ran concurrently with the device as decode time, not overhead.
+    decode_attr_end: Optional[float] = None
     blocked_iters: int = 0
     blocked_reason: Optional[str] = None
     cow_copies: int = 0
@@ -160,6 +169,13 @@ class Slot:
         # (src, dst) block pairs the ENGINE must apply to every pool
         # before the slot's first prefill dispatch
         self.pending_copies: list[tuple[int, int]] = []
+        # dispatch-ahead pipeline (ISSUE 12): 1 while this slot rides
+        # an in-flight decode dispatch whose token has not been
+        # fetched yet — its newest token lives on the DEVICE, and its
+        # host-visible generated count runs one behind by exactly this
+        # amount (the engine's budget-finish prediction and sampled
+        # fold indices add it back)
+        self.inflight = 0
 
     @property
     def free(self) -> bool:
@@ -172,12 +188,24 @@ class Slot:
         self.prefill_pos = 0
         self.admit_seq = -1
         self.pending_copies = []
+        self.inflight = 0
 
 
 class Scheduler:
     """FIFO admission into ``num_slots`` decode slots, chunked prefill,
     recompute preemption. The engine owns the clock and the device; this
-    class owns WHO runs."""
+    class owns WHO runs.
+
+    Under the engine's dispatch-ahead loop (ISSUE 12) every decision
+    here consumes LAGGED observations: one decode dispatch may be in
+    flight, so a slot freed by an un-fetched EOS is not yet free at
+    admission time, and a riding slot's ``context_len`` was already
+    advanced at dispatch (the write lands regardless of the token's
+    value). That advance is what keeps the block math exact — the
+    ``decode_lookahead`` reservation measured from the advanced
+    context covers the in-flight step's write span by construction —
+    and the engine drains the pipeline before any path that can
+    preempt, so recompute always folds fully committed output."""
 
     def __init__(self, num_slots: int, blocks: BlockManager,
                  prefill_chunk: int, max_model_len: int,
@@ -454,8 +482,26 @@ class Scheduler:
         self.waiting.insert(0, req)
 
     def finish(self, slot: Slot) -> Request:
+        """Request complete: publish its GENERATED tail into the
+        prefix index (ISSUE 12 / PR 7a follow-up), then release the
+        table. At finish every resident position's K/V is final —
+        prompt AND generated — so the whole ``context_len`` span's
+        full aligned blocks are registerable, which is what makes
+        agentic multi-turn traffic (a client re-submitting its own
+        completion as the next prompt) hit the cache instead of
+        re-prefilling its own output. ``register_prefix`` only indexes
+        FULL ``block_size`` chunks covered by the table, so the
+        partially-filled last block (and, under the dispatch-ahead
+        loop, any stale in-flight write past ``context_len``) is never
+        published. Zero-ref registered blocks park in the LRU on
+        release — reusable until pool pressure evicts them."""
         req = slot.request
         req.state = FINISHED
+        if self.prefix_cache and slot.context_len > 0:
+            full = np.concatenate(
+                [req.prompt, np.asarray(req.output, np.int32)])
+            self.blocks.register_prefix(full[:slot.context_len],
+                                        slot.table)
         self.blocks.release(slot.table)
         slot.clear()
         return req

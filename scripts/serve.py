@@ -185,6 +185,14 @@ def main() -> None:
                              "events + phase decomposition in the "
                              "summary; default: HSTD_SERVE_TIMELINE "
                              "or on)")
+    parser.add_argument("--overlap", default=None,
+                        choices=("on", "off"),
+                        help="dispatch-ahead decode loop: host "
+                             "scheduling overlaps the in-flight "
+                             "device step, device_get deferred one "
+                             "iteration; off restores the serial "
+                             "loop byte-for-byte (default: "
+                             "HSTD_SERVE_OVERLAP or on)")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="0 = greedy (the default); > 0 samples")
     parser.add_argument("--top_k", type=int, default=0)
@@ -217,7 +225,8 @@ def main() -> None:
                          prefix_cache=args.prefix_cache,
                          kernel=args.kernel,
                          kv_cache_dtype=args.kv_cache_dtype,
-                         timeline=args.timeline)
+                         timeline=args.timeline,
+                         overlap=args.overlap)
     trace = load_trace(args, model.config.vocab_size - 1)
     # precompile the sampled step variants too when the trace will
     # sample, so no request pays a mid-serve compile
@@ -298,6 +307,9 @@ def main() -> None:
         "decode_time_frac": slo.get("decode_time_frac"),
         "preempted_time_frac": slo.get("preempted_time_frac"),
         "overhead_time_frac": slo.get("overhead_time_frac"),
+        "overlap": engine.overlap,
+        "overlap_flushes": (stats.overlap_flushes
+                            if engine.overlap else None),
         "kernel": stats.kernel,
         "kv_dtype": stats.kv_dtype,
         "kv_bytes_read_per_step": (round(

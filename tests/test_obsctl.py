@@ -93,7 +93,8 @@ def three_hosts(tmp_path):
                               queue_wait_p99_s=0.8,
                               queue_time_frac=0.2,
                               decode_time_frac=0.7,
-                              preempted_time_frac=0.05))
+                              preempted_time_frac=0.05,
+                              overhead_time_frac=0.05))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -444,6 +445,55 @@ def test_diff_queue_wait_and_preempted_frac_are_up_worse(three_hosts):
     d0 = diff_reports(zero, worse0, threshold_pct=5.0)
     assert "serve_preempted_time_frac" in d0["regressions"]
     assert d0["metrics"]["serve_preempted_time_frac"]["pct"] is None
+
+
+def test_diff_overhead_time_frac_is_a_ratio_metric(three_hosts):
+    """ISSUE 12: `serve_overhead_time_frac` diffs as a ratio metric
+    whose worse direction is UP — the dispatch-ahead loop exists to
+    shrink the host-overhead share, so it creeping back up (a new
+    sync point on the hot path, a flush storm) must flag. Standard
+    threshold + zero-baseline rules, poison rows skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["overhead_time_frac"] == pytest.approx(0.05)
+    worse = copy.deepcopy(base)
+    worse["serve"]["overhead_time_frac"] = 0.4
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_overhead_time_frac" in d["regressions"]
+    assert d["metrics"]["serve_overhead_time_frac"]["worse_direction"] \
+        == "up"
+    # the better direction never flags; a sub-threshold drift neither
+    assert "serve_overhead_time_frac" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["overhead_time_frac"] = 0.051   # +2%
+    assert "serve_overhead_time_frac" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # zero baseline: a fully-overlapped run hides ALL host overhead,
+    # so any overhead reappearing must flag despite pct undefined
+    zero = copy.deepcopy(base)
+    zero["serve"]["overhead_time_frac"] = 0.0
+    worse0 = copy.deepcopy(zero)
+    worse0["serve"]["overhead_time_frac"] = 0.12
+    d0 = diff_reports(zero, worse0, threshold_pct=5.0)
+    assert "serve_overhead_time_frac" in d0["regressions"]
+    assert d0["metrics"]["serve_overhead_time_frac"]["pct"] is None
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["overhead_time_frac"] = "hidden"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["overhead_time_frac"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_overhead_time_frac" in d["skipped"]
+        assert "serve_overhead_time_frac" not in d["regressions"]
 
 
 def test_diff_poisoned_lifecycle_metrics_skip_not_crash(three_hosts):

@@ -1319,3 +1319,199 @@ def test_parse_kernel_and_kv_dtype_knobs(monkeypatch):
     assert parse_kv_dtype(None, "fp") == "int8"
     with pytest.raises(ValueError, match="fp | int8"):
         parse_kv_dtype("fp16", "fp")
+
+
+# -- dispatch-ahead serving loop (ISSUE 12) ----------------------------------
+
+def _run_overlap_pair(model, params, trace, kws=None, **engine_kw):
+    """Serve the same trace twice — ``overlap`` off then on — and
+    return (off_outputs, on_outputs, on_engine). The exactness torture
+    harness: the pipelined loop must be semantically invisible."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    kws = kws or [dict() for _ in trace]
+    outs = {}
+    engines = {}
+    for mode in ("off", "on"):
+        eng = ServeEngine(model, params, overlap=mode, **engine_kw)
+        reqs = [eng.submit(p, m, **kw) for (p, m), kw in zip(trace, kws)]
+        eng.run()
+        outs[mode] = [[int(t) for t in eng.output_ids(r)] for r in reqs]
+        engines[mode] = eng
+    assert engines["off"].overlap_flushes == 0    # serial never drains
+    return outs["off"], outs["on"], engines["on"]
+
+
+def test_overlap_exact_with_eos_on_inflight_iteration(gpt2_setup):
+    """EOS lands while the next iteration is already in flight (the
+    dispatch-ahead loop discovers a finish one step LATE and must
+    discard the wasted in-flight token): rebuild the model so EOS is a
+    token the reference actually emits mid-stream, serve a multi-slot
+    trace, and require overlap-on output == overlap-off output ==
+    generate_causal, token for token."""
+    import dataclasses
+
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(1, 120, (p,)).astype(np.int32)
+               for p in (5, 9, 12, 7)]
+    # EOS = the 3rd greedy continuation token of prompt 0: that request
+    # finishes mid-decode with other slots still running, so the finish
+    # is always discovered with a dispatch in flight
+    ref = _reference(model, params, prompts[0], 12, eos=-1)
+    eos_cfg = dataclasses.replace(cfg, eos_token_id=int(ref[2]))
+    eos_model = type(model)(eos_cfg)
+    trace = [(p, 12) for p in prompts]
+    off, on, eng = _run_overlap_pair(
+        eos_model, params, trace, num_slots=4, block_size=4,
+        num_blocks=60, prefill_chunk=8, max_model_len=64)
+    assert on == off
+    assert eng.overlap
+    for (p, m), got in zip(trace, on):
+        assert got == _reference(eos_model, params, p, m,
+                                 eos_cfg.eos_token_id)
+
+
+def test_overlap_exact_across_bucket_switches(gpt2_setup):
+    """Bucket grow mid-pipeline: contexts crossing the 16-wide first
+    bucket while dispatches are in flight — the bucket choice is
+    re-derived from exact counts (context advances at dispatch), so
+    the switch needs no flush and changes no tokens."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(22)
+    trace = [(rng.randint(1, 120, (p,)).astype(np.int32), 9)
+             for p in (15, 16, 17, 5)]
+    off, on, eng = _run_overlap_pair(
+        model, params, trace, num_slots=4, block_size=4, num_blocks=60,
+        prefill_chunk=8, max_model_len=64, gather_buckets=[16, 32])
+    assert on == off
+    assert eng.bucket_switches > 0          # the ladder really moved
+    assert eng.overlap_flushes == 0         # growth is count-derived
+
+
+def test_overlap_exact_under_forced_preemption_and_flushes(gpt2_setup):
+    """The mandatory flush: KV pressure / preemption must act on
+    committed state, so the pipeline drains first (overlap_flushes
+    latches it) and recompute preemption stays token-invisible."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(1)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 18)
+             for _ in range(5)]
+    off, on, eng = _run_overlap_pair(
+        model, params, trace, num_slots=4, block_size=4, num_blocks=10,
+        prefill_chunk=8, max_model_len=32)
+    assert on == off
+    assert eng.stats().preemptions > 0
+    assert eng.overlap_flushes > 0          # the drain was mandatory
+    assert eng.stats().overlap_flushes == eng.overlap_flushes
+
+
+def test_overlap_sampled_bitwise_and_spec_rejection_storm(gpt2_setup,
+                                                          spec_draft):
+    """The remaining torture axes in one composition: (a) sampled
+    streams stay bitwise identical across the pipeline (fold indices
+    re-derived through the in-flight count), and (b) a speculative
+    engine under an adversarial draft (rejection storm) + tight-pool
+    preemption — where the window commit is the pipeline boundary —
+    is token-identical with overlap on vs off."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(23)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 14)
+             for _ in range(4)]
+    kws = [dict(temperature=0.9, top_k=20, top_p=0.9, seed=s)
+           for s in (1, 2, 3)] + [dict()]
+    off, on, _ = _run_overlap_pair(
+        model, params, trace, kws=kws, num_slots=3, block_size=4,
+        num_blocks=40, prefill_chunk=8, max_model_len=32)
+    assert on == off                        # bitwise, greedy rider too
+    # speculative rejection storm + preemption, overlap on vs off
+    off_s, on_s, eng = _run_overlap_pair(
+        model, params, trace, num_slots=4, block_size=4, num_blocks=11,
+        prefill_chunk=8, max_model_len=32, speculate_k=2,
+        draft=spec_draft)
+    assert on_s == off_s
+    stats = eng.stats()
+    assert stats.preemptions > 0
+    assert 0 <= stats.acceptance_rate < 1   # rejections actually hit
+    assert (eng.blocks.num_free + eng.blocks.num_cached
+            == eng.blocks.num_blocks - 1)
+    assert eng.blocks.num_used == 0
+
+
+def test_generated_tail_registers_resubmit_hits_cache(gpt2_setup):
+    """PR 7a follow-up: a finished request's GENERATED tail joins the
+    prefix index, so agentic multi-turn traffic that re-submits its
+    own completion as the next prompt hits the cache past the original
+    prompt — exactness vs a cold generate_causal + a nonzero hit rate
+    covering generated blocks are both required."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(24)
+    prompt = rng.randint(1, 120, (12,)).astype(np.int32)
+    eng = ServeEngine(model, params, num_slots=2, block_size=4,
+                      num_blocks=40, prefill_chunk=4, max_model_len=64)
+    first = eng.submit(prompt, 12)
+    eng.run()
+    out1 = eng.output_ids(first)
+    assert len(out1) == 12                  # no EOS: full continuation
+    # the agentic turn: the client folds its completion into the next
+    # prompt. blocks_for(prompt+output minus the partial tail) of the
+    # FIRST request's blocks are now indexed — including generated
+    # ones past the 12-token prompt
+    follow = np.concatenate([prompt, out1]).astype(np.int32)
+    second = eng.submit(follow, 6)
+    eng.run()
+    got = [int(t) for t in eng.output_ids(second)]
+    assert got == _reference(model, params, follow, 6, cfg.eos_token_id)
+    # the cached span covers GENERATED tokens: more than the original
+    # prompt's full blocks were served from cache
+    assert second.prefix_cached_tokens > (len(prompt) // 4) * 4
+    assert second.cache_hit_rate > 0
+    assert eng.stats().cache_hit_rate > 0
+
+
+def test_generated_tail_registration_is_partial_block_safe(gpt2_setup):
+    """Only FULL aligned blocks of the finished sequence are
+    published: a short continuation that never completes a block adds
+    nothing to the index (and the conservation invariant holds with
+    the finished request's blocks parked in the cache LRU)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(25)
+    prompt = rng.randint(1, 120, (8,)).astype(np.int32)
+    eng = ServeEngine(model, params, num_slots=2, block_size=4,
+                      num_blocks=40, prefill_chunk=4, max_model_len=64)
+    req = eng.submit(prompt, 2)             # ctx 9: blocks 0..1 full
+    eng.run()
+    # full blocks of (prompt + 2 generated)[:9] = 2; both indexable
+    assert eng.blocks.num_cached == 2
+    assert (eng.blocks.num_free + eng.blocks.num_cached
+            == eng.blocks.num_blocks - 1)
+    assert eng.blocks.num_used == 0
+    assert req.rid in eng.finished
+
+
+def test_parse_overlap_knob(monkeypatch):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ENV_OVERLAP,
+        parse_overlap,
+    )
+
+    assert parse_overlap(None) is True      # default on
+    assert parse_overlap("off") is False
+    assert parse_overlap("on") is True
+    assert parse_overlap(False) is False
+    monkeypatch.setenv(ENV_OVERLAP, "off")
+    assert parse_overlap(None) is False
+    monkeypatch.setenv(ENV_OVERLAP, "1")
+    assert parse_overlap(None) is True
+    with pytest.raises(ValueError, match=ENV_OVERLAP):
+        parse_overlap("sometimes")

@@ -21,7 +21,8 @@ def test_serve_bench_smoke(capsys, tmp_path):
 
     obs.reset(out_dir=str(tmp_path / "telemetry"), enabled=True)
     try:
-        mixed, bucketed, spec, prefix, paged = bench_serve(smoke=True)
+        (mixed, bucketed, spec, prefix, paged,
+         overlap) = bench_serve(smoke=True)
     finally:
         obs.reset()
     detail = mixed["detail"]
@@ -101,16 +102,35 @@ def test_serve_bench_smoke(capsys, tmp_path):
     assert 0 < kdetail["kv_bytes_ratio"] <= 0.6     # bytes REALLY halve
     assert (kdetail["kv_token_bytes_int8"]
             < kdetail["kv_token_bytes_fp"])
+    # the ISSUE 12 dispatch-ahead line: structural gates enforced at
+    # smoke scale (overlap-on output == overlap-off output, compile
+    # flatness per side — the pipeline is host-side restructuring
+    # only), the ≥1.15x ratio + strict overhead reduction only on the
+    # full CPU trace
+    odetail = overlap["detail"]
+    assert odetail["exact_match"] is True           # on == off
+    # one flatness window spans every measured pass of both modes
+    assert odetail["compiles_steady"] <= len(odetail["gather_buckets"])
+    assert overlap["value"] is not None             # gates structural
+    assert odetail["ratio_gated"] is False          # smoke: no >=1.15x
+    # both sides ran timeline-on: the phase decomposition is this
+    # line's evidence, so the fractions must be present and sane
+    for key in ("overhead_time_frac_overlap",
+                "overhead_time_frac_serial"):
+        assert isinstance(odetail[key], (int, float))
+        assert -0.01 <= odetail[key] <= 1.0
+    assert odetail["overlap_flushes"] >= 0
     # the stdout lines are the driver contract: parseable JSON, all
-    # five metrics present
+    # six metrics present
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
     metrics = [json.loads(ln)["metric"] for ln in lines]
-    assert metrics[-5:] == ["serve_continuous_vs_static_speedup",
+    assert metrics[-6:] == ["serve_continuous_vs_static_speedup",
                             "serve_bucketed_gather_decode_speedup",
                             "serve_speculative_decode_speedup",
                             "serve_prefix_cache_ttft_speedup",
-                            "serve_paged_kernel_decode_speedup"]
+                            "serve_paged_kernel_decode_speedup",
+                            "serve_overlap_decode_speedup"]
 
 
 @pytest.mark.slow
@@ -159,6 +179,26 @@ def test_serve_bench_full_paged_kernel_trace(capsys):
     assert detail["exact_match_fp"] is True
     assert detail["exact_match_int8"] is True
     assert detail["kv_bytes_ratio"] <= 0.6
+
+
+@pytest.mark.slow
+def test_serve_bench_full_overlap_trace(capsys):
+    """The full CPU decode-dominated wide-batch trace — the ISSUE 12
+    acceptance surface where the ≥1.15x dispatch-ahead decode ratio
+    IS enforced in the line (measured 1.25-1.74x on this container)
+    together with the strict overhead-fraction reduction: the
+    decomposition PR 10 built must show the host overhead going
+    CONCURRENT, not just the ratio moving."""
+    from benchmarks.serve_bench import bench_serve_overlap
+
+    result = bench_serve_overlap(smoke=False)
+    assert result.get("error") is None
+    assert result["value"] is not None and result["value"] >= 1.15
+    detail = result["detail"]
+    assert detail["ratio_gated"] is True
+    assert detail["exact_match"] is True
+    assert (detail["overhead_time_frac_overlap"]
+            < detail["overhead_time_frac_serial"])
 
 
 @pytest.mark.slow

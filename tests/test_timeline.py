@@ -308,7 +308,8 @@ def tiny_gpt2():
     return cfg, model, init_params(model, cfg, seed=0)
 
 
-def _run_engine(model, params, tmp, *, timeline, n_req=5):
+def _run_engine(model, params, tmp, *, timeline, n_req=5,
+                overlap=None):
     """A forced-preemption serve run (tight pool) with per-tenant
     groups; returns (engine, events)."""
     from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
@@ -320,7 +321,8 @@ def _run_engine(model, params, tmp, *, timeline, n_req=5):
         rng = np.random.RandomState(1)
         eng = ServeEngine(model, params, num_slots=4, block_size=4,
                           num_blocks=10, prefill_chunk=8,
-                          max_model_len=32, timeline=timeline)
+                          max_model_len=32, timeline=timeline,
+                          overlap=overlap)
         for i in range(n_req):
             eng.submit(rng.randint(1, 120, (9,)).astype(np.int32), 18,
                        group=f"tenant{i % 2}")
@@ -339,10 +341,19 @@ def test_engine_timeline_decomposition_sums_on_real_run(tiny_gpt2,
     preemption, every finished request's emitted decomposition sums to
     its e2e within tolerance, the segment lists agree with the
     aggregates, the iteration ledger covers every iteration, and the
-    whole stream passes the schema validator."""
+    whole stream passes the schema validator.
+
+    ISSUE 12 extension (gate extended, not weakened): the run is a
+    real OVERLAPPED forced-preemption run — the dispatch-ahead loop
+    explicitly pinned on — so the decomposition must stay checkable
+    with host work attributed concurrently with device time, and the
+    mandatory pipeline drains (preemption acts on committed state
+    only) must have latched."""
     _cfg, model, params = tiny_gpt2
     eng, events = _run_engine(model, params, tmp_path / "t",
-                              timeline=True)
+                              timeline=True, overlap=True)
+    assert eng.overlap                          # dispatch-ahead ran
+    assert eng.overlap_flushes > 0              # preemption drained it
     assert eng.sched.n_preemptions > 0          # the run forced it
     recs = collect_timelines(events)
     assert sorted(r["request"] for r in recs) == \
@@ -416,6 +427,33 @@ def test_engine_timeline_off_restores_pre_tracing_stream(tiny_gpt2,
     assert all(v == 0.0 for r in eng.finished.values()
                for v in r.phase_s.values())
     assert all(not r.segments for r in eng.finished.values())
+
+
+def test_engine_overlap_off_restores_pre_overlap_telemetry(tiny_gpt2,
+                                                           tmp_path):
+    """ISSUE 12: ``HSTD_SERVE_OVERLAP=off`` must be byte-identical to
+    the pre-PR (serial-loop) telemetry — allowlist-gated: no new
+    event subtypes, no overlap keys on any serve event, nothing new
+    in the SLO report, and the full PR-10 timeline machinery intact
+    (same forced-preemption run, same decomposition gate)."""
+    _cfg, model, params = tiny_gpt2
+    eng, events = _run_engine(model, params, tmp_path / "t",
+                              timeline=True, overlap=False)
+    assert not eng.overlap and eng.overlap_flushes == 0
+    assert eng.sched.n_preemptions > 0
+    serve_ev = [e for e in events if e["type"] == "serve"]
+    kinds = {e["event"] for e in serve_ev}
+    assert kinds <= {"submit", "admit", "first_token", "finish",
+                     "preempt", "bucket_switch", "report",
+                     "request_timeline", "iteration_ledger"}
+    for e in serve_ev:
+        leaked = {"overlap", "overlap_flushes"} & set(e)
+        assert not leaked, (e["event"], leaked)
+    slo = eng.slo_summary()
+    assert "overlap" not in slo and "overlap_flushes" not in slo
+    # the serial stream still passes the full decomposition gate
+    for rec in collect_timelines(events):
+        assert check_decomposition(rec) == [], rec["request"]
 
 
 # -- obsctl timeline|slo|tail CLI ---------------------------------------------
