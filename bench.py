@@ -71,10 +71,15 @@ Extra modes (each also prints one JSON line per run):
                        line: TTFT p50 with copy-on-write prefix
                        caching on vs off on a repeated-prefix trace
                        (>=2x CPU gate, identical outputs, block
-                       conservation), and the paged-kernel line:
+                       conservation), the paged-kernel line:
                        int8 vs fp KV pools on a decode-dominated
                        trace (>=1.2x CPU gate, per-side exactness,
-                       per-step pool bytes <=0.6x asserted).
+                       per-step pool bytes <=0.6x asserted), and the
+                       tensor-parallel capacity line: TP=2 vs TP=1 on
+                       the same per-device KV byte budget (>=2x
+                       admission depth, <=0.55x per-device pool
+                       bytes/token, token identity — all
+                       deterministic gates).
 
 Every metric line additionally carries a ``memory`` watermark field on
 accelerator backends (peak_bytes_in_use vs bytes_limit, ROADMAP "Memory
@@ -543,7 +548,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
                 "serve_speculative_decode_speedup",
                 "serve_prefix_cache_ttft_speedup",
                 "serve_paged_kernel_decode_speedup",
-                "serve_overlap_decode_speedup"]
+                "serve_overlap_decode_speedup",
+                "serve_tp_shard_capacity"]
     if args.llama_train:
         return ["llama_1b_train_samples_per_sec_per_chip"]
     if args.mixtral_train:
@@ -604,6 +610,18 @@ def supervise(args: argparse.Namespace) -> None:
     print(f"[bench] backend ok: {info.get('platform')} x{info.get('n')} "
           f"({info.get('device_kind')})", file=sys.stderr)
     emit_provisional(metrics, "measuring", backend=info)
+
+    if (getattr(args, "serve", False) and info.get("platform") == "cpu"
+            and "xla_force_host_platform_device_count"
+            not in child_env.get("XLA_FLAGS", "")):
+        # the serve_tp_shard_capacity line shards an engine over 2
+        # devices; a CPU host exposes 1 by default, so force a 2-device
+        # host platform in the measured child (same mechanism the test
+        # conftest uses — harmless to the single-device lines, which
+        # keep placing everything on device 0)
+        child_env["XLA_FLAGS"] = (
+            child_env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
 
     child_argv = [sys.executable, os.path.abspath(__file__),
                   *sys.argv[1:], "--_child"]
@@ -824,7 +842,9 @@ def main() -> None:
                              "bucketed-gather decode speedup on a "
                              "short-context trace + the speculative "
                              "draft/verify decode speedup on a high-"
-                             "acceptance trace")
+                             "acceptance trace + the tensor-parallel "
+                             "shard-capacity line (TP=2 vs TP=1 on "
+                             "the same per-device KV byte budget)")
     parser.add_argument("--llama-train", action="store_true",
                         dest="llama_train",
                         help="TinyLlama-1.1B training throughput "

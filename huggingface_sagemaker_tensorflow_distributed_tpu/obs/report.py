@@ -339,6 +339,15 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # applies: a fully-hidden-overhead run worsening from 0.0 must
     # flag even though the percentage is undefined
     "serve_overhead_time_frac": (+1, "ratio"),
+    # tensor-parallel serving (ISSUE 13): the KV pool's PER-DEVICE
+    # byte footprint, worse UP — a lost heads-sharding (pools silently
+    # replicated), a dropped tp knob, or an fp pool where int8 was
+    # configured all show up as per-chip pool bytes growing for the
+    # same capacity, before any OOM does. Bytes metric like
+    # serve_kv_bytes_read_per_step; the shared zero-baseline rule
+    # applies (a 0-byte baseline only happens on unsized pools, and
+    # bytes appearing against it must still flag).
+    "serve_kv_pool_bytes_per_device": (+1, "ratio"),
 }
 
 
@@ -371,7 +380,8 @@ def _report_scalars(report: dict) -> dict:
                 "decode_tokens_per_sec", "preemptions",
                 "acceptance_rate", "cache_hit_rate",
                 "kv_bytes_read_per_step", "queue_wait_p99_s",
-                "preempted_time_frac", "overhead_time_frac"):
+                "preempted_time_frac", "overhead_time_frac",
+                "kv_pool_bytes_per_device"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out

@@ -114,6 +114,14 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               # byte-identical to the serial engine's
               "overlap": (bool,),
               "overlap_flushes": (int,),
+              # tensor-parallel serving (ISSUE 13): finish events and
+              # the final report carry the engine's mesh degree; the
+              # report additionally the KV pool's PER-DEVICE byte
+              # footprint (block count × per-device block bytes — the
+              # figure sharding divides by tp, and what `obsctl diff`
+              # gates as serve_kv_pool_bytes_per_device)
+              "tp": (int,),
+              "kv_pool_bytes_per_device": (int,),
               # request-lifecycle tracing (ISSUE 10): the
               # `request_timeline` event's five-way phase decomposition
               # (queue + prefill + decode + preempted + overhead sums

@@ -142,6 +142,35 @@ def make_banded_causal_mask(q_len: int, window: int,
 # ---------------------------------------------------------------------------
 
 
+def _pin_heads(x, axis: int):
+    """Under an ambient mesh with a >1 ``tensor`` axis (the serve
+    engine's TP mode traces its steps inside ``use_mesh``), pin ``x``'s
+    heads axis to it — the pools arrive sharded on heads, and pinning
+    the gathered view keeps GSPMD's propagation deterministic instead
+    of letting it re-replicate the per-step KV read (which would
+    round-trip ``1/tp``-resident pools through full-size intermediates
+    every decode step). No-op without an ambient mesh, a 1-wide tensor
+    axis, or a non-dividing head count (the engine rejects that case
+    for its own pools; other callers just stay unconstrained)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+        AXIS_TENSOR,
+        maybe_current_mesh,
+    )
+
+    mesh = maybe_current_mesh()
+    if mesh is None:
+        return x
+    tp = mesh.shape.get(AXIS_TENSOR, 1)
+    if tp <= 1 or x.shape[axis] % tp:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = [None] * x.ndim
+    spec[axis] = AXIS_TENSOR
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+
 def gather_paged_kv(pool, block_tables, width: int | None = None):
     """Materialize per-slot contiguous KV from a paged pool.
 
@@ -164,7 +193,13 @@ def gather_paged_kv(pool, block_tables, width: int | None = None):
     bucket far below ``max_model_len``, the step's read traffic (and
     the attention mask/logits width behind it) shrinks to the bucket
     instead of the full table span. Callers guarantee every valid
-    logical position is ``< width``."""
+    logical position is ``< width``.
+
+    Under a tensor-parallel serving mesh (pool sharded on its heads
+    axis, block tables replicated) the gather is shard-local per kv
+    head and the returned view stays heads-sharded (pinned via
+    :func:`_pin_heads`) — the read never leaves the shard that will
+    attend with it."""
     bs = pool.shape[1]
     if width is not None:
         if width % bs:
@@ -178,7 +213,8 @@ def gather_paged_kv(pool, block_tables, width: int | None = None):
         block_tables = block_tables[:, :nb]
     g = pool[block_tables]                     # [S, nb, bs, H, D]
     S, nb, bs, H, D = g.shape
-    return g.transpose(0, 3, 1, 2, 4).reshape(S, H, nb * bs, D)
+    return _pin_heads(g.transpose(0, 3, 1, 2, 4).reshape(S, H, nb * bs, D),
+                      axis=1)
 
 
 def scatter_paged_kv(pool, block_tables, positions, values):
@@ -188,7 +224,13 @@ def scatter_paged_kv(pool, block_tables, positions, values):
     here is the [n, blocks_per_slot] table of the written slots (one row
     per written token). Callers route writes for INACTIVE slots to the
     reserved null block 0 (never allocated to a request), so a fully
-    static-shape step can always scatter."""
+    static-shape step can always scatter.
+
+    Under a tensor-parallel serving mesh the write is shard-local like
+    the gather: ``values`` carries the pool's heads axis (sharded by
+    propagation from the model's own sharded K/V), the addressing
+    operands are replicated, and the output inherits the pool operand's
+    heads sharding — no collective on the write path."""
     bs = pool.shape[1]
     n = positions.shape[0]
     block_ids = jnp.take_along_axis(

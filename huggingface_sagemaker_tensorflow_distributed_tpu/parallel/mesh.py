@@ -42,6 +42,7 @@ crossing slices).
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 
@@ -194,6 +195,25 @@ def _dcn_grouped(devices: list, dcn_dp: int) -> list:
                 dcn_dp, len(groups), len(groups) // dcn_dp, len(groups))
         devices = [d for k in sorted(groups) for d in groups[k]]
     return devices
+
+
+@functools.lru_cache(maxsize=None)
+def tensor_parallel_mesh(tp: int) -> Mesh:
+    """A pure tensor-parallel serving mesh: ``dp=1 × tp`` over the
+    FIRST ``tp`` addressable devices. Cached so every caller asking for
+    the same degree gets the SAME ``Mesh`` object — mesh identity feeds
+    hashed jit static keys (the serve engine's :class:`CachePlan`
+    carries ``NamedSharding``s built from it), and a fresh-but-equal
+    mesh per engine build would silently retrace every step the warmup
+    already compiled."""
+    if tp < 1:
+        raise ValueError(f"tensor-parallel degree must be >= 1, got {tp}")
+    devices = jax.devices()
+    if len(devices) < tp:
+        raise ValueError(
+            f"tensor-parallel degree {tp} needs {tp} devices, "
+            f"{len(devices)} addressable")
+    return build_mesh(MeshConfig(dp=1, tp=tp), devices=devices[:tp])
 
 
 def shard_map_compat(fn, *, mesh, in_specs, out_specs, check_vma=None):

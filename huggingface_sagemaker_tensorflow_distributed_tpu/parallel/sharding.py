@@ -81,9 +81,11 @@ _PARAM_RULES: Sequence[tuple[str, tuple]] = (
     # attention projections: kernel shape (in, out)
     (r"(query|key|value|q_proj|k_proj|v_proj|qkv).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
     (r"(attention_out|out_proj|o_proj|attn_out).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),
-    # FFN
-    (r"(intermediate|wi|fc1|ffn_in|lin1|gate_proj|up_proj).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
-    (r"(ffn_out|wo|fc2|lin2|down_proj).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),
+    # FFN (fc_in/fc_out = the dense GPT-2 MLP naming — without it a
+    # tensor-parallel GPT-2 replicates its MLP, forfeiting half the
+    # per-chip memory win the serve engine's TP mode exists for)
+    (r"(intermediate|wi|fc1|fc_in|ffn_in|lin1|gate_proj|up_proj).*kernel$", (AXIS_FSDP, AXIS_TENSOR)),
+    (r"(ffn_out|wo|fc2|fc_out|lin2|down_proj).*kernel$", (AXIS_TENSOR, AXIS_FSDP)),
     # embeddings: (vocab, hidden)
     (r"embedding$", (AXIS_FSDP, None)),
     # classifier / pooler / lm heads: shard the big dim over fsdp
@@ -174,6 +176,30 @@ def batch_column_sharding(mesh: Mesh, ndim: int, dim1: int | None = None) -> Nam
             and dim1 % seq_size == 0 and seq_axis_is_process_local(mesh)):
         return NamedSharding(mesh, P(_BATCH_AXES, AXIS_SEQ))
     return NamedSharding(mesh, P(_BATCH_AXES))
+
+
+def kv_pool_sharding(mesh: Mesh, num_heads: int) -> NamedSharding:
+    """Sharding for one paged KV pool ``[num_blocks, block_size, H, D]``
+    (or an int8 scale pool ``[..., H, 1]``): the heads axis over
+    ``tensor``, everything else replicated — the layout that makes the
+    serve engine's per-device KV footprint ``1/tp`` of the model's
+    while block tables, context lens and token feeds stay replicated
+    host-side state.
+
+    Rejects LOUDLY when the pool's kv-head count does not divide over
+    the mesh's tensor degree (GQA included: it is the KV heads that
+    must divide, not the query heads — a Llama with ``num_kv_heads=2``
+    cannot serve at ``tp=4``). Unlike the param rules, which silently
+    replicate a non-dividing dim, a silently-replicated pool would
+    defeat the whole capacity story, so this is an error."""
+    tp = mesh.shape.get(AXIS_TENSOR, 1)
+    if num_heads % tp:
+        raise ValueError(
+            f"KV pool with {num_heads} kv heads cannot shard over a "
+            f"tensor={tp} mesh: num_kv_heads must be divisible by the "
+            f"tensor-parallel degree (GQA models shard their KV heads, "
+            f"not the query heads)")
+    return NamedSharding(mesh, P(None, None, AXIS_TENSOR, None))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

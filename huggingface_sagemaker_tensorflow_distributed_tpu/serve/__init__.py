@@ -27,7 +27,7 @@ def __getattr__(name):
     # host-only consumers (scheduler/block-manager tests)
     if name in ("ServeEngine", "EngineStats", "CachePlan",
                 "build_cache_plan", "parse_gather_buckets",
-                "parse_prefix_cache"):
+                "parse_prefix_cache", "parse_tp"):
         from huggingface_sagemaker_tensorflow_distributed_tpu.serve import (
             engine,
         )

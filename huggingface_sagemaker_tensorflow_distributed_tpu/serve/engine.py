@@ -94,7 +94,23 @@ speculation; vLLM + Orca + Sarathi + Leviathan lineage):
   discarding the wasted in-flight token) or drain the pipeline
   (preemption/KV pressure; ``overlap_flushes``); emitted tokens are
   identical to the serial loop's, which ``overlap='off'`` restores
-  byte-for-byte.
+  byte-for-byte. A LONE stream (decode occupancy 1, empty queue)
+  auto-flushes to the serial schedule — there is no concurrent host
+  work to hide, so the deferred fetch would only delay every token's
+  delivery by one iteration (ISSUE 13 follow-up).
+- **Tensor parallelism** (``mesh`` / ``HSTD_SERVE_TP``, ISSUE 13) —
+  one engine serves a model bigger than a chip: params place
+  Megatron-style over a ``tensor``-axis mesh
+  (``parallel/sharding.py::param_shardings``) and every KV pool
+  shards its HEADS axis (``kv_pool_sharding``; ``num_kv_heads % tp``
+  rejected loudly, GQA included), so each device holds ``1/tp`` of
+  every pool while block tables/context lens/token feeds stay
+  replicated — the scheduler, BlockManager, prefix cache and overlap
+  pipeline are untouched and output is token-identical to the
+  single-device engine. The KV byte budget re-denominates PER DEVICE
+  (``BlockManager.token_bytes`` = shard bytes/token), so the same
+  per-chip ``kv_pool_bytes`` admits ~tp× the concurrent requests —
+  the measurable capacity win even on CPU meshes.
 
 Decoding is greedy by default and token-for-token identical to
 per-request ``generate_causal`` — the exactness gate
@@ -127,6 +143,7 @@ and ``timeline='off'`` is byte-identical to the pre-tracing stream.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 import time
@@ -153,6 +170,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (
     BlockManager,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (
+    DECODE,
     Request,
     Scheduler,
 )
@@ -165,6 +183,27 @@ ENV_KERNEL = "HSTD_SERVE_KERNEL"
 ENV_KV_DTYPE = "HSTD_SERVE_KV_DTYPE"
 ENV_TIMELINE = "HSTD_SERVE_TIMELINE"
 ENV_OVERLAP = "HSTD_SERVE_OVERLAP"
+ENV_TP = "HSTD_SERVE_TP"
+
+
+def parse_tp(spec) -> int:
+    """The tensor-parallel degree knob (ISSUE 13): a positive int, the
+    number of devices one engine shards its params + KV pools over.
+    None reads ``HSTD_SERVE_TP`` (default 1 = the single-device
+    engine). Rejects non-integers and non-positive values here; the
+    divisibility contracts (device count, kv heads) are enforced where
+    the mesh and pool shardings are built — with the offending figure
+    named."""
+    if spec is None:
+        spec = os.environ.get(ENV_TP, "1") or "1"
+    try:
+        tp = int(str(spec).strip() or "1")
+    except ValueError:
+        raise ValueError(f"unparseable {ENV_TP} value {spec!r}: "
+                         "expected a positive integer")
+    if tp < 1:
+        raise ValueError(f"{ENV_TP} must be >= 1, got {tp}")
+    return tp
 
 
 def parse_kernel(spec: Union[str, None]) -> str:
@@ -291,25 +330,58 @@ class CachePlan(NamedTuple):
     so the PAGED cache (kernel mode) can be built as a nested dict with
     a ``block_tables`` sibling injected per attention scope — and the
     mutated pools re-extracted by NAME, immune to the flatten-order
-    shift the extra leaf causes."""
+    shift the extra leaf causes.
+
+    ``kv_shardings`` (ISSUE 13) is one ``NamedSharding`` per KV POOL
+    (pool-index order, empty for a single-device plan): each pool's
+    heads axis over the mesh's ``tensor`` axis. It is how the in/out
+    shardings reach the jitted step families — the engine places the
+    pools with these at init (jit derives its in-shardings from the
+    committed operands) and the steps re-pin their pool OUTPUTS to the
+    same shardings, so the pools-chain can never drift off the mesh
+    mid-serve. ``NamedSharding`` hashes by (mesh, spec), so a TP plan
+    and a single-device plan over the same model are distinct static
+    keys — each compiles its own executables, one per bucket, exactly
+    like two engines over different models would."""
 
     treedef: Any
     kinds: tuple
     paths: tuple
+    kv_shardings: tuple = ()
 
 
-# (model, max_ctx) -> (plan, pool_shapes): the cache structure is a
-# function of the model config + width, so engine rebuilds (bench's
-# measured pass, server restarts) skip the eval_shape re-trace
+def _constrain_pools(pools, plan: CachePlan):
+    """Re-pin mutated pools to the plan's shardings (no-op for a
+    single-device plan): the out-sharding half of the TP contract —
+    scatter/gather propagation already keeps the heads axis sharded,
+    but pinning makes it a stated invariant rather than an inference."""
+    if not plan.kv_shardings:
+        return pools
+    return [lax.with_sharding_constraint(p, s)
+            for p, s in zip(pools, plan.kv_shardings)]
+
+
+# (model, max_ctx, mesh) -> (plan, pool_shapes): the cache structure is
+# a function of the model config + width (+ the serving mesh, which
+# only adds shardings), so engine rebuilds (bench's measured pass,
+# server restarts) skip the eval_shape re-trace
 _PLAN_CACHE: dict = {}
 
 
-def build_cache_plan(model, params, max_ctx: int) -> tuple[CachePlan, list]:
+def build_cache_plan(model, params, max_ctx: int,
+                     mesh=None) -> tuple[CachePlan, list]:
     """(plan, pool_shapes): traverse the cache collection's SHAPE (via
     ``jax.eval_shape`` — nothing is allocated) for a batch-1 decode at
     width ``max_ctx`` and classify every leaf. ``pool_shapes`` is one
-    ``(heads, head_dim, dtype)`` per KV leaf in flatten order."""
-    key = (model, max_ctx)
+    ``(heads, head_dim, dtype)`` per KV leaf in flatten order.
+
+    With ``mesh`` (a tensor-parallel serving mesh, ISSUE 13) the plan
+    additionally carries one ``NamedSharding`` per pool — heads over
+    the ``tensor`` axis — and REJECTS loudly any pool whose kv-head
+    count does not divide the tensor degree (GQA included: the check is
+    on each cache leaf's own head count, which for GQA models is
+    ``num_kv_heads``)."""
+    key = (model, max_ctx, mesh)
     cached = _PLAN_CACHE.get(key)
     if cached is not None:
         return cached
@@ -347,7 +419,16 @@ def build_cache_plan(model, params, max_ctx: int) -> tuple[CachePlan, list]:
                 "speaks the cached_key/cached_value (+ int8 scale) "
                 "protocol only")
         paths.append(names)
-    result = CachePlan(treedef, tuple(kinds), tuple(paths)), pool_shapes
+    kv_shardings: tuple = ()
+    if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
+            kv_pool_sharding,
+        )
+
+        kv_shardings = tuple(kv_pool_sharding(mesh, h)
+                             for h, _d, _dt in pool_shapes)
+    result = (CachePlan(treedef, tuple(kinds), tuple(paths),
+                        kv_shardings), pool_shapes)
     _PLAN_CACHE[key] = result
     return result
 
@@ -408,7 +489,7 @@ def _decode_step(model, params, pools, tokens, block_tables, context_lens,
             leaf, pos[:, None, None, None], axis=2)[:, :, 0, :]  # [S, H, D]
         new_pools[kind[1]] = scatter_paged_kv(
             new_pools[kind[1]], safe_tables, pos, written)
-    return next_tok, new_pools
+    return next_tok, _constrain_pools(new_pools, plan)
 
 
 def _paged_cache(plan: CachePlan, pools, block_tables, context_lens):
@@ -522,7 +603,7 @@ def _prefill_chunk(model, params, pools, chunks, block_tables, start, rel,
         written = written.transpose(0, 2, 1, 3).reshape(G * C, h, d)
         new_pools[kind[1]] = scatter_paged_kv(
             new_pools[kind[1]], tables_tok, positions, written)
-    return next_tok, new_pools
+    return next_tok, _constrain_pools(new_pools, plan)
 
 
 @functools.lru_cache(maxsize=2)
@@ -557,7 +638,12 @@ def _copy_block(pools, src, dst):
     ``dst`` across every pool of one model's KV address space. Scalar
     src/dst are traced, so ONE compile covers every COW a pool
     geometry ever performs (fixed shape — the compile-flatness gates
-    stay honest on the cache-hit path)."""
+    stay honest on the cache-hit path). Under a tensor-parallel mesh
+    the copy is shard-local by construction: the pools are sharded on
+    their heads axis and the copy addresses only the (replicated)
+    block axis, so each device duplicates its own head slice — output
+    sharding propagates from the pool operand, no collective, and the
+    one-compile contract holds per sharding like any other step."""
     return [p.at[dst].set(p[src]) for p in pools]
 
 
@@ -624,7 +710,7 @@ def _scatter_window(pools, plan: CachePlan, cache_leaves, block_tables,
         written = written.transpose(0, 2, 1, 3).reshape(S * (k + 1), h, d)
         new_pools[kind[1]] = scatter_paged_kv(
             new_pools[kind[1]], tables_tok, flat_pos, written)
-    return new_pools
+    return _constrain_pools(new_pools, plan)
 
 
 def _spec_decode_step(model, params, draft_model, draft_params, t_pools,
@@ -787,6 +873,11 @@ class EngineStats(NamedTuple):
     # dispatch-ahead pipeline (ISSUE 12)
     overlap: bool = False
     overlap_flushes: int = 0
+    # tensor-parallel serving (ISSUE 13): the mesh degree and the KV
+    # pool's per-device footprint (num_blocks × per-device block
+    # bytes — kv_token_bytes above is already per-device under TP)
+    tp: int = 1
+    kv_pool_bytes_per_device: int = 0
 
 
 class ServeEngine:
@@ -880,7 +971,33 @@ class ServeEngine:
     the next dispatch (acceptance counts are data-dependent) and
     overlaps the next iteration's admission/prefill/telemetry
     instead. ``overlap='off'`` restores the serial loop byte-for-byte
-    in telemetry."""
+    in telemetry.
+
+    ``mesh`` (ISSUE 13) makes the engine TENSOR-PARALLEL — one engine
+    serving a model bigger than a chip. Pass a ``jax.sharding.Mesh``
+    with a ``tensor`` axis, an int degree (a ``dp=1 × tp`` mesh over
+    the first ``tp`` devices is built via
+    ``parallel.mesh.tensor_parallel_mesh``), or None to read
+    ``HSTD_SERVE_TP`` (default 1 = single-device). Params are placed
+    with ``parallel.sharding.param_shardings`` (Megatron layout) and
+    every per-layer KV pool — int8 scale pools included — shards its
+    HEADS axis over ``tensor`` (``[num_blocks, block_size, H, D]``
+    shards on H cleanly; ``num_kv_heads % tp == 0`` is required and
+    rejected loudly otherwise, GQA included). Block tables, context
+    lens and token feeds stay replicated, so the host-side scheduler,
+    BlockManager, prefix cache, dispatch-ahead pipeline and timeline
+    stamps are untouched — the TP engine emits token-identical output
+    to the single-device engine. The KV byte budget re-denominates PER
+    DEVICE: ``BlockManager.token_bytes`` becomes each shard's bytes
+    per resident token (``1/tp`` of the model's), so
+    ``kv_pool_bytes`` — a per-device figure — buys a TP=2 engine ~2x
+    the blocks, and through the scheduler's block-denominated
+    admission math, ~2x the concurrently-resident requests on the
+    same per-chip memory. Compile expectations are unchanged: one
+    step compile per bucket per engine (a TP plan is its own static
+    key; sharding mints no extra variants within it).
+    ``kernel='pallas'`` does not compose with ``mesh`` (the fused
+    kernel would need a shard_map port) and is rejected loudly."""
 
     #: consecutive iterations a smaller bucket must suffice before the
     #: engine shrinks to it — bounds bucket churn when the max resident
@@ -900,7 +1017,8 @@ class ServeEngine:
                  kv_cache_dtype: Union[str, None] = None,
                  kv_pool_bytes: Optional[int] = None,
                  timeline: Union[str, bool, None] = None,
-                 overlap: Union[str, bool, None] = None):
+                 overlap: Union[str, bool, None] = None,
+                 mesh=None):
         cfg = model.config
         if getattr(cfg, "num_experts", 0):
             raise ValueError(
@@ -912,6 +1030,35 @@ class ServeEngine:
             raise ValueError("ServeEngine needs the dense stack "
                              "(pipeline_stages=0)")
         self.kernel = parse_kernel(kernel)
+        # tensor-parallel mesh resolution (ISSUE 13): an explicit Mesh,
+        # an int degree, or the HSTD_SERVE_TP env default
+        from jax.sharding import Mesh as _Mesh
+
+        if isinstance(mesh, _Mesh):
+            self.mesh = mesh
+            self.tp = int(mesh.shape.get("tensor", 1))
+            if self.tp < 2:
+                # a mesh without a >1 tensor axis is the single-device
+                # engine with extra steps — treat it as one
+                self.mesh = None
+                self.tp = 1
+        else:
+            self.tp = parse_tp(mesh)
+            if self.tp > 1:
+                from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+                    tensor_parallel_mesh,
+                )
+
+                self.mesh = tensor_parallel_mesh(self.tp)
+            else:
+                self.mesh = None
+        if self.mesh is not None and self.kernel == "pallas":
+            raise ValueError(
+                "kernel='pallas' does not compose with a tensor-parallel "
+                "mesh: the fused paged kernel reads whole pools and "
+                "would need a shard_map port — serve TP with the xla "
+                "gather path (the kernel is a per-chip bandwidth "
+                "optimization; TP is a capacity one)")
         self.kv_cache_dtype = parse_kv_dtype(
             kv_cache_dtype, getattr(cfg, "kv_cache_dtype", "fp"))
         if self.kv_cache_dtype != getattr(cfg, "kv_cache_dtype", "fp"):
@@ -927,6 +1074,17 @@ class ServeEngine:
             cfg = dataclasses.replace(cfg,
                                       kv_cache_dtype=self.kv_cache_dtype)
             model = type(model)(cfg)
+        if self.mesh is not None:
+            # place the params once, Megatron layout: qkv/FFN-in
+            # column-parallel, attn-out/FFN-out row-parallel — the
+            # committed shardings are what drive every jitted step's
+            # SPMD partitioning (jit derives in-shardings from them)
+            from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
+                param_shardings,
+            )
+
+            params = jax.device_put(params,
+                                    param_shardings(params, self.mesh))
         self.model, self.params = model, params
         self.eos_token_id = int(cfg.eos_token_id)
         self.pad_token_id = min(int(cfg.pad_token_id), cfg.vocab_size - 1)
@@ -950,13 +1108,20 @@ class ServeEngine:
         self.timeline = parse_timeline(timeline)
         self.overlap = parse_overlap(overlap)
         plan, pool_shapes = build_cache_plan(model, params,
-                                             self.max_model_len)
+                                             self.max_model_len,
+                                             mesh=self.mesh)
         self._plan = plan
         # bytes one resident token costs across every pool (int8 KV +
         # its fp32 scale plane included) — the figure that sizes a
-        # byte-budgeted pool and denominates kv_bytes_read telemetry
+        # byte-budgeted pool and denominates kv_bytes_read telemetry.
+        # Under a tensor-parallel mesh this re-denominates PER DEVICE
+        # (each shard holds H/tp heads of every pool — exact, the plan
+        # already validated divisibility): kv_pool_bytes is a per-chip
+        # budget, so a TP=2 engine on the same per-chip figure holds
+        # ~2x the blocks and admits ~2x the concurrent requests — the
+        # capacity win sharding buys
         token_bytes = sum(h * d * np.dtype(dtype).itemsize
-                          for h, d, dtype in pool_shapes)
+                          for h, d, dtype in pool_shapes) // self.tp
         if kv_pool_bytes is not None:
             # size the pool by a KV MEMORY budget instead of a block
             # count: int8 pools (~half the bytes/token) get ~2x the
@@ -989,8 +1154,16 @@ class ServeEngine:
                                    if b >= self.speculate_k + 1]
         self.prefill_batch = max(1, min(int(prefill_batch), self.num_slots))
 
-        self._pools = [jnp.zeros((num_blocks, block_size, h, d), dtype)
-                       for h, d, dtype in pool_shapes]
+        # place every pool heads-sharded over the mesh: the committed
+        # shardings ARE the jitted steps' pool in-shardings, and
+        # _constrain_pools pins the outputs to the same, so the
+        # pools-chain stays on the mesh end to end. Sharded pools are
+        # materialized from HOST zeros — device_put splits a numpy
+        # array into per-device shards directly, whereas a jnp.zeros
+        # would first allocate the FULL pool on one device, which is
+        # exactly the footprint a bigger-than-a-chip model cannot fit
+        self._pools = self._init_pools(num_blocks, block_size,
+                                       pool_shapes, plan)
         # speculative mode: the draft model's paged pools ride the SAME
         # block tables/allocator as the target's — one allocation
         # domain, two KV address spaces (per-block bytes grow by the
@@ -1011,12 +1184,24 @@ class ServeEngine:
                     "draft and target must share a vocabulary (got "
                     f"{self.draft_model.config.vocab_size} vs "
                     f"{cfg.vocab_size})")
+            if self.mesh is not None:
+                # the draft inherits the target's parallelism: its
+                # params (a layer subset or a second checkpoint) place
+                # by the same Megatron rules, its pools shard on the
+                # same heads axis over the same mesh
+                from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.sharding import (
+                    param_shardings,
+                )
+
+                self.draft_params = jax.device_put(
+                    self.draft_params,
+                    param_shardings(self.draft_params, self.mesh))
             d_plan, d_pool_shapes = build_cache_plan(
-                self.draft_model, self.draft_params, self.max_model_len)
+                self.draft_model, self.draft_params, self.max_model_len,
+                mesh=self.mesh)
             self._d_plan = d_plan
-            self._d_pools = [jnp.zeros((num_blocks, block_size, h, d),
-                                       dtype)
-                             for h, d, dtype in d_pool_shapes]
+            self._d_pools = self._init_pools(num_blocks, block_size,
+                                             d_pool_shapes, d_plan)
         # the jitted step functions are MODULE-level and keyed on
         # (model, plan, width, sampled) static args: a second engine
         # over the same model/geometry — the bench's measured pass, a
@@ -1061,6 +1246,24 @@ class ServeEngine:
         self._iter_prefill_s = 0.0
         self._iter_decode_s = 0.0
         self._iter_decode_slots = 0
+
+    @staticmethod
+    def _init_pools(num_blocks: int, block_size: int, pool_shapes,
+                    plan: CachePlan) -> list:
+        """Zeroed KV pools, placed per the plan. Sharded pools go
+        through ``jax.device_put(host_zeros, sharding)`` so each
+        device only ever materializes its own ``1/tp`` shard — a
+        ``jnp.zeros`` would transiently allocate the WHOLE pool on the
+        default device first, OOMing init in precisely the
+        bigger-than-a-chip regime TP serves."""
+        if not plan.kv_shardings:
+            return [jnp.zeros((num_blocks, block_size, h, d), dtype)
+                    for h, d, dtype in pool_shapes]
+        return [jax.device_put(
+                    np.zeros((num_blocks, block_size, h, d),
+                             np.dtype(dtype)), s)
+                for (h, d, dtype), s in zip(pool_shapes,
+                                            plan.kv_shardings)]
 
     # -- public API ----------------------------------------------------------
 
@@ -1115,7 +1318,7 @@ class ServeEngine:
         modes = [m for m in modes if m not in self._warmed_modes]
         if not modes:
             return
-        with obs.span("serve/warmup"):
+        with self._mesh_ctx(), obs.span("serve/warmup"):
             C = self.sched.prefill_chunk
             nb = self.max_blocks_per_seq
             S = self.num_slots
@@ -1240,6 +1443,12 @@ class ServeEngine:
                 self.decode_tokens / self.decode_time_s, 1)
         out["kernel"] = self.kernel
         out["kv_dtype"] = self.kv_cache_dtype
+        # tensor-parallel serving (ISSUE 13): the degree + the pool's
+        # per-device byte footprint (what `obsctl diff` watches as
+        # serve_kv_pool_bytes_per_device — more bytes per device for
+        # the same capacity is worse)
+        out["tp"] = self.tp
+        out["kv_pool_bytes_per_device"] = self.blocks.pool_bytes
         if self.overlap:
             # dispatch-ahead accounting (absent entirely with the
             # overlap off — that stream stays byte-identical to the
@@ -1355,7 +1564,9 @@ class ServeEngine:
             kv_bytes_read=self.kv_bytes_read,
             kv_token_bytes=self.blocks.token_bytes,
             overlap=self.overlap,
-            overlap_flushes=self.overlap_flushes)
+            overlap_flushes=self.overlap_flushes,
+            tp=self.tp,
+            kv_pool_bytes_per_device=self.blocks.pool_bytes)
 
     def _aggregate_hit_rate(self) -> Optional[float]:
         """Prompt tokens served from cache / prompt tokens admitted,
@@ -1389,7 +1600,25 @@ class ServeEngine:
         computed) tokens — see :meth:`_dispatch_decode` /
         :meth:`_commit_decode`. A speculative engine commits its
         in-flight window first (:meth:`_commit_spec`) because the next
-        window's inputs are data-dependent on the acceptance counts."""
+        window's inputs are data-dependent on the acceptance counts.
+
+        Under a tensor-parallel mesh (ISSUE 13) the whole iteration
+        runs inside ``use_mesh`` — the ambient mesh model code (and
+        the gathered-view head pinning in ``ops.attention``) keys on;
+        every dispatch's SPMD partitioning is otherwise driven by the
+        committed param/pool shardings alone."""
+        with self._mesh_ctx():
+            self._step()
+
+    def _mesh_ctx(self):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.parallel.mesh import (
+            use_mesh,
+        )
+
+        return (use_mesh(self.mesh) if self.mesh is not None
+                else contextlib.nullcontext())
+
+    def _step(self) -> None:
         t_iter0 = time.perf_counter()
         tokens0 = self.tokens_generated
         chunks0, disp0 = self.prefill_chunks, self.prefill_dispatches
@@ -1453,8 +1682,26 @@ class ServeEngine:
                     and not self._capacity_covered()):
                 self._flush("kv_pressure")
             self._capacity_phase()
-            prev, self._pending = self._pending, self._dispatch_decode()
-            self._commit_decode(prev)
+            if self._lone_stream():
+                # low-load auto-flush (ISSUE 13, the PR 12 TTFT
+                # follow-up): a LONE stream with nothing waiting has
+                # no concurrent host work for the pipeline to hide —
+                # dispatch-ahead would only defer every token's fetch
+                # (and the final token's delivery) by one iteration.
+                # Run this iteration serially instead: land any
+                # in-flight dispatch (a plain commit, not a forced
+                # drain — overlap_flushes counts mandatory drains
+                # only), then dispatch+fetch in one go, exactly the
+                # overlap='off' schedule. The condition re-evaluates
+                # every iteration, so the pipeline re-engages the
+                # moment a second stream admits.
+                prev, self._pending = self._pending, None
+                self._commit_decode(prev)
+                self._decode_all()
+            else:
+                prev, self._pending = (self._pending,
+                                       self._dispatch_decode())
+                self._commit_decode(prev)
         # per-iteration scheduler gauges (SLO telemetry): queue pressure
         # and slot occupancy as series, one sample per engine iteration
         waiting = len(self.sched.waiting)
@@ -1500,6 +1747,18 @@ class ServeEngine:
                 # comes back (a killed run) still left its history
                 req.preempt_t = time.perf_counter()
                 self._emit_timeline(req, "preempt", req.preempt_t)
+
+    def _lone_stream(self) -> bool:
+        """True when decode-batch occupancy is exactly one and the
+        waiting queue is empty — the dispatch-ahead pipeline's
+        auto-flush condition (ISSUE 13): the single resident stream is
+        decoding, no other slot is prefilling alongside it and nothing
+        is queued, so there is no concurrent host work to overlap and
+        the deferred fetch would be pure added latency per token."""
+        busy = [s for s in self.sched.slots if not s.free]
+        return (not self.sched.waiting and len(busy) == 1
+                and busy[0].request is not None
+                and busy[0].request.state == DECODE)
 
     def _capacity_covered(self) -> bool:
         """True when every decode slot's next write span is coverable
@@ -2209,6 +2468,7 @@ class ServeEngine:
                     if req.cache_hit_rate is not None else None)
             extra["kernel"] = self.kernel
             extra["kv_dtype"] = self.kv_cache_dtype
+            extra["tp"] = self.tp
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
                       preemptions=req.preemptions, **extra)

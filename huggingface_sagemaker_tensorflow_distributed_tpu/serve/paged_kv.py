@@ -91,7 +91,11 @@ class BlockManager:
         # capacity be reasoned about (and pools be sized) in BYTES:
         # ``ServeEngine(kv_pool_bytes=...)`` divides a memory budget by
         # ``block_bytes``, so int8 pools hold ~2x the blocks — and
-        # admit ~2x the requests — of fp pools on the same budget
+        # admit ~2x the requests — of fp pools on the same budget.
+        # Under a tensor-parallel engine (ISSUE 13) this is each
+        # SHARD's bytes/token (the model's figure / tp), making the
+        # budget — and every byte-denominated gauge derived here —
+        # per DEVICE: same per-chip budget, tp× the blocks.
         self.token_bytes = int(token_bytes)
         # LIFO free list: recently-freed (cache-warm) blocks are reused
         # first; block 0 excluded for good
@@ -146,6 +150,16 @@ class BlockManager:
         """Pool bytes ``n_tokens`` of resident context occupies
         (block-granular — the allocation, not the useful payload)."""
         return self.blocks_for(n_tokens) * self.block_bytes
+
+    @property
+    def pool_bytes(self) -> int:
+        """Total pool footprint in ``token_bytes`` terms — under a
+        tensor-parallel engine this is the PER-DEVICE figure (the
+        engine hands this manager each shard's bytes/token), which is
+        the point: the same token capacity costs ``1/tp`` the HBM per
+        chip, or equivalently the same per-chip budget holds ``tp``×
+        the blocks. 0 when built without a ``token_bytes`` figure."""
+        return self.num_blocks * self.block_bytes
 
     @property
     def num_free(self) -> int:

@@ -205,7 +205,16 @@ class Scheduler:
     ``decode_lookahead`` reservation measured from the advanced
     context covers the in-flight step's write span by construction —
     and the engine drains the pipeline before any path that can
-    preempt, so recompute always folds fully committed output."""
+    preempt, so recompute always folds fully committed output.
+
+    Under a tensor-parallel engine (ISSUE 13) nothing here changes:
+    all capacity math is denominated in BLOCKS, and a block is a
+    mesh-wide logical unit (every device holds its head slice of it).
+    The per-device re-denomination happens one layer down — the
+    engine hands :class:`~.paged_kv.BlockManager` each SHARD's
+    bytes/token, so a byte budget buys ``tp``× the blocks and this
+    scheduler's unchanged block-denominated admission math admits
+    ``tp``× the concurrent requests on the same per-chip memory."""
 
     def __init__(self, num_slots: int, blocks: BlockManager,
                  prefill_chunk: int, max_model_len: int,

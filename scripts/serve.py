@@ -34,7 +34,12 @@ per-request lifecycle tracing: each output row carries its phase
 decomposition (queue/prefill/decode/preempted seconds), the summary the
 run-wide phase fractions + queue-wait p99, and the telemetry stream the
 ``request_timeline``/``iteration_ledger`` events that ``obsctl
-timeline|slo|tail`` consume.
+timeline|slo|tail`` consume. ``--tp N`` (``HSTD_SERVE_TP``, default 1)
+serves TENSOR-PARALLEL: params + KV pools shard over N devices (pools
+on their heads axis — ``num_kv_heads % N == 0`` required), output
+stays token-identical to the single-device engine, and the per-device
+KV byte budget buys ~N× the resident requests; rows and the summary
+carry ``tp``, the summary additionally ``kv_pool_bytes_per_device``.
 """
 
 from __future__ import annotations
@@ -185,6 +190,14 @@ def main() -> None:
                              "events + phase decomposition in the "
                              "summary; default: HSTD_SERVE_TIMELINE "
                              "or on)")
+    parser.add_argument("--tp", type=int, default=None,
+                        help="tensor-parallel degree: shard params + "
+                             "KV pools (heads axis) over this many "
+                             "devices so one engine serves models "
+                             "bigger than a chip; num_kv_heads must "
+                             "divide (rejected loudly otherwise) and "
+                             "the KV byte budget re-denominates per "
+                             "device (default: HSTD_SERVE_TP or 1)")
     parser.add_argument("--overlap", default=None,
                         choices=("on", "off"),
                         help="dispatch-ahead decode loop: host "
@@ -226,7 +239,8 @@ def main() -> None:
                          kernel=args.kernel,
                          kv_cache_dtype=args.kv_cache_dtype,
                          timeline=args.timeline,
-                         overlap=args.overlap)
+                         overlap=args.overlap,
+                         mesh=args.tp)
     trace = load_trace(args, model.config.vocab_size - 1)
     # precompile the sampled step variants too when the trace will
     # sample, so no request pays a mid-serve compile
@@ -246,7 +260,7 @@ def main() -> None:
             "output_ids": [int(t) for t in ids],
             "ttft_s": round(req.ttft_s, 4) if req.ttft_s else None,
             "sampled": req.sampled, "seed": req.seed,
-            "preemptions": req.preemptions}
+            "preemptions": req.preemptions, "tp": engine.tp}
         if engine.speculative:
             row["acceptance_rate"] = (
                 round(req.spec_accepted / req.spec_proposed, 4)
@@ -312,6 +326,8 @@ def main() -> None:
                             if engine.overlap else None),
         "kernel": stats.kernel,
         "kv_dtype": stats.kv_dtype,
+        "tp": stats.tp,
+        "kv_pool_bytes_per_device": stats.kv_pool_bytes_per_device or None,
         "kv_bytes_read_per_step": (round(
             stats.kv_bytes_read / stats.decode_steps, 1)
             if stats.decode_steps else None),

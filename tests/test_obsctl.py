@@ -94,7 +94,9 @@ def three_hosts(tmp_path):
                               queue_time_frac=0.2,
                               decode_time_frac=0.7,
                               preempted_time_frac=0.05,
-                              overhead_time_frac=0.05))
+                              overhead_time_frac=0.05,
+                              tp=2,
+                              kv_pool_bytes_per_device=1 << 20))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -494,6 +496,57 @@ def test_diff_overhead_time_frac_is_a_ratio_metric(three_hosts):
         d = diff_reports(a, b, threshold_pct=5.0)
         assert "serve_overhead_time_frac" in d["skipped"]
         assert "serve_overhead_time_frac" not in d["regressions"]
+
+
+def test_diff_kv_pool_bytes_per_device_is_bytes_metric(three_hosts):
+    """ISSUE 13: `serve_kv_pool_bytes_per_device` diffs as a bytes
+    metric whose worse direction is UP — a lost heads-sharding (pools
+    silently replicated), a dropped tp knob, or an fp pool where int8
+    was configured all show up as per-chip pool bytes growing for the
+    same capacity. Standard threshold + zero-baseline rules, poison
+    rows skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["kv_pool_bytes_per_device"] == 1 << 20
+    worse = copy.deepcopy(base)
+    worse["serve"]["kv_pool_bytes_per_device"] = 2 << 20   # un-sharded
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_kv_pool_bytes_per_device" in d["regressions"]
+    assert d["metrics"]["serve_kv_pool_bytes_per_device"][
+        "worse_direction"] == "up"
+    # the better direction (sharding landed, bytes halved) never flags;
+    # nor does a sub-threshold drift
+    assert "serve_kv_pool_bytes_per_device" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["kv_pool_bytes_per_device"] = int(1.02 * (1 << 20))
+    assert "serve_kv_pool_bytes_per_device" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # zero baseline (unsized pool): bytes appearing must still flag
+    # even though the percentage is undefined — the shared rule
+    zero = copy.deepcopy(base)
+    zero["serve"]["kv_pool_bytes_per_device"] = 0
+    worse0 = copy.deepcopy(zero)
+    worse0["serve"]["kv_pool_bytes_per_device"] = 1 << 18
+    d0 = diff_reports(zero, worse0, threshold_pct=5.0)
+    assert "serve_kv_pool_bytes_per_device" in d0["regressions"]
+    assert d0["metrics"]["serve_kv_pool_bytes_per_device"]["pct"] is None
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["kv_pool_bytes_per_device"] = "one chip's worth"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["kv_pool_bytes_per_device"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_kv_pool_bytes_per_device" in d["skipped"]
+        assert "serve_kv_pool_bytes_per_device" not in d["regressions"]
 
 
 def test_diff_poisoned_lifecycle_metrics_skip_not_crash(three_hosts):

@@ -1515,3 +1515,279 @@ def test_parse_overlap_knob(monkeypatch):
     assert parse_overlap(None) is True
     with pytest.raises(ValueError, match=ENV_OVERLAP):
         parse_overlap("sometimes")
+
+
+# -- ISSUE 13: tensor-parallel serving engine --------------------------------
+
+def _run_tp_pair(model, params, trace, tp=2, **engine_kw):
+    """Serve the same trace on a single-device engine and a TP-mesh
+    engine (the 8-fake-CPU-device conftest backend); returns
+    (base_outputs, tp_outputs, tp_engine). The tentpole gate: sharding
+    must be semantically invisible — token-identical output."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    outs = {}
+    engines = {}
+    for mesh in (None, tp):
+        eng = ServeEngine(model, params, mesh=mesh, **engine_kw)
+        reqs = [eng.submit(p, m) for p, m in trace]
+        eng.run()
+        outs[mesh] = [[int(t) for t in eng.output_ids(r)] for r in reqs]
+        engines[mesh] = eng
+    assert engines[None].tp == 1 and engines[None].mesh is None
+    assert engines[tp].tp == tp and engines[tp].mesh is not None
+    return outs[None], outs[tp], engines[tp]
+
+
+def test_tp_engine_token_exact_across_bucket_boundary(gpt2_setup,
+                                                      devices8):
+    """The ISSUE 13 tier-1 exactness gate, half 1: a TP=2 engine
+    (params Megatron-sharded, every KV pool sharded on heads) emits
+    token-identical output to the TP=1 engine across a gather-bucket
+    boundary — and its per-device KV accounting is half the model's."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(31)
+    # contexts cross the 16-wide first bucket mid-decode
+    trace = [(rng.randint(1, 120, (p,)).astype(np.int32), m)
+             for p, m in [(5, 9), (15, 6), (12, 8)]]
+    base, tp, eng = _run_tp_pair(
+        model, params, trace, num_slots=3, block_size=4, num_blocks=40,
+        prefill_chunk=8, max_model_len=32, gather_buckets=[16, 32])
+    assert tp == base
+    assert eng.bucket_switches > 0          # the boundary really moved
+    # per-device re-denomination: each of the 2 shards holds half the
+    # heads, so bytes/token halves vs the model's own figure
+    # (num_layers × K+V × hidden × 4 bytes fp32)
+    assert eng.blocks.token_bytes * 2 == \
+        cfg.num_layers * 2 * cfg.hidden_size * 4
+    slo = eng.slo_summary()
+    assert slo["tp"] == 2
+    assert slo["kv_pool_bytes_per_device"] == eng.blocks.pool_bytes
+
+
+def test_tp_engine_token_exact_under_forced_preemption(gpt2_setup,
+                                                       devices8):
+    """The ISSUE 13 tier-1 exactness gate, half 2: recompute
+    preemption on the sharded engine — re-prefill over sharded pools
+    reproduces the stream exactly, and the per-device byte figure is
+    half the single-device engine's on the same geometry."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(1)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 18)
+             for _ in range(5)]
+    base, tp, eng = _run_tp_pair(
+        model, params, trace, num_slots=4, block_size=4, num_blocks=10,
+        prefill_chunk=8, max_model_len=32)
+    assert tp == base
+    assert eng.stats().preemptions > 0
+    assert eng.stats().tp == 2
+    # same block geometry, half the bytes per device
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    lone = ServeEngine(model, params, num_slots=4, block_size=4,
+                       num_blocks=10, prefill_chunk=8, max_model_len=32)
+    assert eng.blocks.token_bytes * 2 == lone.blocks.token_bytes
+    assert eng.blocks.pool_bytes * 2 == lone.blocks.pool_bytes
+
+
+def test_tp_engine_kv_pool_bytes_budget_doubles_admission(gpt2_setup,
+                                                          devices8):
+    """The capacity story the bench line gates, as a unit test: on the
+    SAME per-device ``kv_pool_bytes`` budget a TP=2 engine holds ~2x
+    the blocks and keeps ~2x the requests concurrently resident
+    (uniform block need: prompts pad to one chunk, continuations fit
+    the padded span)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(32)
+    trace = [(rng.randint(1, 120, (6,)).astype(np.int32), 2)
+             for _ in range(8)]
+    lone = ServeEngine(model, params, num_slots=1, block_size=4,
+                       num_blocks=4, prefill_chunk=8, max_model_len=32)
+    budget = 4 * 4 * lone.blocks.token_bytes     # 4 blocks single-device
+    kw = dict(num_slots=6, block_size=4, num_blocks=999, prefill_chunk=8,
+              max_model_len=32, kv_pool_bytes=budget)
+    engs = {}
+    for mesh in (None, 2):
+        eng = ServeEngine(model, params, mesh=mesh, **kw)
+        reqs = [eng.submit(p, m) for p, m in trace]
+        eng.run()
+        engs[mesh] = (eng, [[int(t) for t in eng.output_ids(r)]
+                            for r in reqs])
+    base, tp = engs[None][0], engs[2][0]
+    assert engs[2][1] == engs[None][1]
+    assert base.blocks.num_blocks == 5 and tp.blocks.num_blocks == 9
+    assert tp.peak_resident >= 2 * base.peak_resident
+    # same per-device budget — the pools cost each chip the same bytes
+    assert tp.blocks.pool_bytes <= budget + tp.blocks.block_bytes
+
+
+@pytest.mark.slow
+def test_tp_engine_speculative_prefix_int8_composition(gpt2_setup,
+                                                       devices8):
+    """The sharded engine under ALL the riders at once (ISSUE 13 slow
+    tier): speculative draft/verify (draft pools sharded over the same
+    mesh), copy-on-write prefix caching (shard-local block copies),
+    and int8 pools (scale pools shard on their heads axis too) —
+    token-identical to the same composition single-device."""
+    cfg, model, params = gpt2_setup
+    int8 = _int8_model(model, cfg)
+    rng = np.random.RandomState(33)
+    shared = rng.randint(1, 120, (8,)).astype(np.int32)
+    trace = [(np.concatenate([shared,
+                              rng.randint(1, 120, (t,)).astype(np.int32)]),
+              6) for t in (5, 3, 4, 6)]
+    base, tp, eng = _run_tp_pair(
+        model, params, trace, num_slots=3, block_size=4, num_blocks=60,
+        prefill_chunk=8, max_model_len=48, speculate_k=2, draft=1,
+        prefix_cache=True, kv_cache_dtype="int8")
+    assert tp == base
+    stats = eng.stats()
+    assert stats.tp == 2
+    assert stats.draft_proposed > 0
+    assert stats.prefix_cached_tokens > 0   # the template really hit
+    assert {str(p.dtype) for p in eng._pools} == {"int8", "float32"}
+    # the draft's pools shard like the target's
+    assert eng._d_plan.kv_shardings and eng._plan.kv_shardings
+
+
+@pytest.mark.slow
+def test_tp_sampled_serve_seed_deterministic_across_preemption(
+        gpt2_setup, devices8):
+    """ISSUE 13 acceptance, sampled half: streams on the SHARDED
+    engine are bitwise seed-reproducible — a rerun with identical
+    seeds reproduces identical tokens, and tight-pool recompute
+    preemption changes nothing. (Cross-sharding identity is a GREEDY
+    contract only: TP's row-parallel reductions reorder float sums, so
+    sampled warp thresholds may differ in ulps between TP degrees —
+    what is gated here is determinism OF the sharded engine.)"""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(35)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 14)
+             for _ in range(4)]
+    kws = [dict(temperature=0.9, top_k=20, top_p=0.9, seed=s)
+           for s in (1, 2, 3)] + [dict()]
+
+    def run(num_blocks):
+        eng = ServeEngine(model, params, mesh=2, num_slots=3,
+                          block_size=4, num_blocks=num_blocks,
+                          prefill_chunk=8, max_model_len=32)
+        reqs = [eng.submit(p, m, **kw) for (p, m), kw in zip(trace, kws)]
+        eng.run()
+        return [[int(t) for t in eng.output_ids(r)] for r in reqs], eng
+
+    base, eng = run(40)
+    assert eng.tp == 2
+    again, _ = run(40)
+    assert again == base                    # bitwise reproducible
+    tight, teng = run(9)                    # tight pool: preemption
+    assert teng.stats().preemptions > 0
+    assert tight == base                    # preemption-invariant
+
+
+def test_tp_engine_rejections_and_knob(gpt2_setup, devices8,
+                                       monkeypatch):
+    """The loud-rejection contracts: non-dividing kv heads (GQA), the
+    pallas kernel, and the ``HSTD_SERVE_TP`` parsing rules."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ENV_TP,
+        ServeEngine,
+        parse_tp,
+    )
+
+    cfg, model, params = gpt2_setup
+    kw = dict(num_slots=2, block_size=4, num_blocks=20, prefill_chunk=8,
+              max_model_len=32)
+    # GQA: it is the KV heads that must divide — 2 kv heads cannot
+    # shard over tensor=4
+    lcfg = LlamaConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                       num_heads=4, num_kv_heads=2, intermediate_size=64,
+                       max_position_embeddings=128, eos_token_id=127,
+                       pad_token_id=0, dtype=jnp.float32)
+    lmodel = LlamaForCausalLM(lcfg)
+    lparams = init_params(lmodel, lcfg, seed=0)
+    with pytest.raises(ValueError, match="kv heads"):
+        ServeEngine(lmodel, lparams, mesh=4, **kw)
+    # ... and the SAME config serves fine at tp=2 (kv heads divide)
+    eng = ServeEngine(lmodel, lparams, mesh=2, **kw)
+    assert eng.tp == 2
+    with pytest.raises(ValueError, match="pallas"):
+        ServeEngine(model, params, mesh=2, kernel="pallas", **kw)
+    # knob parsing
+    assert parse_tp(None) == 1
+    assert parse_tp(2) == 2
+    assert parse_tp("4") == 4
+    monkeypatch.setenv(ENV_TP, "2")
+    assert parse_tp(None) == 2
+    monkeypatch.setenv(ENV_TP, "")
+    assert parse_tp(None) == 1
+    with pytest.raises(ValueError, match=ENV_TP):
+        parse_tp("two")
+    with pytest.raises(ValueError, match=ENV_TP):
+        parse_tp(0)
+
+
+# -- ISSUE 13 satellite: low-load dispatch-ahead auto-flush ------------------
+
+def test_overlap_lone_stream_auto_flushes_to_serial(gpt2_setup,
+                                                    monkeypatch):
+    """PR 12 follow-up: with decode occupancy 1 and an empty queue the
+    dispatch-ahead pipeline auto-flushes — a lone stream commits every
+    token in the iteration that dispatched it (no one-iteration
+    deferred fetch on any token, and no trailing drain iteration), so
+    last-token latency matches ``overlap='off'`` structurally:
+    identical iteration count, identical tokens, zero pipeline
+    dispatches. Telemetry elsewhere is unchanged — a concurrent trace
+    still engages the pipeline (control below)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(34)
+    prompt = rng.randint(1, 120, (9,)).astype(np.int32)
+    kw = dict(num_slots=3, block_size=4, num_blocks=40, prefill_chunk=8,
+              max_model_len=64)
+    calls = []
+    orig = ServeEngine._dispatch_decode
+    monkeypatch.setattr(ServeEngine, "_dispatch_decode",
+                        lambda self: (calls.append(1), orig(self))[1])
+    off = ServeEngine(model, params, overlap=False, **kw)
+    r_off = off.submit(prompt, 8)
+    off.run()
+    on = ServeEngine(model, params, overlap=True, **kw)
+    r_on = on.submit(prompt, 8)
+    on.run()
+    assert not calls                        # never pipelined
+    assert on.overlap and on.overlap_flushes == 0
+    # last-token latency parity: the pipelined loop would need one
+    # extra iteration to drain the final in-flight dispatch
+    assert on.iterations == off.iterations
+    assert list(on.output_ids(r_on)) == list(off.output_ids(r_off))
+    # control: occupancy > 1 re-engages the pipeline, tokens unchanged
+    calls.clear()
+    trace = [(rng.randint(1, 120, (7,)).astype(np.int32), 6)
+             for _ in range(3)]
+    off2, on2, eng2 = _run_overlap_pair(model, params, trace, **kw)
+    assert on2 == off2
+    assert calls                            # dispatch-ahead really ran

@@ -22,7 +22,7 @@ def test_serve_bench_smoke(capsys, tmp_path):
     obs.reset(out_dir=str(tmp_path / "telemetry"), enabled=True)
     try:
         (mixed, bucketed, spec, prefix, paged,
-         overlap) = bench_serve(smoke=True)
+         overlap, tp) = bench_serve(smoke=True)
     finally:
         obs.reset()
     detail = mixed["detail"]
@@ -120,17 +120,37 @@ def test_serve_bench_smoke(capsys, tmp_path):
         assert isinstance(odetail[key], (int, float))
         assert -0.01 <= odetail[key] <= 1.0
     assert odetail["overlap_flushes"] >= 0
+    # the ISSUE 13 tensor-parallel capacity line: EVERY gate on it is
+    # deterministic capacity arithmetic, so unlike the wall-clock
+    # ratio lines the full acceptance is enforced at smoke scale too —
+    # TP=2 output token-identical to TP=1, per-device bytes/token
+    # exactly halved, admission depth doubled on the same per-device
+    # budget, compile flatness per side (sharding mints no variants)
+    tdetail = tp["detail"]
+    assert tp.get("error") is None
+    assert tp["value"] is not None and tp["value"] >= 2.0
+    assert tdetail["exact_match"] is True
+    assert tdetail["ratio_gated"] is True
+    assert 0 < tdetail["kv_pool_bytes_per_device_ratio"] <= 0.55
+    assert (tdetail["admission_depth_tp"]
+            >= 2 * tdetail["admission_depth_base"])
+    assert tdetail["num_blocks_tp"] > tdetail["num_blocks_base"]
+    assert tdetail["compiles_steady_tp"] <= len(
+        tdetail["gather_buckets"])
+    assert tdetail["compiles_steady_base"] <= len(
+        tdetail["gather_buckets"])
     # the stdout lines are the driver contract: parseable JSON, all
-    # six metrics present
+    # seven metrics present
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
     metrics = [json.loads(ln)["metric"] for ln in lines]
-    assert metrics[-6:] == ["serve_continuous_vs_static_speedup",
+    assert metrics[-7:] == ["serve_continuous_vs_static_speedup",
                             "serve_bucketed_gather_decode_speedup",
                             "serve_speculative_decode_speedup",
                             "serve_prefix_cache_ttft_speedup",
                             "serve_paged_kernel_decode_speedup",
-                            "serve_overlap_decode_speedup"]
+                            "serve_overlap_decode_speedup",
+                            "serve_tp_shard_capacity"]
 
 
 @pytest.mark.slow
@@ -199,6 +219,27 @@ def test_serve_bench_full_overlap_trace(capsys):
     assert detail["exact_match"] is True
     assert (detail["overhead_time_frac_overlap"]
             < detail["overhead_time_frac_serial"])
+
+
+@pytest.mark.slow
+def test_serve_bench_full_tp_trace(capsys):
+    """The full CPU tensor-parallel capacity trace — the ISSUE 13
+    acceptance surface: ≥2x admission depth on the same per-device
+    ``kv_pool_bytes``, per-device pool bytes/token ≤0.55x, TP=2 output
+    token-identical to TP=1, one step compile per bucket per side. All
+    deterministic gates (capacity arithmetic, not wall-clock), enforced
+    in the line itself."""
+    from benchmarks.serve_bench import bench_serve_tp
+
+    result = bench_serve_tp(smoke=False)
+    assert result.get("error") is None
+    assert result["value"] is not None and result["value"] >= 2.0
+    detail = result["detail"]
+    assert detail["exact_match"] is True
+    assert detail["kv_pool_bytes_per_device_ratio"] <= 0.55
+    assert (detail["admission_depth_tp"]
+            >= 2 * detail["admission_depth_base"])
+    assert detail["preemptions_tp"] == detail["preemptions_base"] == 0
 
 
 @pytest.mark.slow
