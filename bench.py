@@ -549,7 +549,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
                 "serve_prefix_cache_ttft_speedup",
                 "serve_paged_kernel_decode_speedup",
                 "serve_overlap_decode_speedup",
-                "serve_tp_shard_capacity"]
+                "serve_tp_shard_capacity",
+                "serve_router_scaleout"]
     if args.llama_train:
         return ["llama_1b_train_samples_per_sec_per_chip"]
     if args.mixtral_train:
@@ -844,7 +845,12 @@ def main() -> None:
                              "draft/verify decode speedup on a high-"
                              "acceptance trace + the tensor-parallel "
                              "shard-capacity line (TP=2 vs TP=1 on "
-                             "the same per-device KV byte budget)")
+                             "the same per-device KV byte budget) + "
+                             "the multi-replica router scale-out line "
+                             "(2 engine replicas vs 1: placement-"
+                             "policy token identity, 2x fleet "
+                             "admission depth, affinity-vs-round-"
+                             "robin cache hit rate, load imbalance)")
     parser.add_argument("--llama-train", action="store_true",
                         dest="llama_train",
                         help="TinyLlama-1.1B training throughput "

@@ -348,6 +348,15 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # applies (a 0-byte baseline only happens on unsized pools, and
     # bytes appearing against it must still flag).
     "serve_kv_pool_bytes_per_device": (+1, "ratio"),
+    # multi-replica serving (ISSUE 14): max/mean requests served per
+    # replica, worse UP — a broken placement policy (every request
+    # pinned to one replica), an affinity index starving load balance,
+    # or a drained replica nobody restarted all show up as imbalance
+    # long before aggregate throughput or the tail percentiles move.
+    # Ratio metric under the shared zero-baseline rule (a 0 baseline
+    # only happens on degenerate reports, and imbalance appearing
+    # against it must still flag).
+    "serve_replica_load_imbalance": (+1, "ratio"),
 }
 
 
@@ -381,7 +390,7 @@ def _report_scalars(report: dict) -> dict:
                 "acceptance_rate", "cache_hit_rate",
                 "kv_bytes_read_per_step", "queue_wait_p99_s",
                 "preempted_time_frac", "overhead_time_frac",
-                "kv_pool_bytes_per_device"):
+                "kv_pool_bytes_per_device", "replica_load_imbalance"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out
@@ -507,6 +516,12 @@ def render_text(report: dict) -> str:
     serve = report.get("serve")
     if serve:
         parts = [f"{serve.get('requests', 0)} requests"]
+        if serve.get("replicas") is not None:
+            imb = (f", imbalance {serve['replica_load_imbalance']}"
+                   if serve.get("replica_load_imbalance") is not None
+                   else "")
+            parts.append(f"{serve['replicas']} replicas "
+                         f"({serve.get('placement')}{imb})")
         if serve.get("ttft_p50_s") is not None:
             parts.append(f"ttft p50 {serve['ttft_p50_s']}s "
                          f"p99 {serve.get('ttft_p99_s')}s")

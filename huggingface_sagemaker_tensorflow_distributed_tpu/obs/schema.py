@@ -122,6 +122,26 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               # gates as serve_kv_pool_bytes_per_device)
               "tp": (int,),
               "kv_pool_bytes_per_device": (int,),
+              # multi-replica serving router (ISSUE 14): per-request
+              # lifecycle events + request_timeline + per-replica
+              # reports carry the owning replica index (what `obsctl
+              # slo` groups tail attribution by); the router's
+              # aggregate report carries the fleet shape (replicas /
+              # placement), the drain/requeue counters, the max/mean
+              # requests-served imbalance `obsctl diff` gates, and a
+              # compact per-replica breakdown; drain/requeue/restart
+              # events carry the move itself (source replica, count,
+              # destination)
+              "replica": (int,),
+              "replicas": (int,),
+              "placement": (str,),
+              "requeued": (int,),
+              "to_replica": (int,),
+              "drains": (int,),
+              "requeues": (int,),
+              "replica_load_imbalance": _NUM,
+              "affinity_fallbacks": (int,),
+              "per_replica": (list,),
               # request-lifecycle tracing (ISSUE 10): the
               # `request_timeline` event's five-way phase decomposition
               # (queue + prefill + decode + preempted + overhead sums

@@ -295,7 +295,11 @@ def slo_attribution(records: list[dict], pct: float = 0.99) -> dict:
     selects the tail (nearest-rank, the one percentile convention
     shared with ``obs.report``); requests at/above the threshold are
     attributed to their dominant phase. Aggregated overall and per
-    ``group`` key (the per-tenant hook)."""
+    ``group`` key (the per-tenant hook), and — when the records carry a
+    ``replica`` tag (a multi-replica router run, ISSUE 14) — per
+    replica, so per-replica tail attribution falls out of the same
+    machinery (a placement policy sending the tail to one sick replica
+    is visible here before any aggregate moves)."""
     out: dict = {"requests": len(records), "percentile": pct}
     if not records:
         return out
@@ -331,6 +335,8 @@ def slo_attribution(records: list[dict], pct: float = 0.99) -> dict:
             row[f"{ph}_s"] = rec.get(f"{ph}_s")
         if rec.get("group"):
             row["group"] = rec["group"]
+        if isinstance(rec.get("replica"), int):
+            row["replica"] = rec["replica"]
         if rec.get("blocked_reason"):
             row["blocked_reason"] = rec["blocked_reason"]
         rows.append(row)
@@ -353,6 +359,24 @@ def slo_attribution(records: list[dict], pct: float = 0.99) -> dict:
                 "e2e_p50_s": round(percentile(ge2es, 0.50), 6),
                 "e2e_p99_s": round(percentile(ge2es, 0.99), 6),
                 "phase_time_frac": _phase_fracs(recs),
+            }
+    replicas: dict[int, list[dict]] = {}
+    for rec in records:
+        if isinstance(rec.get("replica"), int):
+            replicas.setdefault(rec["replica"], []).append(rec)
+    if replicas:
+        out["replicas"] = {}
+        for i in sorted(replicas):
+            recs = replicas[i]
+            re2es = sorted(float(r.get("e2e_s", 0.0)) for r in recs)
+            out["replicas"][str(i)] = {
+                "requests": len(recs),
+                "e2e_p50_s": round(percentile(re2es, 0.50), 6),
+                "e2e_p99_s": round(percentile(re2es, 0.99), 6),
+                "phase_time_frac": _phase_fracs(recs),
+                "tail_count": sum(
+                    1 for r in recs
+                    if float(r.get("e2e_s", 0.0)) >= thr),
             }
     return out
 
@@ -383,6 +407,11 @@ def render_slo_text(doc: dict) -> str:
                      f"{sec['requests']} request(s), "
                      f"e2e p50 {sec['e2e_p50_s']}s "
                      f"p99 {sec['e2e_p99_s']}s")
+    for i, sec in (doc.get("replicas") or {}).items():
+        lines.append(f"  replica {i}: {sec['requests']} request(s), "
+                     f"e2e p50 {sec['e2e_p50_s']}s "
+                     f"p99 {sec['e2e_p99_s']}s, "
+                     f"{sec['tail_count']} in the tail")
     return "\n".join(lines) + "\n"
 
 

@@ -10,11 +10,15 @@ per-slot seeded sampling).
 - :mod:`~.engine` — the jitted prefill/decode step functions (compiled
   per gather bucket) and the driving loop (``scripts/serve.py`` is the
   CLI; ``bench.py --serve`` the measurement).
+- :mod:`~.router` — N engine replicas behind one facade (ISSUE 14):
+  round-robin / least-loaded / prefix-affinity placement, replica
+  drain/restart with requeue-to-siblings.
 """
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (  # noqa: F401
     BlockManager,
     PoolExhausted,
+    prefix_chain_keys,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (  # noqa: F401
     Request,
@@ -32,4 +36,9 @@ def __getattr__(name):
             engine,
         )
         return getattr(engine, name)
+    if name in ("Router", "parse_replicas", "parse_placement"):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.serve import (
+            router,
+        )
+        return getattr(router, name)
     raise AttributeError(name)

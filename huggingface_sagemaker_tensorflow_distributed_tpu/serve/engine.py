@@ -1208,6 +1208,13 @@ class ServeEngine:
         # restarted server — reuses the compiled executables instead of
         # retracing
         donate = jax.default_backend() != "cpu"
+        # multi-replica serving (ISSUE 14): the router sets this to the
+        # replica index when the engine is one of N; every per-request
+        # lifecycle event + the SLO report then carry `replica`, which
+        # is what `obsctl slo` groups tail attribution by. None (the
+        # default, and the single-replica router's choice) adds NOTHING
+        # to the telemetry stream — the byte-identity contract.
+        self.replica: Optional[int] = None
         self._decode_fn = (_paged_decode_step_jit(donate)
                            if self.kernel == "pallas"
                            else _decode_step_jit(donate))
@@ -1267,6 +1274,59 @@ class ServeEngine:
 
     # -- public API ----------------------------------------------------------
 
+    def _replica_kw(self) -> dict:
+        """``{"replica": i}`` when this engine is replica i of a router
+        fleet, ``{}`` otherwise — the single spot that keeps a
+        router-less (or replicas=1) engine's telemetry byte-identical
+        to the pre-router stream."""
+        return {} if self.replica is None else {"replica": self.replica}
+
+    def take_waiting(self) -> list[Request]:
+        """Drain hook (ISSUE 14): remove and return every WAITING
+        request (the scheduler's :meth:`~.scheduler.Scheduler.
+        take_waiting`), dropping their engine-side sampled-key entries
+        — the adopting replica re-derives them (:meth:`adopt`), and a
+        stale entry here would leak per-request state past the
+        request's departure. Resident requests finish on this engine."""
+        moved = self.sched.take_waiting()
+        for req in moved:
+            self._keys.pop(req.rid, None)
+        return moved
+
+    def adopt(self, req: Request) -> None:
+        """Requeue hook (ISSUE 14): enqueue an EXISTING request — a
+        sibling replica's drain victim — keeping its identity, folded
+        prompt, and submit stamp. The sampled PRNG key re-derives from
+        the request's own seed (token n's key is ``fold_in(PRNGKey(
+        seed), n)``, a pure function of (seed, n)), so a moved sampled
+        stream is bitwise what it would have been anywhere else —
+        placement can never change tokens."""
+        self.sched.adopt(req)
+        if req.sampled:
+            self._keys[req.rid] = np.asarray(jax.random.PRNGKey(req.seed),
+                                             np.uint32)
+
+    def load_gauges(self) -> dict:
+        """Live host-side load gauges (ISSUE 14): the placement-policy
+        inputs — waiting depth, occupied slots, and KV pool pressure —
+        read straight off the scheduler/BlockManager so a router never
+        parses its own telemetry stream to route. These are the same
+        figures the per-iteration ``serve/waiting_depth`` /
+        ``serve/running_slots`` series and the ledger's
+        ``kv_used_frac`` carry."""
+        return {
+            "waiting_depth": len(self.sched.waiting),
+            "running": sum(1 for s in self.sched.slots if not s.free),
+            "kv_used_frac": self.blocks.utilization(),
+        }
+
+    def has_work(self) -> bool:
+        """True while anything is queued, resident, or in flight in
+        the dispatch-ahead pipeline — the loop condition :meth:`run`
+        (and a router driving several engines) spins on."""
+        return (self.sched.has_work() or self._pending is not None
+                or self._pending_spec is not None)
+
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, seed: int = 0,
@@ -1290,7 +1350,7 @@ class ServeEngine:
         obs.serve("submit", request=req.rid,
                   prompt_len=len(req.prompt),
                   max_new_tokens=req.max_new_tokens,
-                  sampled=req.sampled)
+                  sampled=req.sampled, **self._replica_kw())
         return req
 
     def output_ids(self, req: Request) -> np.ndarray:
@@ -1402,8 +1462,7 @@ class ServeEngine:
         (`obs/report.py`) reads the serving story from a single line."""
         self.warmup()
         with obs.span("serve/run"):
-            while (self.sched.has_work() or self._pending is not None
-                   or self._pending_spec is not None):
+            while self.has_work():
                 self.step()
         obs.scalar("serve/kv_peak_utilization",
                    self.blocks.peak_used / max(self.blocks.num_blocks - 1, 1))
@@ -1443,6 +1502,11 @@ class ServeEngine:
                 self.decode_tokens / self.decode_time_s, 1)
         out["kernel"] = self.kernel
         out["kv_dtype"] = self.kv_cache_dtype
+        # multi-replica serving (ISSUE 14): a router-owned replica's
+        # report names itself so the merged cross-host report (and
+        # `obsctl slo`'s per-replica grouping) can attribute it; absent
+        # on router-less engines — the byte-identity contract
+        out.update(self._replica_kw())
         # tensor-parallel serving (ISSUE 13): the degree + the pool's
         # per-device byte footprint (what `obsctl diff` watches as
         # serve_kv_pool_bytes_per_device — more bytes per device for
@@ -1637,7 +1701,8 @@ class ServeEngine:
             if self.prefix_cache:
                 extra["prefix_cached_tokens"] = slot.prefill_pos
             obs.serve("admit", request=slot.request.rid, slot=slot.index,
-                      queue_depth=len(self.sched.waiting), **extra)
+                      queue_depth=len(self.sched.waiting),
+                      **self._replica_kw(), **extra)
         if self.timeline and self.sched.waiting:
             # admission-block attribution: FIFO means only the HEAD of
             # the queue is ever capacity-blocked (everyone behind it is
@@ -1731,7 +1796,8 @@ class ServeEngine:
                     decode_slots=self._iter_decode_slots,
                     tokens=self.tokens_generated - tokens0,
                     waiting=waiting,
-                    kv_used_frac=round(self.blocks.utilization(), 4))
+                    kv_used_frac=round(self.blocks.utilization(), 4),
+                    **self._replica_kw())
         self.iterations += 1
 
     def _capacity_phase(self) -> None:
@@ -1740,7 +1806,7 @@ class ServeEngine:
         caller drained the pipeline first when this could preempt)."""
         for req in self.sched.ensure_decode_capacity():
             obs.serve("preempt", request=req.rid,
-                      reason="kv_pool_exhausted")
+                      reason="kv_pool_exhausted", **self._replica_kw())
             if self.timeline:
                 # the preempted interval runs from here to re-admission;
                 # emit the partial timeline NOW so a request that never
@@ -2405,6 +2471,7 @@ class ServeEngine:
         }
         if req.ttft_s is not None:
             fields["ttft_s"] = round(req.ttft_s, 6)
+        fields.update(self._replica_kw())
         if req.group:
             fields["group"] = req.group
         if req.cow_copies:
@@ -2443,7 +2510,8 @@ class ServeEngine:
             req.first_token_t = now
             obs.serve("first_token", request=req.rid,
                       ttft_s=round(req.ttft_s, 6)
-                      if req.ttft_s is not None else None)
+                      if req.ttft_s is not None else None,
+                      **self._replica_kw())
         self.tokens_generated += 1
         if (token == self.eos_token_id
                 or self._generated(req) >= req.max_new_tokens):
@@ -2471,5 +2539,6 @@ class ServeEngine:
             extra["tp"] = self.tp
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
-                      preemptions=req.preemptions, **extra)
+                      preemptions=req.preemptions,
+                      **self._replica_kw(), **extra)
             self._emit_timeline(req, "finish")

@@ -56,6 +56,32 @@ from typing import NamedTuple, Optional, Sequence
 _CHAIN_ROOT = 0x9E3779B97F4A7C15
 
 
+def prefix_chain_keys(tokens, block_size: int):
+    """Yield ``(chain_key, chunk_tokens)`` per FULL ``block_size``-sized
+    chunk of ``tokens``, lazily — a consumer that stops at the first
+    index miss never hashes the rest of the prompt. Key N hashes
+    (key N-1, chunk N), so a key commits to the whole token prefix
+    through its chunk.
+
+    This is THE prefix fingerprint of the serving stack, shared by two
+    consumers on purpose: :meth:`BlockManager.chain_keys` builds the
+    block-level prefix-cache index from it, and the multi-replica
+    router (``serve/router.py``, ISSUE 14) builds its replica-affinity
+    index from the SAME chain values — so "the replica holding this
+    prompt's longest cached prefix" and "the blocks this prompt would
+    hit" are answers to one question asked at two granularities, and
+    the two indexes can never disagree about what counts as a shared
+    prefix. The chain value is a pure function of the tokens (no block
+    ids, no engine state), which is what lets a router-level entry
+    outlive any replica's physical blocks."""
+    bs = int(block_size)
+    h = _CHAIN_ROOT
+    for i in range(len(tokens) // bs):
+        chunk = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+        h = hash((h, chunk))
+        yield h, chunk
+
+
 class CachedBlock(NamedTuple):
     """One prefix-index entry: the physical block plus the exact chunk
     tokens and parent chain key the lookup re-verifies (collision
@@ -342,19 +368,12 @@ class BlockManager:
 
     def chain_keys(self, tokens):
         """Yield ``(chain_key, chunk_tokens)`` per FULL block-sized
-        chunk of ``tokens``, lazily — a consumer that stops at the
-        first index miss never hashes the rest of the prompt. Key N
-        hashes (key N-1, chunk N), so a key commits to the whole token
-        prefix through its block — the property that makes index
-        entries reusable even after their physical parent blocks were
-        evicted and re-prefilled elsewhere (the chain value is a pure
-        function of the tokens)."""
-        bs = self.block_size
-        h = _CHAIN_ROOT
-        for i in range(len(tokens) // bs):
-            chunk = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
-            h = hash((h, chunk))
-            yield h, chunk
+        chunk of ``tokens`` (:func:`prefix_chain_keys` at this pool's
+        ``block_size``) — lazy, and a pure function of the tokens, the
+        property that makes index entries reusable even after their
+        physical parent blocks were evicted and re-prefilled
+        elsewhere."""
+        return prefix_chain_keys(tokens, self.block_size)
 
     def peek_prefix(self, tokens, max_blocks: Optional[int] = None
                     ) -> tuple[list[int], int]:

@@ -1,0 +1,469 @@
+"""Multi-replica serving router (ISSUE 14): N :class:`~.engine.
+ServeEngine` replicas — each with its own scheduler, BlockManager,
+prefix cache, and telemetry stream — behind ONE ``submit()``/``run()``
+facade, with pluggable SLO- and prefix-affinity-aware placement.
+
+This is the data-parallel remainder of the scale-out story: PR 13 made
+one engine span chips (tensor parallel — a model bigger than a chip);
+the router spreads *requests* over N such engines (traffic bigger than
+an engine). vLLM-style fleets win most of their throughput at the
+replica-level load balancer, and Sarathi-Serve's analysis says tail
+latency is won or lost at placement/admission time — and the repo
+already emits every signal a smart router needs (the scheduler's live
+waiting-depth/KV-pressure gauges, PR 10's queue-wait attribution, the
+PR 7 prefix fingerprints), so the router wires them into a placement
+policy instead of FIFO-into-one-engine:
+
+- ``round_robin`` — cycle over admitting replicas; the trivially fair
+  baseline every policy gate compares against.
+- ``least_loaded`` — score each replica by
+  ``waiting_depth + occupied_slots + kv_used_frac`` (the engine's own
+  live :meth:`~.engine.ServeEngine.load_gauges`, read host-side — the
+  router never parses its own telemetry to route) and place on the
+  argmin, index-tiebroken so placement is deterministic.
+- ``affinity`` — a ROUTER-level prefix-fingerprint index built from
+  the same chain-key hashing as the BlockManager's block-level prefix
+  cache (:func:`~.paged_kv.prefix_chain_keys`: key N commits to the
+  whole token prefix through chunk N): a request routes to the replica
+  whose index entry covers its LONGEST hashed prefix — the replica
+  most likely to hold its KV blocks warm — so templated families stick
+  to a replica and the per-replica prefix caches stay hot instead of
+  every replica paying every family's cold miss. The index is a pure
+  function of tokens (no block ids), LRU-aged to ``affinity_cap``
+  entries, and IMBALANCE-BOUNDED: when the sticky replica is more than
+  ``affinity_max_skew`` load units deeper than the lightest sibling
+  (default: one full slot batch), the request falls back to
+  least-loaded — affinity is a cache heuristic and must never starve
+  load balance (the cache-aware admission-ordering follow-up of PR 7,
+  generalized across replicas). Any placement is CORRECT: every
+  replica produces token-identical output (greedy exact, sampled
+  bitwise — per-request seeds), so a stale or evicted index entry
+  degrades to a cold cache, never to wrong tokens.
+
+Replica drain/restart — the fleet degrades instead of dying:
+:meth:`Router.drain` stops admitting to replica i, lets its RESIDENT
+requests finish in place, and requeues its WAITING ones onto siblings
+through the normal placement policy (recompute semantics, the same
+state the scheduler's preemption/requeue path builds — a preemption
+-folded prompt moves unchanged, sampled keys re-derive from the
+request's own seed, queue-wait keeps counting from the original submit
+stamp). :meth:`Router.restart` re-admits. Every move is telemetered
+(``drain`` / ``requeue`` / ``restart`` serve events).
+
+Telemetry: each engine's per-request lifecycle events carry a
+``replica`` tag (``obsctl slo`` groups tail attribution by it); the
+router's ``run()`` emits one report event per replica plus ONE
+aggregate report last (``placement``, ``replicas``,
+``replica_load_imbalance`` = max/mean requests served — the figure
+``obsctl diff`` watches — and a ``per_replica`` hit-rate/depth
+breakdown). A ``replicas=1`` router is a pass-through: it drives the
+single engine's own ``run()`` and tags nothing, so its telemetry is
+byte-identical to the pre-router engine stream (allowlist-gated).
+
+Compile expectations: replicas over the same model/geometry share the
+module-level jitted step families (static keys are (model, plan,
+bucket, sampled) — identical across replicas), so N replicas compile
+ONE bucket ladder total, not N.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from collections import OrderedDict
+from typing import Optional, Union
+
+import numpy as np
+
+from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+    ServeEngine,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (
+    prefix_chain_keys,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (
+    Request,
+)
+
+ENV_REPLICAS = "HSTD_SERVE_REPLICAS"
+ENV_PLACEMENT = "HSTD_SERVE_PLACEMENT"
+
+PLACEMENTS = ("round_robin", "least_loaded", "affinity")
+
+
+def parse_replicas(spec) -> int:
+    """The replica-count knob: a positive int. None reads
+    ``HSTD_SERVE_REPLICAS`` (default 1 = the single pass-through
+    engine, byte-identical telemetry)."""
+    if spec is None:
+        spec = os.environ.get(ENV_REPLICAS, "1") or "1"
+    try:
+        n = int(str(spec).strip() or "1")
+    except ValueError:
+        raise ValueError(f"unparseable {ENV_REPLICAS} value {spec!r}: "
+                         "expected a positive integer")
+    if n < 1:
+        raise ValueError(f"{ENV_REPLICAS} must be >= 1, got {n}")
+    return n
+
+
+def parse_placement(spec: Union[str, None]) -> str:
+    """The placement-policy knob: one of ``round_robin`` (default) /
+    ``least_loaded`` / ``affinity``. None reads
+    ``HSTD_SERVE_PLACEMENT``."""
+    if spec is None:
+        spec = os.environ.get(ENV_PLACEMENT, "round_robin")
+    s = str(spec).strip().lower() or "round_robin"
+    if s not in PLACEMENTS:
+        raise ValueError(f"unparseable {ENV_PLACEMENT} value {spec!r}: "
+                         f"expected {' | '.join(PLACEMENTS)}")
+    return s
+
+
+class Router:
+    """N homogeneous :class:`~.engine.ServeEngine` replicas behind one
+    facade. ``replicas``/``placement`` read their env knobs when None
+    (``HSTD_SERVE_REPLICAS`` / ``HSTD_SERVE_PLACEMENT``); every other
+    keyword is forwarded verbatim to EACH replica's engine constructor,
+    so the fleet is homogeneous by construction (which is what makes a
+    drain-requeued request's submit-time validation transferable).
+
+    ``affinity_cap`` bounds the affinity index (LRU aging — oldest
+    fingerprints fall out first, exactly the staleness order the
+    per-replica block caches evict in). ``affinity_max_skew`` is the
+    load-imbalance bound past which an affinity hit is overridden by
+    least-loaded placement (default: one engine's ``num_slots`` — a
+    full batch of queue depth buys back a cold prefill, not more).
+
+    Placement changes WHERE a request runs, never WHAT it emits:
+    per-request output is token-identical to a single-engine run under
+    every policy and across drains (greedy exact, sampled bitwise —
+    the engine's own exactness/seed contracts, which are per-request
+    and placement-blind)."""
+
+    def __init__(self, model, params, *, replicas=None, placement=None,
+                 affinity_cap: int = 4096,
+                 affinity_max_skew: Optional[int] = None,
+                 **engine_kwargs):
+        self.n = parse_replicas(replicas)
+        self.placement = parse_placement(placement)
+        self.engines = [ServeEngine(model, params, **engine_kwargs)
+                        for _ in range(self.n)]
+        if self.n > 1:
+            for i, eng in enumerate(self.engines):
+                eng.replica = i
+        self.block_size = self.engines[0].blocks.block_size
+        self._rr = 0
+        self._draining: set[int] = set()
+        self._owner: dict[int, int] = {}        # rid -> replica index
+        self.drains = 0
+        self.requeues = 0
+        self.affinity_cap = int(affinity_cap)
+        if self.affinity_cap < 1:
+            raise ValueError("affinity_cap must be >= 1")
+        if affinity_max_skew is None:
+            affinity_max_skew = self.engines[0].num_slots
+        self.affinity_max_skew = float(affinity_max_skew)
+        self.affinity_fallbacks = 0
+        # chain key -> replica index, newest-used last (LRU aging)
+        self._affinity: "OrderedDict[int, int]" = OrderedDict()
+
+    # -- placement -----------------------------------------------------------
+
+    def _admitting(self) -> list[int]:
+        return [i for i in range(self.n) if i not in self._draining]
+
+    def _load(self, i: int) -> float:
+        """One replica's placement score from its live gauges: queued +
+        resident requests (each is one unit of service ahead of a new
+        arrival) plus the KV pool pressure fraction (breaks ties
+        toward the replica with block headroom — the one least likely
+        to preempt what it admits)."""
+        g = self.engines[i].load_gauges()
+        return g["waiting_depth"] + g["running"] + g["kv_used_frac"]
+
+    def _least_loaded(self, cand: list[int]) -> int:
+        return min(cand, key=lambda i: (self._load(i), i))
+
+    def _affine(self, prompt, cand: list[int]) -> int:
+        """The replica covering the prompt's longest hashed prefix —
+        unless it is draining or past the imbalance bound, in which
+        case fall back to least-loaded (counted, so the bench can see
+        affinity yielding to load balance rather than starving it)."""
+        hit: Optional[int] = None
+        for key, _chunk in prefix_chain_keys(prompt, self.block_size):
+            rep = self._affinity.get(key)
+            if rep is None:
+                break
+            hit = rep                    # deepest indexed level wins
+        if hit is None:
+            return self._least_loaded(cand)
+        if hit not in cand or (self._load(hit)
+                               - min(self._load(i) for i in cand)
+                               > self.affinity_max_skew):
+            self.affinity_fallbacks += 1
+            return self._least_loaded(cand)
+        return hit
+
+    def _register_affinity(self, prompt, replica: int) -> None:
+        """Point every full-chunk fingerprint of ``prompt`` at the
+        replica that will prefill (and therefore block-cache) it;
+        last-writer-wins on requeue redirects, LRU-aged at
+        ``affinity_cap``. The index is a routing heuristic over the
+        same chain values the replica's BlockManager indexes — an
+        entry outliving the physical blocks just degrades to a cold
+        cache on arrival, never to wrong tokens."""
+        for key, _chunk in prefix_chain_keys(prompt, self.block_size):
+            if key in self._affinity:
+                self._affinity.move_to_end(key)
+            self._affinity[key] = replica
+        while len(self._affinity) > self.affinity_cap:
+            self._affinity.popitem(last=False)
+
+    def _place(self, prompt) -> int:
+        """The policy's CHOICE only — no state moves here. Callers
+        commit via :meth:`_commit_place` once the engine has accepted
+        the request: a submit the scheduler rejects (over-length, can
+        never fit the pool) must not advance the round-robin cursor or
+        pollute the affinity index with fingerprints pointing at a
+        replica that will never prefill them."""
+        cand = self._admitting()
+        if len(cand) == 1:
+            return cand[0]
+        if self.placement == "round_robin":
+            return cand[self._rr % len(cand)]
+        if self.placement == "least_loaded":
+            return self._least_loaded(cand)
+        return self._affine(prompt, cand)
+
+    def _commit_place(self, prompt, choice: int) -> None:
+        """Land the placement's state changes for an ACCEPTED request:
+        advance the round-robin rotation (only when there was a real
+        choice to rotate over), register the prompt's fingerprints at
+        the chosen replica."""
+        if self.placement == "round_robin":
+            if len(self._admitting()) > 1:
+                self._rr += 1
+        elif self.placement == "affinity":
+            self._register_affinity(prompt, choice)
+
+    # -- public API ----------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, **kw) -> Request:
+        """Place one request per the policy and queue it on the chosen
+        replica. Same signature/semantics as
+        :meth:`~.engine.ServeEngine.submit` — the returned
+        :class:`Request` is the engine's own handle."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        i = self._place(prompt)
+        req = self.engines[i].submit(prompt, max_new_tokens, **kw)
+        self._commit_place(prompt, i)       # only an ACCEPTED submit
+        self._owner[req.rid] = i
+        return req
+
+    def replica_of(self, req: Union[Request, int]) -> int:
+        """Which replica currently owns a request (post-drain requeues
+        included)."""
+        rid = req.rid if isinstance(req, Request) else int(req)
+        return self._owner[rid]
+
+    def output_ids(self, req: Request) -> np.ndarray:
+        return self.engines[self._owner[req.rid]].output_ids(req)
+
+    @property
+    def finished(self) -> dict[int, Request]:
+        """Merged {rid: Request} across replicas (rids are process
+        -global, so keys never collide)."""
+        out: dict[int, Request] = {}
+        for eng in self.engines:
+            out.update(eng.finished)
+        return out
+
+    def has_work(self) -> bool:
+        return any(eng.has_work() for eng in self.engines)
+
+    def warmup(self, sampled: bool = False) -> None:
+        """Warm every replica. Replicas share the module-level jitted
+        step families (identical static keys), so replica 0 compiles
+        the ladder and the rest reuse it — N replicas cost one bucket
+        ladder of compiles, not N (the per-replica compile-flatness
+        gate the bench enforces)."""
+        for eng in self.engines:
+            eng.warmup(sampled=sampled)
+
+    def step(self) -> None:
+        """One interleaved fleet iteration: each replica with work runs
+        one engine iteration. With the engines' dispatch-ahead loop on
+        (the default) replica A's device step stays in flight while
+        replicas B..N run their whole host side — the router's
+        interleave extends the PR 12 overlap across the fleet."""
+        for eng in self.engines:
+            if eng.has_work():
+                eng.step()
+
+    def drain(self, i: int) -> list[Request]:
+        """Stop admitting to replica i: its WAITING requests requeue to
+        siblings through the normal placement policy (recompute
+        semantics — identical tokens, queue clock unreset), its
+        RESIDENT requests finish in place, and until :meth:`restart`
+        no new placement chooses it. Returns the moved requests.
+        Refuses to drain the last admitting replica — a fleet with
+        nowhere to admit is an outage, not a drain."""
+        if not 0 <= i < self.n:
+            raise ValueError(f"replica {i} out of range [0, {self.n})")
+        if i in self._draining:
+            raise ValueError(f"replica {i} is already draining")
+        if len(self._admitting()) <= 1:
+            raise ValueError(
+                "cannot drain the last admitting replica: restart a "
+                "sibling first (a fleet must always have somewhere to "
+                "place work)")
+        self._draining.add(i)
+        self.drains += 1
+        moved = self.engines[i].take_waiting()
+        for req in moved:
+            j = self._place(req.prompt)
+            self.engines[j].adopt(req)          # never rejects
+            self._commit_place(req.prompt, j)
+            self._owner[req.rid] = j
+            self.requeues += 1
+            obs.serve("requeue", request=req.rid, replica=i,
+                      to_replica=j)
+        obs.serve("drain", replica=i, requeued=len(moved),
+                  placement=self.placement)
+        return moved
+
+    def restart(self, i: int) -> None:
+        """Re-admit to a drained replica (its pools/caches/compiled
+        steps were never torn down — restart is instant)."""
+        if i not in self._draining:
+            raise ValueError(f"replica {i} is not draining")
+        self._draining.discard(i)
+        obs.serve("restart", replica=i)
+
+    def run(self) -> dict[int, Request]:
+        """Drive the fleet until every submitted request finishes;
+        returns the merged {rid: Request}. A single-replica router
+        delegates to the engine's own :meth:`~.engine.ServeEngine.run`
+        — no router events, no replica tags: the telemetry stream is
+        byte-identical to the pre-router engine's (the ``--replicas 1``
+        contract). A multi-replica run emits one report event per
+        replica (each tagged) and ONE aggregate router report LAST, so
+        report consumers that keep the last event
+        (``obs/report.py::_serve_summary``) see the fleet view."""
+        if self.n == 1:
+            return dict(self.engines[0].run())
+        self.warmup()
+        with obs.span("serve/router_run"):
+            while self.has_work():
+                self.step()
+        for eng in self.engines:
+            obs.scalar(
+                "serve/kv_peak_utilization",
+                eng.blocks.peak_used / max(eng.blocks.num_blocks - 1, 1))
+            summary = eng.slo_summary()
+            if summary:
+                obs.serve("report", **summary)
+        summary = self.slo_summary()
+        if summary:
+            obs.serve("report", **summary)
+        return self.finished
+
+    # -- aggregates ----------------------------------------------------------
+
+    def replica_load_imbalance(self) -> Optional[float]:
+        """max/mean requests served per replica (1.0 = perfectly even;
+        worse UP — the figure ``obsctl diff`` gates as
+        ``serve_replica_load_imbalance``). None before any finish."""
+        served = [len(eng.finished) for eng in self.engines]
+        mean = sum(served) / len(served)
+        if mean == 0:
+            return None
+        return max(served) / mean
+
+    def slo_summary(self) -> dict:
+        """The fleet-level SLO summary ({} until a request finishes;
+        pass-through to the engine's own for a single-replica router):
+        aggregate TTFT/e2e percentiles over every replica's finished
+        requests, fleet counters (drains/requeues, summed preemptions
+        and tokens), ``replica_load_imbalance``, the aggregate decode
+        tokens/sec from the engines' own decode accounting, the
+        aggregate prefix-cache hit rate, and a compact ``per_replica``
+        breakdown (requests / peak waiting depth / pool peak / hit
+        rate) — the figures the ``scripts/serve.py`` summary and the
+        bench line surface."""
+        if self.n == 1:
+            return self.engines[0].slo_summary()
+        reqs = [r for eng in self.engines for r in eng.finished.values()]
+        if not reqs:
+            return {}
+        from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+            percentile,
+        )
+
+        out: dict = {
+            "requests": len(reqs),
+            "replicas": self.n,
+            "placement": self.placement,
+            "tokens": sum(e.tokens_generated for e in self.engines),
+            "iterations": sum(e.iterations for e in self.engines),
+            "preemptions": sum(e.sched.n_preemptions
+                               for e in self.engines),
+            "peak_waiting_depth": max(e.peak_waiting
+                                      for e in self.engines),
+            "drains": self.drains,
+            "requeues": self.requeues,
+        }
+        imb = self.replica_load_imbalance()
+        if imb is not None:
+            out["replica_load_imbalance"] = round(imb, 4)
+        if self.placement == "affinity":
+            out["affinity_fallbacks"] = self.affinity_fallbacks
+        dtok = sum(e.decode_tokens for e in self.engines)
+        dsec = sum(e.decode_time_s for e in self.engines)
+        if dsec > 0:
+            out["decode_tokens_per_sec"] = round(dtok / dsec, 1)
+        if self.engines[0].prefix_cache:
+            admitted = sum(r.prefix_prompt_tokens for r in reqs)
+            cached = sum(r.prefix_cached_tokens for r in reqs)
+            out["prefix_cache"] = True
+            out["prefix_cached_tokens"] = cached
+            out["cache_hit_rate"] = (round(cached / admitted, 4)
+                                     if admitted else 0.0)
+        per_replica = []
+        for i, eng in enumerate(self.engines):
+            row = {
+                "replica": i,
+                "requests": len(eng.finished),
+                "peak_waiting_depth": eng.peak_waiting,
+                "preemptions": eng.sched.n_preemptions,
+                "kv_peak_utilization": round(
+                    eng.blocks.peak_used
+                    / max(eng.blocks.num_blocks - 1, 1), 4),
+            }
+            hit = eng._aggregate_hit_rate()
+            if hit is not None:
+                row["cache_hit_rate"] = round(hit, 4)
+            per_replica.append(row)
+        out["per_replica"] = per_replica
+        ttfts = sorted(r.ttft_s for r in reqs if r.ttft_s is not None)
+        e2es = sorted(r.finish_t - r.submit_t for r in reqs
+                      if r.finish_t is not None and r.submit_t is not None)
+        for label, vals in (("ttft", ttfts), ("e2e", e2es)):
+            if not vals:
+                continue
+            out[f"{label}_p50_s"] = round(percentile(vals, 0.50), 6)
+            out[f"{label}_p95_s"] = round(percentile(vals, 0.95), 6)
+            out[f"{label}_p99_s"] = round(percentile(vals, 0.99), 6)
+        return out
+
+    @contextlib.contextmanager
+    def draining(self, i: int):
+        """``with router.draining(i):`` — drain on entry, restart on
+        exit (the rolling-restart shape)."""
+        self.drain(i)
+        try:
+            yield self
+        finally:
+            self.restart(i)

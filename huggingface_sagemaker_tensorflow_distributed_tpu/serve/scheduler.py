@@ -290,6 +290,31 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting) or any(not s.free for s in self.slots)
 
+    def take_waiting(self) -> list[Request]:
+        """Drain hook (ISSUE 14, multi-replica router): remove and
+        return every WAITING (not-yet-admitted) request, preemption
+        -requeued ones included — the router moves them onto sibling
+        replicas (recompute semantics: a folded prompt rides along
+        unchanged, exactly the state :meth:`preempt` builds). Resident
+        requests are untouched; a draining replica finishes them
+        itself."""
+        moved, self.waiting = self.waiting, []
+        return moved
+
+    def adopt(self, request: Request) -> None:
+        """Requeue hook (ISSUE 14): append an EXISTING request — a
+        sibling replica's drain victim — to this queue WITHOUT the
+        :meth:`submit` validation or a fresh submit stamp. The
+        original submit already validated the worst-case block need
+        (replicas are homogeneous, and the submit-time formula covers
+        every preemption-folded state of the request), and re-running
+        it on a folded prompt would double-count the generated tokens
+        and spuriously reject requests near ``max_model_len`` — the
+        same reason :meth:`preempt` re-inserts directly. Queue-wait
+        accounting keeps running from the ORIGINAL submit stamp, so a
+        drain shows up as queue time, never as a reset clock."""
+        self.waiting.append(request)
+
     # -- admission -----------------------------------------------------------
 
     def padded_prompt_len(self, request: Request) -> int:

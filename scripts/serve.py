@@ -40,6 +40,17 @@ on their heads axis — ``num_kv_heads % N == 0`` required), output
 stays token-identical to the single-device engine, and the per-device
 KV byte budget buys ~N× the resident requests; rows and the summary
 carry ``tp``, the summary additionally ``kv_pool_bytes_per_device``.
+
+``--replicas N --placement round_robin|least_loaded|affinity``
+(``HSTD_SERVE_REPLICAS`` / ``HSTD_SERVE_PLACEMENT``, default
+1/round_robin) serves MULTI-REPLICA (ISSUE 14): N engine replicas —
+each its own scheduler/pool/prefix cache — behind one router with SLO-
+and prefix-affinity-aware placement. Output is token-identical to a
+single-engine run under every policy (placement cannot change tokens);
+with N > 1 each per-request row carries its ``replica`` and the
+summary the fleet view (``placement``, ``replica_load_imbalance``,
+per-replica hit-rate/depth aggregates). ``--replicas 1`` is the
+byte-identical single-engine path, telemetry included.
 """
 
 from __future__ import annotations
@@ -198,6 +209,23 @@ def main() -> None:
                              "divide (rejected loudly otherwise) and "
                              "the KV byte budget re-denominates per "
                              "device (default: HSTD_SERVE_TP or 1)")
+    parser.add_argument("--replicas", type=int, default=None,
+                        help="multi-replica serving: engine replicas "
+                             "behind the placement router; each "
+                             "replica owns its scheduler/KV pool/"
+                             "prefix cache, output stays token-"
+                             "identical to one engine (default: "
+                             "HSTD_SERVE_REPLICAS or 1 = the byte-"
+                             "identical single-engine path)")
+    parser.add_argument("--placement", default=None,
+                        choices=("round_robin", "least_loaded",
+                                 "affinity"),
+                        help="replica placement policy: round_robin, "
+                             "least_loaded (live waiting-depth + KV-"
+                             "pressure gauges), or affinity (route to "
+                             "the replica holding the longest cached "
+                             "prefix, imbalance-bounded; default: "
+                             "HSTD_SERVE_PLACEMENT or round_robin)")
     parser.add_argument("--overlap", default=None,
                         choices=("on", "off"),
                         help="dispatch-ahead decode loop: host "
@@ -216,8 +244,8 @@ def main() -> None:
     args = parser.parse_args()
 
     from huggingface_sagemaker_tensorflow_distributed_tpu import obs
-    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
-        ServeEngine,
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.router import (
+        Router,
     )
 
     obs.configure()
@@ -227,33 +255,39 @@ def main() -> None:
         // args.block_size) * args.block_size
     num_blocks = args.num_blocks or (
         1 + args.num_slots * (max_len // args.block_size) * 3 // 4)
-    engine = ServeEngine(model, params, num_slots=args.num_slots,
-                         block_size=args.block_size, num_blocks=num_blocks,
-                         prefill_chunk=args.prefill_chunk,
-                         prefill_batch=args.prefill_batch,
-                         max_model_len=max_len,
-                         gather_buckets=args.gather_buckets,
-                         speculate_k=args.speculate_k,
-                         draft=args.draft_layers,
-                         prefix_cache=args.prefix_cache,
-                         kernel=args.kernel,
-                         kv_cache_dtype=args.kv_cache_dtype,
-                         timeline=args.timeline,
-                         overlap=args.overlap,
-                         mesh=args.tp)
+    # the router is the one construction path: replicas=1 (the
+    # default) is a pass-through whose engine behavior AND telemetry
+    # stream are byte-identical to building the ServeEngine directly
+    router = Router(model, params, replicas=args.replicas,
+                    placement=args.placement,
+                    num_slots=args.num_slots,
+                    block_size=args.block_size, num_blocks=num_blocks,
+                    prefill_chunk=args.prefill_chunk,
+                    prefill_batch=args.prefill_batch,
+                    max_model_len=max_len,
+                    gather_buckets=args.gather_buckets,
+                    speculate_k=args.speculate_k,
+                    draft=args.draft_layers,
+                    prefix_cache=args.prefix_cache,
+                    kernel=args.kernel,
+                    kv_cache_dtype=args.kv_cache_dtype,
+                    timeline=args.timeline,
+                    overlap=args.overlap,
+                    mesh=args.tp)
+    engine = router.engines[0]
     trace = load_trace(args, model.config.vocab_size - 1)
     # precompile the sampled step variants too when the trace will
     # sample, so no request pays a mid-serve compile
-    engine.warmup(sampled=any(kw.get("temperature", 0) > 0
+    router.warmup(sampled=any(kw.get("temperature", 0) > 0
                               for _, _, kw in trace))
-    reqs = [engine.submit(p, m, **kw) for p, m, kw in trace]
+    reqs = [router.submit(p, m, **kw) for p, m, kw in trace]
     t0 = time.perf_counter()
-    engine.run()
+    router.run()
     wall = time.perf_counter() - t0
 
     total = 0
     for req in reqs:
-        ids = engine.output_ids(req)
+        ids = router.output_ids(req)
         total += len(ids)
         row = {
             "request": req.rid, "prompt_len": req.orig_prompt_len,
@@ -261,6 +295,8 @@ def main() -> None:
             "ttft_s": round(req.ttft_s, 4) if req.ttft_s else None,
             "sampled": req.sampled, "seed": req.seed,
             "preemptions": req.preemptions, "tp": engine.tp}
+        if router.n > 1:
+            row["replica"] = router.replica_of(req)
         if engine.speculative:
             row["acceptance_rate"] = (
                 round(req.spec_accepted / req.spec_proposed, 4)
@@ -273,6 +309,48 @@ def main() -> None:
             row["phase_s"] = {ph: round(v, 4)
                               for ph, v in req.phase_s.items()}
         print(json.dumps(row))
+    if router.n > 1:
+        # fleet summary (ISSUE 14): the router's own aggregate (the
+        # same figures its final `serve` report telemetry event
+        # carries) plus summed engine counters — per-replica hit-rate/
+        # depth aggregates ride `per_replica`
+        rslo = router.slo_summary()
+        stats_all = [e.stats() for e in router.engines]
+        print(json.dumps({
+            "summary": True,
+            "requests": len(reqs),
+            "tokens": total,
+            "tokens_per_sec": round(total / wall, 1),
+            "replicas": router.n,
+            "placement": router.placement,
+            "drains": router.drains,
+            "requeues": router.requeues,
+            "replica_load_imbalance": rslo.get("replica_load_imbalance"),
+            "affinity_fallbacks": (router.affinity_fallbacks
+                                   if router.placement == "affinity"
+                                   else None),
+            "ttft_p50_s": rslo.get("ttft_p50_s"),
+            "ttft_p95_s": rslo.get("ttft_p95_s"),
+            "ttft_p99_s": rslo.get("ttft_p99_s"),
+            "e2e_p50_s": rslo.get("e2e_p50_s"),
+            "e2e_p95_s": rslo.get("e2e_p95_s"),
+            "e2e_p99_s": rslo.get("e2e_p99_s"),
+            "peak_waiting_depth": rslo.get("peak_waiting_depth"),
+            "decode_steps": sum(s.decode_steps for s in stats_all),
+            "decode_tokens_per_sec": rslo.get("decode_tokens_per_sec"),
+            "prefill_chunks": sum(s.prefill_chunks for s in stats_all),
+            "preemptions": sum(s.preemptions for s in stats_all),
+            "gather_buckets": engine.gather_buckets,
+            "prefix_cache": engine.prefix_cache,
+            "cache_hit_rate": rslo.get("cache_hit_rate"),
+            "timeline": engine.timeline,
+            "overlap": engine.overlap,
+            "kernel": engine.kernel,
+            "kv_dtype": engine.kv_cache_dtype,
+            "tp": engine.tp,
+            "per_replica": rslo.get("per_replica")}))
+        obs.flush()
+        return
     stats = engine.stats()
     # SLO summary from the engine's own accounting (the same figures
     # its final `serve` report telemetry event carries): TTFT + e2e

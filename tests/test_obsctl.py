@@ -96,7 +96,9 @@ def three_hosts(tmp_path):
                               preempted_time_frac=0.05,
                               overhead_time_frac=0.05,
                               tp=2,
-                              kv_pool_bytes_per_device=1 << 20))
+                              kv_pool_bytes_per_device=1 << 20,
+                              replicas=2, placement="least_loaded",
+                              replica_load_imbalance=1.2))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -496,6 +498,56 @@ def test_diff_overhead_time_frac_is_a_ratio_metric(three_hosts):
         d = diff_reports(a, b, threshold_pct=5.0)
         assert "serve_overhead_time_frac" in d["skipped"]
         assert "serve_overhead_time_frac" not in d["regressions"]
+
+
+def test_diff_replica_load_imbalance_is_ratio_metric(three_hosts):
+    """ISSUE 14: `serve_replica_load_imbalance` (max/mean requests
+    served per replica) diffs as a ratio metric whose worse direction
+    is UP — a broken placement policy, an affinity index starving load
+    balance, or a drained replica nobody restarted all show up here
+    before throughput or the tail moves. Standard threshold +
+    zero-baseline rules, poison rows skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["replica_load_imbalance"] == pytest.approx(1.2)
+    worse = copy.deepcopy(base)
+    worse["serve"]["replica_load_imbalance"] = 1.9   # one hot replica
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_replica_load_imbalance" in d["regressions"]
+    assert d["metrics"]["serve_replica_load_imbalance"][
+        "worse_direction"] == "up"
+    # evening out never flags; nor does a sub-threshold drift
+    assert "serve_replica_load_imbalance" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["replica_load_imbalance"] = 1.22   # < +5%
+    assert "serve_replica_load_imbalance" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # zero baseline (degenerate report): imbalance appearing must
+    # still flag even though the percentage is undefined — shared rule
+    zero = copy.deepcopy(base)
+    zero["serve"]["replica_load_imbalance"] = 0.0
+    worse0 = copy.deepcopy(zero)
+    worse0["serve"]["replica_load_imbalance"] = 1.4
+    d0 = diff_reports(zero, worse0, threshold_pct=5.0)
+    assert "serve_replica_load_imbalance" in d0["regressions"]
+    assert d0["metrics"]["serve_replica_load_imbalance"]["pct"] is None
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["replica_load_imbalance"] = "lopsided"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["replica_load_imbalance"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_replica_load_imbalance" in d["skipped"]
+        assert "serve_replica_load_imbalance" not in d["regressions"]
 
 
 def test_diff_kv_pool_bytes_per_device_is_bytes_metric(three_hosts):

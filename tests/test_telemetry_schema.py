@@ -226,6 +226,80 @@ def test_produced_train_and_serve_artifacts_validate(tmp_path):
     assert proc.stdout.count("OK") == 2          # events.jsonl + trace.json
 
 
+def test_produced_router_artifacts_validate(tmp_path):
+    """ISSUE 14 fixture regeneration from a REAL 2-replica router run
+    (a forced mid-trace drain included): the produced stream must
+    carry the replica tag typed on every per-request lifecycle event,
+    the drain/requeue events, the per-replica reports plus the
+    aggregate router report (placement / imbalance / per_replica), and
+    pass the validator end to end — fixtures from live emitters, not
+    hand-built."""
+    import numpy as np
+
+    out = tmp_path / "telemetry"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+            init_params,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+            Gpt2Config,
+            Gpt2LMHeadModel,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.serve.router import (
+            Router,
+        )
+
+        gcfg = Gpt2Config(vocab_size=128, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position_embeddings=64, hidden_dropout=0.0,
+                          embd_dropout=0.0, attention_dropout=0.0,
+                          eos_token_id=127, pad_token_id=0)
+        gmodel = Gpt2LMHeadModel(gcfg)
+        router = Router(gmodel, init_params(gmodel, gcfg, seed=0),
+                        replicas=2, placement="round_robin",
+                        num_slots=1, block_size=8, num_blocks=17,
+                        prefill_chunk=8, max_model_len=32)
+        rng = np.random.RandomState(0)
+        for _ in range(6):
+            router.submit(rng.randint(1, 120, (5,)).astype(np.int32), 4)
+        router.warmup()
+        router.step()                    # admit 1 per replica...
+        moved = router.drain(0)          # ...then requeue 0's waiting
+        assert moved, "drain must move waiting requests"
+        router.run()
+        obs.flush()
+        events = [e for _, e, err in obs.iter_events(
+            str(out / "events.jsonl")) if err is None]
+    finally:
+        obs.reset()
+    serve = [e for e in events if e["type"] == "serve"]
+    kinds = {e.get("event") for e in serve}
+    assert {"submit", "finish", "request_timeline", "drain",
+            "requeue", "report"} <= kinds
+    for kind in ("submit", "admit", "first_token", "finish",
+                 "request_timeline", "iteration_ledger"):
+        rows = [e for e in serve if e.get("event") == kind]
+        assert rows and all(isinstance(e["replica"], int)
+                            for e in rows), kind
+    drains = [e for e in serve if e["event"] == "drain"]
+    assert drains and all(isinstance(e["requeued"], int)
+                          and isinstance(e["placement"], str)
+                          for e in drains)
+    requeues = [e for e in serve if e["event"] == "requeue"]
+    assert requeues and all(
+        isinstance(e["replica"], int) and isinstance(e["to_replica"], int)
+        for e in requeues)
+    reports = [e for e in serve if e["event"] == "report"]
+    agg = reports[-1]
+    assert agg["replicas"] == 2 and isinstance(agg["placement"], str)
+    assert isinstance(agg["replica_load_imbalance"], (int, float))
+    assert isinstance(agg["per_replica"], list)
+    assert isinstance(agg["drains"], int) and agg["drains"] == 1
+    proc = _run(str(out))
+    assert proc.returncode == 0, proc.stdout
+
+
 def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
     """gather_bucket/sampled are optional on `serve` events but TYPED
     when present — a drifted emitter (string bucket, int flag) fails
@@ -298,6 +372,39 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
     assert "optional field 'group'" in proc.stdout
     assert "optional field 'blocked_reason'" in proc.stdout
     assert "optional field 'iteration'" in proc.stdout
+    # ISSUE 14 multi-replica router fields: typed when present, so a
+    # drifted emitter can't poison per-replica attribution silently
+    # (own file — the validator caps printed errors per artifact, and
+    # the router rows would fall past the first file's cap)
+    bad2 = tmp_path / "router_events.jsonl"
+    rows2 = [
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "drain", "replica": 1, "requeued": 3,
+         "placement": "affinity"},                               # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "requeue", "request": 7, "replica": 1,
+         "to_replica": 0},                                       # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "drain", "replica": "one", "requeued": "many",
+         "placement": 3},                                        # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "report", "replicas": 2, "placement": "round_robin",
+         "replica_load_imbalance": 1.1,
+         "per_replica": [{"replica": 0}]},                       # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "report", "replicas": 2.5, "to_replica": "zero",
+         "replica_load_imbalance": "even", "per_replica": "all"},  # drift
+    ]
+    bad2.write_text("\n".join(json.dumps(r) for r in rows2) + "\n")
+    proc2 = _run(str(bad2))
+    assert proc2.returncode == 1
+    assert "optional field 'replica'" in proc2.stdout
+    assert "optional field 'requeued'" in proc2.stdout
+    assert "optional field 'placement'" in proc2.stdout
+    assert "optional field 'replicas'" in proc2.stdout
+    assert "optional field 'to_replica'" in proc2.stdout
+    assert "optional field 'replica_load_imbalance'" in proc2.stdout
+    assert "optional field 'per_replica'" in proc2.stdout
     assert "optional field 'dur_s'" in proc.stdout
     assert "optional field 'decode_slots'" in proc.stdout
     assert "optional field 'waiting'" in proc.stdout

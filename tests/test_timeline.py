@@ -273,6 +273,38 @@ def test_slo_attribution_names_dominant_phase_and_groups():
         assert 0.0 <= frac <= 1.0
 
 
+def test_slo_attribution_groups_by_replica_when_tagged():
+    """ISSUE 14: records carrying a ``replica`` tag (a multi-replica
+    router run) get a per-replica rollup next to the per-group one —
+    per-replica tail attribution out of the same machinery — while
+    untagged (single-engine) streams stay byte-identical."""
+    events = [_tl_event(i, replica=i % 2, dc=0.5 + 0.05 * i)
+              for i in range(8)]
+    events.append(_tl_event(8, replica=1, q=9.0, ttft_s=9.4))
+    doc = slo_attribution(collect_timelines(events), pct=0.95)
+    assert set(doc["replicas"]) == {"0", "1"}
+    assert doc["replicas"]["0"]["requests"] == 4
+    assert doc["replicas"]["1"]["requests"] == 5
+    # the tail (the queue-bound request) sits on replica 1, and its
+    # tail row names the replica
+    assert doc["replicas"]["1"]["tail_count"] == 1
+    assert doc["replicas"]["0"]["tail_count"] == 0
+    assert doc["replicas"]["1"]["e2e_p99_s"] > \
+        doc["replicas"]["0"]["e2e_p99_s"]
+    assert doc["tail"]["requests"][0]["replica"] == 1
+    # untagged records: no replicas section at all
+    plain = slo_attribution(collect_timelines(
+        [_tl_event(i) for i in range(4)]), pct=0.95)
+    assert "replicas" not in plain
+    # the text rendering names replicas
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.timeline import (
+        render_slo_text,
+    )
+
+    text = render_slo_text(doc)
+    assert "replica 0:" in text and "replica 1:" in text
+
+
 def test_gantt_and_chrome_trace_render():
     recs = collect_timelines([_tl_event(0), _tl_event(1, pe=0.4)])
     text = gantt_text(recs, width=32)
