@@ -511,6 +511,42 @@ def run_kernel_parity() -> dict:
     return summary
 
 
+def bench_lint() -> None:
+    """The ``--lint`` stage: run graftlint over the tree and emit one
+    ``lint_findings`` count line. Zero-baseline count semantics (shared
+    with compiles/anomalies): the healthy value is 0, ANY unsuppressed
+    finding is a regression, worse direction UP — which is exactly how
+    ``obsctl diff`` gates the matching report scalar. Runs in-process
+    (no jax, no supervised child: the linter is stdlib-only by rule
+    R1), and mirrors the count into telemetry (``lint/findings``) when
+    a sink is configured so ``obsctl report`` carries it."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+    from huggingface_sagemaker_tensorflow_distributed_tpu.analysis.lint import (
+        LintInputError,
+        run_lint,
+    )
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        result = run_lint(root)
+    except LintInputError as e:
+        emit_error(["lint_findings"], "lint_bad_input",
+                   {"message": str(e)})
+        return
+    n = len(result.active)
+    if obs.has_sink():
+        obs.scalar("lint/findings", n)
+        obs.flush()
+    print(json.dumps({
+        "metric": "lint_findings", "value": n, "unit": "findings",
+        "vs_baseline": None, "worse_direction": "up",
+        "suppressed": len(result.suppressed),
+        "per_rule": result.counts(),
+        "detail": {"finding": [f.render() for f in result.active[:20]]}
+        if n else {},
+    }))
+
+
 def emit_error(metrics: list[str], error: str, detail: dict) -> None:
     """The structured-failure contract: one parseable JSON line per
     metric the mode would have produced, rc 0."""
@@ -851,6 +887,13 @@ def main() -> None:
                              "policy token identity, 2x fleet "
                              "admission depth, affinity-vs-round-"
                              "robin cache hit rate, load imbalance)")
+    parser.add_argument("--lint", action="store_true",
+                        help="graftlint static-analysis stage: emit a "
+                             "lint_findings count line (0 = clean; "
+                             "count metric, worse direction UP, "
+                             "zero-baseline regression rule shared "
+                             "with compiles/anomalies). Runs "
+                             "in-process and jax-less")
     parser.add_argument("--llama-train", action="store_true",
                         dest="llama_train",
                         help="TinyLlama-1.1B training throughput "
@@ -891,6 +934,7 @@ def main() -> None:
                               ("--banded", args.banded),
                               ("--data", args.data),
                               ("--serve", args.serve),
+                              ("--lint", args.lint),
                               ("--llama-train", args.llama_train),
                               ("--mixtral-train", args.mixtral_train)] if on]
     if len(picked) > 1:
@@ -902,7 +946,11 @@ def main() -> None:
         parser.error("--batch/--opt-state-bf16/--remat-policy apply to "
                      f"the headline mode only, not {picked[0]}")
 
-    if getattr(args, "_child"):
+    if args.lint:
+        # no supervised child: the stage is stdlib-only and sub-second,
+        # and the probe/budget machinery exists for jax workloads
+        bench_lint()
+    elif getattr(args, "_child"):
         _run_child(args)
     else:
         supervise(args)

@@ -85,6 +85,18 @@ REQUIRED_FIELDS: dict[str, dict[str, tuple]] = {
 OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
     "serve": {"gather_bucket": (int,), "sampled": (bool,),
               "request": (int,), "speculate_k": (int,),
+              # per-event context riders surfaced by graftlint R4
+              # (ISSUE 15): submit's token budget, admit's slot/queue
+              # placement, preempt's cause, and bucket_switch's
+              # from/to context — emitted since their PRs but never
+              # declared, i.e. exactly the silent schema drift the
+              # telemetry-field-contract rule now fails in the diff
+              "max_new_tokens": (int,),
+              "slot": (int,),
+              "queue_depth": (int,),
+              "reason": (str,),
+              "prev_bucket": (int,),
+              "max_context": (int,),
               "draft_proposed": (int,), "draft_accepted": (int,),
               "acceptance_rate": _NUM,
               "verify_read_waste_peak": _NUM,

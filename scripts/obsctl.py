@@ -25,6 +25,11 @@ Usage::
     # decode tokens/sec / TTFT percentiles over a sliding window,
     # reading only what was appended since the last poll
     python scripts/obsctl.py tail telemetry/events.jsonl --window 64
+    # static analysis (graftlint): enforce the compile-flatness /
+    # host-sync / contract invariants over the tree (or a stdin
+    # snippet); exit 2 on unsuppressed findings, like diff
+    python scripts/obsctl.py lint
+    cat patch.py | python scripts/obsctl.py lint - --format json
 
 ``report`` merges every ``events.jsonl`` it finds under the given
 paths (a run dir, per-host dirs, or dirs of per-host subdirs) into one
@@ -210,6 +215,43 @@ def cmd_slo(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """graftlint over the tree (or one stdin snippet with ``-``): the
+    same run/renderers/exit codes as ``scripts/graftlint.py`` — 0
+    clean, 1 bad input, 2 unsuppressed findings. Stdlib-only like
+    every obsctl command (rule R1 lints the linter itself)."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.analysis.lint import (
+        LintInputError,
+        lint_text,
+        render_json,
+        render_text,
+        run_lint,
+    )
+
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        if args.paths == ["-"]:
+            result = lint_text(sys.stdin.read(), rules=rules)
+        elif "-" in args.paths:
+            print("obsctl: '-' cannot be combined with file paths",
+                  file=sys.stderr)
+            return 1
+        else:
+            root = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+            result = run_lint(root, paths=args.paths or None,
+                              rules=rules)
+    except LintInputError as e:
+        print(f"obsctl: {e}", file=sys.stderr)
+        return 1
+    if args.format == "json":
+        sys.stdout.write(render_json(result))
+    else:
+        sys.stdout.write(render_text(result))
+    return 2 if result.active else 0
+
+
 def cmd_tail(args: argparse.Namespace) -> int:
     """Follow a live events.jsonl: each poll reads only the appended
     suffix (the prefix is never re-read), updates the sliding-window
@@ -330,6 +372,20 @@ def main(argv: list[str] | None = None) -> int:
                       help="exit after N update lines (0 = follow "
                            "forever)")
     tail.set_defaults(func=cmd_tail)
+
+    lint = sub.add_parser("lint",
+                          help="graftlint static analysis: compile-"
+                               "flatness / host-sync / contract "
+                               "invariants (exit 2 on findings)")
+    lint.add_argument("paths", nargs="*",
+                      help="repo-relative files (default: the whole "
+                           "tree); '-' lints stdin with the "
+                           "file-local rules")
+    lint.add_argument("--format", choices=("text", "json"),
+                      default="text")
+    lint.add_argument("--rules", default=None,
+                      help="comma-separated rule ids (default: all)")
+    lint.set_defaults(func=cmd_lint)
 
     args = parser.parse_args(argv)
     return args.func(args)

@@ -303,6 +303,7 @@ def predict(args) -> list[dict]:
 
     # token_type_ids matter for pair inputs (QA): the trainer forwards
     # them (train/trainer.py::_apply), so inference must too
+    # graftlint: allow[R3] no static key: params/ids/mask/type-ids are all traced arrays, the model is closed over — one compile per predict invocation by construction
     apply = jax.jit(lambda p, i, m, t: model.apply(
         {"params": p}, i, m, token_type_ids=t, deterministic=True))
     out = apply(params, ids, mask, token_types)

@@ -235,6 +235,12 @@ _SLOW_TESTS = {
     # allowlist) stay tier-1
     "test_router.py::test_router_affinity_speculative_prefix_composition",
     "test_router.py::test_router_sampled_streams_bitwise_identical_across_placement",
+    # ISSUE 15: the retained runtime no-jax SUBPROCESS smokes — the
+    # primary gate is now graftlint R1's static reachability
+    # (test_graftlint.py, tier-1); the poison runs are the slow-tier
+    # backstop covering runtime (lazily-imported) paths R1 sanctions
+    "test_telemetry_schema.py::test_validator_runs_without_jax",
+    "test_obsctl.py::test_cli_subprocess_smoke_without_jax",
 }
 
 

@@ -629,28 +629,27 @@ def test_cli_tail_exits_nonzero_on_malformed_stream(tmp_path):
 
 # -- the no-jax import contract, extended (ISSUE 10 satellite) ----------------
 
-def test_obs_timeline_runs_without_jax(synthetic_dirs, tmp_path):
-    """obs/timeline.py and every new obsctl subcommand stay on the
-    stdlib-only side of the obs contract: jax import is poisoned."""
-    code = ("import sys; sys.modules['jax'] = None; "
-            "from huggingface_sagemaker_tensorflow_distributed_tpu.obs"
-            ".timeline import SlidingWindow, TailFollower; "
-            "w = SlidingWindow(4); w.push(1.0); print(w.percentile(0.5))")
-    proc = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
-                          stdout=subprocess.PIPE,
-                          stderr=subprocess.STDOUT, text=True)
-    assert proc.returncode == 0, proc.stdout
-    tail_path = str(tmp_path / "tailme.jsonl")
-    _write_events(tail_path, [_ledger_event(0)])
-    for argv in (["timeline", *synthetic_dirs],
-                 ["slo", *synthetic_dirs],
-                 ["tail", tail_path, "--updates", "1",
-                  "--interval", "0.05"]):
-        code = ("import sys, runpy; sys.modules['jax'] = None; "
-                "sys.argv = ['obsctl'] + %r; "
-                "runpy.run_path(%r, run_name='__main__')"
-                % (argv, _OBSCTL))
-        proc = subprocess.run([sys.executable, "-c", code], cwd=_REPO,
-                              stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True)
-        assert proc.returncode == 0, (argv[0], proc.stdout)
+def test_obs_timeline_runs_without_jax():
+    """obs/timeline.py and every obsctl subcommand stay on the
+    stdlib-only side of the obs contract — asserted statically via
+    graftlint R1's import-time reachability (ISSUE 15): complete over
+    all import edges, not just the subcommand paths a poison run
+    happened to execute. The slow-tier subprocess smokes
+    (test_obsctl / test_telemetry_schema) backstop the static view at
+    runtime."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.analysis.lint import (
+        PACKAGE,
+        load_project,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.analysis.rules import (
+        check_r1,
+        r1_reachability,
+        r1_zone_roots,
+    )
+
+    project = load_project(_REPO)
+    assert check_r1(project) == []
+    # timeline is a zone ROOT (all of obs/ is), so even its
+    # lazily-imported consumers can't smuggle jax in at import time
+    assert f"{PACKAGE}/obs/timeline.py" in r1_zone_roots(project)
+    assert "scripts/obsctl.py" in r1_reachability(project)
