@@ -586,7 +586,8 @@ def _mode_metrics(args: argparse.Namespace) -> list[str]:
                 "serve_paged_kernel_decode_speedup",
                 "serve_overlap_decode_speedup",
                 "serve_tp_shard_capacity",
-                "serve_router_scaleout"]
+                "serve_router_scaleout",
+                "serve_open_loop_goodput"]
     if args.llama_train:
         return ["llama_1b_train_samples_per_sec_per_chip"]
     if args.mixtral_train:
@@ -886,7 +887,12 @@ def main() -> None:
                              "(2 engine replicas vs 1: placement-"
                              "policy token identity, 2x fleet "
                              "admission depth, affinity-vs-round-"
-                             "robin cache hit rate, load imbalance)")
+                             "robin cache hit rate, load imbalance) + "
+                             "the open-loop goodput line (Poisson "
+                             "arrival schedule on a virtual clock: "
+                             "SLO attainment at underload/overload "
+                             "rates, queue-dominant miss attribution, "
+                             "wall-clock capacity knee reported)")
     parser.add_argument("--lint", action="store_true",
                         help="graftlint static-analysis stage: emit a "
                              "lint_findings count line (0 = clean; "

@@ -1,4 +1,4 @@
-"""Serving bench (``bench.py --serve``): eight JSON metric lines.
+"""Serving bench (``bench.py --serve``): nine JSON metric lines.
 
 1. ``serve_continuous_vs_static_speedup`` — continuous batching + paged
    KV vs static-batch ``generate_causal`` on a mixed-length request
@@ -106,6 +106,27 @@
    router's fan-out costs nothing (ratio bounded below), while the Nx
    multiplication is an N-chip claim banked for real hardware (the
    same reasoning that kept wall-clock out of the TP line's gates).
+
+9. ``serve_open_loop_goodput`` — the ISSUE 16 tentpole: open-loop
+   arrival-driven load on the 2-replica router fleet, the DistServe
+   goodput question the closed-loop lines structurally cannot ask
+   (a closed loop self-throttles, so it never exhibits queueing
+   collapse). A seeded Poisson schedule with bounded-Pareto
+   prompt/output lengths replays on the driver's VIRTUAL clock at two
+   rates: underload λ_lo and overload λ_hi, each judged against a
+   TTFT/TPOT :class:`~...serve.loadgen.SloSpec` in virtual seconds.
+   Every gate is DETERMINISTIC and enforced at smoke scale too:
+   token identity AND byte-identical goodput summaries across two
+   fresh λ_lo replays (the virtual clock is a pure function of
+   schedule + tokens), attainment exactly 1.0 at λ_lo, attainment
+   strictly lower at λ_hi with ``queue`` the dominant miss phase (the
+   fleet saturates, arrivals do not care — the open-loop signature),
+   and compile flatness across all measured runs (arrival timing is
+   host-side only; it must mint zero new variants). The wall-clock
+   capacity knee — a real-sleep rate sweep through the same driver,
+   knee = first rate whose attainment drops below 0.99 — is
+   additionally REPORTED on full runs but never gated: wall queueing
+   on a shared CPU is honest to show and dishonest to assert.
 
 Structural gates degrade the line to the structured-error shape (value
 null + ``error``) rather than lying with a number. Both sides of every
@@ -1782,8 +1803,200 @@ def bench_serve_router(smoke: bool = False) -> dict:
                  "bench/serve_router_scaleout")
 
 
+def bench_serve_open_loop(smoke: bool = False) -> dict:
+    """Metric line 9 (ISSUE 16): open-loop goodput on the router
+    fleet. See the module docstring — virtual-clock determinism,
+    underload/overload attainment, queue-dominant miss attribution
+    and compile flatness gate at every scale; the wall-clock capacity
+    knee is reported (full runs only) but never gated."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.loadgen import (
+        OpenLoopDriver,
+        SloSpec,
+        make_schedule,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.router import (
+        Router,
+    )
+
+    on_tpu, anomaly_field, memory_watermark = _bench_env()
+
+    if smoke:
+        cfg = Gpt2Config(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=128,
+                         max_position_embeddings=128, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0,
+                         eos_token_id=255, pad_token_id=0)
+        slots, block, chunk, max_len = 2, 8, 8, 64
+        buckets = [32, 64]
+        n_req, prompt_lo, prompt_hi, new_lo, new_hi = 10, 4, 8, 3, 6
+        wall_rates: tuple = ()
+    elif on_tpu:
+        cfg = Gpt2Config(dtype=jnp.bfloat16, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0)  # 124M
+        slots, block, chunk, max_len = 4, 16, 32, 256
+        buckets = [128, 256]
+        n_req, prompt_lo, prompt_hi, new_lo, new_hi = 32, 8, 24, 8, 24
+        wall_rates = (8.0, 32.0, 128.0)
+    else:
+        cfg = Gpt2Config(vocab_size=2048, hidden_size=256, num_layers=2,
+                         num_heads=4, intermediate_size=1024,
+                         max_position_embeddings=256, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0,
+                         eos_token_id=2047, pad_token_id=0)
+        slots, block, chunk, max_len = 2, 8, 8, 64
+        buckets = [32, 64]
+        n_req, prompt_lo, prompt_hi, new_lo, new_hi = 24, 4, 12, 4, 12
+        wall_rates = (8.0, 64.0)
+    # two offered rates, in VIRTUAL requests/sec: λ_lo spaces arrivals
+    # far past the fleet's virtual service time (every deadline holds),
+    # λ_hi lands the whole schedule effectively at once (the fleet's
+    # `2 x slots` admission width saturates and the tail queues — the
+    # open-loop regime a closed loop cannot produce). The SLOs are
+    # virtual-domain: at tick_s = 1ms a TTFT budget of 20ms buys ~20
+    # fleet iterations, which underload always meets, and the overload
+    # budget of 5ms covers first-wave prefill but no queueing at all.
+    rate_lo, rate_hi, tick = 40.0, 100000.0, 0.001
+    slo_lo = SloSpec(ttft_s=0.02, tpot_s=0.01)
+    slo_hi = SloSpec(ttft_s=0.005)
+    sched_seed = 11
+
+    model = Gpt2LMHeadModel(cfg)
+    params = init_params(model, cfg, seed=0)
+    vocab = min(cfg.vocab_size - 2, 1 << 16)
+    num_blocks = 1 + slots * ((prompt_hi + chunk + new_hi + block)
+                              // block + 1)
+    # timeline off: the virtual driver polls SCHEDULER transitions
+    # (admit / first token / finish), not the PR 10 stamps, so the
+    # deterministic gates need no per-token tracing overhead
+    kw = dict(num_slots=slots, block_size=block, prefill_chunk=chunk,
+              max_model_len=max_len, gather_buckets=buckets,
+              timeline="off", overlap="on", prefix_cache=False, mesh=1)
+
+    def schedule(rate):
+        return make_schedule(
+            n_req, vocab, process="poisson", rate=rate, seed=sched_seed,
+            prompt_lo=prompt_lo, prompt_hi=prompt_hi, new_lo=new_lo,
+            new_hi=new_hi, eos_token_id=cfg.eos_token_id,
+            groups=("interactive", "batch"))
+
+    def serve_once(rate, slo, clock="virtual"):
+        r = Router(model, params, replicas=2, placement="round_robin",
+                   num_blocks=num_blocks, **kw)
+        drv = OpenLoopDriver(r, schedule(rate), clock=clock,
+                             tick_s=tick, slo=slo, process="poisson",
+                             rate=rate)
+        finished = drv.run()
+        outs = [list(finished[rid].output) for rid in sorted(finished)]
+        return {"outs": outs, "summary": drv.summary(),
+                "slo": r.slo_summary()}
+
+    with obs.span("bench/serve_open_loop_warm"):
+        serve_once(rate_hi, slo_hi)         # saturating run compiles all
+    tracker = obs.compile_tracker()
+    count0 = tracker.count if tracker else None
+
+    with obs.span("bench/serve_open_loop_virtual"):
+        lo_a = serve_once(rate_lo, slo_lo)
+        lo_b = serve_once(rate_lo, slo_lo)  # fresh replay, same seed
+        hi = serve_once(rate_hi, slo_hi)
+    compile_delta = (tracker.count - count0) if tracker else None
+
+    # -- gates (all deterministic, enforced at every scale) -----------
+    replay = json.dumps(lo_a["summary"], sort_keys=True)
+    replay_ok = (lo_a["outs"] == lo_b["outs"]
+                 and replay == json.dumps(lo_b["summary"],
+                                          sort_keys=True))
+    att_lo = lo_a["summary"].get("slo_attainment")
+    att_hi = hi["summary"].get("slo_attainment")
+    lo_ok = att_lo == 1.0
+    hi_ok = (att_hi is not None and att_lo is not None
+             and att_hi < att_lo
+             and hi["summary"].get("dominant_miss_phase") == "queue")
+    # arrival timing is driver/host-side only: same bucket ladder as
+    # the warm run, zero new variants (router-line bound, per replica)
+    compiles_ok = (compile_delta is None
+                   or compile_delta <= 2 * len(buckets))
+    gate_ok = replay_ok and lo_ok and hi_ok and compiles_ok
+
+    # -- wall-clock knee (reported, never gated) ----------------------
+    wall_sweep = []
+    wall_knee = None
+    if wall_rates and gate_ok:
+        with obs.span("bench/serve_open_loop_wall"):
+            for rate in wall_rates:
+                w = serve_once(rate, SloSpec(ttft_s=0.5, tpot_s=0.25),
+                               clock="wall")
+                att = w["summary"].get("slo_attainment")
+                wall_sweep.append({"rate": rate, "slo_attainment": att})
+                if wall_knee is None and att is not None and att < 0.99:
+                    wall_knee = rate
+
+    result = {
+        "metric": "serve_open_loop_goodput",
+        "value": round(att_lo, 4) if gate_ok else None,
+        "unit": "frac" if gate_ok else None,
+        "vs_baseline": (round(att_hi, 4)
+                        if gate_ok and att_hi is not None else None),
+        "detail": {
+            "replicas": 2,
+            "clock": "virtual",
+            "tick_s": tick,
+            "process": "poisson",
+            "rate_lo": rate_lo,
+            "rate_hi": rate_hi,
+            "slo_lo": {"ttft_s": slo_lo.ttft_s, "tpot_s": slo_lo.tpot_s},
+            "slo_hi": {"ttft_s": slo_hi.ttft_s, "tpot_s": slo_hi.tpot_s},
+            "attainment_lo": att_lo,
+            "attainment_hi": att_hi,
+            "goodput_tokens_lo": lo_a["summary"].get("goodput_tokens"),
+            "goodput_tokens_hi": hi["summary"].get("goodput_tokens"),
+            "miss_phases_hi": hi["summary"].get("miss_phases"),
+            "dominant_miss_phase_hi":
+                hi["summary"].get("dominant_miss_phase"),
+            "group_slo_attainment_hi":
+                hi["summary"].get("group_slo_attainment"),
+            "arrival_backlog_peak_lo":
+                lo_a["slo"].get("arrival_backlog_peak"),
+            "arrival_backlog_peak_hi":
+                hi["slo"].get("arrival_backlog_peak"),
+            "wall_rates": list(wall_rates),
+            "wall_sweep": wall_sweep,
+            "wall_knee_rate": wall_knee,
+            "requests": n_req,
+            "num_slots": slots,
+            "block_size": block,
+            "num_blocks": num_blocks,
+            "prefill_chunk": chunk,
+            "max_model_len": max_len,
+            "gather_buckets": buckets,
+            "compiles_steady": compile_delta,
+            "replay_identical": replay_ok,
+            "model_scale": ("smoke" if smoke
+                            else "real" if on_tpu else "cpu"),
+        },
+    }
+    if not gate_ok:
+        result["error"] = (
+            "virtual_replay_diverged" if not replay_ok
+            else "underload_attainment_below_one" if not lo_ok
+            else "overload_not_queue_bound" if not hi_ok
+            else "steady_state_recompiled")
+    return _emit(result, anomaly_field, memory_watermark,
+                 "bench/serve_open_loop_goodput")
+
+
 def bench_serve(smoke: bool = False) -> list[dict]:
-    """All eight serve metric lines, mixed-trace first (the driver
+    """All nine serve metric lines, mixed-trace first (the driver
     reads stdout lines; the return value is for tests)."""
     return [bench_serve_mixed(smoke=smoke),
             bench_serve_bucketed(smoke=smoke),
@@ -1792,7 +2005,8 @@ def bench_serve(smoke: bool = False) -> list[dict]:
             bench_serve_paged_kernel(smoke=smoke),
             bench_serve_overlap(smoke=smoke),
             bench_serve_tp(smoke=smoke),
-            bench_serve_router(smoke=smoke)]
+            bench_serve_router(smoke=smoke),
+            bench_serve_open_loop(smoke=smoke)]
 
 
 if __name__ == "__main__":

@@ -14,7 +14,10 @@ Contracts (mirrored by ``tests/test_graftlint.py``):
   the offending line (or alone on the line above) suppresses that
   rule's findings there. The reason is mandatory: a pragma without one
   is itself a finding (rule id ``pragma``), so every exception in the
-  tree documents why it is safe.
+  tree documents why it is safe. A pragma whose rule does NOT fire on
+  its line is also a finding (same rule id): stale suppressions are
+  landmines — the code they excused is gone, and the next genuine
+  violation on that line would be silently swallowed.
 - **Exit codes** (CLI layer): 0 clean, 1 bad input (unparseable file,
   missing path), 2 unsuppressed findings — the same shape as
   ``obsctl diff``.
@@ -379,6 +382,31 @@ class LintResult:
         return out
 
 
+def _unused_pragmas(files: dict[str, SourceFile],
+                    findings: list[Finding],
+                    checkable: set[str]) -> list[Finding]:
+    """Pragma findings for every ``allow[rid]`` whose rule produced no
+    finding on its governed line. Runs against PRE-filter findings (a
+    path selection must not turn a used pragma into an "unused" one)
+    and only judges pragmas for rules in ``checkable`` — rules the
+    caller actually ran on input they can fire on. A pragma for a rule
+    outside the selection is not vouching for anything this run can
+    see, so it is left alone (ids unknown to the catalog stay silently
+    ignored, as before)."""
+    fired = {(f.path, f.line, f.rule) for f in findings}
+    out: list[Finding] = []
+    for path in sorted(files):
+        for line in sorted(files[path].pragmas):
+            for rid, _reason in files[path].pragmas[line]:
+                if rid in checkable and (path, line, rid) not in fired:
+                    out.append(Finding(
+                        PRAGMA_RULE, path, line,
+                        f"unused pragma allow[{rid}]: {rid} does not "
+                        f"fire on this line — remove the stale "
+                        f"suppression before it hides a real finding"))
+    return out
+
+
 def _apply_pragmas(project: Project,
                    findings: list[Finding]) -> list[Finding]:
     out = []
@@ -416,6 +444,8 @@ def run_lint(root: str, paths: Optional[Sequence[str]] = None,
     findings: list[Finding] = []
     for rid in selected:
         findings.extend(RULES[rid].check(project))
+    findings.extend(_unused_pragmas(project.files, findings,
+                                    checkable=set(selected)))
     for path in sorted(project.files):
         for line, msg in project.files[path].bad_pragmas:
             findings.append(Finding(PRAGMA_RULE, path, line, msg))
@@ -447,6 +477,12 @@ def lint_text(text: str, name: str = "<stdin>",
     findings: list[Finding] = []
     for rid in selected:
         findings.extend(RULES[rid].check(project))
+    # only R2/R3 can fire on a bare snippet (R1's zones, R4's schema
+    # home, R5's README and R6's pool home are all tree-anchored), so
+    # only their pragmas are judged for staleness here
+    findings.extend(_unused_pragmas({name: sf}, findings,
+                                    checkable={"R2", "R3"}
+                                    & set(selected)))
     for line, msg in sf.bad_pragmas:
         findings.append(Finding(PRAGMA_RULE, name, line, msg))
     findings = _apply_pragmas(project, findings)

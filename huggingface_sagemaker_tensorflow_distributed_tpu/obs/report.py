@@ -369,6 +369,17 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # only happens on degenerate reports, and imbalance appearing
     # against it must still flag).
     "serve_replica_load_imbalance": (+1, "ratio"),
+    # open-loop goodput (ISSUE 16): SLO attainment, worse DOWN — the
+    # DistServe headline figure, and the one every capacity decision
+    # reads; ratio kind under the shared zero-baseline rule (a 0.0
+    # baseline is a fully-missing run, and attainment moving off it is
+    # an improvement in the better direction — only drops flag).
+    "serve_slo_attainment": (-1, "ratio"),
+    # peak count of arrived-but-unadmitted requests across the run,
+    # worse UP — the queueing-collapse early-warning: backlog grows
+    # before attainment falls. Count kind: ANY increase regresses (a
+    # deterministic virtual-clock replay holds this integer exactly).
+    "serve_arrival_backlog_peak": (+1, "count"),
 }
 
 
@@ -405,7 +416,8 @@ def _report_scalars(report: dict) -> dict:
                 "acceptance_rate", "cache_hit_rate",
                 "kv_bytes_read_per_step", "queue_wait_p99_s",
                 "preempted_time_frac", "overhead_time_frac",
-                "kv_pool_bytes_per_device", "replica_load_imbalance"):
+                "kv_pool_bytes_per_device", "replica_load_imbalance",
+                "slo_attainment", "arrival_backlog_peak"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out

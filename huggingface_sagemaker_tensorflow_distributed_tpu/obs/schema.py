@@ -190,7 +190,36 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               "prefill_time_frac": _NUM,
               "decode_time_frac": _NUM,
               "preempted_time_frac": _NUM,
-              "overhead_time_frac": _NUM},
+              "overhead_time_frac": _NUM,
+              # open-loop load + SLO attainment (ISSUE 16): submit
+              # events carry the ARRIVAL timestamp (distinct from the
+              # submit stamp — queue wait decomposes into pre-submit
+              # backlog + in-engine queue) and the request's deadline
+              # targets; finish + request_timeline events the per-
+              # request verdicts (slo_met and the per-target splits,
+              # slack_s = the tightest remaining margin, negative on a
+              # miss); the iteration ledger the count of arrived-but-
+              # unadmitted requests; the report event the aggregate
+              # attainment (the DistServe goodput numerator), its
+              # per-tenant breakdown, and the backlog peak `obsctl
+              # diff` gates. The `open_loop` driver event stamps each
+              # loadgen run with its arrival process / rate / clock so
+              # `obsctl goodput` can split a rate sweep into runs
+              "arrival_s": _NUM,
+              "slo_ttft_s": _NUM,
+              "slo_tpot_s": _NUM,
+              "slo_met": (bool,),
+              "ttft_slo_met": (bool,),
+              "tpot_slo_met": (bool,),
+              "slack_s": _NUM,
+              "slo_attainment": _NUM,
+              "group_slo_attainment": (dict,),
+              "arrival_backlog": (int,),
+              "arrival_backlog_peak": (int,),
+              "process": (str,),
+              "rate": _NUM,
+              "clock": (str,),
+              "requests": (int,)},
 }
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)

@@ -534,6 +534,12 @@ class TailStats:
         self._ttft = SlidingWindow(window)
         self._tokens = SlidingWindow(window)
         self._dur = SlidingWindow(window)
+        # rolling SLO attainment (ISSUE 16): 1/0 per verdict-carrying
+        # finish event over the same window — the live "are we meeting
+        # deadlines RIGHT NOW" gauge an operator watches during an
+        # open-loop run; never populated (and never rendered) on
+        # closed-loop streams
+        self._slo = SlidingWindow(window)
 
     def update(self, event: dict) -> None:
         self.events += 1
@@ -551,6 +557,9 @@ class TailStats:
             elif kind == "first_token" and isinstance(
                     event.get("ttft_s"), (int, float)):
                 self._ttft.push(event["ttft_s"])
+            elif kind == "finish" and isinstance(
+                    event.get("slo_met"), bool):
+                self._slo.push(1.0 if event["slo_met"] else 0.0)
         elif etype == "metric":
             name = event.get("name")
             if name == "serve/waiting_depth" \
@@ -564,12 +573,18 @@ class TailStats:
         tps = None
         if self._dur.sum() > 0:
             tps = self._tokens.sum() / self._dur.sum()
+        # the attainment column appears only once a verdict-carrying
+        # finish has been seen: closed-loop tails keep their exact
+        # pre-open-loop rendering
+        slo = (f"slo_attainment={self._slo.mean():.3f} "
+               if len(self._slo) else "")
         return (f"iter={fmt(self.iteration, '{}')} "
                 f"waiting={fmt(self.waiting, '{}')} "
                 f"kv_used={fmt(self.kv_used_frac)} "
                 f"tok/s={fmt(tps, '{:.1f}')} "
                 f"ttft_p50_s={fmt(self._ttft.percentile(0.50))} "
                 f"ttft_p99_s={fmt(self._ttft.percentile(0.99))} "
+                f"{slo}"
                 f"(window n={len(self._ttft)}, events={self.events})")
 
 

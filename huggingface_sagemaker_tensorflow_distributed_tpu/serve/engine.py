@@ -1238,6 +1238,18 @@ class ServeEngine:
         self.kv_bytes_read = 0      # pool bytes decode dispatches read
         self.spec_windows = 0       # active (slot, iteration) pairs
         self.peak_resident = 0      # max concurrently-occupied slots
+        # open-loop SLO accounting (ISSUE 16): attainment counters over
+        # finished requests that carried targets, plus the per-group
+        # split and the peak count of arrival-stamped requests seen
+        # waiting at any ledger instant. The _has_* flags gate every new
+        # report/ledger field so a closed-loop run's stream stays
+        # byte-identical to the pre-open-loop engine's.
+        self._slo_total = 0
+        self._slo_met = 0
+        self._group_slo: dict[str, list] = {}   # group -> [met, total]
+        self._arrival_backlog_peak = 0
+        self._has_arrivals = False
+        self._has_slo = False
         self._bucket = self.gather_buckets[0]
         self._shrink_streak = 0
         self._warmed_modes: set = set()
@@ -1331,27 +1343,56 @@ class ServeEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, seed: int = 0,
-               group: str = "") -> Request:
+               group: str = "", arrival_s: Optional[float] = None,
+               slo=None) -> Request:
         """Queue one request. ``temperature == 0`` (default) is greedy;
         ``temperature > 0`` samples with the given truncation knobs,
         seeded per request — same knob semantics as
         ``models.generate.generate_causal``. ``group`` is an opaque
         tag (tenant, route) the request's ``request_timeline`` event
-        carries so SLO attribution can aggregate per group."""
+        carries so SLO attribution can aggregate per group.
+
+        Open-loop contract (ISSUE 16): ``arrival_s`` is the request's
+        arrival stamp in this process's ``perf_counter`` domain —
+        distinct from the submit stamp taken here, so queue wait
+        decomposes into pre-submit backlog (load-generator hold time)
+        plus in-engine queue. ``slo`` is any object with ``ttft_s`` /
+        ``tpot_s`` attributes (``serve.loadgen.SloSpec``; duck-typed
+        to keep this module import-free of the load generator) naming
+        per-axis deadline seconds; the finish event then carries the
+        verdicts and :meth:`slo_summary` the attainment. Both are
+        absent-when-default: a closed-loop submit adds nothing to the
+        telemetry stream."""
         req = Request(prompt=np.asarray(prompt, np.int32),
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_k=int(top_k),
                       top_p=float(top_p), seed=int(seed),
-                      group=str(group))
+                      group=str(group),
+                      arrival_s=(None if arrival_s is None
+                                 else float(arrival_s)),
+                      slo_ttft_s=(None if slo is None or slo.ttft_s is None
+                                  else float(slo.ttft_s)),
+                      slo_tpot_s=(None if slo is None or slo.tpot_s is None
+                                  else float(slo.tpot_s)))
         req.submit_t = time.perf_counter()
         self.sched.submit(req)
         if req.sampled:
             self._keys[req.rid] = np.asarray(jax.random.PRNGKey(req.seed),
                                              np.uint32)
+        extra = {}
+        if req.arrival_s is not None:
+            self._has_arrivals = True
+            extra["arrival_s"] = round(req.arrival_s, 6)
+        if req.has_slo:
+            self._has_slo = True
+            if req.slo_ttft_s is not None:
+                extra["slo_ttft_s"] = req.slo_ttft_s
+            if req.slo_tpot_s is not None:
+                extra["slo_tpot_s"] = req.slo_tpot_s
         obs.serve("submit", request=req.rid,
                   prompt_len=len(req.prompt),
                   max_new_tokens=req.max_new_tokens,
-                  sampled=req.sampled, **self._replica_kw())
+                  sampled=req.sampled, **self._replica_kw(), **extra)
         return req
 
     def output_ids(self, req: Request) -> np.ndarray:
@@ -1561,6 +1602,21 @@ class ServeEngine:
             out["shared_read_frac"] = round(
                 self.blocks.shared_read_frac(), 4)
         out["peak_resident_requests"] = self.peak_resident
+
+        # open-loop SLO attainment (ISSUE 16): the DistServe goodput
+        # numerator — fraction of deadline-carrying finished requests
+        # that met EVERY set target, plus the per-group (tenant) split
+        # and the peak arrival-stamped backlog. Each key is gated on
+        # its own feed having appeared, so closed-loop (and target-
+        # less open-loop) reports stay byte-identical to before.
+        if self._has_slo and self._slo_total:
+            out["slo_attainment"] = round(
+                self._slo_met / self._slo_total, 4)
+            out["group_slo_attainment"] = {
+                g: round(m / t, 4)
+                for g, (m, t) in sorted(self._group_slo.items()) if t}
+        if self._has_arrivals:
+            out["arrival_backlog_peak"] = self._arrival_backlog_peak
 
         if self.speculative:
             out["speculate_k"] = self.speculate_k
@@ -1772,6 +1828,18 @@ class ServeEngine:
         # and slot occupancy as series, one sample per engine iteration
         waiting = len(self.sched.waiting)
         self.peak_waiting = max(self.peak_waiting, waiting)
+        arrival_kw = {}
+        if self._has_arrivals:
+            # open-loop backlog (ISSUE 16): how many arrival-stamped
+            # requests are queued at this instant — a deterministic
+            # integer (unlike the wall-time queue decomposition), so
+            # the virtual-clock bench can gate on it. Absent entirely
+            # on closed-loop runs — the byte-identity contract.
+            backlog = sum(1 for r in self.sched.waiting
+                          if r.arrival_s is not None)
+            self._arrival_backlog_peak = max(
+                self._arrival_backlog_peak, backlog)
+            arrival_kw["arrival_backlog"] = backlog
         if obs.has_sink():
             obs.scalar("serve/waiting_depth", waiting, self.iterations)
             obs.scalar("serve/running_slots",
@@ -1798,7 +1866,7 @@ class ServeEngine:
                     tokens=self.tokens_generated - tokens0,
                     waiting=waiting,
                     kv_used_frac=round(self.blocks.utilization(), 4),
-                    **self._replica_kw())
+                    **arrival_kw, **self._replica_kw())
         self.iterations += 1
 
     def _capacity_phase(self) -> None:
@@ -2479,6 +2547,17 @@ class ServeEngine:
         fields.update(self._replica_kw())
         if req.group:
             fields["group"] = req.group
+        # open-loop riders (ISSUE 16): the arrival stamp lets goodput
+        # attribution join pre-submit backlog onto the phase split, and
+        # the finish-time verdict lets `obsctl goodput` name the
+        # dominant phase of each MISS without a second join pass —
+        # absent on closed-loop / target-less requests
+        if req.arrival_s is not None:
+            fields["arrival_s"] = round(req.arrival_s, 6)
+        if at == "finish" and req.slo_met is not None:
+            fields["slo_met"] = req.slo_met
+            if req.slack_s is not None:
+                fields["slack_s"] = req.slack_s
         if req.cow_copies:
             fields["cow_copies"] = req.cow_copies
         if self.prefix_cache:
@@ -2542,8 +2621,57 @@ class ServeEngine:
             extra["kernel"] = self.kernel
             extra["kv_dtype"] = self.kv_cache_dtype
             extra["tp"] = self.tp
+            if req.has_slo:
+                extra.update(self._slo_verdict(req))
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
                       preemptions=req.preemptions,
                       **self._replica_kw(), **extra)
             self._emit_timeline(req, "finish")
+
+    def _slo_verdict(self, req: Request) -> dict:
+        """Write the request's SLO verdicts at finish and return the
+        finish-event riders (ISSUE 16). TTFT is measured from the
+        ARRIVAL stamp when one was threaded (the open-loop truth — the
+        request waited from arrival, not from when the generator got
+        around to submitting it), else from the submit stamp. TPOT is
+        the steady-state inter-token mean over the post-first-token
+        tail. ``slack_s`` is the TIGHTEST remaining margin across the
+        set targets — negative exactly on a miss, the quantity a
+        capacity planner reads as "how close to the knee"."""
+        origin = (req.arrival_s if req.arrival_s is not None
+                  else req.submit_t)
+        margins = []
+        if req.slo_ttft_s is not None:
+            ttft = ((req.first_token_t - origin)
+                    if req.first_token_t is not None else None)
+            req.ttft_slo_met = (ttft is not None
+                                and ttft <= req.slo_ttft_s)
+            if ttft is not None:
+                margins.append(req.slo_ttft_s - ttft)
+        if req.slo_tpot_s is not None:
+            tokens = self._generated(req)
+            tpot = ((req.finish_t - req.first_token_t)
+                    / max(tokens - 1, 1)
+                    if req.first_token_t is not None else None)
+            req.tpot_slo_met = (tpot is not None
+                                and tpot <= req.slo_tpot_s)
+            if tpot is not None:
+                margins.append(req.slo_tpot_s - tpot)
+        req.slo_met = (req.ttft_slo_met is not False
+                       and req.tpot_slo_met is not False)
+        if margins:
+            req.slack_s = round(min(margins), 6)
+        self._slo_total += 1
+        self._slo_met += int(req.slo_met)
+        bucket = self._group_slo.setdefault(req.group, [0, 0])
+        bucket[0] += int(req.slo_met)
+        bucket[1] += 1
+        out = {"slo_met": req.slo_met}
+        if req.ttft_slo_met is not None:
+            out["ttft_slo_met"] = req.ttft_slo_met
+        if req.tpot_slo_met is not None:
+            out["tpot_slo_met"] = req.tpot_slo_met
+        if req.slack_s is not None:
+            out["slack_s"] = req.slack_s
+        return out

@@ -418,6 +418,30 @@ class Router:
         imb = self.replica_load_imbalance()
         if imb is not None:
             out["replica_load_imbalance"] = round(imb, 4)
+        # open-loop SLO attainment (ISSUE 16): fleet attainment from the
+        # summed per-engine counters (each engine already counted its
+        # own finishes), the merged per-group split, and the summed
+        # per-replica backlog peaks — an UPPER BOUND on the
+        # instantaneous fleet backlog (the replicas need not have
+        # peaked at the same iteration). Gated like the engines' own
+        # keys: absent entirely on closed-loop fleets.
+        if any(e._has_slo for e in self.engines):
+            met = sum(e._slo_met for e in self.engines)
+            total = sum(e._slo_total for e in self.engines)
+            if total:
+                out["slo_attainment"] = round(met / total, 4)
+                groups: dict = {}
+                for eng in self.engines:
+                    for g, (m, t) in eng._group_slo.items():
+                        acc = groups.setdefault(g, [0, 0])
+                        acc[0] += m
+                        acc[1] += t
+                out["group_slo_attainment"] = {
+                    g: round(m / t, 4)
+                    for g, (m, t) in sorted(groups.items()) if t}
+        if any(e._has_arrivals for e in self.engines):
+            out["arrival_backlog_peak"] = sum(
+                e._arrival_backlog_peak for e in self.engines)
         if self.placement == "affinity":
             out["affinity_fallbacks"] = self.affinity_fallbacks
         dtok = sum(e.decode_tokens for e in self.engines)

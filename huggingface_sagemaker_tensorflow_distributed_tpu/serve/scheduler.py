@@ -111,6 +111,21 @@ class Request:
     blocked_iters: int = 0
     blocked_reason: Optional[str] = None
     cow_copies: int = 0
+    # open-loop SLO contract (ISSUE 16): `arrival_s` is the request's
+    # ARRIVAL stamp in the engine's perf_counter domain — distinct from
+    # `submit_t`, so queue wait decomposes into pre-submit backlog
+    # (submit_t − arrival_s: time the load generator held the request)
+    # + in-engine queue (admit − submit_t). The slo_* targets are
+    # deadline seconds (None = no target on that axis); the engine
+    # writes the verdicts at finish — slack_s is the TIGHTEST remaining
+    # margin across the set targets, negative on a miss.
+    arrival_s: Optional[float] = None
+    slo_ttft_s: Optional[float] = None
+    slo_tpot_s: Optional[float] = None
+    slo_met: Optional[bool] = None
+    ttft_slo_met: Optional[bool] = None
+    tpot_slo_met: Optional[bool] = None
+    slack_s: Optional[float] = None
     # recompute preemption folds generated tokens back into the prompt;
     # this keeps the ORIGINAL prompt length so output accounting and
     # first-token semantics survive a preemption
@@ -132,10 +147,18 @@ class Request:
             raise ValueError("top_k must be >= 0")
         if not isinstance(self.group, str):
             raise ValueError("group must be a string")
+        for name in ("slo_ttft_s", "slo_tpot_s"):
+            target = getattr(self, name)
+            if target is not None and not target > 0:
+                raise ValueError(f"{name} must be > 0 when set")
 
     @property
     def sampled(self) -> bool:
         return self.temperature > 0
+
+    @property
+    def has_slo(self) -> bool:
+        return self.slo_ttft_s is not None or self.slo_tpot_s is not None
 
     @property
     def ttft_s(self) -> Optional[float]:

@@ -22,9 +22,15 @@ Usage::
     # aggregated per request group (the per-tenant hook)
     python scripts/obsctl.py slo telemetry/ --percentile 99 --text
     # follow a LIVE events.jsonl: rolling waiting-depth / KV-pressure /
-    # decode tokens/sec / TTFT percentiles over a sliding window,
-    # reading only what was appended since the last poll
+    # decode tokens/sec / TTFT percentiles (and, on open-loop streams,
+    # rolling SLO attainment) over a sliding window, reading only what
+    # was appended since the last poll
     python scripts/obsctl.py tail telemetry/events.jsonl --window 64
+    # open-loop goodput replay: SLO attainment / goodput tokens per
+    # arrival rate and tenant, per-phase miss attribution, capacity
+    # knee across a rate sweep; exit 2 when overall attainment falls
+    # below the floor
+    python scripts/obsctl.py goodput telemetry/ --min-attainment 0.99
     # static analysis (graftlint): enforce the compile-flatness /
     # host-sync / contract invariants over the tree (or a stdin
     # snippet); exit 2 on unsuppressed findings, like diff
@@ -252,6 +258,63 @@ def cmd_lint(args: argparse.Namespace) -> int:
     return 2 if result.active else 0
 
 
+def cmd_goodput(args: argparse.Namespace) -> int:
+    """Open-loop goodput replay (ISSUE 16): split a recorded stream
+    into its ``open_loop`` runs, compute SLO attainment / goodput /
+    per-phase miss attribution per run and per swept arrival rate, and
+    locate the capacity knee. Same strict-input contract as timeline
+    (rc 1 on malformed), same deterministic-bytes contract (sorted
+    keys, input-order-independent), and diff-style exit codes: rc 2
+    when the overall attainment falls below ``--min-attainment``."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.goodput import (
+        goodput,
+        render_goodput_text,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.timeline import (
+        load_events,
+    )
+
+    if not 0 < args.knee_target <= 1:
+        print(f"obsctl: --knee-target must be in (0, 1], got "
+              f"{args.knee_target}", file=sys.stderr)
+        return 1
+    if args.min_attainment is not None \
+            and not 0 <= args.min_attainment <= 1:
+        print(f"obsctl: --min-attainment must be in [0, 1], got "
+              f"{args.min_attainment}", file=sys.stderr)
+        return 1
+    events, errors = load_events(args.paths)
+    if errors:
+        for e in errors[:20]:
+            print(f"obsctl: {e}", file=sys.stderr)
+        if len(errors) > 20:
+            print(f"obsctl: ... and {len(errors) - 20} more",
+                  file=sys.stderr)
+        return 1
+    doc = goodput(events, knee_target=args.knee_target)
+    if not doc.get("runs"):
+        print("obsctl: no open_loop events (closed-loop trace, or not "
+              "a serve run?)", file=sys.stderr)
+        return 1
+    if args.text:
+        sys.stdout.write(render_goodput_text(doc))
+    else:
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    attainment = doc.get("overall_attainment")
+    if args.min_attainment is not None:
+        if attainment is None:
+            print("obsctl: --min-attainment set but no run carried "
+                  "SLO verdicts", file=sys.stderr)
+            return 1
+        if attainment < args.min_attainment:
+            print(f"obsctl: attainment {attainment} below the "
+                  f"--min-attainment floor {args.min_attainment}",
+                  file=sys.stderr)
+            return 2
+    return 0
+
+
 def cmd_tail(args: argparse.Namespace) -> int:
     """Follow a live events.jsonl: each poll reads only the appended
     suffix (the prefix is never re-read), updates the sliding-window
@@ -358,6 +421,23 @@ def main(argv: list[str] | None = None) -> int:
     slo.add_argument("--text", action="store_true",
                      help="readable rendering instead of JSON")
     slo.set_defaults(func=cmd_slo)
+
+    good = sub.add_parser("goodput",
+                          help="open-loop goodput replay: SLO "
+                               "attainment per arrival rate/tenant, "
+                               "miss attribution, capacity knee "
+                               "(exit 2 below --min-attainment)")
+    good.add_argument("paths", nargs="+",
+                      help="telemetry dir(s) or event files")
+    good.add_argument("--min-attainment", type=float, default=None,
+                      help="exit 2 when overall attainment falls "
+                           "below this fraction")
+    good.add_argument("--knee-target", type=float, default=0.99,
+                      help="attainment below this marks the capacity "
+                           "knee in a rate sweep (default 0.99)")
+    good.add_argument("--text", action="store_true",
+                      help="readable rendering instead of JSON")
+    good.set_defaults(func=cmd_goodput)
 
     tail = sub.add_parser("tail",
                           help="follow a live events.jsonl: rolling "

@@ -51,6 +51,19 @@ with N > 1 each per-request row carries its ``replica`` and the
 summary the fleet view (``placement``, ``replica_load_imbalance``,
 per-replica hit-rate/depth aggregates). ``--replicas 1`` is the
 byte-identical single-engine path, telemetry included.
+
+``--arrival poisson:RATE|bursty:HI,LO,P|closed`` (``HSTD_SERVE_ARRIVAL``
++ ``HSTD_SERVE_ARRIVAL_SEED``, default closed) serves OPEN-LOOP
+(ISSUE 16): the trace arrives on a seeded schedule through
+``serve/loadgen.py``'s wall-clock driver instead of all at once, so
+offered load no longer self-throttles on engine backpressure.
+``--slo ttft:SECS[,tpot:SECS]`` (``HSTD_SERVE_SLO_TTFT_S`` /
+``HSTD_SERVE_SLO_TPOT_S``) attaches per-request deadlines — each
+output row then carries ``slo_met``/``slack_s`` and the summary the
+run's ``slo_attainment``, goodput tokens, per-group split and
+dominant miss phase (the figures ``obsctl goodput`` recomputes from
+the telemetry stream). ``--slo`` without ``--arrival`` judges the
+closed-loop trace from submit time.
 """
 
 from __future__ import annotations
@@ -234,6 +247,20 @@ def main() -> None:
                              "iteration; off restores the serial "
                              "loop byte-for-byte (default: "
                              "HSTD_SERVE_OVERLAP or on)")
+    parser.add_argument("--arrival", default=None,
+                        help="open-loop arrival process: poisson:RATE "
+                             "(req/s), bursty:RATE_HI,RATE_LO,P_SWITCH "
+                             "(Markov-modulated), or closed = submit "
+                             "the whole trace up front (default: "
+                             "HSTD_SERVE_ARRIVAL or closed; schedule "
+                             "seed: HSTD_SERVE_ARRIVAL_SEED)")
+    parser.add_argument("--slo", default=None,
+                        help="per-request deadline targets, "
+                             "ttft:SECS[,tpot:SECS] or none: rows gain "
+                             "slo_met/slack_s, the summary "
+                             "slo_attainment + miss attribution "
+                             "(default: HSTD_SERVE_SLO_TTFT_S / "
+                             "HSTD_SERVE_SLO_TPOT_S)")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="0 = greedy (the default); > 0 samples")
     parser.add_argument("--top_k", type=int, default=0)
@@ -244,9 +271,24 @@ def main() -> None:
     args = parser.parse_args()
 
     from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.loadgen import (
+        OpenLoopDriver,
+        bursty_arrivals,
+        parse_arrival,
+        parse_arrival_seed,
+        parse_slo,
+        poisson_arrivals,
+    )
     from huggingface_sagemaker_tensorflow_distributed_tpu.serve.router import (
         Router,
     )
+
+    try:
+        arrival = parse_arrival(args.arrival)
+        arrival_seed = parse_arrival_seed()
+        slo_spec = parse_slo(args.slo)
+    except ValueError as e:
+        raise SystemExit(f"serve: {e}")
 
     obs.configure()
     model, params = load_model(args)
@@ -280,10 +322,36 @@ def main() -> None:
     # sample, so no request pays a mid-serve compile
     router.warmup(sampled=any(kw.get("temperature", 0) > 0
                               for _, _, kw in trace))
-    reqs = [router.submit(p, m, **kw) for p, m, kw in trace]
-    t0 = time.perf_counter()
-    router.run()
-    wall = time.perf_counter() - t0
+    driver = None
+    if arrival is not None:
+        # open loop: the trace arrives on the seeded schedule through
+        # the wall-clock driver — arrival_s + the SLO thread into
+        # submit, so the engine stamps real verdicts into telemetry
+        proc, pp = arrival
+        if proc == "poisson":
+            arrivals = poisson_arrivals(pp["rate"], len(trace),
+                                        arrival_seed)
+            rate = pp["rate"]
+        else:
+            arrivals = bursty_arrivals(pp["rate_hi"], pp["rate_lo"],
+                                       pp["p_switch"], len(trace),
+                                       arrival_seed)
+            rate = pp["rate_hi"]
+        schedule = [
+            (a, {"prompt": p, "max_new_tokens": m, **kw})
+            for a, (p, m, kw) in zip(arrivals, trace)]
+        driver = OpenLoopDriver(router, schedule, clock="wall",
+                                slo=slo_spec, process=proc, rate=rate)
+        t0 = time.perf_counter()
+        finished = driver.run()
+        wall = time.perf_counter() - t0
+        reqs = [finished[rid] for rid in sorted(finished)]
+    else:
+        reqs = [router.submit(p, m, slo=slo_spec, **kw)
+                for p, m, kw in trace]
+        t0 = time.perf_counter()
+        router.run()
+        wall = time.perf_counter() - t0
 
     total = 0
     for req in reqs:
@@ -295,6 +363,11 @@ def main() -> None:
             "ttft_s": round(req.ttft_s, 4) if req.ttft_s else None,
             "sampled": req.sampled, "seed": req.seed,
             "preemptions": req.preemptions, "tp": engine.tp}
+        if req.has_slo:
+            # the engine's own verdict (stamped at finish): deadline
+            # met, and the worst axis's margin in seconds
+            row["slo_met"] = req.slo_met
+            row["slack_s"] = req.slack_s
         if router.n > 1:
             row["replica"] = router.replica_of(req)
         if engine.speculative:
@@ -309,6 +382,24 @@ def main() -> None:
             row["phase_s"] = {ph: round(v, 4)
                               for ph, v in req.phase_s.items()}
         print(json.dumps(row))
+    # open-loop / SLO summary fields (absent on a plain closed run):
+    # the driver's goodput accounting — the same figures `obsctl
+    # goodput` recomputes offline from the telemetry stream
+    open_extra = {}
+    if slo_spec is not None:
+        open_extra["slo"] = {"ttft_s": slo_spec.ttft_s,
+                             "tpot_s": slo_spec.tpot_s}
+    if driver is not None:
+        dsum = driver.summary()
+        open_extra["arrival"] = {"process": dsum["process"],
+                                 "rate": dsum.get("rate"),
+                                 "seed": arrival_seed,
+                                 "clock": dsum["clock"]}
+        for k in ("slo_attainment", "slo_met", "slo_missed",
+                  "goodput_tokens", "group_slo_attainment",
+                  "miss_phases", "dominant_miss_phase"):
+            if k in dsum:
+                open_extra[k] = dsum[k]
     if router.n > 1:
         # fleet summary (ISSUE 14): the router's own aggregate (the
         # same figures its final `serve` report telemetry event
@@ -348,7 +439,15 @@ def main() -> None:
             "kernel": engine.kernel,
             "kv_dtype": engine.kv_cache_dtype,
             "tp": engine.tp,
-            "per_replica": rslo.get("per_replica")}))
+            "per_replica": rslo.get("per_replica"),
+            **({"arrival_backlog_peak":
+                rslo.get("arrival_backlog_peak")}
+               if driver is not None else {}),
+            **({"slo_attainment": rslo.get("slo_attainment"),
+                "group_slo_attainment":
+                rslo.get("group_slo_attainment")}
+               if slo_spec is not None and driver is None else {}),
+            **open_extra}))
         obs.flush()
         return
     stats = engine.stats()
@@ -409,7 +508,13 @@ def main() -> None:
         "kv_bytes_read_per_step": (round(
             stats.kv_bytes_read / stats.decode_steps, 1)
             if stats.decode_steps else None),
-        "kv_peak_utilization": round(stats.kv_peak_utilization, 3)}))
+        "kv_peak_utilization": round(stats.kv_peak_utilization, 3),
+        **({"arrival_backlog_peak": slo.get("arrival_backlog_peak")}
+           if driver is not None else {}),
+        **({"slo_attainment": slo.get("slo_attainment"),
+            "group_slo_attainment": slo.get("group_slo_attainment")}
+           if slo_spec is not None and driver is None else {}),
+        **open_extra}))
     obs.flush()
 
 

@@ -292,6 +292,69 @@ def test_pragma_for_wrong_rule_does_not_suppress(tmp_path):
     assert len(active(run_lint(root, rules=["R2"]), "R2")) == 1
 
 
+def test_unused_pragma_is_itself_a_finding(tmp_path):
+    """ISSUE 16: a pragma whose rule does NOT fire on its line is a
+    `pragma` finding — stale suppressions are landmines that silently
+    swallow the next real finding on that line. The fixture pair: the
+    same pragma on a line where R2 DOES fire stays a clean, counted
+    suppression."""
+    used = make_tree(tmp_path / "used", {_ENGINE: """\
+        import jax
+
+        def _commit_decode(p):
+            return jax.device_get(p)  # graftlint: allow[R2] deferred fetch
+        """})
+    result = run_lint(used, rules=["R2"])
+    assert active(result) == []
+    assert len(result.suppressed) == 1
+
+    stale = make_tree(tmp_path / "stale", {_ENGINE: """\
+        import jax
+
+        def _commit_decode(p):
+            return p + 1  # graftlint: allow[R2] fetch long since removed
+        """})
+    result = run_lint(stale, rules=["R2"])
+    assert [f.rule for f in active(result)] == ["pragma"]
+    assert "unused pragma allow[R2]" in active(result)[0].message
+    assert result.suppressed == []
+
+
+def test_unused_pragma_only_flagged_for_selected_rules(tmp_path):
+    """A pragma can only be judged stale by RUNNING its rule: under
+    --rules R2 an allow[R3] pragma is unjudgeable (R3 never ran) and
+    must not be flagged; selecting R3 over the same tree flags it."""
+    root = make_tree(tmp_path, {_ENGINE: """\
+        import jax
+
+        def _commit_decode(p):
+            return p + 1  # graftlint: allow[R3] stale sync claim
+        """})
+    assert active(run_lint(root, rules=["R2"])) == []
+    assert [f.rule for f in active(run_lint(root, rules=["R3"]))] \
+        == ["pragma"]
+
+
+def test_unused_pragma_detected_on_stdin_snippets():
+    """The `obsctl lint -` path judges stale pragmas too — but only
+    for the rules that CAN fire on a bare snippet (R2/R3); a zone or
+    registry pragma is not judgeable without the tree."""
+    result = lint_text(
+        "def _step(x):\n"
+        "    return x + 1  # graftlint: allow[R2] no fetch here anymore\n")
+    assert [f.rule for f in active(result)] == ["pragma"]
+    # the same pragma id on a genuinely-firing line suppresses cleanly
+    fired = lint_text(
+        "import jax\n"
+        "def _step(x):\n"
+        "    return jax.device_get(x)  # graftlint: allow[R2] safe fetch\n")
+    assert active(fired) == []
+    assert len(fired.suppressed) == 1
+    # tree-anchored rules (e.g. R1 zones) are never judged on stdin
+    zone = lint_text("x = 1  # graftlint: allow[R1] zone claim\n")
+    assert active(zone) == []
+
+
 # -- determinism --------------------------------------------------------------
 
 def test_output_byte_identical_across_input_orderings(tmp_path):

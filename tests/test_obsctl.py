@@ -98,7 +98,9 @@ def three_hosts(tmp_path):
                               tp=2,
                               kv_pool_bytes_per_device=1 << 20,
                               replicas=2, placement="least_loaded",
-                              replica_load_imbalance=1.2))
+                              replica_load_imbalance=1.2,
+                              slo_attainment=0.97,
+                              arrival_backlog_peak=3))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -610,6 +612,93 @@ def test_diff_kv_pool_bytes_per_device_is_bytes_metric(three_hosts):
         assert "serve_kv_pool_bytes_per_device" not in d["regressions"]
 
 
+def test_diff_slo_attainment_is_down_worse_ratio(three_hosts):
+    """ISSUE 16: `serve_slo_attainment` (deadline-met fraction from an
+    open-loop run's report event) diffs as a ratio metric whose worse
+    direction is DOWN — goodput is the currency, so attainment eroding
+    under the same offered load is THE serving regression, ahead of
+    any single latency percentile moving. Standard threshold rules,
+    poison rows skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["slo_attainment"] == pytest.approx(0.97)
+    worse = copy.deepcopy(base)
+    worse["serve"]["slo_attainment"] = 0.80
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_slo_attainment" in d["regressions"]
+    assert d["metrics"]["serve_slo_attainment"][
+        "worse_direction"] == "down"
+    # attainment improving never flags; nor does a sub-threshold dip
+    assert "serve_slo_attainment" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["slo_attainment"] = 0.95       # ~-2.1%
+    assert "serve_slo_attainment" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["slo_attainment"] = "mostly"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["slo_attainment"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_slo_attainment" in d["skipped"]
+        assert "serve_slo_attainment" not in d["regressions"]
+
+
+def test_diff_arrival_backlog_peak_is_count_metric(three_hosts):
+    """ISSUE 16: `serve_arrival_backlog_peak` (deepest arrived-but-
+    unadmitted queue an open-loop run saw) diffs as a count metric
+    whose worse direction is UP — admission slowing down shows up here
+    BEFORE attainment falls, the leading indicator of the capacity
+    knee. Standard threshold + zero-baseline rules, poison rows
+    skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["arrival_backlog_peak"] == 3
+    worse = copy.deepcopy(base)
+    worse["serve"]["arrival_backlog_peak"] = 11
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_arrival_backlog_peak" in d["regressions"]
+    assert d["metrics"]["serve_arrival_backlog_peak"][
+        "worse_direction"] == "up"
+    # backlog shrinking never flags; nor does a sub-threshold drift
+    assert "serve_arrival_backlog_peak" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    # zero baseline (underloaded run, backlog never formed): a backlog
+    # appearing must still flag though the percentage is undefined
+    zero = copy.deepcopy(base)
+    zero["serve"]["arrival_backlog_peak"] = 0
+    worse0 = copy.deepcopy(zero)
+    worse0["serve"]["arrival_backlog_peak"] = 6
+    d0 = diff_reports(zero, worse0, threshold_pct=5.0)
+    assert "serve_arrival_backlog_peak" in d0["regressions"]
+    assert d0["metrics"]["serve_arrival_backlog_peak"]["pct"] is None
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["arrival_backlog_peak"] = "deep"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["arrival_backlog_peak"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_arrival_backlog_peak" in d["skipped"]
+        assert "serve_arrival_backlog_peak" not in d["regressions"]
+
+
 def test_diff_poisoned_lifecycle_metrics_skip_not_crash(three_hosts):
     """Poisoned inputs for the new metrics: a mistyped (string/bool)
     or missing value must land the metric in `skipped`, never crash
@@ -682,6 +771,134 @@ def test_cli_diff_exit_codes_and_text(three_hosts, tmp_path):
     assert run(str(a), str(invalid)).returncode == 1
 
 
+# -- open-loop goodput replay (ISSUE 16: `obsctl goodput`) -------------------
+
+def _open_loop_run(pid, rate, n, missed=(), t0=1000.0):
+    """One open-loop run's serve events: the driver's stamp, then a
+    verdict-carrying finish (+ queue-dominant timeline for misses) per
+    request — the recorded shape `obsctl goodput` replays."""
+    events = [_ev(0, t0, "serve", event="open_loop", process="poisson",
+                  clock="wall", rate=float(rate), requests=n,
+                  slo_ttft_s=0.1)]
+    for e in events:
+        e["pid"] = pid
+    for rid in range(n):
+        met = rid not in missed
+        fin = _ev(0, t0 + 1 + rid, "serve", event="finish", request=rid,
+                  tokens=8, preemptions=0, slo_met=met,
+                  ttft_slo_met=met, slack_s=0.05 if met else -0.04)
+        fin["pid"] = pid
+        events.append(fin)
+        if not met:
+            tl = _ev(0, t0 + 1 + rid, "serve", event="request_timeline",
+                     request=rid, at="finish", group="interactive",
+                     e2e_s=1.0, queue_s=0.7, prefill_s=0.1,
+                     decode_s=0.15, preempted_s=0.0, overhead_s=0.05,
+                     tokens=8, prompt_len=4, preemptions=0,
+                     segments=[])
+            tl["pid"] = pid
+            events.append(tl)
+    return events
+
+
+def test_cli_goodput_deterministic_sweep_and_knee(tmp_path):
+    """The capacity answer end to end: two runs at different offered
+    rates (underload clean, overload queue-bound) merge into one sweep
+    with the knee at the overloaded rate — and the JSON is
+    byte-identical across every input-path ordering."""
+    lo = tmp_path / "lo"
+    hi = tmp_path / "hi"
+    _write(str(lo / "events.jsonl"), _open_loop_run(1, 8.0, 4))
+    _write(str(hi / "events.jsonl"),
+           _open_loop_run(2, 64.0, 4, missed=(1, 3)))
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, _OBSCTL, "goodput", *argv],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=_REPO)
+
+    fwd = run(str(lo), str(hi))
+    rev = run(str(hi), str(lo))
+    assert fwd.returncode == 0, fwd.stderr
+    assert fwd.stdout == rev.stdout          # byte-deterministic
+    doc = json.loads(fwd.stdout)
+    assert doc["runs"] == 2
+    assert doc["overall_attainment"] == pytest.approx(0.75)
+    assert [r["rate"] for r in doc["rates"]] == [8.0, 64.0]
+    assert doc["rates"][0]["slo_attainment"] == 1.0
+    assert doc["rates"][1]["slo_attainment"] == 0.5
+    assert doc["rates"][1]["miss_phases"] == {"queue": 2}
+    assert doc["knee"] == {"rate": 64.0, "target": 0.99}
+    # per-run records carry the tenant split and the miss attribution
+    runs = [r for p in doc["processes"] for r in p["runs"]]
+    over = next(r for r in runs if r["rate"] == 64.0)
+    assert over["dominant_miss_phase"] == "queue"
+    assert over["group_slo_attainment"] == {"interactive": 0.0,
+                                            "": 1.0}
+    assert over["goodput_tokens"] == 16      # missed tokens don't count
+    # --text names the knee
+    text = run(str(lo), str(hi), "--text")
+    assert text.returncode == 0
+    assert "capacity knee at 64.0/s" in text.stdout
+    # a higher knee target moves the knee down to the first rate that
+    # fails it; an un-failed sweep reports no knee
+    strict = json.loads(run(str(lo), "--knee-target", "0.5").stdout)
+    assert strict["knee"] is None
+
+
+def test_cli_goodput_min_attainment_exit_codes(tmp_path):
+    """diff-style gating: rc 2 when overall attainment sits below the
+    floor, rc 0 at or above it, rc 1 for nonsense flag values."""
+    d = tmp_path / "run"
+    _write(str(d / "events.jsonl"),
+           _open_loop_run(1, 64.0, 4, missed=(1, 3)))   # attainment 0.5
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, _OBSCTL, "goodput", str(d), *argv],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=_REPO)
+
+    assert run().returncode == 0                      # no floor, no gate
+    gated = run("--min-attainment", "0.99")
+    assert gated.returncode == 2
+    assert "below the --min-attainment floor" in gated.stderr
+    assert run("--min-attainment", "0.5").returncode == 0
+    assert run("--min-attainment", "1.5").returncode == 1
+    assert run("--knee-target", "0").returncode == 1
+
+
+def test_cli_goodput_rejects_closed_loop_and_malformed(tmp_path):
+    """Strict-input contract: a closed-loop trace (no open_loop
+    stamps) and a malformed stream both refuse with rc 1 — never a
+    fabricated zero-attainment report."""
+    closed = tmp_path / "closed"
+    _write(str(closed / "events.jsonl"),
+           [_ev(0, 1000.0, "serve", event="finish", request=0,
+                tokens=4, preemptions=0)])
+    proc = subprocess.run(
+        [sys.executable, _OBSCTL, "goodput", str(closed)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=_REPO)
+    assert proc.returncode == 1
+    assert "no open_loop events" in proc.stderr
+    # poison line mid-stream (a malformed FINAL line is a torn tail
+    # from a mid-write kill and is tolerated by design)
+    bad = tmp_path / "bad"
+    run_events = _open_loop_run(1, 8.0, 2)
+    _write(str(bad / "events.jsonl"), run_events[:-1])
+    with open(str(bad / "events.jsonl"), "a", encoding="utf-8") as f:
+        f.write("not json\n")
+        f.write(json.dumps(run_events[-1]) + "\n")
+    proc = subprocess.run(
+        [sys.executable, _OBSCTL, "goodput", str(bad)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=_REPO)
+    assert proc.returncode == 1
+    assert "unparseable" in proc.stderr
+
+
 def test_cli_diff_runs_without_jax():
     """diff stays on the stdlib-only side of the obs contract —
     statically (graftlint R1): obs/report.py (where diff lives) is in
@@ -731,6 +948,7 @@ def test_cli_subprocess_smoke_without_jax(three_hosts, tmp_path):
         (["slo", *three_hosts], 1, "no request_timeline events"),
         (["tail", str(tail_file), "--updates", "1",
           "--interval", "0.05"], 0, None),
+        (["goodput", *three_hosts], 1, "no open_loop events"),
     ]
     for argv, want_rc, marker in cases:
         code = ("import sys, runpy; sys.modules['jax'] = None; "

@@ -22,7 +22,7 @@ def test_serve_bench_smoke(capsys, tmp_path):
     obs.reset(out_dir=str(tmp_path / "telemetry"), enabled=True)
     try:
         (mixed, bucketed, spec, prefix, paged,
-         overlap, tp, router) = bench_serve(smoke=True)
+         overlap, tp, router, open_loop) = bench_serve(smoke=True)
     finally:
         obs.reset()
     detail = mixed["detail"]
@@ -162,19 +162,45 @@ def test_serve_bench_smoke(capsys, tmp_path):
     assert rdetail["cache_hit_rate_affinity"] > 0
     assert rdetail["compiles_steady"] <= 2 * len(
         rdetail["gather_buckets"])
+    # the ISSUE 16 open-loop goodput line: EVERY gate on it is
+    # deterministic (virtual clock), so the full acceptance is
+    # enforced at smoke scale too — byte-identical replay across two
+    # fresh runs of the same seeded schedule, attainment exactly 1.0
+    # at the underload rate, strictly lower at the overload rate with
+    # queue the dominant miss phase, compile flatness (arrival timing
+    # is host-side only); the wall-clock knee sweep is full-trace-only
+    gdetail = open_loop["detail"]
+    assert open_loop.get("error") is None
+    assert open_loop["value"] == 1.0                # attainment at λ_lo
+    assert gdetail["replay_identical"] is True
+    assert gdetail["attainment_lo"] == 1.0
+    assert gdetail["attainment_hi"] < 1.0
+    assert gdetail["dominant_miss_phase_hi"] == "queue"
+    assert gdetail["miss_phases_hi"].get("queue", 0) > 0
+    # overload REALLY queued: the deterministic backlog rider peaked
+    # above the underload run's
+    assert (gdetail["arrival_backlog_peak_hi"]
+            > gdetail["arrival_backlog_peak_lo"])
+    # goodput (deadline-meeting tokens) collapses under overload
+    assert (gdetail["goodput_tokens_hi"]
+            < gdetail["goodput_tokens_lo"])
+    assert gdetail["compiles_steady"] <= 2 * len(
+        gdetail["gather_buckets"])
+    assert gdetail["wall_sweep"] == []              # smoke: no sleeps
     # the stdout lines are the driver contract: parseable JSON, all
-    # eight metrics present
+    # nine metrics present
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
     metrics = [json.loads(ln)["metric"] for ln in lines]
-    assert metrics[-8:] == ["serve_continuous_vs_static_speedup",
+    assert metrics[-9:] == ["serve_continuous_vs_static_speedup",
                             "serve_bucketed_gather_decode_speedup",
                             "serve_speculative_decode_speedup",
                             "serve_prefix_cache_ttft_speedup",
                             "serve_paged_kernel_decode_speedup",
                             "serve_overlap_decode_speedup",
                             "serve_tp_shard_capacity",
-                            "serve_router_scaleout"]
+                            "serve_router_scaleout",
+                            "serve_open_loop_goodput"]
 
 
 @pytest.mark.slow
@@ -290,6 +316,28 @@ def test_serve_bench_full_router_trace(capsys):
     assert (detail["cache_hit_rate_affinity"]
             >= detail["cache_hit_rate_round_robin"])
     assert detail["replica_load_imbalance"] <= detail["imbalance_bound"]
+
+
+@pytest.mark.slow
+def test_serve_bench_full_open_loop_trace(capsys):
+    """The full CPU open-loop trace — the ISSUE 16 surface with the
+    wall-clock knee sweep included: the deterministic virtual-clock
+    gates (replay identity, underload attainment 1.0, queue-bound
+    overload, compile flatness) hold at full scale, and the wall
+    sweep reports one attainment figure per swept rate (the knee
+    itself is hardware-dependent and never gated)."""
+    from benchmarks.serve_bench import bench_serve_open_loop
+
+    result = bench_serve_open_loop(smoke=False)
+    assert result.get("error") is None
+    assert result["value"] == 1.0
+    detail = result["detail"]
+    assert detail["replay_identical"] is True
+    assert detail["attainment_hi"] < 1.0
+    assert detail["dominant_miss_phase_hi"] == "queue"
+    assert len(detail["wall_sweep"]) == len(detail["wall_rates"]) > 0
+    for row in detail["wall_sweep"]:
+        assert 0.0 <= row["slo_attainment"] <= 1.0
 
 
 @pytest.mark.slow

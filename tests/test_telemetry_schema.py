@@ -305,6 +305,139 @@ def test_produced_router_artifacts_validate(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_produced_open_loop_artifacts_validate(tmp_path):
+    """ISSUE 16 fixture regeneration from a REAL wall-clock open-loop
+    run (Poisson arrivals + generous SLOs on a tiny engine): the
+    produced stream must lead with the driver's ``open_loop`` stamp,
+    carry arrival/SLO-target riders typed on submits, per-request
+    verdicts on finishes, the attainment aggregate + backlog peak on
+    the report, and pass the validator end to end — fixtures from live
+    emitters, not hand-built."""
+    out = tmp_path / "telemetry"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+            init_params,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+            Gpt2Config,
+            Gpt2LMHeadModel,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+            ServeEngine,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.serve.loadgen import (
+            OpenLoopDriver,
+            SloSpec,
+            make_schedule,
+        )
+
+        gcfg = Gpt2Config(vocab_size=128, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position_embeddings=64, hidden_dropout=0.0,
+                          embd_dropout=0.0, attention_dropout=0.0,
+                          eos_token_id=127, pad_token_id=0)
+        gmodel = Gpt2LMHeadModel(gcfg)
+        eng = ServeEngine(gmodel, init_params(gmodel, gcfg, seed=0),
+                          num_slots=2, block_size=8, num_blocks=17,
+                          prefill_chunk=8, max_model_len=32)
+        drv = OpenLoopDriver(
+            eng,
+            make_schedule(5, 128, process="poisson", rate=100.0, seed=2,
+                          prompt_lo=4, prompt_hi=8, new_lo=3, new_hi=5,
+                          eos_token_id=127, groups=("a", "b")),
+            clock="wall", slo=SloSpec(ttft_s=10.0, tpot_s=10.0),
+            process="poisson", rate=100.0)
+        drv.run()
+        obs.flush()
+        events = [e for _, e, err in obs.iter_events(
+            str(out / "events.jsonl")) if err is None]
+    finally:
+        obs.reset()
+    serve = [e for e in events if e["type"] == "serve"]
+    kinds = [e.get("event") for e in serve]
+    assert kinds.index("open_loop") < kinds.index("submit")
+    stamp = next(e for e in serve if e["event"] == "open_loop")
+    assert stamp["process"] == "poisson" and stamp["clock"] == "wall"
+    assert stamp["requests"] == 5 and isinstance(stamp["rate"], float)
+    assert isinstance(stamp["slo_ttft_s"], (int, float))
+    submits = [e for e in serve if e["event"] == "submit"]
+    assert len(submits) == 5 and all(
+        isinstance(e["arrival_s"], (int, float))
+        and isinstance(e["slo_ttft_s"], (int, float))
+        and isinstance(e["slo_tpot_s"], (int, float)) for e in submits)
+    finishes = [e for e in serve if e["event"] == "finish"]
+    assert len(finishes) == 5 and all(
+        isinstance(e["slo_met"], bool)
+        and isinstance(e["ttft_slo_met"], bool)
+        and isinstance(e["tpot_slo_met"], bool)
+        and isinstance(e["slack_s"], (int, float)) for e in finishes)
+    report = [e for e in serve if e["event"] == "report"][-1]
+    assert report["slo_attainment"] == 1.0       # generous targets
+    assert isinstance(report["arrival_backlog_peak"], int)
+    assert isinstance(report["group_slo_attainment"], dict)
+    ledgers = [e for e in serve if e["event"] == "iteration_ledger"]
+    assert ledgers and all(isinstance(e["arrival_backlog"], int)
+                           for e in ledgers)
+    proc = _run(str(out))
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_validator_rejects_mistyped_open_loop_fields(tmp_path):
+    """ISSUE 16 deadline fields: optional on `serve` events but TYPED
+    when present — a drifted emitter (string verdict, float backlog)
+    fails the gate instead of silently poisoning goodput replay. Own
+    file: the validator caps printed errors per artifact and these
+    rows would fall past the serve-fields file's cap."""
+    bad = tmp_path / "events.jsonl"
+    rows = [
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "open_loop", "process": "poisson", "clock": "wall",
+         "rate": 8.0, "requests": 16, "slo_ttft_s": 0.1,
+         "slo_tpot_s": 0.05},                                    # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "open_loop", "process": 7, "clock": True,
+         "rate": "fast", "requests": 2.5},                       # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "submit", "request": 0, "arrival_s": 0.25,
+         "slo_ttft_s": 0.1},                                     # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "submit", "request": 1, "arrival_s": "soon",
+         "slo_ttft_s": "tight", "slo_tpot_s": False},            # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "finish", "request": 0, "slo_met": True,
+         "ttft_slo_met": True, "tpot_slo_met": True,
+         "slack_s": 0.04},                                       # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "finish", "request": 1, "slo_met": 1,
+         "ttft_slo_met": "yes", "tpot_slo_met": 0.5,
+         "slack_s": "none"},                                     # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "iteration_ledger", "iteration": 3, "dur_s": 0.01,
+         "arrival_backlog": 4},                                  # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "iteration_ledger", "iteration": 4, "dur_s": 0.01,
+         "arrival_backlog": 4.5},                                # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "report", "slo_attainment": 0.97,
+         "group_slo_attainment": {"a": 1.0},
+         "arrival_backlog_peak": 6},                             # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "report", "slo_attainment": "high",
+         "group_slo_attainment": [1.0],
+         "arrival_backlog_peak": "deep"},                        # drift
+    ]
+    bad.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    proc = _run(str(bad))
+    assert proc.returncode == 1
+    for field in ("process", "clock", "rate", "requests", "arrival_s",
+                  "slo_ttft_s", "slo_tpot_s", "slo_met", "ttft_slo_met",
+                  "tpot_slo_met", "slack_s", "arrival_backlog",
+                  "slo_attainment", "group_slo_attainment",
+                  "arrival_backlog_peak"):
+        assert f"optional field '{field}'" in proc.stdout, field
+
+
 def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
     """gather_bucket/sampled are optional on `serve` events but TYPED
     when present — a drifted emitter (string bucket, int flag) fails

@@ -191,6 +191,60 @@ def test_tail_stats_rolls_ledger_and_ttft():
     assert "tok/s=8.0" in line and "ttft_p50_s=0.25" in line
 
 
+def test_tail_stats_rolling_slo_attainment():
+    """ISSUE 16: verdict-carrying finish events roll a windowed
+    attainment column into the tail line; closed-loop streams (no
+    verdicts) keep their exact pre-16 rendering — the column is
+    absent, not 'slo_attainment=-'."""
+    def _finish(rid, met):
+        return {"v": 1, "t": 1000.0 + rid, "host": 0, "pid": 1,
+                "type": "serve", "event": "finish", "request": rid,
+                "tokens": 4, "preemptions": 0, "slo_met": met}
+
+    closed = TailStats(window=4)
+    closed.update(_ledger_event(0))
+    assert "slo_attainment" not in closed.render()
+    # a finish WITHOUT a verdict (closed-loop) keeps the column absent
+    no_verdict = _finish(1, True)
+    del no_verdict["slo_met"]
+    closed.update(no_verdict)
+    assert "slo_attainment" not in closed.render()
+    # a mistyped verdict is ignored, not crashed on or miscounted
+    closed.update({**_finish(2, True), "slo_met": "yes"})
+    assert "slo_attainment" not in closed.render()
+
+    stats = TailStats(window=4)
+    for rid, met in enumerate([True, True, False, True]):
+        stats.update(_finish(rid, met))
+    assert "slo_attainment=0.750" in stats.render()
+    # the window ROLLS: four more hits evict the miss entirely
+    for rid in range(4, 8):
+        stats.update(_finish(rid, True))
+    assert "slo_attainment=1.000" in stats.render()
+
+
+def test_cli_tail_renders_attainment_column(tmp_path):
+    """The live view of the same column: one poll over a stream whose
+    finishes carry verdicts prints it, rc 0."""
+    path = str(tmp_path / "events.jsonl")
+    _write_events(path, [
+        _ledger_event(0),
+        {"v": 1, "t": 1001.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "finish", "request": 0, "tokens": 4,
+         "preemptions": 0, "slo_met": True},
+        {"v": 1, "t": 1002.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "finish", "request": 1, "tokens": 4,
+         "preemptions": 0, "slo_met": False},
+    ])
+    proc = subprocess.run(
+        [sys.executable, _OBSCTL, "tail", path, "--updates", "1",
+         "--interval", "0.05"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=_REPO)
+    assert proc.returncode == 0, proc.stderr
+    assert "slo_attainment=0.500" in proc.stdout
+
+
 # -- decomposition checker / attribution over synthetic records ---------------
 
 def test_check_decomposition_accepts_consistent_and_names_bugs():
