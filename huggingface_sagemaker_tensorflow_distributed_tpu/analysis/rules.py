@@ -96,8 +96,18 @@ R2_HOT_FUNCS = frozenset({
     "_dispatch_decode", "_commit_decode", "_dispatch_spec",
     "_commit_spec", "_append", "_apply_cow",
     "_accrue_prefill", "_accrue_decode", "_stamp_admit",
-    "_emit_timeline",
+    "_emit_timeline", "_swap_out", "_apply_restores", "_spill_block",
 })
+
+#: the CLI driver feeding the engine (PR 17): its per-request loop
+#: sits upstream of admit(), so a stray blocking fetch there starves
+#: the engine of ready work just as surely as one inside the engine
+R2_DRIVER_FILE = "scripts/serve.py"
+
+R2_DRIVER_FUNCS = frozenset({"main", "load_trace", "load_model"})
+
+#: file -> function names whose bodies R2 scans
+_R2_SCOPES = {R2_FILE: R2_HOT_FUNCS, R2_DRIVER_FILE: R2_DRIVER_FUNCS}
 
 #: call patterns that block the host on device state
 _R2_CALLS = ("jax.device_get", "jax.block_until_ready",
@@ -126,10 +136,14 @@ def _r2_sync_calls(fn: ast.FunctionDef) -> list[tuple[int, str]]:
 def check_r2(project: Project) -> list[Finding]:
     findings = []
     for path in sorted(project.files):
-        if path != R2_FILE and "<stdin>" not in path:
+        if "<stdin>" in path:
+            scope = R2_HOT_FUNCS | R2_DRIVER_FUNCS
+        elif path in _R2_SCOPES:
+            scope = _R2_SCOPES[path]
+        else:
             continue
         for fn in walk_functions(project.files[path].tree):
-            if fn.name not in R2_HOT_FUNCS:
+            if fn.name not in scope:
                 continue
             for lineno, what in _r2_sync_calls(fn):
                 findings.append(Finding(
@@ -385,7 +399,8 @@ RULES: dict[str, Rule] = {
         "R2", "host-sync-in-hot-path",
         "the dispatch-ahead decode loop's only blocking fetches are "
         "the deferred commit/spec ones; an unannotated sync silently "
-        "eats the overlap win.",
+        "eats the overlap win. Also covers the scripts/serve.py "
+        "driver loop, which sits upstream of admit().",
         check_r2),
     "R3": Rule(
         "R3", "jit-static-key-hygiene",

@@ -380,6 +380,21 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # before attainment falls. Count kind: ANY increase regresses (a
     # deterministic virtual-clock replay holds this integer exactly).
     "serve_arrival_backlog_peak": (+1, "count"),
+    # host-RAM KV spill tier (ISSUE 17): total bytes the swap path
+    # moved, worse UP — a broken auto estimate (swapping short contexts
+    # recompute would beat), a policy pin to `always` nobody meant, or
+    # a working set outgrowing the pool all show up as swap traffic
+    # growing before the latency percentiles move. Ratio kind under the
+    # shared zero-baseline rule: the healthy baseline swaps NOTHING, so
+    # bytes appearing against 0 must flag even though the percentage is
+    # undefined.
+    "serve_swap_bytes": (+1, "ratio"),
+    # demote-tier hit rate, worse DOWN — the host tier exists to make
+    # evicted templates revivable, so the rate going cold (a broken
+    # chain re-verify, payloads evicted by a shrunk budget, a thrashing
+    # working set) is the first sign the RAM-sized prefix cache stopped
+    # paying; ratio kind like serve_cache_hit_rate (only drops flag).
+    "serve_host_tier_hit_rate": (-1, "ratio"),
 }
 
 
@@ -417,7 +432,8 @@ def _report_scalars(report: dict) -> dict:
                 "kv_bytes_read_per_step", "queue_wait_p99_s",
                 "preempted_time_frac", "overhead_time_frac",
                 "kv_pool_bytes_per_device", "replica_load_imbalance",
-                "slo_attainment", "arrival_backlog_peak"):
+                "slo_attainment", "arrival_backlog_peak",
+                "swap_bytes", "host_tier_hit_rate"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out

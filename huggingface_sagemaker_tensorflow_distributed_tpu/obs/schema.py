@@ -219,7 +219,25 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               "process": (str,),
               "rate": _NUM,
               "clock": (str,),
-              "requests": (int,)},
+              "requests": (int,),
+              # host-RAM KV spill tier (ISSUE 17): swap_out / swap_in
+              # events carry the per-victim transfer (bytes moved; the
+              # restore additionally its scatter seconds and the
+              # re-prefill tokens it avoided), and the report event the
+              # run aggregates — the policy in force, swap traffic
+              # totals, and the demote tier's hit accounting (what
+              # `obsctl diff` gates as serve_swap_bytes /
+              # serve_host_tier_hit_rate). Absent entirely with
+              # HSTD_SERVE_SWAP=off — that stream is byte-identical to
+              # the pre-tier engine's
+              "swap_policy": (str,),
+              "swap_outs": (int,),
+              "swap_ins": (int,),
+              "swap_bytes": (int,),
+              "restore_s": _NUM,
+              "recompute_tokens_avoided": (int,),
+              "host_tier_hits": (int,),
+              "host_tier_hit_rate": _NUM},
 }
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)

@@ -168,6 +168,8 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.ops.attention import (
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (
     BlockManager,
+    extract_blocks,
+    insert_blocks,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (
     DECODE,
@@ -184,6 +186,8 @@ ENV_KV_DTYPE = "HSTD_SERVE_KV_DTYPE"
 ENV_TIMELINE = "HSTD_SERVE_TIMELINE"
 ENV_OVERLAP = "HSTD_SERVE_OVERLAP"
 ENV_TP = "HSTD_SERVE_TP"
+ENV_SWAP = "HSTD_SERVE_SWAP"
+ENV_SWAP_BYTES = "HSTD_SERVE_SWAP_BYTES"
 
 
 def parse_tp(spec) -> int:
@@ -280,6 +284,43 @@ def parse_overlap(spec: Union[str, bool, None]) -> bool:
     schedule→dispatch→fetch→commit loop byte-for-byte, telemetry
     included."""
     return _parse_on_off(spec, ENV_OVERLAP)
+
+
+def parse_swap(spec: Union[str, None]) -> str:
+    """The KV spill-tier policy knob (ISSUE 17). ``off`` (the default)
+    disables the host tier entirely — telemetry byte-identical to the
+    pre-swap engine. ``never`` activates the tier for prefix DEMOTION
+    only (preemption stays vLLM-recompute). ``always`` swaps every
+    preemption victim to host (budget permitting); ``auto`` picks swap
+    vs recompute per victim from the bytes-moved vs tokens-recomputed
+    estimate. None reads ``HSTD_SERVE_SWAP``."""
+    if spec is None:
+        spec = os.environ.get(ENV_SWAP, "off")
+    s = str(spec).strip().lower() or "off"
+    if s not in ("auto", "always", "never", "off"):
+        raise ValueError(f"unparseable {ENV_SWAP} value {spec!r}: "
+                         "expected auto | always | never | off")
+    return s
+
+
+def parse_swap_bytes(spec: Union[str, int, None]) -> Optional[int]:
+    """The host-tier byte budget (ISSUE 17): a non-negative int capping
+    demoted payloads + swap reservations together, or None for
+    unbounded. None reads ``HSTD_SERVE_SWAP_BYTES`` (empty/``0`` =
+    unbounded — "no budget" is the safe default on a host whose RAM
+    dwarfs the KV pool)."""
+    if spec is None:
+        spec = os.environ.get(ENV_SWAP_BYTES) or None
+    if spec is None:
+        return None
+    try:
+        n = int(str(spec).strip() or "0")
+    except ValueError:
+        raise ValueError(f"unparseable {ENV_SWAP_BYTES} value {spec!r}: "
+                         "expected a byte count (0/empty = unbounded)")
+    if n < 0:
+        raise ValueError(f"{ENV_SWAP_BYTES} must be >= 0, got {n}")
+    return n or None
 
 
 def parse_gather_buckets(spec: Union[str, Sequence[int], None],
@@ -879,6 +920,16 @@ class EngineStats(NamedTuple):
     # bytes — kv_token_bytes above is already per-device under TP)
     tp: int = 1
     kv_pool_bytes_per_device: int = 0
+    # host-RAM KV spill tier (ISSUE 17): swap-mode preemption +
+    # prefix demotion. All zero/"off" when the tier is disabled.
+    swap_policy: str = "off"
+    swap_outs: int = 0
+    swap_ins: int = 0
+    swap_bytes: int = 0
+    restore_s: float = 0.0
+    recompute_tokens_avoided: int = 0
+    host_tier_hits: int = 0
+    host_tier_hit_rate: Optional[float] = None
 
 
 class ServeEngine:
@@ -998,7 +1049,30 @@ class ServeEngine:
     step compile per bucket per engine (a TP plan is its own static
     key; sharding mints no extra variants within it).
     ``kernel='pallas'`` does not compose with ``mesh`` (the fused
-    kernel would need a shard_map port) and is rejected loudly."""
+    kernel would need a shard_map port) and is rejected loudly.
+
+    ``swap`` (ISSUE 17, None reads ``HSTD_SERVE_SWAP``, default
+    ``off``) turns on the host-RAM KV spill tier. Preemption victims
+    are EXTRACTED to host (:func:`extract_blocks` — value pools and
+    int8 scale pools atomically) instead of recomputed: on re-admit
+    the blocks scatter back (:func:`insert_blocks`) and the request
+    resumes DECODE with its output intact — no re-prefill, token
+    emission bitwise what the uninterrupted run produces (the sampled
+    fold indices are a pure function of output length, which swap
+    never rewinds). ``auto`` picks swap vs recompute per victim by
+    comparing bytes moved (2 × blocks × host block bytes) against the
+    weight traffic re-prefill would stream (param bytes × prefill
+    dispatches); ``always``/``never`` force the choice; ``never``
+    still keeps the tier for PREFIX DEMOTION — zero-ref cached blocks
+    write back to host before true eviction and revive on match, so
+    the effective prefix cache is RAM-sized. ``swap_bytes`` (None
+    reads ``HSTD_SERVE_SWAP_BYTES``) caps demoted payloads + swap
+    reservations together; a victim that cannot reserve falls back to
+    recompute. Extraction/insertion are per-block jitted
+    gather/scatters over TRACED indices — zero new step variants, and
+    both directions are precompiled at :meth:`warmup`. ``off`` keeps
+    the engine (and its telemetry) byte-identical to the pre-tier
+    build."""
 
     #: consecutive iterations a smaller bucket must suffice before the
     #: engine shrinks to it — bounds bucket churn when the max resident
@@ -1019,7 +1093,9 @@ class ServeEngine:
                  kv_pool_bytes: Optional[int] = None,
                  timeline: Union[str, bool, None] = None,
                  overlap: Union[str, bool, None] = None,
-                 mesh=None):
+                 mesh=None,
+                 swap: Union[str, None] = None,
+                 swap_bytes: Union[str, int, None] = None):
         cfg = model.config
         if getattr(cfg, "num_experts", 0):
             raise ValueError(
@@ -1209,6 +1285,7 @@ class ServeEngine:
         # restarted server — reuses the compiled executables instead of
         # retracing
         donate = jax.default_backend() != "cpu"
+        self._donate = donate
         # multi-replica serving (ISSUE 14): the router sets this to the
         # replica index when the engine is one of N; every per-request
         # lifecycle event + the SLO report then carry `replica`, which
@@ -1266,6 +1343,42 @@ class ServeEngine:
         self._iter_prefill_s = 0.0
         self._iter_decode_s = 0.0
         self._iter_decode_slots = 0
+        # host-RAM KV spill tier (ISSUE 17). `off` leaves every hook
+        # uninstalled — scheduler, BlockManager and telemetry behave
+        # byte-identically to the pre-tier engine. Otherwise the
+        # scheduler's preemption path gets the swap hook and (with the
+        # prefix cache on) the BlockManager gets the spill/demotion
+        # hook, both closing over the live pools.
+        self.swap = parse_swap(swap)
+        self.swap_bytes = parse_swap_bytes(swap_bytes)
+        self.swap_ins = 0
+        self.swap_outs = 0
+        self.swap_bytes_moved = 0
+        self.restore_s = 0.0
+        self.recompute_tokens_avoided = 0
+        if self.swap != "off":
+            # host bytes one block costs across every pool, UNSHARDED
+            # (device_get assembles the full logical block regardless
+            # of tp), draft pools included — the figure behind both
+            # the budget charge and the auto estimate's bytes-moved
+            # side. The recompute side streams the params once per
+            # prefill dispatch, so the crossover is
+            #   2 * blocks * host_block_bytes
+            #     vs param_bytes * ceil(context / prefill_chunk)
+            self._host_block_bytes = block_size * sum(
+                h * d * np.dtype(dtype).itemsize
+                for h, d, dtype in pool_shapes)
+            if self.speculate_k:
+                self._host_block_bytes += block_size * sum(
+                    h * d * np.dtype(dtype).itemsize
+                    for h, d, dtype in d_pool_shapes)
+            self._param_bytes = sum(
+                x.size * x.dtype.itemsize
+                for x in jax.tree_util.tree_leaves(self.params))
+            self.sched.swap_hook = self._swap_out
+            if self.prefix_cache:
+                self.blocks.set_spill(self._spill_block,
+                                      host_budget=self.swap_bytes)
 
     @staticmethod
     def _init_pools(num_blocks: int, block_size: int, pool_shapes,
@@ -1488,6 +1601,19 @@ class ServeEngine:
                 if self.speculative:
                     self._d_pools = self._copy_fn(self._d_pools,
                                                   np.int32(0), np.int32(0))
+            if self.swap != "off" and not self._warmed_modes:
+                # precompile BOTH spill-tier directions (a null-block
+                # self-round-trip: extract reads block 0, insert puts
+                # the same zeros back) so a mid-serve swap-out, prefix
+                # demotion, or restore never traces — the "zero new
+                # step variants" contract of ISSUE 17
+                d = self._d_pools if self.speculative else None
+                bset = extract_blocks(self._pools, [0], d_pools=d)
+                self._pools, d = insert_blocks(
+                    self._pools, bset, [0], d_pools=d,
+                    donate=self._donate)
+                if self.speculative:
+                    self._d_pools = d
             jax.block_until_ready(tok)
         if not self._warmed_modes:
             # announce the starting bucket so every instrumented run
@@ -1618,6 +1744,21 @@ class ServeEngine:
         if self._has_arrivals:
             out["arrival_backlog_peak"] = self._arrival_backlog_peak
 
+        # host-RAM spill tier (ISSUE 17): swap traffic and prefix
+        # demotion-tier accounting — absent entirely with the tier off,
+        # keeping that report byte-identical to the pre-tier engine's
+        if self.swap != "off":
+            out["swap_policy"] = self.swap
+            out["swap_outs"] = self.swap_outs
+            out["swap_ins"] = self.swap_ins
+            out["swap_bytes"] = self.swap_bytes_moved
+            out["restore_s"] = round(self.restore_s, 6)
+            out["recompute_tokens_avoided"] = self.recompute_tokens_avoided
+            out["host_tier_hits"] = self.blocks.host_tier_hits
+            out["host_tier_hit_rate"] = round(
+                self.blocks.host_tier_hits
+                / max(1, self.blocks.host_tier_lookups), 4)
+
         if self.speculative:
             out["speculate_k"] = self.speculate_k
             out["draft_proposed"] = self.draft_proposed
@@ -1687,7 +1828,18 @@ class ServeEngine:
             overlap=self.overlap,
             overlap_flushes=self.overlap_flushes,
             tp=self.tp,
-            kv_pool_bytes_per_device=self.blocks.pool_bytes)
+            kv_pool_bytes_per_device=self.blocks.pool_bytes,
+            swap_policy=self.swap,
+            swap_outs=self.swap_outs,
+            swap_ins=self.swap_ins,
+            swap_bytes=self.swap_bytes_moved,
+            restore_s=self.restore_s,
+            recompute_tokens_avoided=self.recompute_tokens_avoided,
+            host_tier_hits=self.blocks.host_tier_hits,
+            host_tier_hit_rate=(
+                self.blocks.host_tier_hits
+                / max(1, self.blocks.host_tier_lookups)
+                if self.swap != "off" else None))
 
     def _aggregate_hit_rate(self) -> Optional[float]:
         """Prompt tokens served from cache / prompt tokens admitted,
@@ -1753,6 +1905,7 @@ class ServeEngine:
                 # interval ends at admission, and the copy dispatches
                 # land in overhead (the documented contract)
                 self._stamp_admit(slot, n_cow)
+            self._apply_restores(slot)
             self._apply_cow(slot)
             extra = {}
             if self.prefix_cache:
@@ -2582,6 +2735,97 @@ class ServeEngine:
                 self._d_pools = self._copy_fn(self._d_pools,
                                               np.int32(src), np.int32(dst))
         slot.pending_copies = []
+
+    def _spill_block(self, b: int):
+        """BlockManager spill hook (ISSUE 17): one block's payload out
+        of the live pools — target and draft atomically, int8 scale
+        planes included (they are ordinary pool entries in the plan)."""
+        return extract_blocks(
+            self._pools, [b],
+            d_pools=self._d_pools if self.speculative else None)
+
+    def _swap_out(self, slot) -> bool:
+        """Scheduler preemption hook (ISSUE 17): try to EXTRACT the
+        victim's resident blocks to host instead of recomputing. Runs
+        before the scheduler releases the table (extraction copies; the
+        release is the same either way), and only ever on committed
+        state — the overlap pipeline drained before the capacity phase
+        that picked this victim, exactly as for recompute. Returns True
+        when the request now carries its ``swap_set`` (the scheduler
+        then skips the prompt fold), False to fall back to vLLM
+        recompute: policy ``never``/``off``, an ``auto`` estimate that
+        favors re-prefill, or a host budget that cannot take the
+        reservation."""
+        if self.swap in ("off", "never"):
+            return False
+        req = slot.request
+        n = self.blocks.blocks_for(slot.context_len)
+        if n <= 0 or n > len(slot.table):
+            return False
+        est = n * self._host_block_bytes
+        if self.swap == "auto":
+            # bytes moved (extract now + scatter on re-admit) vs the
+            # weight traffic re-prefill streams: params once per chunk
+            # dispatch. Contexts long enough that re-prefill re-reads
+            # the weights more than the block set costs to round-trip
+            # swap; short ones recompute — the vLLM crossover.
+            dispatches = -(-slot.context_len // self.sched.prefill_chunk)
+            if 2 * est > self._param_bytes * dispatches:
+                return False
+        if not self.blocks.host_reserve(est):
+            return False
+        req.swap_set = extract_blocks(
+            self._pools, slot.table[:n],
+            d_pools=self._d_pools if self.speculative else None)
+        actual = req.swap_set.nbytes
+        if actual != est:
+            # true the reservation up to the payload's real size (the
+            # estimate is exact for full pools; belt and braces)
+            self.blocks.host_release(est - actual)
+        req.swap_context = slot.context_len
+        self.swap_outs += 1
+        self.swap_bytes_moved += actual
+        obs.serve("swap_out", request=req.rid, swap_bytes=actual,
+                  **self._replica_kw())
+        return True
+
+    def _apply_restores(self, slot) -> None:
+        """Apply the admission's queued HOST->DEVICE scatters before
+        any dispatch reads the slot's table (the pending-copies timing
+        contract): a swapped victim's whole block set, and/or the
+        per-block prefix-cache revivals the reservation pulled out of
+        the host tier."""
+        req = slot.request
+        if slot.pending_swap_in is not None:
+            bset, slot.pending_swap_in = slot.pending_swap_in, None
+            t0 = time.perf_counter()
+            self._pools, d = insert_blocks(
+                self._pools, bset, slot.table[:bset.n_blocks],
+                d_pools=self._d_pools if self.speculative else None,
+                donate=self._donate)
+            if self.speculative:
+                self._d_pools = d
+            dt = time.perf_counter() - t0
+            self.restore_s += dt
+            self.blocks.host_release(bset.nbytes)
+            self.swap_ins += 1
+            self.swap_bytes_moved += bset.nbytes
+            self.recompute_tokens_avoided += slot.context_len
+            obs.serve("swap_in", request=req.rid,
+                      swap_bytes=bset.nbytes, restore_s=round(dt, 6),
+                      recompute_tokens_avoided=slot.context_len,
+                      **self._replica_kw())
+        if slot.pending_restores:
+            t0 = time.perf_counter()
+            for b, payload in slot.pending_restores:
+                self._pools, d = insert_blocks(
+                    self._pools, payload, [b],
+                    d_pools=self._d_pools if self.speculative else None,
+                    donate=self._donate)
+                if self.speculative:
+                    self._d_pools = d
+            self.restore_s += time.perf_counter() - t0
+            slot.pending_restores = []
 
     def _generated(self, req: Request) -> int:
         return (len(req.prompt) - req.orig_prompt_len) + len(req.output)

@@ -45,10 +45,36 @@ map onto the SAME physical blocks. Lifecycle:
 Every entry stores its chunk's actual tokens and its parent key, and
 lookup verifies both per level — a hash collision degrades to a cache
 miss, never to serving another prompt's KV.
+
+The host-RAM spill tier (ISSUE 17) adds a SECOND level under the device
+pool: :func:`extract_blocks` / :func:`insert_blocks` serialize a set of
+blocks (every pool atomically — int8 value pools and their fp32 scale
+planes travel together) into host memory as a :class:`BlockSet` and
+scatter them back into freshly allocated blocks, token-exact by
+construction. Two consumers share the primitive:
+
+- **swap-based preemption**: the engine extracts a preemption victim's
+  resident blocks before release and restores them at re-admission —
+  no re-prefill, the vLLM swap alternative to recompute.
+- **prefix demotion**: a zero-ref cached block being evicted spills its
+  payload host-side first (when a spill hook is installed), keyed by
+  its chain key; a later :meth:`BlockManager.peek_hosted` match revives
+  it into a fresh device block, so the effective prefix cache is
+  host-RAM-sized, not pool-sized. :meth:`BlockManager.demote`
+  additionally write-backs still-resident cold blocks, whose device
+  ids then become reclaimable WITHOUT data loss (``num_hosted`` —
+  conservation: ``num_free + num_used + num_cached + num_hosted ==
+  num_blocks - 1`` at every step).
+
+The BlockManager itself stays payload-agnostic plain Python (payloads
+are opaque objects with an ``nbytes`` attribute); only the module-level
+extract/insert helpers touch jax, and they import it lazily so the
+allocator remains unit-testable with no backend.
 """
 
 from __future__ import annotations
 
+import functools
 from collections import OrderedDict
 from typing import NamedTuple, Optional, Sequence
 
@@ -92,9 +118,140 @@ class CachedBlock(NamedTuple):
     chunk: tuple
 
 
+class HostedBlock(NamedTuple):
+    """One host-tier entry: the spilled payload (opaque — the engine
+    stores a :class:`BlockSet`; tests store anything with ``nbytes``)
+    plus the parent chain key and exact chunk tokens revival
+    re-verifies, mirroring :class:`CachedBlock`'s collision safety."""
+
+    parent: int
+    chunk: tuple
+    payload: object
+    nbytes: int
+
+
 class PoolExhausted(Exception):
     """Raised by :meth:`BlockManager.allocate` when the pool cannot
     satisfy a request — the scheduler catches it and preempts."""
+
+
+class BlockSet(NamedTuple):
+    """Host-RAM serialization of a set of KV blocks: one stacked numpy
+    array per device pool (shape ``[n_blocks, block_size, H, D]``, the
+    pool's own dtype — bf16/int8 round-trip bitwise), plus the draft
+    pools' arrays for a speculative engine (the draft rides the same
+    block tables, so its KV must travel with the target's). Built by
+    :func:`extract_blocks`, consumed by :func:`insert_blocks`; the
+    payload is engine-agnostic numpy, which is what lets a later PR
+    point the same object at ANOTHER engine (KV migration /
+    disaggregated serving per ROADMAP) instead of back at this one."""
+
+    payloads: tuple
+    draft_payloads: Optional[tuple]
+
+    @property
+    def n_blocks(self) -> int:
+        """How many blocks this set carries."""
+        return int(self.payloads[0].shape[0]) if self.payloads else 0
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes the set occupies (target + draft pools)."""
+        n = sum(int(p.nbytes) for p in self.payloads)
+        if self.draft_payloads is not None:
+            n += sum(int(p.nbytes) for p in self.draft_payloads)
+        return n
+
+
+def _gather_block(pools, src):
+    """One block's rows out of every pool — ``src`` is a TRACED scalar
+    (the :func:`~.engine._copy_block` convention), so ONE compile per
+    pool geometry covers every block any extraction ever reads."""
+    return [p[src] for p in pools]
+
+
+def _scatter_block(pools, dst, block):
+    """One host block's rows into every pool at ``dst`` (traced scalar;
+    the per-pool ``block`` arrays are fixed ``[block_size, H, D]``
+    shapes) — one compile per pool geometry covers every insertion."""
+    return [p.at[dst].set(b) for p, b in zip(pools, block)]
+
+
+@functools.lru_cache(maxsize=1)
+def _gather_block_jit():
+    """Process-wide jitted block gather (reads never donate)."""
+    import jax
+
+    # graftlint: allow[R3] no static key by design: pools are traced arrays and src is a traced scalar, so one compile covers every block a pool geometry extracts
+    return jax.jit(_gather_block)
+
+
+@functools.lru_cache(maxsize=2)
+def _scatter_block_jit(donate: bool):
+    """Process-wide jitted block scatter, one per donation mode — the
+    pool chain flows through it, so the donating build reuses the pool
+    buffers exactly like the engine's COW copy does."""
+    import jax
+
+    # graftlint: allow[R3] no static key by design: pools are traced arrays and dst is a traced scalar, so one compile covers every block a pool geometry restores
+    return jax.jit(_scatter_block, donate_argnums=(0,) if donate else ())
+
+
+def extract_blocks(pools, ids: Sequence[int], d_pools=None) -> BlockSet:
+    """Serialize blocks ``ids`` out of the device ``pools`` (and the
+    draft's ``d_pools`` when given) into one host-side
+    :class:`BlockSet`. Every pool travels atomically — int8 KV values
+    and their fp32 scale planes are ordinary pool entries, so a
+    quantized block's scales can never be separated from its values.
+    One jitted traced-index gather per block (zero new compiled
+    variants per id value or id count), then ONE ``device_get`` for
+    the whole set — this host-side fetch is the swap transfer itself,
+    not a hot-loop sync."""
+    import jax
+    import numpy as np
+
+    if not ids:
+        return BlockSet((), None if d_pools is None else ())
+    gather = _gather_block_jit()
+    dev = [gather(pools, np.int32(b)) for b in ids]
+    d_dev = (None if d_pools is None
+             else [gather(d_pools, np.int32(b)) for b in ids])
+    host, d_host = jax.device_get((dev, d_dev))
+    payloads = tuple(np.stack([blk[i] for blk in host])
+                     for i in range(len(host[0])))
+    draft = (None if d_host is None
+             else tuple(np.stack([blk[i] for blk in d_host])
+                        for i in range(len(d_host[0]))))
+    return BlockSet(payloads, draft)
+
+
+def insert_blocks(pools, block_set: BlockSet, ids: Sequence[int],
+                  d_pools=None, donate: bool = False):
+    """Scatter a :class:`BlockSet` back into freshly allocated blocks
+    ``ids`` (``len(ids) == block_set.n_blocks``); returns the advanced
+    ``(pools, d_pools)`` chain. Token-exact by construction: the
+    payload was read with :func:`extract_blocks` and lands bitwise
+    unchanged, scale planes included. One jitted traced-index scatter
+    per block — fixed per-pool block shapes, so zero new compiled
+    variants regardless of which (or how many) blocks restore."""
+    import numpy as np
+
+    if len(ids) != block_set.n_blocks:
+        raise ValueError(
+            f"inserting {block_set.n_blocks} extracted blocks into "
+            f"{len(ids)} target ids")
+    if (d_pools is None) != (block_set.draft_payloads is None):
+        raise ValueError(
+            "draft pools and draft payloads must be given together "
+            "(a speculative engine's draft KV rides the same tables)")
+    scatter = _scatter_block_jit(bool(donate))
+    for j, b in enumerate(ids):
+        pools = scatter(pools, np.int32(b),
+                        tuple(p[j] for p in block_set.payloads))
+        if d_pools is not None:
+            d_pools = scatter(d_pools, np.int32(b),
+                              tuple(p[j] for p in block_set.draft_payloads))
+    return pools, d_pools
 
 
 class BlockManager:
@@ -148,6 +305,33 @@ class BlockManager:
         self.prefix_evictions = 0
         self._shared_read_tokens = 0
         self.peak_used = 0
+        # host-RAM spill tier (ISSUE 17): the spill hook (installed by
+        # the engine — block id -> opaque payload with an ``nbytes``),
+        # the byte budget shared by demoted payloads and swap
+        # reservations, the DEMOTED device blocks (still resident and
+        # matchable, but reclaimable without data loss — their host
+        # copy exists), and the host payload store keyed by chain key
+        # (LRU for budget eviction). Payloads are content-addressed by
+        # the chain key — a chain key's KV is a pure function of its
+        # token prefix — so an entry stays valid across any number of
+        # evict/revive cycles of its physical blocks.
+        self._spill = None
+        self.host_budget: Optional[int] = None
+        self._hosted: "OrderedDict[int, None]" = OrderedDict()
+        self._host_payloads: "OrderedDict[int, HostedBlock]" = OrderedDict()
+        # chain keys an in-flight admission matched and is about to
+        # revive: budget eviction must not take them mid-reservation
+        # (the reservation's own allocations can spill-demote evicted
+        # cached blocks, and without the pin that demotion could push
+        # the just-matched oldest payloads out of the budget window
+        # between peek_hosted and revive_hosted)
+        self._host_pinned: set = set()
+        self._host_bytes = 0         # demote-tier payload bytes
+        self._swap_bytes_held = 0    # engine swap reservations
+        self.host_tier_hits = 0      # blocks revived from host payloads
+        self.host_tier_lookups = 0   # host-tier probes at admission
+        self.prefix_demotions = 0    # fresh payload spills performed
+        self.host_evictions = 0      # payloads dropped by budget pressure
         # bucket-padded READ waste (decode-side, orthogonal to the
         # allocation fragmentation below): latched by note_gather()
         self.peak_gather_waste = 0.0
@@ -203,11 +387,29 @@ class BlockManager:
         hit until reclaimed."""
         return len(self._lru)
 
+    @property
+    def num_hosted(self) -> int:
+        """Demoted blocks: zero-ref registered blocks whose payload
+        was written back to the host tier while the device copy stays
+        resident and matchable — free CAPACITY like the cached LRU,
+        but reclaimable WITHOUT data loss (the host copy serves later
+        revivals). Conservation: ``num_free + num_used + num_cached +
+        num_hosted == num_blocks - 1`` always."""
+        return len(self._hosted)
+
+    @property
+    def hosted_bytes(self) -> int:
+        """Host bytes the spill tier currently holds (demoted payloads
+        plus the engine's swap reservations — one budget)."""
+        return self._host_bytes + self._swap_bytes_held
+
     def can_allocate(self, n_blocks: int) -> bool:
         """Cached LRU blocks count as allocatable capacity: they are
         evicted (oldest first) the moment a real allocation needs
-        them."""
-        return n_blocks <= len(self._free) + len(self._lru)
+        them. Demoted blocks likewise — reclaimed FIRST, since their
+        host copy makes the eviction lossless."""
+        return n_blocks <= (len(self._free) + len(self._lru)
+                            + len(self._hosted))
 
     def utilization(self) -> float:
         """Fraction of allocatable blocks currently held by requests."""
@@ -289,15 +491,15 @@ class BlockManager:
         blocks are evicted from the LRU — oldest first, unpublishing
         their prefix-index entries — only once the free list runs
         dry."""
-        if n_blocks > len(self._free) + len(self._lru):
+        if not self.can_allocate(n_blocks):
             raise PoolExhausted(
                 f"need {n_blocks} blocks, {len(self._free)} free + "
-                f"{len(self._lru)} cached "
+                f"{len(self._lru)} cached + {len(self._hosted)} hosted "
                 f"(pool {self.num_blocks - 1} allocatable)")
         out = []
         for _ in range(n_blocks):
             if not self._free:
-                self._evict_cached()
+                self._reclaim_one()
             b = self._free.pop()
             self._ref[b] = 1
             out.append(b)
@@ -305,13 +507,44 @@ class BlockManager:
         self.peak_used = max(self.peak_used, self._used)
         return out
 
+    def _reclaim_one(self) -> None:
+        """Put one reclaimable block on the free list. Demoted blocks
+        go first (lossless — the host copy keeps serving revivals),
+        then the cached LRU's oldest (spilled host-side on the way out
+        when a spill hook is installed — "demote before true
+        eviction")."""
+        if self._hosted:
+            b, _ = self._hosted.popitem(last=False)
+            key = self._block_key.pop(b)
+            del self._index[key]
+            self.prefix_evictions += 1
+            self._free.append(b)
+            return
+        self._evict_cached()
+
     def _evict_cached(self) -> None:
         """Reclaim the least-recently-released cached block: drop its
-        index entry (future lookups of that prefix miss from this level
-        on) and put the block on the free list."""
+        index entry (future lookups of that prefix miss at the DEVICE
+        level from here on) and put the block on the free list. With a
+        spill hook installed the payload is written back to the host
+        tier first — budget permitting — so the eviction only demotes
+        the prefix instead of forgetting it."""
         b, _ = self._lru.popitem(last=False)
         key = self._block_key.pop(b)
-        del self._index[key]
+        entry = self._index.pop(key)
+        if self._spill is not None:
+            if key in self._host_payloads:
+                # content-addressed: an identical payload is already
+                # resident (a revived block re-cooling) — no new copy
+                self._host_payloads.move_to_end(key)
+            else:
+                payload = self._spill(b)
+                nbytes = int(getattr(payload, "nbytes", 0))
+                if self._host_admit(nbytes):
+                    self._host_payloads[key] = HostedBlock(
+                        entry.parent, entry.chunk, payload, nbytes)
+                    self._host_bytes += nbytes
+                    self.prefix_demotions += 1
         self.prefix_evictions += 1
         self._free.append(b)
 
@@ -411,7 +644,12 @@ class BlockManager:
         called once admission capacity is assured."""
         for b in blocks:
             if self._ref[b] == 0:
-                del self._lru[b]
+                if b in self._hosted:
+                    # a demoted block revived in place: its host copy
+                    # stays resident (content-addressed — still valid)
+                    del self._hosted[b]
+                else:
+                    del self._lru[b]
                 self._used += 1
             else:
                 self._extra_refs += 1
@@ -454,6 +692,181 @@ class BlockManager:
                     registered += 1
             parent = key
         return registered
+
+    # -- host-RAM spill tier (ISSUE 17) --------------------------------------
+
+    def set_spill(self, spill, host_budget: Optional[int] = None) -> None:
+        """Install the spill hook (``block_id -> payload`` — the engine
+        wires :func:`extract_blocks` over its live pools; payloads are
+        opaque here beyond their ``nbytes``) and the host byte budget
+        shared by demoted payloads and swap reservations (None =
+        unbounded). With no hook installed every host-tier path is
+        inert and the manager behaves exactly as before."""
+        self._spill = spill
+        self.host_budget = None if host_budget is None else int(host_budget)
+
+    @property
+    def host_tier_active(self) -> bool:
+        """True once a spill hook is installed — the flag admission
+        (``Scheduler._reserve``) keys its host-tier probe on."""
+        return self._spill is not None
+
+    def demote(self, max_blocks: int = 1) -> int:
+        """Write back up to ``max_blocks`` of the COLDEST zero-ref
+        cached blocks to the host tier: the device copy stays resident
+        and matchable (a hit revives it in place, no transfer), but
+        the id becomes reclaimable without data loss — under pressure
+        :meth:`allocate` takes demoted blocks first and only the host
+        copy survives. Returns how many blocks were demoted (0 when no
+        spill hook is installed, the LRU is empty, or the budget is
+        full)."""
+        n = 0
+        while n < max_blocks and self._lru and self._spill is not None:
+            b = next(iter(self._lru))            # oldest
+            key = self._block_key[b]
+            if key in self._host_payloads:
+                self._host_payloads.move_to_end(key)
+            else:
+                payload = self._spill(b)
+                nbytes = int(getattr(payload, "nbytes", 0))
+                if not self._host_admit(nbytes):
+                    break                        # budget can't take it
+                entry = self._index[key]
+                self._host_payloads[key] = HostedBlock(
+                    entry.parent, entry.chunk, payload, nbytes)
+                self._host_bytes += nbytes
+                self.prefix_demotions += 1
+            del self._lru[b]
+            self._hosted[b] = None
+            n += 1
+        return n
+
+    def peek_hosted(self, tokens, start: int,
+                    max_blocks: Optional[int] = None
+                    ) -> tuple[list[int], bool]:
+        """Read-only host-tier probe CONTINUING a device-level match:
+        ``(chain_keys, missed)`` for the chunks from index ``start``
+        (= the device-matched block count) whose payloads are resident
+        host-side, chunk-and-parent verified like every lookup here;
+        ``missed`` is True when the walk ended on a genuine miss
+        rather than the ``max_blocks`` cap or the prompt running out —
+        the hit-rate denominator's input. Mutates nothing (a failed
+        admission probe re-runs every iteration)."""
+        out: list[int] = []
+        missed = False
+        parent = _CHAIN_ROOT
+        for i, (key, chunk) in enumerate(self.chain_keys(tokens)):
+            if i < start:
+                parent = key
+                continue
+            if max_blocks is not None and start + len(out) >= max_blocks:
+                break
+            entry = self._host_payloads.get(key)
+            if entry is None or entry.chunk != chunk \
+                    or entry.parent != parent:
+                missed = True
+                break
+            out.append(key)
+            parent = key
+        return out, missed
+
+    def note_host_probe(self, hits: int, missed: bool) -> None:
+        """Account one COMMITTED admission's host-tier probe outcome
+        (the write half of :meth:`peek_hosted` — counters move only
+        when an admission actually lands, so failed-capacity re-probes
+        do not inflate the hit rate)."""
+        self.host_tier_lookups += int(hits) + (1 if missed else 0)
+
+    def host_pin(self, keys: Sequence[int]) -> None:
+        """Shield host-tier entries ``keys`` from budget eviction for
+        the duration of one admission reservation: between the
+        :meth:`peek_hosted` match and the :meth:`revive_hosted` commit
+        the reservation's own ``allocate`` calls may evict cached
+        blocks, and spilling THOSE on the way out must not push the
+        matched (LRU-oldest — peek mutates nothing) payloads out of
+        the budget window. While pinned entries block the budget,
+        demotion simply drops instead of spilling — a demoted prefix
+        is an opportunity, a matched one a commitment. Always paired
+        with :meth:`host_unpin` (try/finally)."""
+        self._host_pinned.update(keys)
+
+    def host_unpin(self, keys: Sequence[int]) -> None:
+        """Release a :meth:`host_pin` (the reservation committed via
+        :meth:`revive_hosted` — which re-warms the entries — or rolled
+        back)."""
+        self._host_pinned.difference_update(keys)
+
+    def revive_hosted(self, keys: Sequence[int], blocks: Sequence[int]
+                      ) -> list[tuple[int, object]]:
+        """Re-materialize host-tier entries ``keys`` into freshly
+        ALLOCATED device blocks ``blocks`` (the caller owns them at ref
+        1): each key is re-registered in the prefix index at its new
+        block, and the returned ``(block, payload)`` pairs are the
+        device-side scatters the CALLER must apply (every pool, target
+        and draft alike) before any dispatch reads the blocks —
+        exactly the :meth:`privatize` pending-copy contract. Payloads
+        stay resident (content-addressed — a re-eviction re-demotes
+        without a new copy)."""
+        restores: list[tuple[int, object]] = []
+        for key, b in zip(keys, blocks):
+            entry = self._host_payloads[key]
+            self._host_payloads.move_to_end(key)
+            self._index[key] = CachedBlock(b, entry.parent, entry.chunk)
+            self._block_key[b] = key
+            self.host_tier_hits += 1
+            restores.append((b, entry.payload))
+        return restores
+
+    def host_reserve(self, nbytes: int) -> bool:
+        """Charge ``nbytes`` of swap-out payload against the host
+        budget (evicting demoted payloads oldest-first to make room —
+        a swapped request's restore is a promise, a demoted prefix
+        only an opportunity). False = would not fit even empty, and
+        the caller must fall back to recompute."""
+        nbytes = int(nbytes)
+        if self.host_budget is not None:
+            while (self.hosted_bytes + nbytes > self.host_budget
+                   and self._host_evict_one()):
+                pass
+            if self.hosted_bytes + nbytes > self.host_budget:
+                return False
+        self._swap_bytes_held += nbytes
+        return True
+
+    def host_release(self, nbytes: int) -> None:
+        """Return a swap reservation (the request restored or died)."""
+        self._swap_bytes_held -= int(nbytes)
+
+    def _host_admit(self, nbytes: int) -> bool:
+        """True when the budget can take one more demoted payload of
+        ``nbytes`` after evicting older payloads as needed."""
+        if self.host_budget is None:
+            return True
+        while (self.hosted_bytes + nbytes > self.host_budget
+               and self._host_evict_one()):
+            pass
+        return self.hosted_bytes + nbytes <= self.host_budget
+
+    def _host_evict_one(self) -> bool:
+        """Drop the oldest demoted payload (True) or report the tier
+        empty (False). A payload backing a currently-DEMOTED device
+        block takes that block back to the plain cached LRU — its
+        device copy is intact, it just lost the lossless-reclaim
+        property — re-inserted at the COLD end (it was the tier's
+        oldest)."""
+        key = next((k for k in self._host_payloads
+                    if k not in self._host_pinned), None)
+        if key is None:                  # empty, or everything pinned
+            return False
+        entry = self._host_payloads.pop(key)
+        self._host_bytes -= entry.nbytes
+        self.host_evictions += 1
+        ent = self._index.get(key)
+        if ent is not None and ent.block in self._hosted:
+            del self._hosted[ent.block]
+            self._lru[ent.block] = None
+            self._lru.move_to_end(ent.block, last=False)
+        return True
 
     def privatize(self, table: list[int], lo: int, hi: int
                   ) -> list[tuple[int, int]]:

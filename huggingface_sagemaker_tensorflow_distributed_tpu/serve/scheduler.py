@@ -126,6 +126,14 @@ class Request:
     ttft_slo_met: Optional[bool] = None
     tpot_slo_met: Optional[bool] = None
     slack_s: Optional[float] = None
+    # swap-based preemption (ISSUE 17): the extracted host-side
+    # BlockSet a swapped-out victim carries while WAITING, and the
+    # context length it restores to. Unlike recompute, the generated
+    # tokens stay in `output` (nothing folds into the prompt) — the
+    # request resumes decoding from output[-1] the moment its blocks
+    # scatter back, no re-prefill.
+    swap_set: Optional[object] = None
+    swap_context: int = 0
     # recompute preemption folds generated tokens back into the prompt;
     # this keeps the ORIGINAL prompt length so output accounting and
     # first-token semantics survive a preemption
@@ -192,6 +200,13 @@ class Slot:
         # (src, dst) block pairs the ENGINE must apply to every pool
         # before the slot's first prefill dispatch
         self.pending_copies: list[tuple[int, int]] = []
+        # host-RAM spill tier (ISSUE 17): (block, payload) revivals
+        # admission queued — host-tier prefix payloads the ENGINE must
+        # scatter into the listed fresh blocks before the slot's first
+        # dispatch — and the swapped-out request's whole BlockSet,
+        # restored into `table` at re-admission (same timing contract)
+        self.pending_restores: list[tuple[int, object]] = []
+        self.pending_swap_in: Optional[object] = None
         # dispatch-ahead pipeline (ISSUE 12): 1 while this slot rides
         # an in-flight decode dispatch whose token has not been
         # fetched yet — its newest token lives on the DEVICE, and its
@@ -211,6 +226,8 @@ class Slot:
         self.prefill_pos = 0
         self.admit_seq = -1
         self.pending_copies = []
+        self.pending_restores = []
+        self.pending_swap_in = None
         self.inflight = 0
 
 
@@ -276,6 +293,12 @@ class Scheduler:
         self._admit_seq = itertools.count()
         self._prefill_rr = 0
         self.n_preemptions = 0
+        # swap-based preemption (ISSUE 17): the engine installs a
+        # `hook(slot) -> bool` that may extract the victim's blocks to
+        # host BEFORE release (True = swapped; the request's `swap_set`
+        # is set and :meth:`preempt` skips the recompute prompt fold).
+        # None = pure recompute, byte-identical to the pre-swap engine.
+        self.swap_hook = None
 
     # -- queue side ----------------------------------------------------------
 
@@ -366,7 +389,13 @@ class Scheduler:
             if not slot.free:
                 continue
             req = self.waiting[0]
-            table, start0, copies = self._reserve(req)
+            if req.swap_set is not None:
+                if not self._reserve_swapped(req, slot):
+                    break                   # FIFO: no queue-jumping
+                self.waiting.pop(0)
+                admitted.append(slot)
+                continue
+            table, start0, copies, restores = self._reserve(req)
             if table is None:
                 break                       # FIFO: no queue-jumping
             self.waiting.pop(0)
@@ -375,23 +404,54 @@ class Scheduler:
             slot.context_len = 0
             slot.prefill_pos = start0
             slot.pending_copies = copies
+            slot.pending_restores = restores
             slot.admit_seq = next(self._admit_seq)
             req.state = PREFILL
             admitted.append(slot)
         return admitted
 
+    def _reserve_swapped(self, req: Request, slot: Slot) -> bool:
+        """Re-admit a SWAPPED-OUT request (ISSUE 17): allocate exactly
+        the blocks its extracted :class:`~.paged_kv.BlockSet` fills,
+        hand the set to the engine as the slot's pending swap-in (the
+        scatter must land before any dispatch reads the table — the
+        pending-copies timing contract), and resume in DECODE directly:
+        the restored context IS the prefill, no prompt recompute. The
+        generated tokens never left ``req.output``, so the decode feed
+        (``output[-1]``) and the sampled fold indices are exactly the
+        uninterrupted run's."""
+        n = req.swap_set.n_blocks
+        # charge the decode lookahead on top of the restored blocks so
+        # the re-admitted request cannot bounce straight back out on
+        # its first post-restore capacity check
+        ahead = self.blocks.blocks_for(
+            req.swap_context + self.decode_lookahead) - n
+        if not self.blocks.can_allocate(n + max(0, ahead)):
+            return False
+        slot.request = req
+        slot.table = self.blocks.allocate(n)
+        slot.context_len = req.swap_context
+        slot.prefill_pos = 0
+        slot.pending_copies = []
+        slot.pending_swap_in = req.swap_set
+        slot.admit_seq = next(self._admit_seq)
+        req.swap_set = None
+        req.state = DECODE
+        return True
+
     def _reserve(self, req: Request):
         """One request's admission reservation: ``(table, prefill_pos,
-        cow_copies)``, or ``(None, 0, [])`` when the pool cannot carry
-        it yet (every acquired reference rolled back)."""
+        cow_copies, host_restores)``, or ``(None, 0, [], [])`` when the
+        pool cannot carry it yet (every acquired reference rolled
+        back)."""
         bs = self.blocks.block_size
         C = self.prefill_chunk
         padded = self.padded_prompt_len(req)
         total_need = self.blocks.blocks_for(padded)
         if not self.prefix_cache:
             if not self.blocks.can_allocate(total_need):
-                return None, 0, []
-            return self.blocks.allocate(total_need), 0, []
+                return None, 0, [], []
+            return self.blocks.allocate(total_need), 0, [], []
         # the final prompt token is never served from cache — its
         # logits seed generation, so its block stays recomputed. Peek
         # first, commit only once capacity is assured: a failed probe
@@ -399,25 +459,54 @@ class Scheduler:
         # queue, and it must neither churn refcounts nor re-park LRU
         # entries as freshly used (which would bias eviction toward
         # everyone else's prefixes)
+        max_cached = (len(req.prompt) - 1) // bs
         shared, revivals = self.blocks.peek_prefix(
-            req.prompt, max_blocks=(len(req.prompt) - 1) // bs)
-        cached = len(shared) * bs
+            req.prompt, max_blocks=max_cached)
+        # host-RAM spill tier (ISSUE 17): chunks past the device match
+        # may still be resident host-side (demoted before eviction) —
+        # each hit extends the cached prefix at the cost of one fresh
+        # block plus the engine-applied scatter of its payload
+        hosted_keys: list[int] = []
+        host_missed = False
+        if self.blocks.host_tier_active:
+            hosted_keys, host_missed = self.blocks.peek_hosted(
+                req.prompt, len(shared), max_blocks=max_cached)
+        cached = (len(shared) + len(hosted_keys)) * bs
         # prefill resumes on the chunk grid; the overlap [start0,
         # cached) gets rewritten (with identical values) and must be
         # privately owned before the dispatch scatters into it
         start0 = (cached // C) * C
         overlap = cached // bs - start0 // bs
-        private_need = total_need - len(shared)
+        private_need = total_need - len(shared) - len(hosted_keys)
         # committing the match pulls `revivals` blocks out of the
-        # evictable LRU, so they are charged alongside the private need
-        if not self.blocks.can_allocate(private_need + overlap + revivals):
-            return None, 0, []
-        self.blocks.commit_match(shared)
-        table = shared + self.blocks.allocate(private_need)
-        copies = self.blocks.privatize(table, start0 // bs, cached // bs)
+        # evictable LRU, so they are charged alongside the private
+        # need; every host-tier revival additionally needs a fresh
+        # device block to scatter its payload into
+        if not self.blocks.can_allocate(
+                private_need + overlap + revivals + len(hosted_keys)):
+            return None, 0, [], []
+        # pin the matched payloads across the commit: the allocations
+        # below may evict cached blocks, and spilling those under a
+        # tight host budget must not push the matched (still LRU-cold)
+        # entries out before revive_hosted lands
+        self.blocks.host_pin(hosted_keys)
+        try:
+            self.blocks.commit_match(shared)
+            revive_blocks = self.blocks.allocate(len(hosted_keys))
+            restores = self.blocks.revive_hosted(hosted_keys,
+                                                 revive_blocks)
+            if self.blocks.host_tier_active:
+                self.blocks.note_host_probe(len(hosted_keys),
+                                            host_missed)
+            table = (shared + revive_blocks
+                     + self.blocks.allocate(private_need))
+            copies = self.blocks.privatize(table, start0 // bs,
+                                           cached // bs)
+        finally:
+            self.blocks.host_unpin(hosted_keys)
         req.prefix_cached_tokens += start0
         req.prefix_prompt_tokens += len(req.prompt)
-        return table, start0, copies
+        return table, start0, copies, restores
 
     # -- prefill -------------------------------------------------------------
 
@@ -524,13 +613,23 @@ class Scheduler:
                 preempted.append(victim_req)
 
     def preempt(self, slot: Slot) -> None:
-        """Recompute-style preemption: release everything, fold the
-        generated tokens into the prompt, rejoin the queue FRONT (it
-        keeps its place — preemption must not reorder FIFO service)."""
+        """Preempt one slot, rejoining the queue FRONT (it keeps its
+        place — preemption must not reorder FIFO service). Default is
+        vLLM recompute: release everything and fold the generated
+        tokens into the prompt. With a swap hook installed (ISSUE 17)
+        the hook may instead extract the victim's resident blocks to
+        host BEFORE the release — the request then carries its
+        ``swap_set`` while waiting and re-admits straight into DECODE,
+        output unfolded, no re-prefill. Either way the blocks release
+        here (swap extraction only COPIES), so the pool sees one
+        preemption semantics."""
         req = slot.request
-        req.prompt = np.concatenate(
-            [req.prompt, np.asarray(req.output, np.int32)])
-        req.output = []
+        swapped = bool(self.swap_hook is not None
+                       and self.swap_hook(slot))
+        if not swapped:
+            req.prompt = np.concatenate(
+                [req.prompt, np.asarray(req.output, np.int32)])
+            req.output = []
         req.state = WAITING
         req.preemptions += 1
         self.n_preemptions += 1

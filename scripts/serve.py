@@ -64,6 +64,18 @@ run's ``slo_attainment``, goodput tokens, per-group split and
 dominant miss phase (the figures ``obsctl goodput`` recomputes from
 the telemetry stream). ``--slo`` without ``--arrival`` judges the
 closed-loop trace from submit time.
+
+``--swap auto|always|never|off`` (``HSTD_SERVE_SWAP``, default off)
+turns on the host-RAM KV spill tier (ISSUE 17): preemption victims
+swap their KV block sets to host and restore on re-admit without
+re-prefill (``auto`` picks swap vs recompute per victim from the
+bytes-moved vs weight-traffic estimate), and zero-ref prefix-cache
+blocks demote to host before true eviction, reviving on match.
+``--swap_bytes N`` (``HSTD_SERVE_SWAP_BYTES``, 0 = unbounded) caps the
+host tier. With the tier on, the summary carries ``swap_policy``,
+swap traffic (``swap_outs``/``swap_ins``/``swap_bytes``/``restore_s``),
+``recompute_tokens_avoided`` and the demote tier's
+``host_tier_hits``/``host_tier_hit_rate``.
 """
 
 from __future__ import annotations
@@ -144,6 +156,7 @@ def load_trace(args, vocab: int):
                 row = json.loads(line)
                 kw = _sampling_kw(row, defaults,
                                   f"{args.input_file}:{lineno}")
+                # graftlint: allow[R2] host-side JSONL decode before the engine exists — nothing device-resident to block on
                 trace.append((np.asarray(row["prompt_ids"], np.int32),
                               int(row.get("max_new_tokens",
                                           args.max_new_tokens)), kw))
@@ -261,6 +274,18 @@ def main() -> None:
                              "slo_attainment + miss attribution "
                              "(default: HSTD_SERVE_SLO_TTFT_S / "
                              "HSTD_SERVE_SLO_TPOT_S)")
+    parser.add_argument("--swap", default=None,
+                        choices=("auto", "always", "never", "off"),
+                        help="host-RAM KV spill tier: swap preemption "
+                             "victims to host + demote evicted prefix "
+                             "blocks (auto = per-victim bytes-vs-"
+                             "recompute estimate; never = demotion "
+                             "only; default: HSTD_SERVE_SWAP or off)")
+    parser.add_argument("--swap_bytes", type=int, default=None,
+                        help="host-tier byte budget shared by demoted "
+                             "payloads and swap reservations "
+                             "(default: HSTD_SERVE_SWAP_BYTES or "
+                             "0 = unbounded)")
     parser.add_argument("--temperature", type=float, default=0.0,
                         help="0 = greedy (the default); > 0 samples")
     parser.add_argument("--top_k", type=int, default=0)
@@ -315,7 +340,9 @@ def main() -> None:
                     kv_cache_dtype=args.kv_cache_dtype,
                     timeline=args.timeline,
                     overlap=args.overlap,
-                    mesh=args.tp)
+                    mesh=args.tp,
+                    swap=args.swap,
+                    swap_bytes=args.swap_bytes)
     engine = router.engines[0]
     trace = load_trace(args, model.config.vocab_size - 1)
     # precompile the sampled step variants too when the trace will
@@ -440,6 +467,15 @@ def main() -> None:
             "kv_dtype": engine.kv_cache_dtype,
             "tp": engine.tp,
             "per_replica": rslo.get("per_replica"),
+            **({"swap_policy": engine.swap,
+                "swap_outs": sum(s.swap_outs for s in stats_all),
+                "swap_ins": sum(s.swap_ins for s in stats_all),
+                "swap_bytes": sum(s.swap_bytes for s in stats_all),
+                "recompute_tokens_avoided": sum(
+                    s.recompute_tokens_avoided for s in stats_all),
+                "host_tier_hits": sum(
+                    s.host_tier_hits for s in stats_all)}
+               if engine.swap != "off" else {}),
             **({"arrival_backlog_peak":
                 rslo.get("arrival_backlog_peak")}
                if driver is not None else {}),
@@ -509,6 +545,17 @@ def main() -> None:
             stats.kv_bytes_read / stats.decode_steps, 1)
             if stats.decode_steps else None),
         "kv_peak_utilization": round(stats.kv_peak_utilization, 3),
+        **({"swap_policy": stats.swap_policy,
+            "swap_outs": stats.swap_outs,
+            "swap_ins": stats.swap_ins,
+            "swap_bytes": stats.swap_bytes,
+            "restore_s": round(stats.restore_s, 6),
+            "recompute_tokens_avoided": stats.recompute_tokens_avoided,
+            "host_tier_hits": stats.host_tier_hits,
+            "host_tier_hit_rate": (
+                round(stats.host_tier_hit_rate, 4)
+                if stats.host_tier_hit_rate is not None else None)}
+           if engine.swap != "off" else {}),
         **({"arrival_backlog_peak": slo.get("arrival_backlog_peak")}
            if driver is not None else {}),
         **({"slo_attainment": slo.get("slo_attainment"),

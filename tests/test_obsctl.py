@@ -100,7 +100,13 @@ def three_hosts(tmp_path):
                               replicas=2, placement="least_loaded",
                               replica_load_imbalance=1.2,
                               slo_attainment=0.97,
-                              arrival_backlog_peak=3))
+                              arrival_backlog_peak=3,
+                              swap_policy="always", swap_outs=5,
+                              swap_ins=4, swap_bytes=1 << 19,
+                              restore_s=0.02,
+                              recompute_tokens_avoided=320,
+                              host_tier_hits=12,
+                              host_tier_hit_rate=0.92))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -697,6 +703,96 @@ def test_diff_arrival_backlog_peak_is_count_metric(three_hosts):
         d = diff_reports(a, b, threshold_pct=5.0)
         assert "serve_arrival_backlog_peak" in d["skipped"]
         assert "serve_arrival_backlog_peak" not in d["regressions"]
+
+
+def test_diff_swap_bytes_is_up_worse(three_hosts):
+    """ISSUE 17: `serve_swap_bytes` (host RAM moved by the KV spill
+    tier) diffs as a bytes metric whose worse direction is UP — more
+    traffic over the host boundary for the same trace means the
+    preemption economics shifted (shrunken pool, lost prefix sharing,
+    or a mis-tuned budget forcing churn). Standard threshold +
+    zero-baseline rules, poison rows skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["swap_bytes"] == 1 << 19
+    worse = copy.deepcopy(base)
+    worse["serve"]["swap_bytes"] = 4 << 19       # tier thrashing
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_swap_bytes" in d["regressions"]
+    assert d["metrics"]["serve_swap_bytes"]["worse_direction"] == "up"
+    # less host traffic never flags; nor does a sub-threshold drift
+    assert "serve_swap_bytes" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["swap_bytes"] = int(1.02 * (1 << 19))
+    assert "serve_swap_bytes" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # zero baseline (swap=never run, tier idle): bytes appearing must
+    # still flag even though the percentage is undefined
+    zero = copy.deepcopy(base)
+    zero["serve"]["swap_bytes"] = 0
+    worse0 = copy.deepcopy(zero)
+    worse0["serve"]["swap_bytes"] = 1 << 16
+    d0 = diff_reports(zero, worse0, threshold_pct=5.0)
+    assert "serve_swap_bytes" in d0["regressions"]
+    assert d0["metrics"]["serve_swap_bytes"]["pct"] is None
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["swap_bytes"] = "a lot"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["swap_bytes"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_swap_bytes" in d["skipped"]
+        assert "serve_swap_bytes" not in d["regressions"]
+
+
+def test_diff_host_tier_hit_rate_is_down_worse_ratio(three_hosts):
+    """ISSUE 17: `serve_host_tier_hit_rate` (fraction of prefix-cache
+    probes revived from the demoted host tier) diffs as a ratio metric
+    whose worse direction is DOWN — the tier eroding means demoted
+    prefixes are being evicted (budget too small) or never matched
+    (demotion ordering broke), and those misses come back as re-prefill
+    FLOPs. Standard threshold rules, poison rows skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["host_tier_hit_rate"] == pytest.approx(0.92)
+    worse = copy.deepcopy(base)
+    worse["serve"]["host_tier_hit_rate"] = 0.55
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_host_tier_hit_rate" in d["regressions"]
+    assert d["metrics"]["serve_host_tier_hit_rate"][
+        "worse_direction"] == "down"
+    # the tier catching more never flags; nor does a sub-threshold dip
+    assert "serve_host_tier_hit_rate" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["host_tier_hit_rate"] = 0.90   # ~-2.2%
+    assert "serve_host_tier_hit_rate" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["host_tier_hit_rate"] = "usually"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["host_tier_hit_rate"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_host_tier_hit_rate" in d["skipped"]
+        assert "serve_host_tier_hit_rate" not in d["regressions"]
 
 
 def test_diff_poisoned_lifecycle_metrics_skip_not_crash(three_hosts):

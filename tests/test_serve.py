@@ -871,11 +871,15 @@ def test_block_manager_privatize_cow_semantics():
 def test_block_conservation_under_random_schedule(rng):
     """The satellite property test: across a randomized
     submit/admit/prefill/decode/preempt/finish/share/COW schedule with
-    prefix caching on (small pool => LRU eviction pressure), every
-    step preserves ``num_free + num_used + num_cached ==
-    num_blocks - 1``, every table reference is backed by exactly its
-    refcount, and no table references a freed block."""
+    prefix caching on (small pool => LRU eviction pressure) PLUS the
+    ISSUE 17 host tier (a stand-in spill/swap hook drives swap-out /
+    swap-in / demote / revive / payload-evict through the same
+    churn), every step preserves ``num_free + num_used + num_cached +
+    num_hosted == num_blocks - 1``, every table reference is backed by
+    exactly its refcount, no table references a freed block, and
+    hosted blocks are never simultaneously free or held."""
     from collections import Counter
+    from types import SimpleNamespace
 
     bm = BlockManager(num_blocks=20, block_size=4)
     # chunk 8 vs block 4: a cached prefix of 12 tokens re-aligns to
@@ -884,10 +888,29 @@ def test_block_conservation_under_random_schedule(rng):
     s = Scheduler(3, bm, 8, 32, prefix_cache=True)
     prefixes = [rng.randint(1, 100, (12,)).astype(np.int32),
                 rng.randint(1, 100, (20,)).astype(np.int32)]
+    # the host tier, engine-free: payloads are opaque (conservation is
+    # about IDs, not bytes) and the budget is tight enough that
+    # reserve failures and oldest-first payload eviction both happen
+    bm.set_spill(lambda b: SimpleNamespace(nbytes=64), host_budget=1024)
+
+    def swap_hook(slot):
+        if not rng.randint(0, 2):
+            return False                     # the recompute arm
+        req = slot.request
+        n = bm.blocks_for(slot.context_len)
+        if n <= 0 or n > len(slot.table):
+            return False
+        if not bm.host_reserve(n * 64):
+            return False                     # budget starved: recompute
+        req.swap_set = SimpleNamespace(n_blocks=n, nbytes=n * 64)
+        req.swap_context = slot.context_len
+        return True
+
+    s.swap_hook = swap_hook
 
     def check():
         assert (bm.num_free + bm.num_used + bm.num_cached
-                == bm.num_blocks - 1)
+                + bm.num_hosted == bm.num_blocks - 1)
         held = Counter(b for slot in s.slots if not slot.free
                        for b in slot.table)
         refs = {b: bm._ref[b] for b in range(1, bm.num_blocks)
@@ -896,10 +919,15 @@ def test_block_conservation_under_random_schedule(rng):
         free_set = set(bm._free)
         assert not (set(held) & free_set)    # no table refs a freed block
         assert 0 not in held                 # the null block is never owned
+        hosted = set(bm._hosted)
+        assert not (hosted & free_set)       # demoted ids are resident
+        assert not (hosted & set(held))      # ...and zero-ref
 
     for step in range(300):
-        op = rng.randint(0, 5)
-        if op == 0 and len(s.waiting) < 4:
+        op = rng.randint(0, 6)
+        if op == 5 and bm.host_tier_active:  # demotion pressure
+            bm.demote(max_blocks=int(rng.randint(1, 3)))
+        elif op == 0 and len(s.waiting) < 4:
             if rng.randint(0, 2):
                 pre = prefixes[rng.randint(0, len(prefixes))]
                 tail = rng.randint(1, 100,
@@ -1791,3 +1819,290 @@ def test_overlap_lone_stream_auto_flushes_to_serial(gpt2_setup,
     off2, on2, eng2 = _run_overlap_pair(model, params, trace, **kw)
     assert on2 == off2
     assert calls                            # dispatch-ahead really ran
+
+
+# -- ISSUE 17: KV host tier (swap preemption + prefix demotion) --------------
+
+def test_extract_insert_blocks_roundtrip_bitwise():
+    """The tentpole's standalone unit gate: ``extract_blocks`` /
+    ``insert_blocks`` round-trip a block set bitwise — value pools AND
+    int8-style scale pools travel atomically — into the SAME or
+    DIFFERENT destination ids, and the pair never touches the
+    BlockManager (no refcount or free-list movement: pool I/O and
+    block accounting are separate layers by design)."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (
+        extract_blocks,
+        insert_blocks,
+    )
+
+    rng = np.random.RandomState(17)
+    nb, bs = 12, 4
+    # an int8-mode pool family: int8 values + fp32 scale planes
+    pools = (
+        jnp.asarray(rng.randn(nb, bs, 2, 3).astype(np.float32)),
+        jnp.asarray(rng.randint(-128, 128, (nb, bs, 2, 3), np.int32)
+                    .astype(np.int8)),
+        jnp.asarray(rng.randn(nb, bs, 2).astype(np.float32)),
+    )
+    d_pools = (jnp.asarray(rng.randn(nb, bs, 2, 3).astype(np.float32)),)
+    before = [np.asarray(p) for p in pools]
+
+    bm = BlockManager(num_blocks=nb, block_size=bs)
+    src = bm.allocate(3)
+    free0, used0 = bm.num_free, bm.num_used
+    snapshot = list(bm._free)
+
+    bset = extract_blocks(pools, src, d_pools=d_pools)
+    assert bset.n_blocks == 3 and bset.nbytes > 0
+    # scatter into different ids on zeroed pools: bitwise per block
+    dst = [b for b in range(1, nb) if b not in src][:3]
+    zero = tuple(jnp.zeros_like(p) for p in pools)
+    zero_d = tuple(jnp.zeros_like(p) for p in d_pools)
+    out, out_d = insert_blocks(zero, bset, dst, d_pools=zero_d)
+    for pi, p in enumerate(out):
+        got = np.asarray(p)
+        for s, d in zip(src, dst):
+            np.testing.assert_array_equal(got[d], before[pi][s])
+            assert got[d].dtype == before[pi][s].dtype
+        # untouched rows stay zero
+        other = [b for b in range(nb) if b not in dst]
+        assert not np.asarray(p)[other].any()
+    for s, d in zip(src, dst):
+        np.testing.assert_array_equal(
+            np.asarray(out_d[0])[d], np.asarray(d_pools[0])[s])
+    # round-trip into the SAME ids reproduces the original pools
+    back, _ = insert_blocks(zero, bset, src, d_pools=zero_d)
+    for pi, p in enumerate(back):
+        for s in src:
+            np.testing.assert_array_equal(np.asarray(p)[s], before[pi][s])
+    # the manager never moved: extraction is not an eviction
+    assert (bm.num_free, bm.num_used) == (free0, used0)
+    assert list(bm._free) == snapshot
+    bm.release(src)
+    assert bm.num_used == 0
+    # shape mismatches are loud
+    with pytest.raises(ValueError):
+        insert_blocks(zero, bset, dst[:2])
+    with pytest.raises(ValueError):
+        insert_blocks(zero, bset, dst)      # draft payloads, no d_pools
+
+
+def _run_swap(model, params, trace, swap, kws=None, swap_bytes=None,
+              **engine_kw):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ServeEngine,
+    )
+
+    kws = kws or [dict() for _ in trace]
+    eng = ServeEngine(model, params, swap=swap, swap_bytes=swap_bytes,
+                      **engine_kw)
+    reqs = [eng.submit(p, m, **kw) for (p, m), kw in zip(trace, kws)]
+    eng.run()
+    return [[int(t) for t in eng.output_ids(r)] for r in reqs], eng
+
+
+def test_swap_preemption_token_exact_greedy(gpt2_setup):
+    """The ISSUE 17 exactness gate, greedy arm: on the forced-preemption
+    trace a swapped-and-restored request is token-identical to the
+    recompute path AND to generate_causal (= the unpreempted answer),
+    with overlap ON and the pipeline provably drained before every
+    extraction; the swap path really ran (outs/ins/tokens-avoided all
+    positive) and a starved byte budget falls back to recompute, still
+    exact."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(1)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 18)
+             for _ in range(5)]
+    kw = dict(num_slots=4, block_size=4, num_blocks=10, prefill_chunk=8,
+              max_model_len=32)
+    swp, eng = _run_swap(model, params, trace, "always", **kw)
+    rec, rec_eng = _run_swap(model, params, trace, "never", **kw)
+    assert swp == rec
+    for (p, m), got in zip(trace, swp):
+        assert got == _reference(model, params, p, m, cfg.eos_token_id)
+    st = eng.stats()
+    assert st.preemptions > 0 and rec_eng.stats().preemptions > 0
+    assert st.swap_outs > 0 and st.swap_ins > 0
+    assert st.recompute_tokens_avoided > 0 and st.swap_bytes > 0
+    assert st.swap_policy == "always"
+    assert rec_eng.stats().swap_outs == 0   # never = recompute arm
+    # overlap pipeline drained before extraction (the default loop ran)
+    assert eng.overlap and eng.overlap_flushes > 0
+    # conservation after the run: swap freed what it extracted
+    assert eng.blocks.num_used == 0
+    assert (eng.blocks.num_free + eng.blocks.num_cached
+            + eng.blocks.num_hosted == eng.blocks.num_blocks - 1)
+    # a 1-byte budget can never reserve a set: recompute fallback, exact
+    starved, s_eng = _run_swap(model, params, trace, "always",
+                               swap_bytes=1, **kw)
+    assert starved == swp
+    assert s_eng.stats().swap_outs == 0
+    assert s_eng.stats().preemptions > 0
+
+
+def test_swap_sampled_bitwise_and_auto_policy(gpt2_setup):
+    """Sampled arm: seeded streams under swap preemption are BITWISE
+    identical to the roomy-pool unpreempted run (swap keeps the
+    request's emitted output intact, so fold indices never shift), and
+    ``auto`` stays exact while actually exercising its estimate — on
+    this geometry a victim's few KV blocks are far cheaper to move
+    than the weight reads its re-prefill would stream, so auto picks
+    the swap arm."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(9)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 14)
+             for _ in range(4)]
+    kws = [dict(temperature=0.9, top_k=20, top_p=0.9, seed=s)
+           for s in (1, 2, 3)] + [dict()]
+    base, _ = _run_swap(model, params, trace, "off", kws=kws,
+                        num_slots=3, block_size=4, num_blocks=40,
+                        prefill_chunk=8, max_model_len=32)
+    tight, teng = _run_swap(model, params, trace, "always", kws=kws,
+                            num_slots=3, block_size=4, num_blocks=9,
+                            prefill_chunk=8, max_model_len=32)
+    assert teng.stats().preemptions > 0 and teng.stats().swap_outs > 0
+    assert tight == base                    # bitwise, greedy rider too
+    auto, aeng = _run_swap(model, params, trace, "auto", kws=kws,
+                           num_slots=3, block_size=4, num_blocks=9,
+                           prefill_chunk=8, max_model_len=32)
+    assert auto == base
+    assert aeng.stats().swap_policy == "auto"
+    # 2 * set_bytes << param_bytes * prefill_dispatches here: the
+    # estimate picks swap, and the telemetry names the avoided work
+    assert aeng.stats().swap_outs > 0
+    assert aeng.stats().recompute_tokens_avoided > 0
+
+
+def test_swap_preemption_exact_int8_pools(gpt2_setup):
+    """int8 arm: the scale planes travel with the value blocks, so a
+    swapped int8 request restores bitwise and stays token-exact vs
+    generate_causal on the int8-cache config."""
+    cfg, model, params = gpt2_setup
+    int8 = _int8_model(model, cfg)
+    rng = np.random.RandomState(12)
+    trace = [(rng.randint(1, 120, (9,)).astype(np.int32), 18)
+             for _ in range(4)]
+    kw = dict(num_slots=4, block_size=4, num_blocks=10, prefill_chunk=8,
+              max_model_len=32)
+    swp, eng = _run_swap(int8, params, trace, "always", **kw)
+    rec, _ = _run_swap(int8, params, trace, "never", **kw)
+    assert swp == rec
+    for (p, m), got in zip(trace, swp):
+        assert got == _reference(int8, params, p, m, cfg.eos_token_id)
+    assert eng.stats().swap_outs > 0
+    assert eng.kv_cache_dtype == "int8"
+
+
+def test_prefix_demotion_revives_instead_of_recomputing(gpt2_setup):
+    """The demotion tier: two templates alternating over a pool that
+    holds only one — evict-only (swap='off') pays a cold miss every
+    swing, the tier ('never': demote active, recompute preemption)
+    revives demoted blocks from host and keeps the hit rate up, tokens
+    identical."""
+    cfg, model, params = gpt2_setup
+    rng = np.random.RandomState(31)
+    t1 = rng.randint(1, 120, (16,)).astype(np.int32)
+    t2 = rng.randint(1, 120, (16,)).astype(np.int32)
+    trace = []
+    for _ in range(6):
+        for t in (t1, t2):
+            tail = rng.randint(1, 120, (2,)).astype(np.int32)
+            trace.append((np.concatenate([t, tail]), 3))
+    kw = dict(num_slots=1, block_size=4, num_blocks=8, prefill_chunk=8,
+              max_model_len=32)
+    off, off_eng = _run_swap(model, params, trace, "off", **kw)
+    tier, tier_eng = _run_swap(model, params, trace, "never", **kw)
+    assert tier == off
+    off_hit = off_eng.stats().cache_hit_rate or 0.0
+    tier_hit = tier_eng.stats().cache_hit_rate or 0.0
+    assert tier_hit > off_hit               # revives beat cold misses
+    st = tier_eng.stats()
+    assert st.host_tier_hits > 0
+    assert st.host_tier_hit_rate and 0 < st.host_tier_hit_rate <= 1
+    assert off_eng.stats().host_tier_hit_rate is None  # off: field absent
+    # host-tier state drains clean: every hosted block still conserved
+    bm = tier_eng.blocks
+    assert (bm.num_free + bm.num_used + bm.num_cached + bm.num_hosted
+            == bm.num_blocks - 1)
+
+
+def test_parse_swap_knobs(monkeypatch):
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+        ENV_SWAP,
+        ENV_SWAP_BYTES,
+        parse_swap,
+        parse_swap_bytes,
+    )
+
+    assert parse_swap(None) == "off"        # default: tier fully off
+    for mode in ("auto", "always", "never", "off"):
+        assert parse_swap(mode) == mode
+    monkeypatch.setenv(ENV_SWAP, "always")
+    assert parse_swap(None) == "always"
+    with pytest.raises(ValueError, match=ENV_SWAP):
+        parse_swap("sometimes")
+
+    assert parse_swap_bytes(None) is None   # unbounded
+    assert parse_swap_bytes(0) is None      # 0 = unbounded too
+    assert parse_swap_bytes(1 << 20) == 1 << 20
+    assert parse_swap_bytes("4096") == 4096
+    monkeypatch.setenv(ENV_SWAP_BYTES, "2048")
+    assert parse_swap_bytes(None) == 2048
+    with pytest.raises(ValueError, match=ENV_SWAP_BYTES):
+        parse_swap_bytes(-1)
+
+
+def test_revive_survives_budget_eviction_during_reservation():
+    """Regression (found by the bench's budgeted run): an admission
+    that matched host-tier payloads must not lose them to its OWN
+    allocations. ``_reserve``'s revive-block / private-block allocates
+    can evict cached blocks, and spilling those under a FULL host
+    budget evicts payloads oldest-first — which is exactly where the
+    matched (still LRU-cold, peek mutates nothing) entries sit.
+    Unpinned, ``revive_hosted`` KeyErrors; pinned, the in-flight
+    demotions drop instead (a demoted prefix is an opportunity, a
+    matched one a commitment) and the revival lands."""
+    from types import SimpleNamespace
+
+    bm = BlockManager(num_blocks=10, block_size=4)
+    s = Scheduler(2, bm, 4, 16, prefix_cache=True)
+    # budget = exactly two 64-byte payloads: demoting anything further
+    # must evict oldest-first
+    bm.set_spill(lambda b: SimpleNamespace(nbytes=64), host_budget=128)
+
+    # park prefix A (2 full blocks) host-side ONLY: register, release,
+    # demote both payloads (budget now full), then reclaim the demoted
+    # device ids so a future match is host-tier-or-nothing
+    tokens_a = np.arange(1, 9).astype(np.int32)
+    ta = bm.allocate(2)
+    bm.register_prefix(tokens_a, ta)
+    bm.release(ta)
+    assert bm.demote(max_blocks=2) == 2
+    held = bm.allocate(7) + bm.allocate(2)   # 2nd call reclaims hosted
+    assert bm.num_hosted == 0 and bm.num_free == 0
+    # refill the LRU with OTHER registered prefixes (3 x 2 blocks) so
+    # the admission below must evict-and-spill to allocate at all
+    for lo in (20, 40, 60):
+        t = held[:2]
+        held = held[2:]
+        bm.register_prefix(np.arange(lo, lo + 8).astype(np.int32), t)
+        bm.release(t)
+    assert bm.num_cached == 6 and bm.num_free == 0
+
+    # admission: prompt = prefix A + one fresh block. peek_hosted
+    # matches A's 2 keys; the 3 needed allocations each evict + spill
+    # a cached block against the full budget
+    s.submit(Request(prompt=np.concatenate(
+        [tokens_a, np.arange(100, 104).astype(np.int32)]),
+        max_new_tokens=2))
+    [slot] = s.admit()
+    assert bm.host_tier_hits == 2
+    assert len(slot.pending_restores) == 2
+    assert slot.prefill_pos == 8             # revived spans skipped
+    # the matched payloads survived; the in-flight demotions were
+    # dropped, not queued behind them
+    assert bm.host_evictions == 0
+    assert (bm.num_free + bm.num_used + bm.num_cached
+            + bm.num_hosted == bm.num_blocks - 1)

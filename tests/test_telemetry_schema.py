@@ -383,6 +383,76 @@ def test_produced_open_loop_artifacts_validate(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_produced_swap_artifacts_validate(tmp_path):
+    """ISSUE 17 fixture regeneration from a REAL forced-swap run (a
+    pool far too small for the resident requests, ``swap='always'``):
+    the produced stream must carry per-victim ``swap_out`` /
+    ``swap_in`` events typed (bytes moved; the restore additionally
+    its scatter seconds and the re-prefill tokens it avoided), the
+    report event the run aggregates the ``obsctl diff`` gates read,
+    and pass the validator end to end — fixtures from live emitters,
+    not hand-built."""
+    import numpy as np
+
+    out = tmp_path / "telemetry"
+    obs.reset(out_dir=str(out), enabled=True)
+    try:
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+            init_params,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+            Gpt2Config,
+            Gpt2LMHeadModel,
+        )
+        from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
+            ServeEngine,
+        )
+
+        gcfg = Gpt2Config(vocab_size=128, hidden_size=32, num_layers=2,
+                          num_heads=2, intermediate_size=64,
+                          max_position_embeddings=64, hidden_dropout=0.0,
+                          embd_dropout=0.0, attention_dropout=0.0,
+                          eos_token_id=127, pad_token_id=0)
+        gmodel = Gpt2LMHeadModel(gcfg)
+        # 5 requests of up to 27 tokens against 9 usable 4-token
+        # blocks: the scheduler MUST preempt, and swap='always' turns
+        # every preemption into a host round-trip
+        eng = ServeEngine(gmodel, init_params(gmodel, gcfg, seed=0),
+                          num_slots=4, block_size=4, num_blocks=10,
+                          prefill_chunk=8, max_model_len=32,
+                          prefix_cache=True, swap="always")
+        for i in range(5):
+            eng.submit(np.arange(1 + i, 10 + i, dtype=np.int32), 18)
+        eng.run()
+        obs.flush()
+        events = [e for _, e, err in obs.iter_events(
+            str(out / "events.jsonl")) if err is None]
+    finally:
+        obs.reset()
+    serve = [e for e in events if e["type"] == "serve"]
+    swap_outs = [e for e in serve if e.get("event") == "swap_out"]
+    swap_ins = [e for e in serve if e.get("event") == "swap_in"]
+    # the run really swapped, and every transfer event is typed
+    assert swap_outs and swap_ins
+    assert all(isinstance(e["swap_bytes"], int) and e["swap_bytes"] > 0
+               for e in swap_outs + swap_ins)
+    assert all(isinstance(e["restore_s"], (int, float))
+               and isinstance(e["recompute_tokens_avoided"], int)
+               for e in swap_ins)
+    # the report event carries the aggregates `obsctl diff` gates
+    report = [e for e in serve if e.get("event") == "report"][-1]
+    assert report["swap_policy"] == "always"
+    assert isinstance(report["swap_outs"], int) and report["swap_outs"] > 0
+    assert isinstance(report["swap_ins"], int) and report["swap_ins"] > 0
+    assert isinstance(report["swap_bytes"], int) and report["swap_bytes"] > 0
+    assert isinstance(report["restore_s"], (int, float))
+    assert isinstance(report["recompute_tokens_avoided"], int)
+    assert isinstance(report["host_tier_hits"], int)
+    assert isinstance(report["host_tier_hit_rate"], (int, float))
+    proc = _run(str(out))
+    assert proc.returncode == 0, proc.stdout
+
+
 def test_validator_rejects_mistyped_open_loop_fields(tmp_path):
     """ISSUE 16 deadline fields: optional on `serve` events but TYPED
     when present — a drifted emitter (string verdict, float backlog)
@@ -547,6 +617,44 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
     assert "optional field 'decode_slots'" in proc.stdout
     assert "optional field 'waiting'" in proc.stdout
     assert "optional field 'kv_used_frac'" in proc.stdout
+    # ISSUE 17 host-RAM KV tier fields: typed when present, so a
+    # drifted emitter can't poison the swap-traffic / tier-hit
+    # accounting `obsctl diff` gates (own file — same error-cap
+    # reasoning as the router rows)
+    bad3 = tmp_path / "swap_events.jsonl"
+    rows3 = [
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "swap_out", "request": 3,
+         "swap_bytes": 1 << 16},                                 # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "swap_in", "request": 3, "swap_bytes": 1 << 16,
+         "restore_s": 0.01, "recompute_tokens_avoided": 120},    # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "swap_out", "request": 4,
+         "swap_bytes": "heavy"},                                 # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "swap_in", "request": 4, "restore_s": "fast",
+         "recompute_tokens_avoided": 9.5},                       # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "report", "swap_policy": "auto", "swap_outs": 2,
+         "swap_ins": 2, "host_tier_hits": 8,
+         "host_tier_hit_rate": 0.8},                             # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "report", "swap_policy": True, "swap_outs": 2.5,
+         "swap_ins": "both", "host_tier_hits": "some",
+         "host_tier_hit_rate": "warm"},                          # drift
+    ]
+    bad3.write_text("\n".join(json.dumps(r) for r in rows3) + "\n")
+    proc3 = _run(str(bad3))
+    assert proc3.returncode == 1
+    assert "optional field 'swap_bytes'" in proc3.stdout
+    assert "optional field 'restore_s'" in proc3.stdout
+    assert "optional field 'recompute_tokens_avoided'" in proc3.stdout
+    assert "optional field 'swap_policy'" in proc3.stdout
+    assert "optional field 'swap_outs'" in proc3.stdout
+    assert "optional field 'swap_ins'" in proc3.stdout
+    assert "optional field 'host_tier_hits'" in proc3.stdout
+    assert "optional field 'host_tier_hit_rate'" in proc3.stdout
 
 
 def test_validator_accepts_anomaly_and_flight_artifacts(tmp_path):
