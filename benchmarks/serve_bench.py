@@ -1,4 +1,4 @@
-"""Serving bench (``bench.py --serve``): ten JSON metric lines.
+"""Serving bench (``bench.py --serve``): eleven JSON metric lines.
 
 1. ``serve_continuous_vs_static_speedup`` — continuous batching + paged
    KV vs static-batch ``generate_causal`` on a mixed-length request
@@ -149,6 +149,30 @@
     detail un-gated — the demotion tier sits in both arms and
     revives a recompute victim's shared spans nearly free, so the
     policies are at structural parity on CPU.
+
+11. ``serve_disagg_goodput`` — the ISSUE 18 tentpole: disaggregated
+    prefill/decode (a prefill-only and a decode-only replica joined by
+    ``serve/transport.py``'s block-set migration) vs two mixed
+    replicas, on a prefill-heavy open-loop virtual-clock trace (long
+    prompts, short continuations — interactive traffic, where TTFT is
+    the whole deadline). The interference being eliminated is
+    structural: a mixed replica holds each slot from admission THROUGH
+    decode and throttles its Sarathi prefill budget per active
+    decoder, so under arrival pressure its admission queue clogs with
+    decoding residents and the TTFT tail collapses; the prefill-only
+    replica gets every slot back at migration time and prefills at the
+    full ``chunk x slots`` budget. Deterministic gates at EVERY scale:
+    token identity disagg vs mixed (migration cannot change tokens —
+    the same exactness the transport tests assert bitwise), strict
+    role separation (zero decode iterations on the prefill side, zero
+    prefill dispatches on the decode side), full transport coverage
+    (every request migrates exactly once, bytes > 0), byte-identical
+    replay across two fresh disagg runs, and compile flatness (the
+    roles split mints zero new step variants — replicas share the
+    module-level jit families). The full CPU trace gates the claim:
+    SLO attainment ratio (disagg / mixed) ≥ 1.1 with the per-side
+    figures each no worse — prefill-side TTFT p99 on the shared
+    virtual clock, decode-side tokens/sec from dispatch accounting.
 
 Structural gates degrade the line to the structured-error shape (value
 null + ``error``) rather than lying with a number. Both sides of every
@@ -2281,8 +2305,211 @@ def bench_serve_kv_swap(smoke: bool = False) -> dict:
                  "bench/serve_kv_swap_vs_recompute")
 
 
+def bench_serve_disagg(smoke: bool = False) -> dict:
+    """Metric line 11 (ISSUE 18): disaggregated prefill/decode vs two
+    mixed replicas on a prefill-heavy open-loop trace. See the module
+    docstring — the interference story is structural (a mixed replica's
+    slots clog with decoders, starving admission and throttling the
+    Sarathi budget; the prefill replica hands each finished block set
+    to the decode side over the transport primitive and keeps its slots
+    free), so token identity, role separation, full migration coverage,
+    replay determinism and compile flatness gate at every scale; the
+    attainment ratio and the per-side no-worse claims gate on the full
+    CPU trace only."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.loadgen import (
+        OpenLoopDriver,
+        SloSpec,
+        make_schedule,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.router import (
+        Router,
+    )
+
+    on_tpu, anomaly_field, memory_watermark = _bench_env()
+
+    if smoke:
+        cfg = Gpt2Config(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=128,
+                         max_position_embeddings=128, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0,
+                         eos_token_id=255, pad_token_id=0)
+        slots, block, chunk, max_len = 2, 8, 8, 64
+        buckets = [32, 64]
+        n_req, prompt_lo, prompt_hi, new_lo, new_hi = 8, 4, 16, 3, 6
+        rate, slo = 300.0, SloSpec(ttft_s=0.02)
+    elif on_tpu:
+        cfg = Gpt2Config(dtype=jnp.bfloat16, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0)  # 124M
+        slots, block, chunk, max_len = 4, 16, 32, 256
+        buckets = [128, 256]
+        n_req, prompt_lo, prompt_hi, new_lo, new_hi = 32, 32, 128, 8, 24
+        rate, slo = 500.0, SloSpec(ttft_s=0.01)
+    else:
+        # CPU trace, prefill-heavy by construction: prompts several
+        # chunks long, continuations a handful of tokens — the
+        # interactive-traffic shape where TTFT is the whole deadline.
+        # At 0.5 requests per virtual tick a mixed replica is past its
+        # slot-cycle capacity (a slot is held prefill THROUGH decode,
+        # ~2 + ~7 ticks) so its admission queue grows and the TTFT
+        # tail collapses, while the prefill-only replica — slots
+        # returned at migration, budget never decode-throttled — stays
+        # under its ~1 request/tick service rate.
+        cfg = Gpt2Config(vocab_size=2048, hidden_size=256, num_layers=2,
+                         num_heads=4, intermediate_size=1024,
+                         max_position_embeddings=256, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0,
+                         eos_token_id=2047, pad_token_id=0)
+        slots, block, chunk, max_len = 2, 8, 8, 128
+        buckets = [64, 128]
+        n_req, prompt_lo, prompt_hi, new_lo, new_hi = 24, 12, 48, 6, 16
+        rate, slo = 500.0, SloSpec(ttft_s=0.01)
+    tick, sched_seed = 0.001, 11
+
+    model = Gpt2LMHeadModel(cfg)
+    params = init_params(model, cfg, seed=0)
+    vocab = min(cfg.vocab_size - 2, 1 << 16)
+    num_blocks = 1 + slots * ((prompt_hi + chunk + new_hi + block)
+                              // block + 1)
+    kw = dict(num_slots=slots, block_size=block, prefill_chunk=chunk,
+              max_model_len=max_len, gather_buckets=buckets,
+              num_blocks=num_blocks, timeline="off", overlap="on",
+              prefix_cache=False, mesh=1)
+    schedule = make_schedule(
+        n_req, vocab, process="poisson", rate=rate, seed=sched_seed,
+        prompt_lo=prompt_lo, prompt_hi=prompt_hi, new_lo=new_lo,
+        new_hi=new_hi, eos_token_id=cfg.eos_token_id)
+
+    def serve_once(disagg: bool):
+        r = (Router(model, params, roles={"prefill": 1, "decode": 1},
+                    **kw) if disagg
+             else Router(model, params, replicas=2,
+                         placement="round_robin", **kw))
+        drv = OpenLoopDriver(r, schedule, clock="virtual", tick_s=tick,
+                             slo=slo, process="poisson", rate=rate)
+        finished = drv.run()
+        outs = [list(finished[rid].output) for rid in sorted(finished)]
+        return {"outs": outs, "summary": drv.summary(),
+                "slo": r.slo_summary(), "router": r,
+                "stats": [e.stats() for e in r.engines]}
+
+    with obs.span("bench/serve_disagg_warm"):
+        serve_once(True)                     # compiles every variant
+        serve_once(False)                    # (both arms share them)
+    tracker = obs.compile_tracker()
+    count0 = tracker.count if tracker else None
+
+    with obs.span("bench/serve_disagg_measured"):
+        dis_a = serve_once(True)
+        dis_b = serve_once(True)             # fresh replay, same seed
+        mix = serve_once(False)
+    compile_delta = (tracker.count - count0) if tracker else None
+
+    # -- gates (deterministic, enforced at every scale) ---------------
+    exact = dis_a["outs"] == mix["outs"]
+    replay_ok = (dis_a["outs"] == dis_b["outs"]
+                 and json.dumps(dis_a["summary"], sort_keys=True)
+                 == json.dumps(dis_b["summary"], sort_keys=True))
+    # role separation is structural, not statistical: a prefill-only
+    # replica never runs a decode iteration, a decode replica never
+    # takes a submission — leaks mean the split didn't happen
+    r = dis_a["router"]
+    roles_ok = all(
+        (s.decode_steps == 0 if r.role_of[i] == "prefill"
+         else s.prefill_dispatches == 0)
+        for i, s in enumerate(dis_a["stats"]))
+    # every request crosses the transport exactly once (prompts all
+    # want >= 1 decode token, so none can finish on the prefill side)
+    migrations = r.migrations
+    mig_bytes = sum(s.migration_bytes for s in dis_a["stats"])
+    migrations_ok = migrations == n_req and mig_bytes > 0
+    compiles_ok = (compile_delta is None
+                   or compile_delta <= 2 * len(buckets))
+    att_dis = dis_a["summary"].get("slo_attainment")
+    att_mix = mix["summary"].get("slo_attainment")
+    ratio = (att_dis / att_mix if att_dis and att_mix else 0.0)
+    # per-side no-worse claims (full CPU, like the ratio): prefill-side
+    # TTFT p99 on the shared virtual clock, decode-side tokens/sec from
+    # the engines' own dispatch accounting (wall — 0.9 honesty floor)
+    ttft_dis = dis_a["summary"].get("ttft_p99_s")
+    ttft_mix = mix["summary"].get("ttft_p99_s")
+    tps_dis = dis_a["slo"].get("decode_tokens_per_sec")
+    tps_mix = mix["slo"].get("decode_tokens_per_sec")
+    sides_ok = (ttft_dis is not None and ttft_mix is not None
+                and ttft_dis <= ttft_mix
+                and tps_dis is not None and tps_mix is not None
+                and tps_dis >= 0.9 * tps_mix)
+    gate_ok = (exact and replay_ok and roles_ok and migrations_ok
+               and compiles_ok
+               and (smoke or on_tpu or (ratio >= 1.1 and sides_ok)))
+
+    result = {
+        "metric": "serve_disagg_goodput",
+        "value": round(ratio, 3) if gate_ok else None,
+        "unit": "x" if gate_ok else None,
+        "vs_baseline": (round(att_mix, 4)
+                        if gate_ok and att_mix is not None else None),
+        "detail": {
+            "roles": "prefill:1,decode:1",
+            "baseline": "2 mixed replicas, round_robin",
+            "clock": "virtual",
+            "tick_s": tick,
+            "process": "poisson",
+            "rate": rate,
+            "slo_ttft_s": slo.ttft_s,
+            "attainment_disagg": att_dis,
+            "attainment_mixed": att_mix,
+            "ttft_p99_s_disagg": ttft_dis,
+            "ttft_p99_s_mixed": ttft_mix,
+            "decode_tokens_per_sec_disagg": tps_dis,
+            "decode_tokens_per_sec_mixed": tps_mix,
+            "migrations": migrations,
+            "migration_bytes": mig_bytes,
+            "migration_restore_s":
+                dis_a["slo"].get("migration_restore_s"),
+            "per_role": dis_a["slo"].get("per_role"),
+            "goodput_tokens_disagg":
+                dis_a["summary"].get("goodput_tokens"),
+            "goodput_tokens_mixed":
+                mix["summary"].get("goodput_tokens"),
+            "requests": n_req,
+            "num_slots": slots,
+            "block_size": block,
+            "num_blocks": num_blocks,
+            "prefill_chunk": chunk,
+            "max_model_len": max_len,
+            "gather_buckets": buckets,
+            "compiles_steady": compile_delta,
+            "replay_identical": replay_ok,
+            "exact_match": exact,
+            "model_scale": ("smoke" if smoke
+                            else "real" if on_tpu else "cpu"),
+            "ratio_gated": not (smoke or on_tpu),
+        },
+    }
+    if not gate_ok:
+        result["error"] = (
+            "disagg_output_diverged" if not exact
+            else "virtual_replay_diverged" if not replay_ok
+            else "role_separation_leaked" if not roles_ok
+            else "transport_not_exercised" if not migrations_ok
+            else "steady_state_recompiled" if not compiles_ok
+            else "disagg_goodput_below_gate")
+    return _emit(result, anomaly_field, memory_watermark,
+                 "bench/serve_disagg_goodput")
+
+
 def bench_serve(smoke: bool = False) -> list[dict]:
-    """All ten serve metric lines, mixed-trace first (the driver
+    """All eleven serve metric lines, mixed-trace first (the driver
     reads stdout lines; the return value is for tests)."""
     return [bench_serve_mixed(smoke=smoke),
             bench_serve_bucketed(smoke=smoke),
@@ -2293,7 +2520,8 @@ def bench_serve(smoke: bool = False) -> list[dict]:
             bench_serve_tp(smoke=smoke),
             bench_serve_router(smoke=smoke),
             bench_serve_open_loop(smoke=smoke),
-            bench_serve_kv_swap(smoke=smoke)]
+            bench_serve_kv_swap(smoke=smoke),
+            bench_serve_disagg(smoke=smoke)]
 
 
 if __name__ == "__main__":

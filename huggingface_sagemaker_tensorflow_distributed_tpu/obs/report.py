@@ -395,6 +395,23 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # working set) is the first sign the RAM-sized prefix cache stopped
     # paying; ratio kind like serve_cache_hit_rate (only drops flag).
     "serve_host_tier_hit_rate": (-1, "ratio"),
+    # cross-engine transport (ISSUE 18): total bytes migrations moved
+    # between engines, worse UP — a harvest loop thrashing (migrating
+    # work that could have stayed put), a drain migrating residents a
+    # requeue would have served, or a placement policy ping-ponging a
+    # request all show up as transport traffic growing before the
+    # latency percentiles move. Ratio kind under the shared
+    # zero-baseline rule: the healthy mixed-fleet baseline migrates
+    # NOTHING, so bytes appearing against 0 must flag even though the
+    # percentage is undefined.
+    "serve_migration_bytes": (+1, "ratio"),
+    # disaggregated-fleet SLO attainment, worse DOWN — the headline
+    # figure for a prefill/decode split fleet: if role separation stops
+    # paying (handoff stalls, a starved decode side, migration overhead
+    # eating the TTFT win) this drops before any per-role percentile
+    # is obviously wrong; ratio kind like serve_slo_attainment (only
+    # drops flag; a 0.0 baseline is a fully-missing run).
+    "serve_disagg_slo_attainment": (-1, "ratio"),
 }
 
 
@@ -433,7 +450,8 @@ def _report_scalars(report: dict) -> dict:
                 "preempted_time_frac", "overhead_time_frac",
                 "kv_pool_bytes_per_device", "replica_load_imbalance",
                 "slo_attainment", "arrival_backlog_peak",
-                "swap_bytes", "host_tier_hit_rate"):
+                "swap_bytes", "host_tier_hit_rate",
+                "migration_bytes", "disagg_slo_attainment"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out

@@ -237,7 +237,29 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               "restore_s": _NUM,
               "recompute_tokens_avoided": (int,),
               "host_tier_hits": (int,),
-              "host_tier_hit_rate": _NUM},
+              "host_tier_hit_rate": _NUM,
+              # cross-engine KV transport (ISSUE 18): `migrate` events
+              # carry one move (source/destination replica, payload
+              # bytes, destination scatter seconds ride the existing
+              # restore_s key); `drain` events gain the migrated /
+              # residents_in_place split; report events the fleet
+              # totals, the role spec, the per-role attribution
+              # breakdown, and the disaggregated attainment `obsctl
+              # diff` gates as serve_disagg_slo_attainment /
+              # serve_migration_bytes. All absent on migration-free
+              # runs — the byte-identity contract
+              "from_replica": (int,),
+              "migration_bytes": (int,),
+              "migrated": (int,),
+              "residents_in_place": (int,),
+              "migrations": (int,),
+              "migrations_in": (int,),
+              "migrations_out": (int,),
+              "migration_restore_s": _NUM,
+              "roles": (str,),
+              "role": (str,),
+              "per_role": (dict,),
+              "disagg_slo_attainment": _NUM},
 }
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)

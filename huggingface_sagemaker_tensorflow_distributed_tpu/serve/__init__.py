@@ -11,8 +11,12 @@ per-slot seeded sampling).
   per gather bucket) and the driving loop (``scripts/serve.py`` is the
   CLI; ``bench.py --serve`` the measurement).
 - :mod:`~.router` — N engine replicas behind one facade (ISSUE 14):
-  round-robin / least-loaded / prefix-affinity placement, replica
-  drain/restart with requeue-to-siblings.
+  round-robin / least-loaded / prefix-affinity / length-aware
+  placement, replica drain/restart with requeue-to-siblings and live
+  resident migration, disaggregated prefill/decode roles (ISSUE 18).
+- :mod:`~.transport` — cross-engine KV block-set migration (ISSUE 18):
+  one primitive moves a live request between engines with zero
+  re-prefill, token-exactly.
 """
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (  # noqa: F401
@@ -36,9 +40,16 @@ def __getattr__(name):
             engine,
         )
         return getattr(engine, name)
-    if name in ("Router", "parse_replicas", "parse_placement"):
+    if name in ("Router", "parse_replicas", "parse_placement",
+                "parse_roles"):
         from huggingface_sagemaker_tensorflow_distributed_tpu.serve import (
             router,
         )
         return getattr(router, name)
+    if name in ("TransportError", "migrate_request", "can_accept",
+                "pool_signature"):
+        from huggingface_sagemaker_tensorflow_distributed_tpu.serve import (
+            transport,
+        )
+        return getattr(transport, name)
     raise AttributeError(name)

@@ -930,6 +930,11 @@ class EngineStats(NamedTuple):
     recompute_tokens_avoided: int = 0
     host_tier_hits: int = 0
     host_tier_hit_rate: Optional[float] = None
+    # cross-engine KV transport (ISSUE 18): migration traffic through
+    # this engine — all zero unless migrate_request touched it
+    migrations_in: int = 0
+    migrations_out: int = 0
+    migration_bytes: int = 0
 
 
 class ServeEngine:
@@ -1356,6 +1361,24 @@ class ServeEngine:
         self.swap_bytes_moved = 0
         self.restore_s = 0.0
         self.recompute_tokens_avoided = 0
+        # cross-engine transport (ISSUE 18): counters stay zero —
+        # and every rider stays absent — unless migrate_request runs,
+        # the byte-identity contract for single-engine traffic.
+        # _migrated_in maps an adopted resident's rid to its source
+        # replica index until the restore applies, which is how
+        # _apply_restores tells a migration arrival (migration
+        # accounting, `migrate` event) from a swap-tier re-admission
+        # (host-budget release, `swap_in` event).
+        self.migrations_in = 0
+        self.migrations_out = 0
+        self.migration_bytes = 0
+        self.migration_restore_s = 0.0
+        self._migrated_in: dict = {}
+        # role-designated prefill replica (ISSUE 18): the Router flips
+        # this on disaggregated fleets; _step then suppresses the
+        # decode phase entirely and finished prefills park in DECODE
+        # state until the router migrates them to a decode replica
+        self.prefill_only = False
         if self.swap != "off":
             # host bytes one block costs across every pool, UNSHARDED
             # (device_get assembles the full logical block regardless
@@ -1428,6 +1451,26 @@ class ServeEngine:
         stream is bitwise what it would have been anywhere else —
         placement can never change tokens."""
         self.sched.adopt(req)
+        if req.sampled:
+            self._keys[req.rid] = np.asarray(jax.random.PRNGKey(req.seed),
+                                             np.uint32)
+
+    def adopt_resident(self, req: Request,
+                       from_replica: Optional[int] = None) -> None:
+        """Migration hook (ISSUE 18): enqueue a sibling engine's LIVE
+        resident at the queue front (:meth:`~.scheduler.Scheduler.
+        adopt_resident`). A hot migrant carries its extracted block
+        set as ``swap_set`` — registering its rid here routes the
+        eventual restore through migration accounting instead of the
+        swap tier's; a cold (mid-prefill) migrant just re-prefills.
+        The sampled key re-derives exactly as :meth:`adopt` — token
+        ``n``'s key is a pure function of (seed, n), so migration can
+        never change tokens."""
+        self.sched.adopt_resident(req)
+        if req.swap_set is not None:
+            self._migrated_in[req.rid] = from_replica
+        else:
+            self.migrations_in += 1
         if req.sampled:
             self._keys[req.rid] = np.asarray(jax.random.PRNGKey(req.seed),
                                              np.uint32)
@@ -1759,6 +1802,16 @@ class ServeEngine:
                 self.blocks.host_tier_hits
                 / max(1, self.blocks.host_tier_lookups), 4)
 
+        # cross-engine transport (ISSUE 18): absent entirely unless a
+        # migration touched this engine — the byte-identity contract
+        # for single-engine and migration-free fleet traffic
+        if self.migrations_in or self.migrations_out:
+            out["migrations_in"] = self.migrations_in
+            out["migrations_out"] = self.migrations_out
+            out["migration_bytes"] = self.migration_bytes
+            out["migration_restore_s"] = round(
+                self.migration_restore_s, 6)
+
         if self.speculative:
             out["speculate_k"] = self.speculate_k
             out["draft_proposed"] = self.draft_proposed
@@ -1839,7 +1892,10 @@ class ServeEngine:
             host_tier_hit_rate=(
                 self.blocks.host_tier_hits
                 / max(1, self.blocks.host_tier_lookups)
-                if self.swap != "off" else None))
+                if self.swap != "off" else None),
+            migrations_in=self.migrations_in,
+            migrations_out=self.migrations_out,
+            migration_bytes=self.migration_bytes)
 
     def _aggregate_hit_rate(self) -> Optional[float]:
         """Prompt tokens served from cache / prompt tokens admitted,
@@ -1937,7 +1993,15 @@ class ServeEngine:
             if not dispatched_rows:
                 break
             budget -= dispatched_rows * C
-        if not self.overlap:
+        if self.prefill_only:
+            # disaggregated prefill replica (ISSUE 18): no decode phase
+            # at all — no capacity math either, since parked DECODE
+            # slots never grow their tables here (the router migrates
+            # them to a decode replica between iterations, and "zero
+            # decode iterations on a prefill replica" is the bench's
+            # role-separation gate)
+            pass
+        elif not self.overlap:
             self._capacity_phase()
             self._decode_all()
         elif self.speculative:
@@ -2806,15 +2870,34 @@ class ServeEngine:
             if self.speculative:
                 self._d_pools = d
             dt = time.perf_counter() - t0
-            self.restore_s += dt
-            self.blocks.host_release(bset.nbytes)
-            self.swap_ins += 1
-            self.swap_bytes_moved += bset.nbytes
-            self.recompute_tokens_avoided += slot.context_len
-            obs.serve("swap_in", request=req.rid,
-                      swap_bytes=bset.nbytes, restore_s=round(dt, 6),
-                      recompute_tokens_avoided=slot.context_len,
-                      **self._replica_kw())
+            if req.rid in self._migrated_in:
+                # migration arrival (ISSUE 18): the set came from a
+                # SIBLING engine's pools, not this engine's host tier —
+                # no reservation to release (host_release here would
+                # corrupt the swap budget), and the traffic lands in
+                # migration accounting, not the swap tier's
+                src_replica = self._migrated_in.pop(req.rid)
+                self.migrations_in += 1
+                self.migration_bytes += bset.nbytes
+                self.migration_restore_s += dt
+                kw = {}
+                if src_replica is not None:
+                    kw["from_replica"] = src_replica
+                if self.replica is not None:
+                    kw["to_replica"] = self.replica
+                obs.serve("migrate", request=req.rid,
+                          migration_bytes=bset.nbytes,
+                          restore_s=round(dt, 6), **kw)
+            else:
+                self.restore_s += dt
+                self.blocks.host_release(bset.nbytes)
+                self.swap_ins += 1
+                self.swap_bytes_moved += bset.nbytes
+                self.recompute_tokens_avoided += slot.context_len
+                obs.serve("swap_in", request=req.rid,
+                          swap_bytes=bset.nbytes, restore_s=round(dt, 6),
+                          recompute_tokens_avoided=slot.context_len,
+                          **self._replica_kw())
         if slot.pending_restores:
             t0 = time.perf_counter()
             for b, payload in slot.pending_restores:

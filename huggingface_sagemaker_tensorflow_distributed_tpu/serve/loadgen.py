@@ -459,11 +459,31 @@ class OpenLoopDriver:
         ``miss_phases`` (miss count per dominant phase) and
         ``dominant_miss_phase`` (None with zero misses); plus
         ``goodput_tokens`` — tokens generated by deadline-meeting
-        requests, the DistServe goodput numerator."""
+        requests, the DistServe goodput numerator. In virtual mode the
+        summary also carries ``ttft_p50/p95/p99_s`` and
+        ``tpot_p50/p95/p99_s`` over the virtual timeline — the
+        deterministic per-side attribution the disagg bench gates read
+        (TTFT is the prefill side's figure, TPOT the decode side's)."""
         out: dict = {"requests": len(self._recs), "clock": self.clock,
                      "process": self.process}
         if self.rate is not None:
             out["rate"] = self.rate
+        if self.clock == "virtual":
+            from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (  # noqa: E501
+                percentile,
+            )
+            ttfts = sorted(rec["v_first"] - rec["arrival"]
+                           for rec in self._recs if "v_first" in rec)
+            tpots = sorted(
+                (rec["v_finish"] - rec["v_first"])
+                / max(self._generated(rec["req"]) - 1, 1)
+                for rec in self._recs
+                if "v_first" in rec and "v_finish" in rec)
+            for label, vals in (("ttft", ttfts), ("tpot", tpots)):
+                if vals:
+                    out[f"{label}_p50_s"] = round(percentile(vals, 0.50), 6)
+                    out[f"{label}_p95_s"] = round(percentile(vals, 0.95), 6)
+                    out[f"{label}_p99_s"] = round(percentile(vals, 0.99), 6)
         if self.slo is None:
             return out
         met = 0

@@ -142,12 +142,30 @@ class BlockSet(NamedTuple):
     pools' arrays for a speculative engine (the draft rides the same
     block tables, so its KV must travel with the target's). Built by
     :func:`extract_blocks`, consumed by :func:`insert_blocks`; the
-    payload is engine-agnostic numpy, which is what lets a later PR
-    point the same object at ANOTHER engine (KV migration /
-    disaggregated serving per ROADMAP) instead of back at this one."""
+    payload is engine-agnostic numpy, which is what lets
+    :func:`~.transport.migrate_request` (ISSUE 18) point the same
+    object at ANOTHER engine — same-geometry pools accept it bitwise,
+    and a destination at a different tensor-parallel degree re-shards
+    the heads axis simply by scattering into its own sharded pools
+    (the payload is always the full logical block)."""
 
     payloads: tuple
     draft_payloads: Optional[tuple]
+
+    @property
+    def signature(self) -> tuple:
+        """Logical pool geometry the set was extracted from — per-pool
+        ``(block shape, dtype)``, target then draft. Sets transplant
+        only between engines whose pools report the same signature
+        (sharding excluded: shapes here are the assembled host
+        shapes)."""
+        def sig(ps):
+            # dim 0 is the set's block count — geometry is the rest
+            return tuple((tuple(int(d) for d in p.shape[1:]),
+                          str(p.dtype)) for p in ps)
+        return (sig(self.payloads),
+                sig(self.draft_payloads)
+                if self.draft_payloads is not None else None)
 
     @property
     def n_blocks(self) -> int:

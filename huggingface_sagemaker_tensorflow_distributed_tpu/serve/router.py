@@ -41,14 +41,35 @@ policy instead of FIFO-into-one-engine:
   degrades to a cold cache, never to wrong tokens.
 
 Replica drain/restart — the fleet degrades instead of dying:
-:meth:`Router.drain` stops admitting to replica i, lets its RESIDENT
-requests finish in place, and requeues its WAITING ones onto siblings
-through the normal placement policy (recompute semantics, the same
-state the scheduler's preemption/requeue path builds — a preemption
--folded prompt moves unchanged, sampled keys re-derive from the
-request's own seed, queue-wait keeps counting from the original submit
-stamp). :meth:`Router.restart` re-admits. Every move is telemetered
-(``drain`` / ``requeue`` / ``restart`` serve events).
+:meth:`Router.drain` stops admitting to replica i, requeues its
+WAITING requests onto siblings through the normal placement policy
+(recompute semantics, the same state the scheduler's preemption
+/requeue path builds — a preemption-folded prompt moves unchanged,
+sampled keys re-derive from the request's own seed, queue-wait keeps
+counting from the original submit stamp), and LIVE-MIGRATES its
+RESIDENT requests (ISSUE 18): each resident's KV block set moves to a
+sibling through :func:`~.transport.migrate_request` with zero
+re-prefill, so a drain completes without waiting for any resident to
+finish — preemption-free rolling restarts. A resident no sibling can
+take (heterogeneous fleets) finishes in place, counted in the drain
+event's ``residents_in_place``. :meth:`Router.restart` re-admits.
+Every move is telemetered (``drain`` / ``requeue`` / ``migrate`` /
+``restart`` serve events).
+
+Disaggregated fleets (ISSUE 18): ``Router(roles="prefill:N,decode:M")``
+designates prefill-only and decode-only replicas. Submissions place
+over the prefill side only; a prefill replica runs chunked prefill
+with its decode phase suppressed entirely (its idle decode slots feed
+the Sarathi token budget, so prefill runs at full width instead of
+one chunk per iteration), and each finished prefill's block set is
+handed to the least-loaded decode replica between fleet iterations —
+wide prefill dispatches never stall another tenant's decode iteration,
+which is the DistServe/Splitwise goodput argument the bench's
+disaggregation line gates. With ``replica_kwargs`` the fleet may also
+be HETEROGENEOUS (e.g. TP=2 replicas for long-context traffic beside
+TP=1 for short) — the ``length_aware`` placement policy routes by
+prompt length, and migration re-shards the KV heads axis simply by
+scattering into the destination's own sharded pools.
 
 Telemetry: each engine's per-request lifecycle events carry a
 ``replica`` tag (``obsctl slo`` groups tail attribution by it); the
@@ -83,13 +104,20 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (
     prefix_chain_keys,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (
+    DECODE,
     Request,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.transport import (
+    TransportError,
+    can_accept,
+    migrate_request,
 )
 
 ENV_REPLICAS = "HSTD_SERVE_REPLICAS"
 ENV_PLACEMENT = "HSTD_SERVE_PLACEMENT"
+ENV_ROLES = "HSTD_SERVE_ROLES"
 
-PLACEMENTS = ("round_robin", "least_loaded", "affinity")
+PLACEMENTS = ("round_robin", "least_loaded", "affinity", "length_aware")
 
 
 def parse_replicas(spec) -> int:
@@ -108,9 +136,51 @@ def parse_replicas(spec) -> int:
     return n
 
 
+def parse_roles(spec) -> Optional[dict]:
+    """The disaggregation knob (ISSUE 18): ``prefill:N,decode:M``
+    (both >= 1) designates the first N replicas prefill-only and the
+    next M decode-only; an empty value keeps every replica mixed (the
+    pre-disaggregation fleet, byte-identical behavior). None reads
+    ``HSTD_SERVE_ROLES``. A dict ``{"prefill": N, "decode": M}``
+    passes through."""
+    if spec is None:
+        spec = os.environ.get(ENV_ROLES, "")
+    if isinstance(spec, dict):
+        parts = {str(k).strip().lower(): v for k, v in spec.items()}
+    else:
+        s = str(spec).strip().lower()
+        if not s:
+            return None
+        parts = {}
+        for tok in s.split(","):
+            role, sep, count = tok.partition(":")
+            if not sep:
+                raise ValueError(
+                    f"unparseable {ENV_ROLES} value {spec!r}: expected "
+                    "role:count pairs like 'prefill:1,decode:1'")
+            parts[role.strip()] = count.strip()
+    unknown = set(parts) - {"prefill", "decode"}
+    if unknown:
+        raise ValueError(
+            f"unparseable {ENV_ROLES} value {spec!r}: unknown role(s) "
+            f"{sorted(unknown)} (expected prefill / decode)")
+    try:
+        out = {"prefill": int(parts.get("prefill", 0)),
+               "decode": int(parts.get("decode", 0))}
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"unparseable {ENV_ROLES} value {spec!r}: counts must be "
+            "positive integers")
+    if out["prefill"] < 1 or out["decode"] < 1:
+        raise ValueError(
+            f"{ENV_ROLES} needs at least one prefill and one decode "
+            f"replica, got {out}")
+    return out
+
+
 def parse_placement(spec: Union[str, None]) -> str:
     """The placement-policy knob: one of ``round_robin`` (default) /
-    ``least_loaded`` / ``affinity``. None reads
+    ``least_loaded`` / ``affinity`` / ``length_aware``. None reads
     ``HSTD_SERVE_PLACEMENT``."""
     if spec is None:
         spec = os.environ.get(ENV_PLACEMENT, "round_robin")
@@ -122,12 +192,15 @@ def parse_placement(spec: Union[str, None]) -> str:
 
 
 class Router:
-    """N homogeneous :class:`~.engine.ServeEngine` replicas behind one
-    facade. ``replicas``/``placement`` read their env knobs when None
-    (``HSTD_SERVE_REPLICAS`` / ``HSTD_SERVE_PLACEMENT``); every other
-    keyword is forwarded verbatim to EACH replica's engine constructor,
-    so the fleet is homogeneous by construction (which is what makes a
-    drain-requeued request's submit-time validation transferable).
+    """N :class:`~.engine.ServeEngine` replicas behind one facade.
+    ``replicas``/``placement``/``roles`` read their env knobs when
+    None (``HSTD_SERVE_REPLICAS`` / ``HSTD_SERVE_PLACEMENT`` /
+    ``HSTD_SERVE_ROLES``); every other keyword is forwarded verbatim
+    to EACH replica's engine constructor — homogeneous by default
+    (which is what makes a drain-requeued request's submit-time
+    validation transferable), with per-replica ``replica_kwargs``
+    overrides for heterogeneous fleets (ISSUE 18: transport re-checks
+    geometry before every cross-replica move).
 
     ``affinity_cap`` bounds the affinity index (LRU aging — oldest
     fingerprints fall out first, exactly the staleness order the
@@ -143,22 +216,61 @@ class Router:
     and placement-blind)."""
 
     def __init__(self, model, params, *, replicas=None, placement=None,
+                 roles=None, replica_kwargs=None,
+                 length_threshold: Optional[int] = None,
                  affinity_cap: int = 4096,
                  affinity_max_skew: Optional[int] = None,
                  **engine_kwargs):
-        self.n = parse_replicas(replicas)
+        self.roles = parse_roles(roles)
+        if self.roles is not None:
+            n_roles = self.roles["prefill"] + self.roles["decode"]
+            if replicas is not None and parse_replicas(replicas) != n_roles:
+                raise ValueError(
+                    f"replicas={replicas} contradicts roles {self.roles} "
+                    f"(= {n_roles} replicas): pass one or the other")
+            self.n = n_roles
+        else:
+            self.n = parse_replicas(replicas)
         self.placement = parse_placement(placement)
-        self.engines = [ServeEngine(model, params, **engine_kwargs)
-                        for _ in range(self.n)]
+        # per-replica overrides (ISSUE 18, heterogeneous fleets): the
+        # shared engine_kwargs build the fleet's common geometry; a
+        # replica_kwargs[i] dict layers replica i's own knobs (e.g.
+        # mesh=2 for a TP=2 long-context replica) on top. Transportable
+        # requests require equal POOL signatures (transport validates),
+        # which mixed-TP replicas over one model satisfy by design.
+        if replica_kwargs is not None and len(replica_kwargs) != self.n:
+            raise ValueError(
+                f"replica_kwargs has {len(replica_kwargs)} entries for "
+                f"{self.n} replicas")
+        self.engines = []
+        for i in range(self.n):
+            kw = dict(engine_kwargs)
+            if replica_kwargs is not None:
+                kw.update(replica_kwargs[i])
+            self.engines.append(ServeEngine(model, params, **kw))
         if self.n > 1:
             for i, eng in enumerate(self.engines):
                 eng.replica = i
+        self.role_of: list[str] = (
+            ["prefill"] * self.roles["prefill"]
+            + ["decode"] * self.roles["decode"]
+            if self.roles is not None else ["mixed"] * self.n)
+        for i, eng in enumerate(self.engines):
+            if self.role_of[i] == "prefill":
+                eng.prefill_only = True
         self.block_size = self.engines[0].blocks.block_size
         self._rr = 0
         self._draining: set[int] = set()
         self._owner: dict[int, int] = {}        # rid -> replica index
         self.drains = 0
         self.requeues = 0
+        self.migrations = 0
+        # length-aware routing threshold (heterogeneous fleets):
+        # prompts at/above it go to the deepest capacity class
+        if length_threshold is None:
+            length_threshold = min(
+                e.sched.max_model_len for e in self.engines) // 2
+        self.length_threshold = int(length_threshold)
         self.affinity_cap = int(affinity_cap)
         if self.affinity_cap < 1:
             raise ValueError("affinity_cap must be >= 1")
@@ -173,6 +285,15 @@ class Router:
 
     def _admitting(self) -> list[int]:
         return [i for i in range(self.n) if i not in self._draining]
+
+    def _intake(self) -> list[int]:
+        """Replicas NEW submissions may target: every admitting one —
+        minus the decode side of a disaggregated fleet, which only
+        receives migrated residents (ISSUE 18)."""
+        cand = self._admitting()
+        if self.roles is not None:
+            cand = [i for i in cand if self.role_of[i] == "prefill"]
+        return cand
 
     def _load(self, i: int) -> float:
         """One replica's placement score from its live gauges: queued +
@@ -228,14 +349,37 @@ class Router:
         never fit the pool) must not advance the round-robin cursor or
         pollute the affinity index with fingerprints pointing at a
         replica that will never prefill them."""
-        cand = self._admitting()
+        cand = self._intake()
         if len(cand) == 1:
             return cand[0]
         if self.placement == "round_robin":
             return cand[self._rr % len(cand)]
         if self.placement == "least_loaded":
             return self._least_loaded(cand)
+        if self.placement == "length_aware":
+            return self._length_aware(prompt, cand)
         return self._affine(prompt, cand)
+
+    def _capacity_class(self, i: int) -> tuple:
+        """A replica's capacity rank for length-aware routing: its
+        tensor-parallel degree first (a TP=2 replica holds the deep
+        pool long contexts need), pool blocks as the tiebreak."""
+        eng = self.engines[i]
+        return (eng.tp, eng.blocks.num_blocks)
+
+    def _length_aware(self, prompt, cand: list[int]) -> int:
+        """Heterogeneous-fleet policy (ISSUE 18): prompts at/above
+        ``length_threshold`` go to the DEEPEST capacity class (TP
+        degree, then pool size), short ones to the shallowest — so
+        long-context traffic lands on the replicas built for it and
+        never crowds the small replicas' pools. Least-loaded inside
+        the chosen class; on a homogeneous fleet every replica is one
+        class and this IS least-loaded."""
+        classes = {self._capacity_class(i) for i in cand}
+        want = max(classes) if len(prompt) >= self.length_threshold \
+            else min(classes)
+        pool = [i for i in cand if self._capacity_class(i) == want]
+        return self._least_loaded(pool)
 
     def _commit_place(self, prompt, choice: int) -> None:
         """Land the placement's state changes for an ACCEPTED request:
@@ -243,7 +387,7 @@ class Router:
         choice to rotate over), register the prompt's fingerprints at
         the chosen replica."""
         if self.placement == "round_robin":
-            if len(self._admitting()) > 1:
+            if len(self._intake()) > 1:
                 self._rr += 1
         elif self.placement == "affinity":
             self._register_affinity(prompt, choice)
@@ -256,6 +400,21 @@ class Router:
         :meth:`~.engine.ServeEngine.submit` — the returned
         :class:`Request` is the engine's own handle."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.roles is not None:
+            # the prefill side validates against ITS pool below; also
+            # require that SOME decode replica can eventually hold the
+            # request, or the post-prefill handoff would retry forever
+            # (only reachable on heterogeneous decode sides)
+            import types
+            shim = types.SimpleNamespace(
+                prompt=prompt, max_new_tokens=int(max_new_tokens))
+            if not any(can_accept(self.engines[j], shim)
+                       for j in range(self.n)
+                       if self.role_of[j] == "decode"):
+                raise ValueError(
+                    f"request (prompt {len(prompt)} + max_new_tokens "
+                    f"{max_new_tokens}) can never fit any decode "
+                    "replica of the disaggregated fleet")
         i = self._place(prompt)
         req = self.engines[i].submit(prompt, max_new_tokens, **kw)
         self._commit_place(prompt, i)       # only an ACCEPTED submit
@@ -301,38 +460,156 @@ class Router:
         for eng in self.engines:
             if eng.has_work():
                 eng.step()
+        if self.roles is not None:
+            self._harvest()
+
+    def _harvest(self) -> None:
+        """Disaggregated handoff (ISSUE 18): every request that
+        FINISHED PREFILL on a prefill replica this iteration (parked
+        in DECODE state — the replica's decode phase is suppressed)
+        migrates to the least-loaded admitting decode replica with
+        zero re-prefill. A saturated or draining decode side just
+        defers the handoff to the next fleet iteration — the parked
+        residents are the disaggregation backpressure, and their held
+        slots throttle the prefill side's own admission."""
+        for i, eng in enumerate(self.engines):
+            if self.role_of[i] != "prefill":
+                continue
+            ready = sorted(
+                (s for s in eng.sched.slots
+                 if s.request is not None and s.request.state == DECODE),
+                key=lambda s: s.admit_seq, reverse=True)
+            for slot in ready:
+                req = slot.request
+                cand = [j for j in self._admitting()
+                        if self.role_of[j] == "decode"
+                        and can_accept(self.engines[j], req)]
+                if not cand:
+                    return
+                j = self._least_loaded(cand)
+                info = migrate_request(eng, self.engines[j], req.rid)
+                if info is None:
+                    continue        # finished at the handoff commit
+                self._owner[req.rid] = j
+                self.migrations += 1
 
     def drain(self, i: int) -> list[Request]:
-        """Stop admitting to replica i: its WAITING requests requeue to
-        siblings through the normal placement policy (recompute
+        """Stop admitting to replica i: its WAITING requests requeue
+        to siblings through the normal placement policy (recompute
         semantics — identical tokens, queue clock unreset), its
-        RESIDENT requests finish in place, and until :meth:`restart`
-        no new placement chooses it. Returns the moved requests.
-        Refuses to drain the last admitting replica — a fleet with
-        nowhere to admit is an outage, not a drain."""
+        RESIDENT requests LIVE-MIGRATE to the least-loaded compatible
+        sibling (:func:`~.transport.migrate_request` — the KV block
+        set moves, decode resumes with zero re-prefill, so the drain
+        completes without waiting for any resident to finish), and
+        until :meth:`restart` no new placement chooses it. A resident
+        no sibling can take (heterogeneous fleets) finishes in place —
+        the drain event's ``residents_in_place`` counts them. Returns
+        the requeued WAITING requests (the migrated residents keep
+        their engine handles; :meth:`replica_of` tracks both). Refuses
+        to drain the last admitting replica — per role on a
+        disaggregated fleet — a fleet with nowhere to admit is an
+        outage, not a drain."""
         if not 0 <= i < self.n:
             raise ValueError(f"replica {i} out of range [0, {self.n})")
         if i in self._draining:
             raise ValueError(f"replica {i} is already draining")
-        if len(self._admitting()) <= 1:
+        peers_like_i = [j for j in self._admitting()
+                        if j != i and self.role_of[j] == self.role_of[i]]
+        if not peers_like_i:
+            role = ("" if self.roles is None
+                    else f" {self.role_of[i]}-role")
             raise ValueError(
-                "cannot drain the last admitting replica: restart a "
-                "sibling first (a fleet must always have somewhere to "
-                "place work)")
+                f"cannot drain the last admitting{role} replica: "
+                "restart a sibling first (a fleet must always have "
+                "somewhere to place work)")
         self._draining.add(i)
         self.drains += 1
-        moved = self.engines[i].take_waiting()
+        src = self.engines[i]
+        moved = src.take_waiting()
         for req in moved:
-            j = self._place(req.prompt)
-            self.engines[j].adopt(req)          # never rejects
-            self._commit_place(req.prompt, j)
+            if req.swap_set is not None:
+                # a swap-preempted victim changing engines: return the
+                # SOURCE's host-tier reservation (the destination
+                # never reserved for it), and land the restore as a
+                # MIGRATION arrival — its restore traffic is migration
+                # traffic, not the destination's swap-tier traffic
+                src.blocks.host_release(req.swap_set.nbytes)
+                cand = [j for j in self._drain_peers(i, req)
+                        if can_accept(self.engines[j], req)]
+                if cand:
+                    j = self._least_loaded(cand)
+                    src.migrations_out += 1
+                    self.engines[j]._migrated_in[req.rid] = i
+                    self.engines[j].adopt(req)
+                else:
+                    # no compatible sibling for the payload: forfeit
+                    # it — recompute semantics, the swap tier's own
+                    # lossless fallback
+                    req.swap_set = None
+                    req.swap_context = 0
+                    j = self._place(req.prompt)
+                    self.engines[j].adopt(req)
+                    self._commit_place(req.prompt, j)
+            else:
+                j = self._place(req.prompt)
+                self.engines[j].adopt(req)      # never rejects
+                self._commit_place(req.prompt, j)
             self._owner[req.rid] = j
             self.requeues += 1
             obs.serve("requeue", request=req.rid, replica=i,
                       to_replica=j)
+        migrated = 0
+        residents_in_place = 0
+        # snapshot rids: migrating one resident lands the engine's
+        # in-flight pipeline, which can FINISH (or clear) others
+        resident_rids = [
+            s.request.rid for s in sorted(
+                (s for s in src.sched.slots if s.request is not None),
+                key=lambda s: s.admit_seq, reverse=True)]
+        for rid in resident_rids:
+            if rid in src.finished:
+                continue
+            slot = next((s for s in src.sched.slots
+                         if s.request is not None
+                         and s.request.rid == rid), None)
+            if slot is None:
+                continue
+            req = slot.request
+            cand = self._drain_peers(i, req)
+            cand = [j for j in cand if can_accept(self.engines[j], req)]
+            if not cand:
+                residents_in_place += 1
+                continue
+            j = self._least_loaded(cand)
+            try:
+                info = migrate_request(src, self.engines[j], rid)
+            except TransportError:
+                residents_in_place += 1
+                continue
+            if info is None:
+                continue            # finished at the pipeline flush
+            self._owner[rid] = j
+            self.migrations += 1
+            migrated += 1
         obs.serve("drain", replica=i, requeued=len(moved),
+                  migrated=migrated,
+                  residents_in_place=residents_in_place,
                   placement=self.placement)
         return moved
+
+    def _drain_peers(self, i: int, req: Request) -> list[int]:
+        """Where a draining replica's resident may go: any admitting
+        sibling on a mixed fleet; on a disaggregated one, a DECODE
+        resident goes to the decode side (even off a prefill replica —
+        it is exactly a finished prefill awaiting handoff) and a
+        mid-prefill one to another prefill replica."""
+        if self.roles is None:
+            return [j for j in self._admitting() if j != i]
+        want = ("decode"
+                if req.state == DECODE or req.swap_set is not None
+                else "prefill")
+        return [j for j in self._admitting()
+                if j != i and self.role_of[j] == want]
 
     def restart(self, i: int) -> None:
         """Re-admit to a drained replica (its pools/caches/compiled
@@ -415,6 +692,15 @@ class Router:
             "drains": self.drains,
             "requeues": self.requeues,
         }
+        # cross-engine transport (ISSUE 18): absent on migration-free
+        # fleets — the byte-identity contract
+        mig_out = sum(e.migrations_out for e in self.engines)
+        if mig_out:
+            out["migrations"] = mig_out
+            out["migration_bytes"] = sum(
+                e.migration_bytes for e in self.engines)
+            out["migration_restore_s"] = round(
+                sum(e.migration_restore_s for e in self.engines), 6)
         imb = self.replica_load_imbalance()
         if imb is not None:
             out["replica_load_imbalance"] = round(imb, 4)
@@ -466,11 +752,22 @@ class Router:
                     eng.blocks.peak_used
                     / max(eng.blocks.num_blocks - 1, 1), 4),
             }
+            if self.roles is not None:
+                row["role"] = self.role_of[i]
             hit = eng._aggregate_hit_rate()
             if hit is not None:
                 row["cache_hit_rate"] = round(hit, 4)
             per_replica.append(row)
         out["per_replica"] = per_replica
+        if self.roles is not None:
+            out["roles"] = (f"prefill:{self.roles['prefill']},"
+                            f"decode:{self.roles['decode']}")
+            if "slo_attainment" in out:
+                # the disaggregation bench/diff metric: the fleet's
+                # attainment UNDER role separation, named apart so
+                # `obsctl diff` can gate disaggregated runs distinctly
+                out["disagg_slo_attainment"] = out["slo_attainment"]
+            out["per_role"] = self._per_role(reqs)
         ttfts = sorted(r.ttft_s for r in reqs if r.ttft_s is not None)
         e2es = sorted(r.finish_t - r.submit_t for r in reqs
                       if r.finish_t is not None and r.submit_t is not None)
@@ -480,6 +777,57 @@ class Router:
             out[f"{label}_p50_s"] = round(percentile(vals, 0.50), 6)
             out[f"{label}_p95_s"] = round(percentile(vals, 0.95), 6)
             out[f"{label}_p99_s"] = round(percentile(vals, 0.99), 6)
+        return out
+
+    def _per_role(self, reqs) -> dict:
+        """Per-role attribution for a disaggregated fleet (ISSUE 18).
+        Every request prefills on the prefill side and decodes on the
+        decode side, so the split is by PHASE, not by request: the
+        prefill row carries the fleet's TTFT percentiles (first tokens
+        are emitted by the final prefill chunk) and the decode row the
+        TPOT percentiles plus the aggregate decode tokens/sec — the
+        two figures the bench line's no-worse-than-mixed side gates
+        read."""
+        from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+            percentile,
+        )
+
+        def pcts(row, label, vals):
+            vals = sorted(vals)
+            if vals:
+                row[f"{label}_p50_s"] = round(percentile(vals, 0.50), 6)
+                row[f"{label}_p95_s"] = round(percentile(vals, 0.95), 6)
+                row[f"{label}_p99_s"] = round(percentile(vals, 0.99), 6)
+
+        out = {}
+        for role in ("prefill", "decode"):
+            ids = [i for i in range(self.n) if self.role_of[i] == role]
+            engs = [self.engines[i] for i in ids]
+            row: dict = {
+                "replicas": ids,
+                "prefill_chunks": sum(e.prefill_chunks for e in engs),
+                "prefill_dispatches": sum(e.prefill_dispatches
+                                          for e in engs),
+                "decode_steps": sum(e.decode_steps for e in engs),
+                "migrations_out": sum(e.migrations_out for e in engs),
+                "migrations_in": sum(e.migrations_in for e in engs),
+            }
+            if role == "prefill":
+                pcts(row, "ttft",
+                     (r.ttft_s for r in reqs if r.ttft_s is not None))
+            else:
+                pcts(row, "tpot",
+                     ((r.finish_t - r.first_token_t)
+                      / max((len(r.prompt) - r.orig_prompt_len)
+                            + len(r.output) - 1, 1)
+                      for r in reqs
+                      if r.finish_t is not None
+                      and r.first_token_t is not None))
+                dtok = sum(e.decode_tokens for e in engs)
+                dsec = sum(e.decode_time_s for e in engs)
+                if dsec > 0:
+                    row["decode_tokens_per_sec"] = round(dtok / dsec, 1)
+            out[role] = row
         return out
 
     @contextlib.contextmanager

@@ -361,6 +361,21 @@ class Scheduler:
         drain shows up as queue time, never as a reset clock."""
         self.waiting.append(request)
 
+    def adopt_resident(self, request: Request) -> None:
+        """Migration hook (ISSUE 18): enqueue a sibling engine's LIVE
+        resident at the queue FRONT. The request already held a slot —
+        it carries its extracted block set (``swap_set``, restored
+        through :meth:`_reserve_swapped` with zero re-prefill) or, for
+        a mid-prefill cold move, just its unmodified prompt — and a
+        migration must not demote it behind work that was never
+        admitted. FIFO order among multiple migrants is the CALLER's
+        job (insert in reverse admission order); validation is skipped
+        for the same reason :meth:`adopt` skips it, except that a
+        HETEROGENEOUS destination's geometry is no longer covered by
+        the original submit — :func:`~.transport.can_accept` re-checks
+        it before the transplant."""
+        self.waiting.insert(0, request)
+
     # -- admission -----------------------------------------------------------
 
     def padded_prompt_len(self, request: Request) -> int:

@@ -65,6 +65,18 @@ dominant miss phase (the figures ``obsctl goodput`` recomputes from
 the telemetry stream). ``--slo`` without ``--arrival`` judges the
 closed-loop trace from submit time.
 
+``--roles prefill:N,decode:M`` (``HSTD_SERVE_ROLES``, default off)
+serves DISAGGREGATED (ISSUE 18): N prefill-only replicas run chunked
+prefill at the full token budget and hand each finished request's live
+KV block set to the least-loaded decode replica over
+``serve/transport.py`` — zero re-prefill, token-identical output. The
+summary gains ``roles``, ``migrations``/``migration_bytes`` and a
+``per_role`` breakdown (prefill-side TTFT percentiles, decode-side
+TPOT percentiles + tokens/sec). Requires ``--replicas`` unset or equal
+to N+M. The same transport powers ``Router.drain``: draining a replica
+now live-migrates its RESIDENT requests to siblings mid-decode instead
+of waiting them out, so rolling restarts are preemption-free.
+
 ``--swap auto|always|never|off`` (``HSTD_SERVE_SWAP``, default off)
 turns on the host-RAM KV spill tier (ISSUE 17): preemption victims
 swap their KV block sets to host and restore on re-admit without
@@ -252,6 +264,14 @@ def main() -> None:
                              "the replica holding the longest cached "
                              "prefix, imbalance-bounded; default: "
                              "HSTD_SERVE_PLACEMENT or round_robin)")
+    parser.add_argument("--roles", default=None,
+                        help="disaggregated prefill/decode fleet, "
+                             "prefill:N,decode:M — prefill-only "
+                             "replicas hand finished KV block sets to "
+                             "decode replicas over the transport "
+                             "primitive, token-identically (default: "
+                             "HSTD_SERVE_ROLES or off = mixed "
+                             "replicas)")
     parser.add_argument("--overlap", default=None,
                         choices=("on", "off"),
                         help="dispatch-ahead decode loop: host "
@@ -306,12 +326,14 @@ def main() -> None:
     )
     from huggingface_sagemaker_tensorflow_distributed_tpu.serve.router import (
         Router,
+        parse_roles,
     )
 
     try:
         arrival = parse_arrival(args.arrival)
         arrival_seed = parse_arrival_seed()
         slo_spec = parse_slo(args.slo)
+        roles = parse_roles(args.roles)
     except ValueError as e:
         raise SystemExit(f"serve: {e}")
 
@@ -326,7 +348,7 @@ def main() -> None:
     # default) is a pass-through whose engine behavior AND telemetry
     # stream are byte-identical to building the ServeEngine directly
     router = Router(model, params, replicas=args.replicas,
-                    placement=args.placement,
+                    placement=args.placement, roles=roles,
                     num_slots=args.num_slots,
                     block_size=args.block_size, num_blocks=num_blocks,
                     prefill_chunk=args.prefill_chunk,
@@ -467,6 +489,12 @@ def main() -> None:
             "kv_dtype": engine.kv_cache_dtype,
             "tp": engine.tp,
             "per_replica": rslo.get("per_replica"),
+            **({"roles": rslo.get("roles"),
+                "per_role": rslo.get("per_role"),
+                "migrations": router.migrations,
+                "migration_bytes": sum(
+                    s.migration_bytes for s in stats_all)}
+               if router.roles is not None else {}),
             **({"swap_policy": engine.swap,
                 "swap_outs": sum(s.swap_outs for s in stats_all),
                 "swap_ins": sum(s.swap_ins for s in stats_all),

@@ -106,7 +106,11 @@ def three_hosts(tmp_path):
                               restore_s=0.02,
                               recompute_tokens_avoided=320,
                               host_tier_hits=12,
-                              host_tier_hit_rate=0.92))
+                              host_tier_hit_rate=0.92,
+                              roles="prefill:1,decode:1",
+                              migrations=6, migration_bytes=1 << 18,
+                              migration_restore_s=0.015,
+                              disagg_slo_attainment=0.96))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -793,6 +797,103 @@ def test_diff_host_tier_hit_rate_is_down_worse_ratio(three_hosts):
         d = diff_reports(a, b, threshold_pct=5.0)
         assert "serve_host_tier_hit_rate" in d["skipped"]
         assert "serve_host_tier_hit_rate" not in d["regressions"]
+
+
+def test_diff_migration_bytes_is_up_worse(three_hosts):
+    """ISSUE 18: `serve_migration_bytes` (KV bytes moved between
+    engines by the transport) diffs as a bytes metric whose worse
+    direction is UP — more cross-engine traffic for the same trace
+    means the harvest loop or drain policy started moving work a
+    steady fleet would have left in place. Standard threshold +
+    zero-baseline rules, poison rows skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["migration_bytes"] == 1 << 18
+    worse = copy.deepcopy(base)
+    worse["serve"]["migration_bytes"] = 4 << 18   # transport thrashing
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_migration_bytes" in d["regressions"]
+    assert d["metrics"]["serve_migration_bytes"][
+        "worse_direction"] == "up"
+    # less transport traffic never flags; nor does sub-threshold drift
+    assert "serve_migration_bytes" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["migration_bytes"] = int(1.02 * (1 << 18))
+    assert "serve_migration_bytes" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # zero baseline (mixed fleet, no drains — transport idle): bytes
+    # appearing must still flag though the percentage is undefined
+    zero = copy.deepcopy(base)
+    zero["serve"]["migration_bytes"] = 0
+    worse0 = copy.deepcopy(zero)
+    worse0["serve"]["migration_bytes"] = 1 << 16
+    d0 = diff_reports(zero, worse0, threshold_pct=5.0)
+    assert "serve_migration_bytes" in d0["regressions"]
+    assert d0["metrics"]["serve_migration_bytes"]["pct"] is None
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["migration_bytes"] = "heavy"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["migration_bytes"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_migration_bytes" in d["skipped"]
+        assert "serve_migration_bytes" not in d["regressions"]
+
+
+def test_diff_disagg_slo_attainment_is_down_worse_ratio(three_hosts):
+    """ISSUE 18: `serve_disagg_slo_attainment` (deadline attainment of
+    the disaggregated fleet) diffs as a ratio metric whose worse
+    direction is DOWN — the split fleet's headline eroding means role
+    separation stopped paying (stalled handoffs, a starved decode
+    side, migration overhead eating the TTFT win). Standard threshold
+    rules, poison rows skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["disagg_slo_attainment"] == pytest.approx(0.96)
+    worse = copy.deepcopy(base)
+    worse["serve"]["disagg_slo_attainment"] = 0.6
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_disagg_slo_attainment" in d["regressions"]
+    assert d["metrics"]["serve_disagg_slo_attainment"][
+        "worse_direction"] == "down"
+    # attainment improving never flags; nor does a sub-threshold dip
+    assert "serve_disagg_slo_attainment" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["disagg_slo_attainment"] = 0.94   # ~-2.1%
+    assert "serve_disagg_slo_attainment" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # zero baseline (fully-missing run): attainment moving OFF zero is
+    # the better direction — only drops flag
+    zero = copy.deepcopy(base)
+    zero["serve"]["disagg_slo_attainment"] = 0.0
+    d0 = diff_reports(zero, base, threshold_pct=5.0)
+    assert "serve_disagg_slo_attainment" not in d0["regressions"]
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["disagg_slo_attainment"] = "mostly"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["disagg_slo_attainment"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_disagg_slo_attainment" in d["skipped"]
+        assert "serve_disagg_slo_attainment" not in d["regressions"]
 
 
 def test_diff_poisoned_lifecycle_metrics_skip_not_crash(three_hosts):

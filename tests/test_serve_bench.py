@@ -21,8 +21,8 @@ def test_serve_bench_smoke(capsys, tmp_path):
 
     obs.reset(out_dir=str(tmp_path / "telemetry"), enabled=True)
     try:
-        (mixed, bucketed, spec, prefix, paged,
-         overlap, tp, router, open_loop, kv_swap) = bench_serve(smoke=True)
+        (mixed, bucketed, spec, prefix, paged, overlap, tp, router,
+         open_loop, kv_swap, disagg) = bench_serve(smoke=True)
     finally:
         obs.reset()
     detail = mixed["detail"]
@@ -209,12 +209,36 @@ def test_serve_bench_smoke(capsys, tmp_path):
     assert wdetail["compiles_steady_swap"] == 0     # strict: fixed geometry
     assert wdetail["compiles_steady_recompute"] == 0
     assert wdetail["compiles_steady_off"] == 0
+    # the ISSUE 18 disaggregated prefill/decode line: the structural
+    # gates are deterministic and enforced at smoke scale too — the
+    # split fleet's outputs token-identical to the mixed fleet's,
+    # byte-identical virtual replay, role separation airtight (zero
+    # decode iterations on the prefill replica, zero prefill
+    # dispatches on the decode replica), EVERY request crossing the
+    # transport exactly once with real bytes moved, compile flatness
+    # (migration reuses the swap-tier gather/scatter); only the ≥1.1x
+    # attainment ratio + per-side no-worse claims wait for the full
+    # CPU trace
+    ddetail = disagg["detail"]
+    assert disagg.get("error") is None
+    assert disagg["value"] is not None
+    assert ddetail["ratio_gated"] is False          # smoke: no >=1.1x
+    assert ddetail["exact_match"] is True           # disagg == mixed
+    assert ddetail["replay_identical"] is True
+    assert ddetail["migrations"] == ddetail["requests"]
+    assert ddetail["migration_bytes"] > 0
+    assert ddetail["compiles_steady"] <= 2 * len(
+        ddetail["gather_buckets"])
+    # the per-role attribution rides the line: prefill rows own TTFT,
+    # decode rows own TPOT + tokens/sec
+    assert ddetail["per_role"]["prefill"]["ttft_p99_s"] > 0
+    assert ddetail["per_role"]["decode"]["decode_tokens_per_sec"] > 0
     # the stdout lines are the driver contract: parseable JSON, all
-    # ten metrics present
+    # eleven metrics present
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
     metrics = [json.loads(ln)["metric"] for ln in lines]
-    assert metrics[-10:] == ["serve_continuous_vs_static_speedup",
+    assert metrics[-11:] == ["serve_continuous_vs_static_speedup",
                              "serve_bucketed_gather_decode_speedup",
                              "serve_speculative_decode_speedup",
                              "serve_prefix_cache_ttft_speedup",
@@ -223,7 +247,8 @@ def test_serve_bench_smoke(capsys, tmp_path):
                              "serve_tp_shard_capacity",
                              "serve_router_scaleout",
                              "serve_open_loop_goodput",
-                             "serve_kv_swap_vs_recompute"]
+                             "serve_kv_swap_vs_recompute",
+                             "serve_disagg_goodput"]
 
 
 @pytest.mark.slow
@@ -410,3 +435,29 @@ def test_serve_bench_full_kv_swap_trace(capsys):
     assert detail["swap_outs"] > 0
     assert detail["recompute_tokens_avoided"] > 0
     assert detail["cache_hit_rate_tier"] > detail["cache_hit_rate_off"]
+
+
+@pytest.mark.slow
+def test_serve_bench_full_disagg_trace(capsys):
+    """The full CPU prefill-heavy open-loop trace — the ISSUE 18
+    acceptance surface where the ratio IS enforced in the line: a
+    1 prefill + 1 decode pair must beat 2 mixed replicas on SLO
+    attainment by ≥ 1.1× (measured 4.0x on this container — the mixed
+    fleet's slot-cycle capacity collapses under the arrival rate while
+    the prefill-only replica's slots recycle at migration), with the
+    per-side no-worse claims (prefill-side TTFT p99, decode-side
+    tokens/sec ≥ 0.9x) and every deterministic gate the smoke tier
+    already pins."""
+    from benchmarks.serve_bench import bench_serve_disagg
+
+    result = bench_serve_disagg(smoke=False)
+    assert result.get("error") is None
+    assert result["value"] is not None and result["value"] >= 1.1
+    detail = result["detail"]
+    assert detail["ratio_gated"] is True
+    assert detail["exact_match"] is True
+    assert detail["replay_identical"] is True
+    assert detail["migrations"] == detail["requests"]
+    assert detail["ttft_p99_s_disagg"] <= detail["ttft_p99_s_mixed"]
+    assert (detail["decode_tokens_per_sec_disagg"]
+            >= 0.9 * detail["decode_tokens_per_sec_mixed"])

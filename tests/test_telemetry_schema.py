@@ -655,6 +655,50 @@ def test_validator_rejects_mistyped_serve_optional_fields(tmp_path):
     assert "optional field 'swap_ins'" in proc3.stdout
     assert "optional field 'host_tier_hits'" in proc3.stdout
     assert "optional field 'host_tier_hit_rate'" in proc3.stdout
+    # ISSUE 18 cross-engine transport fields: the migrate event and
+    # the fleet report riders are typed when present, so a drifted
+    # emitter can't poison the migration-traffic / disagg-attainment
+    # accounting `obsctl diff` gates
+    bad4 = tmp_path / "migrate_events.jsonl"
+    rows4 = [
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "migrate", "request": 3, "from_replica": 0,
+         "to_replica": 1, "migration_bytes": 1 << 16,
+         "restore_s": 0.01},                                     # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "migrate", "request": 4, "from_replica": "zero",
+         "migration_bytes": "heavy"},                            # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "drain", "replica": 0, "requeued": 2,
+         "migrated": 3, "residents_in_place": 0},                # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "drain", "replica": 0, "migrated": "all",
+         "residents_in_place": 0.5},                             # drift
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "report", "roles": "prefill:1,decode:1",
+         "migrations": 8, "migrations_in": 8, "migrations_out": 8,
+         "migration_restore_s": 0.2, "per_role": {"prefill": {}},
+         "disagg_slo_attainment": 0.97},                         # ok
+        {"v": 1, "t": 1.0, "host": 0, "pid": 1, "type": "serve",
+         "event": "report", "roles": 2, "migrations": "many",
+         "migrations_in": 8.5, "migrations_out": [8],
+         "migration_restore_s": "slow", "per_role": "both",
+         "disagg_slo_attainment": "mostly"},                     # drift
+    ]
+    bad4.write_text("\n".join(json.dumps(r) for r in rows4) + "\n")
+    proc4 = _run(str(bad4))
+    assert proc4.returncode == 1
+    assert "optional field 'from_replica'" in proc4.stdout
+    assert "optional field 'migration_bytes'" in proc4.stdout
+    assert "optional field 'migrated'" in proc4.stdout
+    assert "optional field 'residents_in_place'" in proc4.stdout
+    assert "optional field 'roles'" in proc4.stdout
+    assert "optional field 'migrations'" in proc4.stdout
+    assert "optional field 'migrations_in'" in proc4.stdout
+    assert "optional field 'migrations_out'" in proc4.stdout
+    assert "optional field 'migration_restore_s'" in proc4.stdout
+    assert "optional field 'per_role'" in proc4.stdout
+    assert "optional field 'disagg_slo_attainment'" in proc4.stdout
 
 
 def test_validator_accepts_anomaly_and_flight_artifacts(tmp_path):
