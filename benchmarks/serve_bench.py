@@ -2313,9 +2313,12 @@ def bench_serve_disagg(smoke: bool = False) -> dict:
     Sarathi budget; the prefill replica hands each finished block set
     to the decode side over the transport primitive and keeps its slots
     free), so token identity, role separation, full migration coverage,
-    replay determinism and compile flatness gate at every scale; the
-    attainment ratio and the per-side no-worse claims gate on the full
-    CPU trace only."""
+    replay determinism, compile flatness and the fleet-tracing stitch
+    (ISSUE 19: every migrated request reassembles into one complete
+    cross-engine trace whose hop-aware decomposition checks out and
+    whose fleet TTFT attribution reconciles with the per-role riders)
+    gate at every scale; the attainment ratio and the per-side
+    no-worse claims gate on the full CPU trace only."""
     import jax.numpy as jnp
 
     from huggingface_sagemaker_tensorflow_distributed_tpu import obs
@@ -2389,11 +2392,12 @@ def bench_serve_disagg(smoke: bool = False) -> dict:
         prompt_lo=prompt_lo, prompt_hi=prompt_hi, new_lo=new_lo,
         new_hi=new_hi, eos_token_id=cfg.eos_token_id)
 
-    def serve_once(disagg: bool):
+    def serve_once(disagg: bool, traced: bool = False):
+        rkw = dict(kw, timeline="on", trace="on") if traced else kw
         r = (Router(model, params, roles={"prefill": 1, "decode": 1},
-                    **kw) if disagg
+                    **rkw) if disagg
              else Router(model, params, replicas=2,
-                         placement="round_robin", **kw))
+                         placement="round_robin", **rkw))
         drv = OpenLoopDriver(r, schedule, clock="virtual", tick_s=tick,
                              slo=slo, process="poisson", rate=rate)
         finished = drv.run()
@@ -2413,6 +2417,68 @@ def bench_serve_disagg(smoke: bool = False) -> dict:
         dis_b = serve_once(True)             # fresh replay, same seed
         mix = serve_once(False)
     compile_delta = (tracker.count - count0) if tracker else None
+
+    # -- trace gate (ISSUE 19): one traced pass, timeline ON, into a
+    # private telemetry sink so the stitcher reads only its own event
+    # stream. Fleet tracing must hold this workload perfectly: tracing
+    # must not perturb tokens, every migrated request must stitch into
+    # ONE complete cross-engine trace, every stitched trace must pass
+    # the hop-aware decomposition check, and the stitcher's fleet TTFT
+    # attribution must reconcile EXACTLY with the router's own
+    # per-role report riders (same nearest-rank percentile, same
+    # 6-decimal rounding — any daylight is an attribution bug, not
+    # noise). Deterministic, so it gates at every scale.
+    import os
+    import shutil
+    import tempfile
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.timeline import (
+        load_events,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.trace import (
+        check_trace,
+        collect_traces,
+        fleet_summary,
+    )
+
+    trace_sink = tempfile.mkdtemp(prefix="serve_disagg_trace_")
+    obs.reset(out_dir=trace_sink, enabled=True)
+    try:
+        with obs.span("bench/serve_disagg_traced"):
+            traced = serve_once(True, traced=True)
+        obs.flush()
+        tr_events, tr_errors = load_events(
+            [os.path.join(trace_sink, "events.jsonl")])
+    finally:
+        obs.reset()                  # restore the env-configured sink
+        shutil.rmtree(trace_sink, ignore_errors=True)
+    stitched = collect_traces(tr_events)
+    fleet = fleet_summary(stitched)
+    stitch_problems = [p for t in stitched for p in check_trace(t)]
+    fleet_pr = (fleet.get("per_role") or {}).get("prefill") or {}
+    router_pr = (traced["slo"].get("per_role") or {}).get("prefill") or {}
+    ttft_keys = ("ttft_p50_s", "ttft_p95_s", "ttft_p99_s")
+    reconciled = all(
+        fleet_pr.get(k) is not None and fleet_pr.get(k) == router_pr.get(k)
+        for k in ttft_keys)
+    trace_ok = (not tr_errors
+                and traced["outs"] == dis_a["outs"]
+                and len(stitched) == n_req
+                and fleet.get("complete_traces") == n_req
+                and fleet.get("trace_stitch_failures") == 0
+                and all(len(t["migrates"]) >= 1 for t in stitched)
+                and not stitch_problems
+                and reconciled)
+    # the stitch summary event rides the AMBIENT stream (restored
+    # above) so `obsctl report|diff` see the counters next to the SLO
+    # percentiles; no-op when the driver runs without telemetry
+    obs.serve("trace_stitch",
+              traces=fleet.get("traces", 0),
+              complete_traces=fleet.get("complete_traces", 0),
+              trace_stitch_failures=fleet.get("trace_stitch_failures", 0),
+              **({"transport_hop_s_p99": fleet["transport_hop_s_p99"]}
+                 if isinstance(fleet.get("transport_hop_s_p99"),
+                               (int, float)) else {}))
 
     # -- gates (deterministic, enforced at every scale) ---------------
     exact = dis_a["outs"] == mix["outs"]
@@ -2449,7 +2515,7 @@ def bench_serve_disagg(smoke: bool = False) -> dict:
                 and tps_dis is not None and tps_mix is not None
                 and tps_dis >= 0.9 * tps_mix)
     gate_ok = (exact and replay_ok and roles_ok and migrations_ok
-               and compiles_ok
+               and compiles_ok and trace_ok
                and (smoke or on_tpu or (ratio >= 1.1 and sides_ok)))
 
     result = {
@@ -2491,6 +2557,13 @@ def bench_serve_disagg(smoke: bool = False) -> dict:
             "compiles_steady": compile_delta,
             "replay_identical": replay_ok,
             "exact_match": exact,
+            "traces_stitched": fleet.get("traces", 0),
+            "traces_complete": fleet.get("complete_traces", 0),
+            "trace_stitch_failures":
+                fleet.get("trace_stitch_failures", 0),
+            "trace_decomposition_errors": len(stitch_problems),
+            "trace_ttft_reconciled": reconciled,
+            "transport_hop_s_p99": fleet.get("transport_hop_s_p99"),
             "model_scale": ("smoke" if smoke
                             else "real" if on_tpu else "cpu"),
             "ratio_gated": not (smoke or on_tpu),
@@ -2503,6 +2576,7 @@ def bench_serve_disagg(smoke: bool = False) -> dict:
             else "role_separation_leaked" if not roles_ok
             else "transport_not_exercised" if not migrations_ok
             else "steady_state_recompiled" if not compiles_ok
+            else "trace_stitch_incomplete" if not trace_ok
             else "disagg_goodput_below_gate")
     return _emit(result, anomaly_field, memory_watermark,
                  "bench/serve_disagg_goodput")

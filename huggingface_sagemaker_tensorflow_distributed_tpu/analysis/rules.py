@@ -262,11 +262,32 @@ def _schema_serve_fields(project: Project) -> Optional[set]:
     return fields if found else None
 
 
+def _schema_serve_events(project: Project) -> Optional[set]:
+    """The serve-event vocabulary, extracted STATICALLY from the
+    schema module's ``SERVE_EVENTS`` tuple literal (ISSUE 19) — same
+    no-import contract as the field extraction."""
+    sf = project.files.get(R4_SCHEMA)
+    if sf is None:
+        return None
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        if "SERVE_EVENTS" not in names:
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return None
+
+
 def check_r4(project: Project) -> list[Finding]:
     fields = _schema_serve_fields(project)
     if fields is None:
         return []          # no schema in scope (stdin / partial tree)
     allowed = fields | {"event"}
+    events = _schema_serve_events(project)
     findings = []
     for path in sorted(project.files):
         if path == R4_SCHEMA:
@@ -275,6 +296,21 @@ def check_r4(project: Project) -> list[Finding]:
             if not (isinstance(node, ast.Call)
                     and dotted_name(node.func) == "obs.serve"):
                 continue
+            # the event KIND (the literal first positional arg) must
+            # come from the schema's SERVE_EVENTS vocabulary (ISSUE
+            # 19) — an invented kind is the same silent drift for
+            # consumers that switch on `event` as an undeclared field
+            # is for ones that type-check kwargs
+            if (events is not None and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value not in events):
+                findings.append(Finding(
+                    "R4", path, node.lineno,
+                    f"serve-event kind {node.args[0].value!r} is not "
+                    "declared in obs/schema.py SERVE_EVENTS — "
+                    "undeclared kinds are silent schema drift; add it "
+                    "to the vocabulary"))
             for kw in node.keywords:
                 if kw.arg is None:       # **dynamic: not checkable here
                     continue
@@ -412,7 +448,8 @@ RULES: dict[str, Rule] = {
     "R4": Rule(
         "R4", "telemetry-field-contract",
         "string field keys passed to obs.serve() must exist in "
-        "obs/schema.py, so schema drift fails lint instead of "
+        "obs/schema.py, and literal event kinds in its SERVE_EVENTS "
+        "vocabulary, so schema drift fails lint instead of "
         "surfacing only when a test exercises the emitting path.",
         check_r4),
     "R5": Rule(

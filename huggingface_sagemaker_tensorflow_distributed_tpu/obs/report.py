@@ -203,8 +203,19 @@ def _serve_summary(events: list[dict]) -> Optional[dict]:
     reports = [e for e in serves if e.get("event") == "report"]
     if reports:
         last = reports[-1]
-        return {k: v for k, v in last.items()
-                if k not in ("v", "t", "host", "pid", "type", "event")}
+        out = {k: v for k, v in last.items()
+               if k not in ("v", "t", "host", "pid", "type", "event")}
+        # Fleet tracing (ISSUE 19): the stitch summary is emitted as a
+        # separate ``trace_stitch`` event (the stitcher runs AFTER the
+        # router's final report) — overlay its fields so the trace
+        # counters reach the scalar/diff surface alongside the SLO
+        # percentiles. Last one wins, like the report event itself.
+        stitches = [e for e in serves if e.get("event") == "trace_stitch"]
+        if stitches:
+            out.update({k: v for k, v in stitches[-1].items()
+                        if k not in ("v", "t", "host", "pid", "type",
+                                     "event")})
+        return out
     ttfts = [e.get("ttft_s") for e in serves
              if e.get("event") == "first_token"
              and e.get("ttft_s") is not None]
@@ -412,6 +423,21 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # is obviously wrong; ratio kind like serve_slo_attainment (only
     # drops flag; a 0.0 baseline is a fully-missing run).
     "serve_disagg_slo_attainment": (-1, "ratio"),
+    # fleet tracing (ISSUE 19): stitch failures, worse UP — a healthy
+    # fleet stitches EVERY traced request into one complete causal
+    # chain, so any count here means an engine dropped a hop's
+    # evidence (torn event tail, a finish racing a migrate, a stamp
+    # regression in the propagation path). Count kind: the baseline is
+    # exactly zero and ANY appearance is a correctness regression, not
+    # a percentage move.
+    "serve_trace_stitch_failures": (+1, "count"),
+    # per-hop transport latency p99, worse UP — the stitched view of
+    # what ONE migration hop costs end to end (extract + wire + restore
+    # + destination admission). Growth here flags transport regressions
+    # (a serialization slowdown, a saturated restore path) before the
+    # fleet TTFT percentiles absorb them. Ratio kind under the shared
+    # zero-baseline rule.
+    "serve_transport_hop_s_p99": (+1, "ratio"),
 }
 
 
@@ -451,7 +477,8 @@ def _report_scalars(report: dict) -> dict:
                 "kv_pool_bytes_per_device", "replica_load_imbalance",
                 "slo_attainment", "arrival_backlog_peak",
                 "swap_bytes", "host_tier_hit_rate",
-                "migration_bytes", "disagg_slo_attainment"):
+                "migration_bytes", "disagg_slo_attainment",
+                "trace_stitch_failures", "transport_hop_s_p99"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out

@@ -259,8 +259,43 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               "roles": (str,),
               "role": (str,),
               "per_role": (dict,),
-              "disagg_slo_attainment": _NUM},
+              "disagg_slo_attainment": _NUM,
+              # fleet-level distributed tracing (ISSUE 19): the
+              # router-minted trace context every lifecycle event of a
+              # traced request carries — `trace_id` names the request
+              # fleet-wide, `hop` counts its inter-engine moves (0 on
+              # the placement engine; migrate/requeue advance it).
+              # Hot `migrate` events additionally price the hop:
+              # `transport_hop_s` (source extraction stamp ->
+              # destination scatter complete) with `extract_s` split
+              # out so the stitcher (obs/trace.py) can telescope pure
+              # data movement against admission wait. The bench's
+              # `trace_stitch` summary event and the router report's
+              # transport_hop_s_p99 rider carry the fleet aggregates
+              # `obsctl diff` gates. All absent on untraced runs —
+              # the byte-identity contract.
+              "trace_id": (str,),
+              "hop": (int,),
+              "extract_s": _NUM,
+              "transport_hop_s": _NUM,
+              "transport_hop_s_p99": _NUM,
+              "traces": (int,),
+              "complete_traces": (int,),
+              "trace_stitch_failures": (int,)},
 }
+
+# The serve-event vocabulary: every literal first argument an
+# `obs.serve(...)` call site may pass. graftlint's telemetry-contract
+# rule (analysis/rules.py R4) extracts this tuple STATICALLY (it must
+# stay a pure literal) and flags any emitter inventing an event kind
+# outside it — the same no-silent-drift contract the field registry
+# above enforces for kwargs.
+SERVE_EVENTS = (
+    "submit", "admit", "first_token", "finish", "preempt",
+    "bucket_switch", "report", "request_timeline", "iteration_ledger",
+    "open_loop", "swap_out", "swap_in", "migrate", "drain", "requeue",
+    "restart", "trace_stitch",
+)
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)
 

@@ -229,19 +229,32 @@ def gantt_text(records: list[dict], width: int = 48) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _track_key(rec: dict) -> tuple:
+    """The viewer-track identity of one record: the emitting process
+    PLUS the replica tag (ISSUE 19). A multi-replica router runs its
+    whole fleet in ONE OS process, so (host, pid) alone folded two
+    replicas' identically-named spans onto one track — the
+    single-engine assumption this fixes. Untagged records sort first
+    (replica -1), so single-engine exports keep their pid 0."""
+    host, pid = _proc_key(rec)
+    rep = rec.get("replica")
+    return (host, pid, rep if isinstance(rep, int) else -1)
+
+
 def chrome_trace(records: list[dict]) -> dict:
     """Chrome-trace-viewer projection: ``pid`` = a stable index over
-    the distinct emitting processes (sorted (host, pid) envelope
-    pairs — one viewer process-row per serve process, so rid
-    collisions across hosts OR same-host runs never merge), ``tid`` =
-    request, one complete ("X") event per segment, timestamps in
-    microseconds on the shared wall clock (each record's emission time
-    anchors its request's submit instant at ``t - e2e_s``).
-    Deterministic: derived from event fields only, rows in
-    (host, pid, request-id) order; the real host/pid ride each
-    event's ``args``."""
+    the distinct emitting tracks (sorted (host, pid, replica) keys —
+    one viewer process-row per serve process AND per router replica,
+    so rid collisions across hosts, same-host runs, or same-process
+    replicas never merge), ``tid`` = request, one complete ("X")
+    event per segment, timestamps in microseconds on the shared wall
+    clock (each record's emission time anchors its request's submit
+    instant at ``t - e2e_s``). Deterministic: derived from event
+    fields only, rows in (host, pid, replica, request-id) order; the
+    real host/pid (and replica, when tagged) ride each event's
+    ``args``."""
     proc_index = {key: i for i, key in enumerate(
-        sorted({_proc_key(r) for r in records}))}
+        sorted({_track_key(r) for r in records}))}
     trace = []
     for rec in records:
         submit_wall = float(rec.get("t", 0.0)) - float(
@@ -255,6 +268,8 @@ def chrome_trace(records: list[dict]) -> dict:
             args["request"] = rec["request"]
             args["host"] = host
             args["os_pid"] = pid
+            if isinstance(rec.get("replica"), int):
+                args.setdefault("replica", rec["replica"])
             if rec.get("group"):
                 args["group"] = rec["group"]
             trace.append({
@@ -263,7 +278,7 @@ def chrome_trace(records: list[dict]) -> dict:
                 "ts": round((submit_wall
                              + float(seg.get("t0", 0.0))) * 1e6, 3),
                 "dur": round(float(seg.get("dur", 0.0)) * 1e6, 3),
-                "pid": proc_index[_proc_key(rec)],
+                "pid": proc_index[_track_key(rec)],
                 "tid": int(rec["request"]),
                 "args": args,
             })

@@ -1374,6 +1374,15 @@ class ServeEngine:
         self.migration_bytes = 0
         self.migration_restore_s = 0.0
         self._migrated_in: dict = {}
+        # fleet tracing (ISSUE 19): per-hop transport seconds observed
+        # at this engine's restore applies (migrate-out stamp →
+        # scatter-complete), the sample list behind the router's
+        # transport_hop_s_p99 rider. _migrate_hold marks rids whose
+        # NEXT admission closes a migration hold — the stamp tags that
+        # preempted segment `via: "migrate"` so the stitcher can split
+        # cross-engine admission wait out of same-engine preemption.
+        self.transport_hop_s: list = []
+        self._migrate_hold: set = set()
         # role-designated prefill replica (ISSUE 18): the Router flips
         # this on disaggregated fleets; _step then suppresses the
         # decode phase entirely and finished prefills park in DECODE
@@ -1430,6 +1439,15 @@ class ServeEngine:
         to the pre-router stream."""
         return {} if self.replica is None else {"replica": self.replica}
 
+    def _trace_kw(self, req: Request) -> dict:
+        """``{"trace_id": ..., "hop": ...}`` when the request carries a
+        router-minted trace context (ISSUE 19), ``{}`` otherwise — the
+        absent-when-default twin of :meth:`_replica_kw`: untraced runs
+        emit byte-identical events to the pre-tracing stream."""
+        if not req.trace_id:
+            return {}
+        return {"trace_id": req.trace_id, "hop": req.hop}
+
     def take_waiting(self) -> list[Request]:
         """Drain hook (ISSUE 14): remove and return every WAITING
         request (the scheduler's :meth:`~.scheduler.Scheduler.
@@ -1471,6 +1489,8 @@ class ServeEngine:
             self._migrated_in[req.rid] = from_replica
         else:
             self.migrations_in += 1
+        if req.trace_id:
+            self._migrate_hold.add(req.rid)
         if req.sampled:
             self._keys[req.rid] = np.asarray(jax.random.PRNGKey(req.seed),
                                              np.uint32)
@@ -1500,7 +1520,7 @@ class ServeEngine:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, seed: int = 0,
                group: str = "", arrival_s: Optional[float] = None,
-               slo=None) -> Request:
+               slo=None, trace_id: str = "") -> Request:
         """Queue one request. ``temperature == 0`` (default) is greedy;
         ``temperature > 0`` samples with the given truncation knobs,
         seeded per request — same knob semantics as
@@ -1529,7 +1549,8 @@ class ServeEngine:
                       slo_ttft_s=(None if slo is None or slo.ttft_s is None
                                   else float(slo.ttft_s)),
                       slo_tpot_s=(None if slo is None or slo.tpot_s is None
-                                  else float(slo.tpot_s)))
+                                  else float(slo.tpot_s)),
+                      trace_id=str(trace_id))
         req.submit_t = time.perf_counter()
         self.sched.submit(req)
         if req.sampled:
@@ -1548,7 +1569,8 @@ class ServeEngine:
         obs.serve("submit", request=req.rid,
                   prompt_len=len(req.prompt),
                   max_new_tokens=req.max_new_tokens,
-                  sampled=req.sampled, **self._replica_kw(), **extra)
+                  sampled=req.sampled, **self._replica_kw(),
+                  **self._trace_kw(req), **extra)
         return req
 
     def output_ids(self, req: Request) -> np.ndarray:
@@ -1968,7 +1990,8 @@ class ServeEngine:
                 extra["prefix_cached_tokens"] = slot.prefill_pos
             obs.serve("admit", request=slot.request.rid, slot=slot.index,
                       queue_depth=len(self.sched.waiting),
-                      **self._replica_kw(), **extra)
+                      **self._replica_kw(),
+                      **self._trace_kw(slot.request), **extra)
         if self.timeline and self.sched.waiting:
             # admission-block attribution: FIFO means only the HEAD of
             # the queue is ever capacity-blocked (everyone behind it is
@@ -2092,7 +2115,8 @@ class ServeEngine:
         caller drained the pipeline first when this could preempt)."""
         for req in self.sched.ensure_decode_capacity():
             obs.serve("preempt", request=req.rid,
-                      reason="kv_pool_exhausted", **self._replica_kw())
+                      reason="kv_pool_exhausted", **self._replica_kw(),
+                      **self._trace_kw(req))
             if self.timeline:
                 # the preempted interval runs from here to re-admission;
                 # emit the partial timeline NOW so a request that never
@@ -2676,6 +2700,20 @@ class ServeEngine:
         dt = max(now - t_from, 0.0)
         req.phase_s[phase] += dt
         seg = {"ph": phase, "t0": t_from - req.submit_t, "dur": dt}
+        if req.trace_id:
+            # fleet tracing (ISSUE 19): segments carry WHERE they ran,
+            # and a segment that closes a migration hold says so — the
+            # stitcher splits cross-engine admission wait (`via:
+            # "migrate"`, priced net of the source's extraction
+            # seconds) out of same-engine preemption. Tagged only on
+            # traced requests: untraced streams stay byte-identical.
+            if self.replica is not None:
+                seg["replica"] = self.replica
+            if req.rid in self._migrate_hold:
+                self._migrate_hold.discard(req.rid)
+                if phase == "preempted":
+                    seg["via"] = "migrate"
+                    seg["hop"] = req.hop
         if slot.prefill_pos:
             # prefix-cache hit: prefill starts past the cached span
             seg["cached_tokens"] = int(slot.prefill_pos)
@@ -2698,10 +2736,13 @@ class ServeEngine:
             last["dur"] += dur
             last["chunks"] += 1
         else:
-            req.segments.append({"ph": "prefill",
-                                 "t0": t0 - req.submit_t, "dur": dur,
-                                 "from": int(slot.prefill_pos),
-                                 "chunks": 1})
+            seg = {"ph": "prefill",
+                   "t0": t0 - req.submit_t, "dur": dur,
+                   "from": int(slot.prefill_pos),
+                   "chunks": 1}
+            if req.trace_id and self.replica is not None:
+                seg["replica"] = self.replica
+            req.segments.append(seg)
 
     def _accrue_decode(self, req: Request, t0: float, dur: float,
                        bucket: int, tokens: int, proposed: int = 0,
@@ -2725,6 +2766,8 @@ class ServeEngine:
         else:
             seg = {"ph": "decode", "t0": t0 - req.submit_t, "dur": dur,
                    "bucket": int(bucket), "iters": 1, "tokens": tokens}
+            if req.trace_id and self.replica is not None:
+                seg["replica"] = self.replica
             if self.speculative:
                 seg["proposed"] = proposed
                 seg["accepted"] = accepted
@@ -2762,6 +2805,7 @@ class ServeEngine:
         if req.ttft_s is not None:
             fields["ttft_s"] = round(req.ttft_s, 6)
         fields.update(self._replica_kw())
+        fields.update(self._trace_kw(req))
         if req.group:
             fields["group"] = req.group
         # open-loop riders (ISSUE 16): the arrival stamp lets goodput
@@ -2850,7 +2894,7 @@ class ServeEngine:
         self.swap_outs += 1
         self.swap_bytes_moved += actual
         obs.serve("swap_out", request=req.rid, swap_bytes=actual,
-                  **self._replica_kw())
+                  **self._replica_kw(), **self._trace_kw(req))
         return True
 
     def _apply_restores(self, slot) -> None:
@@ -2885,6 +2929,20 @@ class ServeEngine:
                     kw["from_replica"] = src_replica
                 if self.replica is not None:
                     kw["to_replica"] = self.replica
+                kw.update(self._trace_kw(req))
+                if req.trace_id and req.migrate_out_t is not None:
+                    # the transport hop's full price (ISSUE 19):
+                    # source extraction stamp → destination scatter
+                    # complete — the sample behind the router's
+                    # transport_hop_s_p99 rider; extract_s rides so
+                    # the stitcher can split pure data movement out
+                    # of the admission wait it telescopes against
+                    kw["transport_hop_s"] = round(
+                        time.perf_counter() - req.migrate_out_t, 6)
+                    kw["extract_s"] = round(req.migrate_extract_s, 6)
+                    self.transport_hop_s.append(kw["transport_hop_s"])
+                req.migrate_out_t = None
+                req.migrate_extract_s = 0.0
                 obs.serve("migrate", request=req.rid,
                           migration_bytes=bset.nbytes,
                           restore_s=round(dt, 6), **kw)
@@ -2897,7 +2955,7 @@ class ServeEngine:
                 obs.serve("swap_in", request=req.rid,
                           swap_bytes=bset.nbytes, restore_s=round(dt, 6),
                           recompute_tokens_avoided=slot.context_len,
-                          **self._replica_kw())
+                          **self._replica_kw(), **self._trace_kw(req))
         if slot.pending_restores:
             t0 = time.perf_counter()
             for b, payload in slot.pending_restores:
@@ -2922,7 +2980,7 @@ class ServeEngine:
             obs.serve("first_token", request=req.rid,
                       ttft_s=round(req.ttft_s, 6)
                       if req.ttft_s is not None else None,
-                      **self._replica_kw())
+                      **self._replica_kw(), **self._trace_kw(req))
         self.tokens_generated += 1
         if (token == self.eos_token_id
                 or self._generated(req) >= req.max_new_tokens):
@@ -2953,7 +3011,8 @@ class ServeEngine:
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
                       preemptions=req.preemptions,
-                      **self._replica_kw(), **extra)
+                      **self._replica_kw(), **self._trace_kw(req),
+                      **extra)
             self._emit_timeline(req, "finish")
 
     def _slo_verdict(self, req: Request) -> dict:

@@ -116,6 +116,7 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.serve.transport import (
 ENV_REPLICAS = "HSTD_SERVE_REPLICAS"
 ENV_PLACEMENT = "HSTD_SERVE_PLACEMENT"
 ENV_ROLES = "HSTD_SERVE_ROLES"
+ENV_TRACE = "HSTD_SERVE_TRACE"
 
 PLACEMENTS = ("round_robin", "least_loaded", "affinity", "length_aware")
 
@@ -191,6 +192,24 @@ def parse_placement(spec: Union[str, None]) -> str:
     return s
 
 
+def parse_trace(spec) -> bool:
+    """The fleet-tracing knob (ISSUE 19): ``on`` (default) mints a
+    ``trace_id`` + hop counter per MULTI-replica submit so every
+    lifecycle event the request leaves — on whichever engine — can be
+    stitched back into one causal trace (:mod:`~.obs.trace`); ``off``
+    suppresses minting, telemetry byte-identical to the pre-tracing
+    stream. None reads ``HSTD_SERVE_TRACE``. Single-replica routers
+    never mint regardless (the pass-through byte-identity contract —
+    there is nothing to stitch)."""
+    if spec is None:
+        spec = os.environ.get(ENV_TRACE, "on")
+    s = str(spec).strip().lower() or "on"
+    if s not in ("on", "off"):
+        raise ValueError(f"unparseable {ENV_TRACE} value {spec!r}: "
+                         "expected on | off")
+    return s == "on"
+
+
 class Router:
     """N :class:`~.engine.ServeEngine` replicas behind one facade.
     ``replicas``/``placement``/``roles`` read their env knobs when
@@ -220,7 +239,7 @@ class Router:
                  length_threshold: Optional[int] = None,
                  affinity_cap: int = 4096,
                  affinity_max_skew: Optional[int] = None,
-                 **engine_kwargs):
+                 trace=None, **engine_kwargs):
         self.roles = parse_roles(roles)
         if self.roles is not None:
             n_roles = self.roles["prefill"] + self.roles["decode"]
@@ -265,6 +284,12 @@ class Router:
         self.drains = 0
         self.requeues = 0
         self.migrations = 0
+        # fleet tracing (ISSUE 19): mint only on real fleets — a
+        # single-replica router is the byte-identical pass-through and
+        # mints nothing. The id is deterministic (router-scoped
+        # sequence), so replayed runs produce identical traces.
+        self.trace = parse_trace(trace) and self.n > 1
+        self._trace_seq = 0
         # length-aware routing threshold (heterogeneous fleets):
         # prompts at/above it go to the deepest capacity class
         if length_threshold is None:
@@ -416,6 +441,9 @@ class Router:
                     f"{max_new_tokens}) can never fit any decode "
                     "replica of the disaggregated fleet")
         i = self._place(prompt)
+        if self.trace and "trace_id" not in kw:
+            kw = dict(kw, trace_id=f"t{self._trace_seq:06d}")
+            self._trace_seq += 1
         req = self.engines[i].submit(prompt, max_new_tokens, **kw)
         self._commit_place(prompt, i)       # only an ACCEPTED submit
         self._owner[req.rid] = i
@@ -556,8 +584,15 @@ class Router:
                 self._commit_place(req.prompt, j)
             self._owner[req.rid] = j
             self.requeues += 1
+            trace_kw = {}
+            if req.trace_id:
+                # a requeue is an inter-engine move: it advances the
+                # hop counter just as migrate_request does, and the
+                # event is the stitcher's evidence for that hop
+                req.hop += 1
+                trace_kw = {"trace_id": req.trace_id, "hop": req.hop}
             obs.serve("requeue", request=req.rid, replica=i,
-                      to_replica=j)
+                      to_replica=j, **trace_kw)
         migrated = 0
         residents_in_place = 0
         # snapshot rids: migrating one resident lands the engine's
@@ -701,6 +736,16 @@ class Router:
                 e.migration_bytes for e in self.engines)
             out["migration_restore_s"] = round(
                 sum(e.migration_restore_s for e in self.engines), 6)
+            # fleet tracing (ISSUE 19): the tail price of one transport
+            # hop (source extraction stamp -> destination scatter
+            # complete), pooled over every engine's observed hops —
+            # absent when tracing is off (no samples), so untraced
+            # fleets keep their PR 18 report bytes
+            hops = sorted(h for e in self.engines
+                          for h in e.transport_hop_s)
+            if hops:
+                out["transport_hop_s_p99"] = round(
+                    percentile(hops, 0.99), 6)
         imb = self.replica_load_imbalance()
         if imb is not None:
             out["replica_load_imbalance"] = round(imb, 4)

@@ -138,6 +138,19 @@ class Request:
     # this keeps the ORIGINAL prompt length so output accounting and
     # first-token semantics survive a preemption
     orig_prompt_len: int = field(default=-1)
+    # fleet tracing (ISSUE 19): the Router mints `trace_id` at submit
+    # and it rides the request across every engine it visits; `hop`
+    # counts inter-engine moves (migrate_request, drain requeue) — 0
+    # on the placement engine. `migrate_out_t` is the source-side
+    # perf_counter stamp taken just before extraction and
+    # `migrate_extract_s` the extraction seconds, both consumed by the
+    # destination's restore apply to price the transport hop; empty
+    # trace_id = tracing off (single-engine runs), which keeps every
+    # telemetry event byte-identical to the pre-tracing stream.
+    trace_id: str = ""
+    hop: int = 0
+    migrate_out_t: Optional[float] = None
+    migrate_extract_s: float = 0.0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

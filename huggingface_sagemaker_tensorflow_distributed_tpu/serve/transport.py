@@ -140,23 +140,30 @@ def migrate_request(src, dst, rid: int) -> Optional[dict]:
         return None
     # the destination's re-admission closes this as the request's
     # migration-hold interval (the timeline's "preempted" phase — a
-    # migrated request is off-accelerator either way)
+    # migrated request is off-accelerator either way). The same stamp
+    # opens the transport-hop clock the destination's restore apply
+    # closes (ISSUE 19).
     req.preempt_t = time.perf_counter()
+    req.migrate_out_t = req.preempt_t
     cold = req.state != DECODE
     if cold:
         nbytes, ctx = 0, 0
+        req.migrate_extract_s = 0.0
     else:
         n = src.blocks.blocks_for(slot.context_len)
+        t0 = time.perf_counter()
         with src._mesh_ctx():
             req.swap_set = extract_blocks(
                 src._pools, slot.table[:n],
                 d_pools=src._d_pools if src.speculative else None)
+        req.migrate_extract_s = time.perf_counter() - t0
         req.swap_context = slot.context_len
         nbytes, ctx = req.swap_set.nbytes, slot.context_len
     src.blocks.release(slot.table)
     slot.clear()
     src._keys.pop(rid, None)
     req.state = WAITING
+    req.hop += 1
     src.migrations_out += 1
     dst.adopt_resident(req, from_replica=src.replica)
     if cold:
@@ -168,6 +175,9 @@ def migrate_request(src, dst, rid: int) -> Optional[dict]:
             kw["from_replica"] = src.replica
         if dst.replica is not None:
             kw["to_replica"] = dst.replica
+        if req.trace_id:
+            kw["trace_id"] = req.trace_id
+            kw["hop"] = req.hop
         obs.serve("migrate", request=rid, migration_bytes=0,
                   restore_s=0.0, **kw)
     return {"rid": rid, "bytes": nbytes, "context_len": ctx,

@@ -21,6 +21,12 @@ Usage::
     # budget in (queue / prefill / decode / preempted / overhead),
     # aggregated per request group (the per-tenant hook)
     python scripts/obsctl.py slo telemetry/ --percentile 99 --text
+    # one stitched cross-engine trace (a multi-replica/disaggregated
+    # run): the causal narrative of where the request's latency went
+    python scripts/obsctl.py trace t000002 telemetry/
+    # fleet SLO attribution over every stitched trace, plus the merged
+    # multi-track Chrome export (one pid per replica, transport arrows)
+    python scripts/obsctl.py fleet telemetry/ --trace fleet_trace.json
     # follow a LIVE events.jsonl: rolling waiting-depth / KV-pressure /
     # decode tokens/sec / TTFT percentiles (and, on open-loop streams,
     # rolling SLO attainment) over a sliding window, reading only what
@@ -315,6 +321,102 @@ def cmd_goodput(args: argparse.Namespace) -> int:
     return 0
 
 
+def _load_traces(paths) -> "tuple[list[dict], int]":
+    """(stitched traces, rc): strictly load the stream and stitch it
+    (ISSUE 19) — same strict-input contract as ``_load_timelines``."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.timeline import (
+        load_events,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.trace import (
+        collect_traces,
+    )
+
+    events, errors = load_events(paths)
+    if errors:
+        for e in errors[:20]:
+            print(f"obsctl: {e}", file=sys.stderr)
+        if len(errors) > 20:
+            print(f"obsctl: ... and {len(errors) - 20} more",
+                  file=sys.stderr)
+        return [], 1
+    return collect_traces(events), 0
+
+
+def cmd_trace(args: argparse.Namespace) -> int:
+    """One stitched cross-engine trace as a causal narrative (ISSUE
+    19). ``id`` selects by trace_id (``t000002``) or request id.
+    Deterministic bytes under any input order. Exit 0 on a complete,
+    decomposition-clean trace; 1 on malformed input, an unknown id, an
+    INCOMPLETE trace (flagged, still rendered) or a decomposition
+    error — never a silently wrong narrative."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.trace import (
+        check_trace,
+        trace_text,
+    )
+
+    traces, rc = _load_traces(args.paths)
+    if rc:
+        return rc
+    if not traces:
+        print("obsctl: no traced serve events (single-replica run, or "
+              "HSTD_SERVE_TRACE=off?)", file=sys.stderr)
+        return 1
+    want = str(args.id)
+    sel = [t for t in traces
+           if t["trace_id"] == want or str(t.get("request")) == want]
+    if not sel:
+        known = ", ".join(t["trace_id"] for t in traces[:8])
+        print(f"obsctl: no trace {want!r} (known: {known}"
+              f"{', ...' if len(traces) > 8 else ''})", file=sys.stderr)
+        return 1
+    bad = 0
+    for tr in sel:
+        sys.stdout.write(trace_text(tr))
+        if not tr["complete"] or check_trace(tr):
+            bad += 1
+    return 1 if bad else 0
+
+
+def cmd_fleet(args: argparse.Namespace) -> int:
+    """Fleet SLO-attribution rollup over every stitched trace (ISSUE
+    19), with the merged multi-track Chrome export (one pid per
+    replica, transport hops as flow arrows) behind ``--trace``.
+    Incomplete traces are FLAGGED in the output and exit 0 (a torn
+    tail is an input condition, not a wrongness); a decomposition
+    error on a claimed-complete trace exits 1."""
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.trace import (
+        check_trace,
+        fleet_chrome_trace,
+        fleet_summary,
+        fleet_text,
+    )
+
+    traces, rc = _load_traces(args.paths)
+    if rc:
+        return rc
+    if not traces:
+        print("obsctl: no traced serve events (single-replica run, or "
+              "HSTD_SERVE_TRACE=off?)", file=sys.stderr)
+        return 1
+    problems = [m for tr in traces for m in check_trace(tr)]
+    if problems:
+        for p in problems[:20]:
+            print(f"obsctl: inconsistent trace: {p}", file=sys.stderr)
+        return 1
+    if args.trace:
+        with open(args.trace, "w", encoding="utf-8") as f:
+            json.dump(fleet_chrome_trace(traces), f, sort_keys=True)
+            f.write("\n")
+        print(f"obsctl: wrote {args.trace}", file=sys.stderr)
+    if args.json:
+        json.dump(fleet_summary(traces), sys.stdout, indent=2,
+                  sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(fleet_text(traces))
+    return 0
+
+
 def cmd_tail(args: argparse.Namespace) -> int:
     """Follow a live events.jsonl: each poll reads only the appended
     suffix (the prefix is never re-read), updates the sliding-window
@@ -421,6 +523,30 @@ def main(argv: list[str] | None = None) -> int:
     slo.add_argument("--text", action="store_true",
                      help="readable rendering instead of JSON")
     slo.set_defaults(func=cmd_slo)
+
+    trc = sub.add_parser("trace",
+                         help="one stitched cross-engine request "
+                              "trace as a causal narrative (by "
+                              "trace_id or request id)")
+    trc.add_argument("id", help="trace_id (t000002) or request id")
+    trc.add_argument("paths", nargs="+",
+                     help="telemetry dir(s) or event files")
+    trc.set_defaults(func=cmd_trace)
+
+    flt = sub.add_parser("fleet",
+                         help="fleet SLO-attribution rollup over "
+                              "stitched traces + merged multi-track "
+                              "Chrome export (--trace)")
+    flt.add_argument("paths", nargs="+",
+                     help="telemetry dir(s) or event files")
+    flt.add_argument("--trace", default=None,
+                     help="write the merged multi-track Chrome-trace "
+                          "JSON here (one pid per replica, transport "
+                          "flow arrows)")
+    flt.add_argument("--json", action="store_true",
+                     help="raw fleet summary as JSON instead of the "
+                          "table rendering")
+    flt.set_defaults(func=cmd_fleet)
 
     good = sub.add_parser("goodput",
                           help="open-loop goodput replay: SLO "

@@ -175,6 +175,49 @@ def test_r4_declared_fields_and_dynamic_kwargs_are_legal(tmp_path):
     })
     assert active(run_lint(root, rules=["R4"]), "R4") == []
 
+_SCHEMA_SRC_EVENTS = """\
+    REQUIRED_FIELDS = {"serve": {"event": (str,)}}
+    OPTIONAL_FIELDS = {"serve": {"request": (int,), "tokens": (int,)}}
+    SERVE_EVENTS = ("admit", "finish")
+    """
+
+def test_r4_fires_on_undeclared_event_kind(tmp_path):
+    """ISSUE 19: an emitter inventing a serve-event KIND outside the
+    schema's SERVE_EVENTS vocabulary is the same silent drift for
+    consumers switching on `event` as an undeclared field is for
+    field type-checkers."""
+    root = make_tree(tmp_path, {
+        _SCHEMA: _SCHEMA_SRC_EVENTS,
+        f"{PACKAGE}/serve/engine.py": (
+            "from {p} import obs\n"
+            "obs.serve('teleport', request=1)\n".format(p=PACKAGE)),
+    })
+    hits = active(run_lint(root, rules=["R4"]), "R4")
+    assert len(hits) == 1 and "'teleport'" in hits[0].message
+    assert "SERVE_EVENTS" in hits[0].message
+
+def test_r4_declared_kinds_dynamic_kinds_and_no_registry_are_legal(
+        tmp_path):
+    # declared kinds and a non-literal kind (not statically checkable)
+    root = make_tree(tmp_path, {
+        _SCHEMA: _SCHEMA_SRC_EVENTS,
+        f"{PACKAGE}/serve/engine.py": (
+            "from {p} import obs\n"
+            "kind = 'admit'\n"
+            "obs.serve('finish', request=1)\n"
+            "obs.serve(kind, request=1)\n".format(p=PACKAGE)),
+    })
+    assert active(run_lint(root, rules=["R4"]), "R4") == []
+    # a schema without SERVE_EVENTS (pre-19 trees): kinds unchecked,
+    # field checks still live
+    root = make_tree(tmp_path / "old", {
+        _SCHEMA: _SCHEMA_SRC,
+        f"{PACKAGE}/serve/engine.py": (
+            "from {p} import obs\n"
+            "obs.serve('teleport', request=1)\n".format(p=PACKAGE)),
+    })
+    assert active(run_lint(root, rules=["R4"]), "R4") == []
+
 
 # -- R5: env-knob registry ----------------------------------------------------
 

@@ -111,6 +111,13 @@ def three_hosts(tmp_path):
                               migrations=6, migration_bytes=1 << 18,
                               migration_restore_s=0.015,
                               disagg_slo_attainment=0.96))
+            # fleet tracing (ISSUE 19): the stitch summary is a
+            # SEPARATE event after the report — _serve_summary must
+            # overlay its counters onto the scalar surface
+            events.append(_ev(0, t + 9, "serve", event="trace_stitch",
+                              traces=48, complete_traces=48,
+                              trace_stitch_failures=0,
+                              transport_hop_s_p99=0.004))
         if host == 2:
             events.append(_ev(2, t + 9, "anomaly", name="step_time_spike",
                               message="step time 0.9s exceeds rolling "
@@ -894,6 +901,99 @@ def test_diff_disagg_slo_attainment_is_down_worse_ratio(three_hosts):
         d = diff_reports(a, b, threshold_pct=5.0)
         assert "serve_disagg_slo_attainment" in d["skipped"]
         assert "serve_disagg_slo_attainment" not in d["regressions"]
+
+
+def test_diff_trace_stitch_failures_is_zero_baseline_count(three_hosts):
+    """ISSUE 19: `serve_trace_stitch_failures` diffs as a count metric
+    whose worse direction is UP against an exactly-zero baseline — a
+    healthy fleet stitches EVERY traced request, so any failure count
+    (a dropped hop's evidence, a torn tail, a stamp regression) flags
+    regardless of percentage. The counter reaches the scalar surface
+    through the trace_stitch event overlay, proving _serve_summary
+    merges the stitch summary onto the report. Poison rows
+    skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    # the overlay: trace_stitch is a separate event, yet its counters
+    # land next to the report event's SLO figures
+    assert base["serve"]["trace_stitch_failures"] == 0
+    assert base["serve"]["complete_traces"] == 48
+    worse = copy.deepcopy(base)
+    worse["serve"]["trace_stitch_failures"] = 2
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_trace_stitch_failures" in d["regressions"]
+    assert d["metrics"]["serve_trace_stitch_failures"][
+        "worse_direction"] == "up"
+    assert d["metrics"]["serve_trace_stitch_failures"]["pct"] is None
+    # recovering to zero never flags
+    assert "serve_trace_stitch_failures" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["trace_stitch_failures"] = "some"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["trace_stitch_failures"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_trace_stitch_failures" in d["skipped"]
+        assert "serve_trace_stitch_failures" not in d["regressions"]
+
+
+def test_diff_transport_hop_p99_is_up_worse_ratio(three_hosts):
+    """ISSUE 19: `serve_transport_hop_s_p99` (the stitched per-hop
+    transport latency tail — extract + wire + restore + destination
+    admission) diffs as a ratio metric whose worse direction is UP: a
+    serialization slowdown or saturated restore path grows this
+    before the fleet TTFT percentiles absorb it. Standard threshold +
+    zero-baseline rules, poison rows skip-not-crash."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert base["serve"]["transport_hop_s_p99"] == pytest.approx(0.004)
+    worse = copy.deepcopy(base)
+    worse["serve"]["transport_hop_s_p99"] = 0.04
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_transport_hop_s_p99" in d["regressions"]
+    assert d["metrics"]["serve_transport_hop_s_p99"][
+        "worse_direction"] == "up"
+    # a faster hop never flags; nor does a sub-threshold drift
+    assert "serve_transport_hop_s_p99" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["transport_hop_s_p99"] = 0.00408   # +2%
+    assert "serve_transport_hop_s_p99" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # zero baseline (hop never priced — no hot migration): latency
+    # appearing must still flag though the percentage is undefined
+    zero = copy.deepcopy(base)
+    zero["serve"]["transport_hop_s_p99"] = 0.0
+    worse0 = copy.deepcopy(zero)
+    worse0["serve"]["transport_hop_s_p99"] = 0.01
+    d0 = diff_reports(zero, worse0, threshold_pct=5.0)
+    assert "serve_transport_hop_s_p99" in d0["regressions"]
+    assert d0["metrics"]["serve_transport_hop_s_p99"]["pct"] is None
+    # poison rows: mistyped or missing -> skipped, never a crash or a
+    # fabricated regression
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["transport_hop_s_p99"] = "slow"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["transport_hop_s_p99"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_transport_hop_s_p99" in d["skipped"]
+        assert "serve_transport_hop_s_p99" not in d["regressions"]
 
 
 def test_diff_poisoned_lifecycle_metrics_skip_not_crash(three_hosts):

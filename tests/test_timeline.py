@@ -706,4 +706,7 @@ def test_obs_timeline_runs_without_jax():
     # timeline is a zone ROOT (all of obs/ is), so even its
     # lazily-imported consumers can't smuggle jax in at import time
     assert f"{PACKAGE}/obs/timeline.py" in r1_zone_roots(project)
+    # the fleet-trace stitcher (ISSUE 19) rides the same contract —
+    # `obsctl trace|fleet` run on the same jax-less boxes
+    assert f"{PACKAGE}/obs/trace.py" in r1_zone_roots(project)
     assert "scripts/obsctl.py" in r1_reachability(project)
