@@ -1,4 +1,4 @@
-"""Serving bench (``bench.py --serve``): eleven JSON metric lines.
+"""Serving bench (``bench.py --serve``): twelve JSON metric lines.
 
 1. ``serve_continuous_vs_static_speedup`` — continuous batching + paged
    KV vs static-batch ``generate_causal`` on a mixed-length request
@@ -173,6 +173,27 @@
     SLO attainment ratio (disagg / mixed) ≥ 1.1 with the per-side
     figures each no worse — prefill-side TTFT p99 on the shared
     virtual clock, decode-side tokens/sec from dispatch accounting.
+
+12. ``serve_slo_admission_goodput`` — the ISSUE 20 tentpole: pluggable
+    admission ordering on the open-loop fleet past its capacity knee
+    (the line-9 λ_hi regime, where the whole schedule lands at once
+    and admission ORDER is the only free variable). The identical
+    seeded schedule — interactive rows on a tight virtual deadline +
+    priority class 0, batch rows on a loose deadline + class 1 — runs
+    under ``policy="fifo"`` and ``policy="slo"`` (earliest effective
+    deadline folding in priority, prefix-aware predicted demand, and a
+    bounded aging term). Deterministic gates at EVERY scale: token
+    identity fifo vs slo (ordering changes WHO admits WHEN, never
+    WHAT), byte-identical replay across two fresh slo runs, deadline
+    attainment (1 − miss fraction; per-request deadlines are what
+    ordering can move — a uniform TTFT budget at saturation is
+    order-invariant) no worse than fifo's and ≥ 1.1x it on the full
+    traces, deadline-miss fraction STRICTLY lower, no starvation
+    (every submitted request finishes, and the rate-limited arm's
+    structured rejections +
+    finishes sum to the schedule — nothing silently dropped), and
+    compile flatness with ZERO new variants (admission ordering is
+    host arithmetic; graftlint R7 pins the policy module jax-free).
 
 Structural gates degrade the line to the structured-error shape (value
 null + ``error``) rather than lying with a number. Both sides of every
@@ -2582,8 +2603,214 @@ def bench_serve_disagg(smoke: bool = False) -> dict:
                  "bench/serve_disagg_goodput")
 
 
+def bench_serve_slo_admission(smoke: bool = False) -> dict:
+    """Metric line 12 (ISSUE 20): goodput-aware admission control.
+    See the module docstring — the open-loop fleet past its capacity
+    knee, ``policy="fifo"`` vs ``policy="slo"`` on the identical
+    schedule; ordering is the only free variable and every gate is
+    deterministic on the virtual clock."""
+    import jax.numpy as jnp
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu import obs
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.auto import (
+        init_params,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.models.gpt2 import (
+        Gpt2Config,
+        Gpt2LMHeadModel,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.loadgen import (
+        OpenLoopDriver,
+        SloSpec,
+        make_schedule,
+    )
+    from huggingface_sagemaker_tensorflow_distributed_tpu.serve.router import (
+        Router,
+    )
+
+    on_tpu, anomaly_field, memory_watermark = _bench_env()
+
+    if smoke:
+        cfg = Gpt2Config(vocab_size=256, hidden_size=64, num_layers=2,
+                         num_heads=4, intermediate_size=128,
+                         max_position_embeddings=128, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0,
+                         eos_token_id=255, pad_token_id=0)
+        slots, block, chunk, max_len = 2, 8, 8, 64
+        buckets = [32, 64]
+        n_req, prompt_lo, prompt_hi, new_lo, new_hi = 10, 4, 8, 3, 6
+        tight = 0.012
+    elif on_tpu:
+        cfg = Gpt2Config(dtype=jnp.bfloat16, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0)  # 124M
+        slots, block, chunk, max_len = 4, 16, 32, 256
+        buckets = [128, 256]
+        n_req, prompt_lo, prompt_hi, new_lo, new_hi = 32, 8, 24, 8, 24
+        tight = 0.060
+    else:
+        cfg = Gpt2Config(vocab_size=2048, hidden_size=256, num_layers=2,
+                         num_heads=4, intermediate_size=1024,
+                         max_position_embeddings=256, hidden_dropout=0.0,
+                         embd_dropout=0.0, attention_dropout=0.0,
+                         eos_token_id=2047, pad_token_id=0)
+        slots, block, chunk, max_len = 2, 8, 8, 64
+        buckets = [32, 64]
+        n_req, prompt_lo, prompt_hi, new_lo, new_hi = 24, 4, 12, 4, 12
+        tight = 0.030
+    # the tight deadline sits between the interactive class's makespan
+    # under slo ordering (urgent class served first — most or all rows
+    # meet it) and under fifo interleaving (the class's back half
+    # queues behind batch rows and misses) — measured virtual-clock
+    # figures, deterministic per (schedule seed, geometry)
+    # one offered rate — the open-loop line's λ_hi, past the knee: the
+    # whole schedule lands effectively at once, so admission ORDER is
+    # the only free variable between the two policies. Interactive rows
+    # (priority 0) carry a tight virtual deadline and the SLO's TTFT
+    # budget; batch rows (priority 1) a deadline loose enough to absorb
+    # being served last. Under fifo the classes interleave and the back
+    # half of the interactive class queues past both budgets; the slo
+    # policy serves the urgent class first, which is the whole goodput
+    # claim.
+    rate, tick, loose = 100000.0, 0.001, 30.0
+    slo = SloSpec(ttft_s=tight)
+    sched_seed = 13
+
+    model = Gpt2LMHeadModel(cfg)
+    params = init_params(model, cfg, seed=0)
+    vocab = min(cfg.vocab_size - 2, 1 << 16)
+    num_blocks = 1 + slots * ((prompt_hi + chunk + new_hi + block)
+                              // block + 1)
+    # prefill_batch=1 pins the prefill dispatch shape per chunk count:
+    # admission reordering changes which prompts share an iteration,
+    # and the ZERO-new-variants gate must not depend on batch makeup
+    kw = dict(num_slots=slots, block_size=block, prefill_chunk=chunk,
+              prefill_batch=1, max_model_len=max_len,
+              gather_buckets=buckets, timeline="off", overlap="on",
+              prefix_cache=False, mesh=1)
+    rows = make_schedule(
+        n_req, vocab, process="poisson", rate=rate, seed=sched_seed,
+        prompt_lo=prompt_lo, prompt_hi=prompt_hi, new_lo=new_lo,
+        new_hi=new_hi, eos_token_id=cfg.eos_token_id,
+        groups=("interactive", "batch"), priorities=(0, 1),
+        deadline_s=(tight, loose))
+
+    def serve_once(policy, rate_limit=None):
+        r = Router(model, params, replicas=2, placement="round_robin",
+                   num_blocks=num_blocks, policy=policy,
+                   rate_limit=rate_limit, **kw)
+        drv = OpenLoopDriver(r, rows, clock="virtual", tick_s=tick,
+                             slo=slo, process="poisson", rate=rate)
+        finished = drv.run()
+        outs = [list(finished[rid].output) for rid in sorted(finished)]
+        return {"outs": outs, "served": len(finished),
+                "summary": drv.summary(), "slo": r.slo_summary()}
+
+    with obs.span("bench/serve_slo_admission_warm"):
+        serve_once("fifo")                  # compiles every variant
+    tracker = obs.compile_tracker()
+    count0 = tracker.count if tracker else None
+
+    with obs.span("bench/serve_slo_admission_measured"):
+        fifo = serve_once("fifo")
+        slo_a = serve_once("slo")
+        slo_b = serve_once("slo")           # fresh replay, same seed
+        # per-tenant token bucket on the batch class: over-budget
+        # submits get a STRUCTURED rejection (deterministic — the
+        # bucket clock is arrival_s in virtual mode), never a silent
+        # drop, and everything admitted still finishes
+        limited = serve_once("slo", rate_limit={"batch": (1000.0, 2)})
+    compile_delta = (tracker.count - count0) if tracker else None
+
+    # -- gates (all deterministic, enforced at every scale) -----------
+    replay_ok = (slo_a["outs"] == slo_b["outs"]
+                 and json.dumps(slo_a["summary"], sort_keys=True)
+                 == json.dumps(slo_b["summary"], sort_keys=True))
+    # the policy contract: WHO admits WHEN, never WHAT
+    tokens_ok = slo_a["outs"] == fifo["outs"]
+    miss_fifo = fifo["summary"].get("deadline_miss_frac")
+    miss_slo = slo_a["summary"].get("deadline_miss_frac")
+    miss_ok = (miss_fifo is not None and miss_slo is not None
+               and miss_slo < miss_fifo)
+    # attainment here is DEADLINE attainment (fraction of requests
+    # finishing inside their own per-class deadline): per-request
+    # deadlines are what admission ordering can move — a uniform TTFT
+    # budget at full saturation is order-invariant (the fleet admits
+    # the same number of requests per tick whoever goes first)
+    att_fifo = (None if miss_fifo is None
+                else round(1.0 - miss_fifo, 4))
+    att_slo = (None if miss_slo is None
+               else round(1.0 - miss_slo, 4))
+    att_ok = (att_fifo is not None and att_slo is not None
+              and att_slo >= att_fifo)
+    if att_ok and not smoke:
+        # the full-trace acceptance: ≥ 1.1x fifo's attainment
+        att_ok = att_fifo > 0 and att_slo >= 1.1 * att_fifo
+    rejected = limited["summary"].get("rate_limited", 0)
+    starve_ok = (fifo["served"] == n_req and slo_a["served"] == n_req
+                 and rejected > 0
+                 and limited["served"] + rejected == n_req)
+    compiles_ok = compile_delta is None or compile_delta == 0
+    gate_ok = (replay_ok and tokens_ok and att_ok and miss_ok
+               and starve_ok and compiles_ok)
+
+    result = {
+        "metric": "serve_slo_admission_goodput",
+        "value": round(att_slo, 4) if gate_ok else None,
+        "unit": "frac" if gate_ok else None,
+        "vs_baseline": (round(att_fifo, 4)
+                        if gate_ok and att_fifo is not None else None),
+        "detail": {
+            "replicas": 2,
+            "clock": "virtual",
+            "tick_s": tick,
+            "process": "poisson",
+            "rate": rate,
+            "slo_ttft_s": slo.ttft_s,
+            "deadline_tight_s": tight,
+            "deadline_loose_s": loose,
+            "deadline_attainment_fifo": att_fifo,
+            "deadline_attainment_slo": att_slo,
+            "deadline_miss_frac_fifo": miss_fifo,
+            "deadline_miss_frac_slo": miss_slo,
+            "slo_ttft_attainment_fifo":
+                fifo["summary"].get("slo_attainment"),
+            "slo_ttft_attainment_slo":
+                slo_a["summary"].get("slo_attainment"),
+            "goodput_tokens_fifo": fifo["summary"].get("goodput_tokens"),
+            "goodput_tokens_slo": slo_a["summary"].get("goodput_tokens"),
+            "priority_slo_attainment":
+                slo_a["slo"].get("priority_slo_attainment"),
+            "aging_promotions": slo_a["slo"].get("aging_promotions"),
+            "rate_limited": rejected,
+            "rate_limited_served": limited["served"],
+            "requests": n_req,
+            "num_slots": slots,
+            "block_size": block,
+            "num_blocks": num_blocks,
+            "prefill_chunk": chunk,
+            "max_model_len": max_len,
+            "gather_buckets": buckets,
+            "compiles_steady": compile_delta,
+            "replay_identical": replay_ok,
+            "tokens_identical": tokens_ok,
+            "model_scale": ("smoke" if smoke
+                            else "real" if on_tpu else "cpu"),
+        },
+    }
+    if not gate_ok:
+        result["error"] = (
+            "slo_replay_diverged" if not replay_ok
+            else "policy_changed_tokens" if not tokens_ok
+            else "attainment_below_fifo" if not att_ok
+            else "deadline_misses_not_reduced" if not miss_ok
+            else "starvation_or_silent_drop" if not starve_ok
+            else "policy_minted_compiles")
+    return _emit(result, anomaly_field, memory_watermark,
+                 "bench/serve_slo_admission_goodput")
+
+
 def bench_serve(smoke: bool = False) -> list[dict]:
-    """All eleven serve metric lines, mixed-trace first (the driver
+    """All twelve serve metric lines, mixed-trace first (the driver
     reads stdout lines; the return value is for tests)."""
     return [bench_serve_mixed(smoke=smoke),
             bench_serve_bucketed(smoke=smoke),
@@ -2595,7 +2822,8 @@ def bench_serve(smoke: bool = False) -> list[dict]:
             bench_serve_router(smoke=smoke),
             bench_serve_open_loop(smoke=smoke),
             bench_serve_kv_swap(smoke=smoke),
-            bench_serve_disagg(smoke=smoke)]
+            bench_serve_disagg(smoke=smoke),
+            bench_serve_slo_admission(smoke=smoke)]
 
 
 if __name__ == "__main__":

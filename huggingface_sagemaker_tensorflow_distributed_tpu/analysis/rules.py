@@ -1,4 +1,4 @@
-"""graftlint rules R1–R6: the repo-specific invariants, each grounded
+"""graftlint rules R1–R7: the repo-specific invariants, each grounded
 in a property a bench gate or poison test already hunts dynamically —
 the rule catches the regression in the diff instead.
 
@@ -421,6 +421,41 @@ def check_r6(project: Project) -> list[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# R7: admission policy stays host-side — serve/policy.py and its
+# import-time closure are jax-free
+# ---------------------------------------------------------------------------
+
+R7_ROOT = f"{PACKAGE}/serve/policy.py"
+
+
+def check_r7(project: Project) -> list[Finding]:
+    """Same reachability walk as R1, rooted at the admission-policy
+    module. The policy layer's contract is that admission ordering is
+    pure host arithmetic — the bench's compile-flatness gate (ZERO new
+    compiled variants under ``policy=slo``) rests on no jax reaching
+    the module at import time, and the router's rate limiter must keep
+    importing on jax-less driver boxes."""
+    if R7_ROOT not in project.files:
+        return []
+    findings = []
+    parent = project.import_closure([R7_ROOT])
+    for path in sorted(parent):
+        seen: set = set()           # one finding per banned package
+        for name, lineno in project.top_level_imports(path):
+            top = name.split(".")[0]
+            if top in R1_BANNED and (lineno, top) not in seen:
+                seen.add((lineno, top))
+                chain = " -> ".join(Project.chain(parent, path))
+                findings.append(Finding(
+                    "R7", path, lineno,
+                    f"import-time dependency on {top!r} in the "
+                    f"admission-policy zone (reached via {chain}); "
+                    "admission ordering is host-side by contract — "
+                    "keep serve/policy.py's closure jax-free"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -463,4 +498,11 @@ RULES: dict[str, Rule] = {
         "inside serve/paged_kv.py — a raw free from the scheduler is "
         "exactly the double-free class the conservation test hunts.",
         check_r6),
+    "R7": Rule(
+        "R7", "policy-jax-free",
+        "serve/policy.py and everything it imports stay jax-free — "
+        "admission ordering is host arithmetic, which is what makes "
+        "the policy bench's zero-new-compiles gate and jax-less "
+        "driver-box imports hold.",
+        check_r7),
 }

@@ -438,6 +438,15 @@ DIFF_METRICS: dict[str, tuple[int, str]] = {
     # fleet TTFT percentiles absorb them. Ratio kind under the shared
     # zero-baseline rule.
     "serve_transport_hop_s_p99": (+1, "ratio"),
+    # goodput-aware admission (ISSUE 20): fraction of deadline-carrying
+    # requests finishing past their end-to-end deadline, worse UP — the
+    # admission policy's headline figure: an ordering regression (a
+    # starved class, a broken aging bound, a demand predictor gone
+    # stale) grows this before aggregate attainment visibly moves.
+    # Ratio kind under the shared zero-baseline rule: the healthy
+    # baseline misses NOTHING, so misses appearing against 0.0 must
+    # flag even though the percentage is undefined.
+    "serve_deadline_miss_frac": (+1, "ratio"),
 }
 
 
@@ -478,7 +487,8 @@ def _report_scalars(report: dict) -> dict:
                 "slo_attainment", "arrival_backlog_peak",
                 "swap_bytes", "host_tier_hit_rate",
                 "migration_bytes", "disagg_slo_attainment",
-                "trace_stitch_failures", "transport_hop_s_p99"):
+                "trace_stitch_failures", "transport_hop_s_p99",
+                "deadline_miss_frac"):
         val = serve.get(key)
         out[f"serve_{key}"] = val if isinstance(val, (int, float)) else None
     return out

@@ -281,7 +281,27 @@ OPTIONAL_FIELDS: dict[str, dict[str, tuple]] = {
               "transport_hop_s_p99": _NUM,
               "traces": (int,),
               "complete_traces": (int,),
-              "trace_stitch_failures": (int,)},
+              "trace_stitch_failures": (int,),
+              # goodput-aware admission control (ISSUE 20): submit
+              # events carry the request's deadline/priority riders,
+              # finish events the end-to-end `deadline_miss` verdict,
+              # `rate_limited` events the router's structured
+              # per-tenant rejection (retry_after_s is the bucket's
+              # time-to-next-token), and report events the fleet
+              # rollups (`policy`, aging promotion count, miss
+              # fraction, per-priority-class attainment). All absent
+              # under the default fifo policy with no deadlines,
+              # priorities, or rate limits — the byte-identity
+              # contract
+              "policy": (str,),
+              "deadline_s": _NUM,
+              "priority": (int,),
+              "deadline_miss": (bool,),
+              "rate_limited": (int,),
+              "retry_after_s": _NUM,
+              "aging_promotions": (int,),
+              "deadline_miss_frac": _NUM,
+              "priority_slo_attainment": (dict,)},
 }
 
 # The serve-event vocabulary: every literal first argument an
@@ -294,7 +314,7 @@ SERVE_EVENTS = (
     "submit", "admit", "first_token", "finish", "preempt",
     "bucket_switch", "report", "request_timeline", "iteration_ledger",
     "open_loop", "swap_out", "swap_in", "migrate", "drain", "requeue",
-    "restart", "trace_stitch",
+    "restart", "trace_stitch", "rate_limited",
 )
 
 EVENT_TYPES = tuple(REQUIRED_FIELDS)

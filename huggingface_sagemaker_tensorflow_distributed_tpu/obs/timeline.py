@@ -314,7 +314,11 @@ def slo_attribution(records: list[dict], pct: float = 0.99) -> dict:
     ``replica`` tag (a multi-replica router run, ISSUE 14) — per
     replica, so per-replica tail attribution falls out of the same
     machinery (a placement policy sending the tail to one sick replica
-    is visible here before any aggregate moves)."""
+    is visible here before any aggregate moves). Records tagged with a
+    ``priority`` class (ISSUE 20 admission control) additionally get a
+    per-class rollup — attainment and deadline misses per priority
+    next to the per-tenant split, so a starved class is visible next
+    to the tenant it belongs to."""
     out: dict = {"requests": len(records), "percentile": pct}
     if not records:
         return out
@@ -393,6 +397,40 @@ def slo_attribution(records: list[dict], pct: float = 0.99) -> dict:
                     1 for r in recs
                     if float(r.get("e2e_s", 0.0)) >= thr),
             }
+    # per-priority-class rollup (ISSUE 20): emitters stamp `priority`
+    # only when non-zero (absent-when-default), so any tagged record
+    # implies classes are in play and untagged records are class 0
+    prios: dict[int, list[dict]] = {}
+    for rec in records:
+        p = rec.get("priority")
+        if isinstance(p, int) and not isinstance(p, bool):
+            prios.setdefault(p, []).append(rec)
+    if prios:
+        for rec in records:
+            if not isinstance(rec.get("priority"), int):
+                prios.setdefault(0, []).append(rec)
+        out["priorities"] = {}
+        for p in sorted(prios):
+            recs = prios[p]
+            pe2es = sorted(float(r.get("e2e_s", 0.0)) for r in recs)
+            sec = {
+                "requests": len(recs),
+                "e2e_p50_s": round(percentile(pe2es, 0.50), 6),
+                "e2e_p99_s": round(percentile(pe2es, 0.99), 6),
+                "tail_count": sum(
+                    1 for r in recs
+                    if float(r.get("e2e_s", 0.0)) >= thr),
+            }
+            met = [r["slo_met"] for r in recs
+                   if isinstance(r.get("slo_met"), bool)]
+            if met:
+                sec["slo_attainment"] = round(
+                    sum(met) / len(met), 4)
+            misses = [r["deadline_miss"] for r in recs
+                      if isinstance(r.get("deadline_miss"), bool)]
+            if misses:
+                sec["deadline_misses"] = int(sum(misses))
+            out["priorities"][str(p)] = sec
     return out
 
 
@@ -427,6 +465,16 @@ def render_slo_text(doc: dict) -> str:
                      f"e2e p50 {sec['e2e_p50_s']}s "
                      f"p99 {sec['e2e_p99_s']}s, "
                      f"{sec['tail_count']} in the tail")
+    for p, sec in (doc.get("priorities") or {}).items():
+        extras = ""
+        if "slo_attainment" in sec:
+            extras += f", attainment {sec['slo_attainment']:.2%}"
+        if "deadline_misses" in sec:
+            extras += f", {sec['deadline_misses']} deadline miss(es)"
+        lines.append(f"  priority {p}: {sec['requests']} request(s), "
+                     f"e2e p50 {sec['e2e_p50_s']}s "
+                     f"p99 {sec['e2e_p99_s']}s, "
+                     f"{sec['tail_count']} in the tail{extras}")
     return "\n".join(lines) + "\n"
 
 

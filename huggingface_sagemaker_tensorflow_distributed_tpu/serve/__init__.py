@@ -17,12 +17,25 @@ per-slot seeded sampling).
 - :mod:`~.transport` — cross-engine KV block-set migration (ISSUE 18):
   one primitive moves a live request between engines with zero
   re-prefill, token-exactly.
+- :mod:`~.policy` — goodput-aware admission control (ISSUE 20):
+  pluggable scheduler ordering (fifo | slo), per-tenant token-bucket
+  rate limits, structured rejections. Host-side by contract
+  (graftlint R7).
 """
 
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (  # noqa: F401
     BlockManager,
     PoolExhausted,
     prefix_chain_keys,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.policy import (  # noqa: F401
+    POLICIES,
+    RateLimited,
+    SloPolicy,
+    TokenBucket,
+    parse_aging_s,
+    parse_policy,
+    parse_rate_limit,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (  # noqa: F401
     Request,

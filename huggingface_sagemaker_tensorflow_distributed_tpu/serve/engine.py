@@ -1100,7 +1100,9 @@ class ServeEngine:
                  overlap: Union[str, bool, None] = None,
                  mesh=None,
                  swap: Union[str, None] = None,
-                 swap_bytes: Union[str, int, None] = None):
+                 swap_bytes: Union[str, int, None] = None,
+                 policy: Union[str, None] = None,
+                 aging_s: Union[str, float, None] = None):
         cfg = model.config
         if getattr(cfg, "num_experts", 0):
             raise ValueError(
@@ -1218,7 +1220,12 @@ class ServeEngine:
         self.sched = Scheduler(num_slots, self.blocks, prefill_chunk,
                                self.max_model_len,
                                decode_lookahead=self.speculate_k + 1,
-                               prefix_cache=self.prefix_cache)
+                               prefix_cache=self.prefix_cache,
+                               policy=policy, aging_s=aging_s)
+        # admission policy (ISSUE 20): parsed once by the scheduler;
+        # "fifo" keeps every event stream byte-identical to the
+        # pre-policy engine (all policy riders gate on != "fifo")
+        self.policy = self.sched.policy
         self.max_blocks_per_seq = self.max_model_len // block_size
         if gather_buckets is None:
             gather_buckets = os.environ.get(ENV_GATHER_BUCKETS)
@@ -1332,6 +1339,14 @@ class ServeEngine:
         self._arrival_backlog_peak = 0
         self._has_arrivals = False
         self._has_slo = False
+        # admission-policy accounting (ISSUE 20): deadline verdicts
+        # over finished requests that carried one, and per-priority-
+        # class SLO attainment. _has_priorities flips on the first
+        # nonzero-priority submit; all riders stay absent otherwise.
+        self._deadline_total = 0
+        self._deadline_miss = 0
+        self._priority_slo: dict[int, list] = {}  # class -> [met, total]
+        self._has_priorities = False
         self._bucket = self.gather_buckets[0]
         self._shrink_streak = 0
         self._warmed_modes: set = set()
@@ -1520,7 +1535,9 @@ class ServeEngine:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 0.0, seed: int = 0,
                group: str = "", arrival_s: Optional[float] = None,
-               slo=None, trace_id: str = "") -> Request:
+               slo=None, trace_id: str = "",
+               deadline_s: Optional[float] = None,
+               priority: int = 0) -> Request:
         """Queue one request. ``temperature == 0`` (default) is greedy;
         ``temperature > 0`` samples with the given truncation knobs,
         seeded per request — same knob semantics as
@@ -1538,7 +1555,16 @@ class ServeEngine:
         per-axis deadline seconds; the finish event then carries the
         verdicts and :meth:`slo_summary` the attainment. Both are
         absent-when-default: a closed-loop submit adds nothing to the
-        telemetry stream."""
+        telemetry stream.
+
+        Admission-policy contract (ISSUE 20): ``deadline_s`` is an
+        end-to-end deadline measured from the request's origin
+        (``arrival_s`` when threaded, else the submit stamp) and
+        ``priority`` the admission class, smaller = more urgent.
+        Under ``policy="slo"`` both order WHO admits WHEN — never
+        WHAT; under fifo they still drive the finish-side
+        ``deadline_miss`` verdict. Absent-when-default like every
+        other rider: no deadline and priority 0 add nothing."""
         req = Request(prompt=np.asarray(prompt, np.int32),
                       max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_k=int(top_k),
@@ -1550,7 +1576,10 @@ class ServeEngine:
                                   else float(slo.ttft_s)),
                       slo_tpot_s=(None if slo is None or slo.tpot_s is None
                                   else float(slo.tpot_s)),
-                      trace_id=str(trace_id))
+                      trace_id=str(trace_id),
+                      deadline_s=(None if deadline_s is None
+                                  else float(deadline_s)),
+                      priority=int(priority))
         req.submit_t = time.perf_counter()
         self.sched.submit(req)
         if req.sampled:
@@ -1566,6 +1595,11 @@ class ServeEngine:
                 extra["slo_ttft_s"] = req.slo_ttft_s
             if req.slo_tpot_s is not None:
                 extra["slo_tpot_s"] = req.slo_tpot_s
+        if req.deadline_s is not None:
+            extra["deadline_s"] = req.deadline_s
+        if req.priority:
+            self._has_priorities = True
+            extra["priority"] = req.priority
         obs.serve("submit", request=req.rid,
                   prompt_len=len(req.prompt),
                   max_new_tokens=req.max_new_tokens,
@@ -1809,6 +1843,21 @@ class ServeEngine:
         if self._has_arrivals:
             out["arrival_backlog_peak"] = self._arrival_backlog_peak
 
+        # admission policy (ISSUE 20): each rider gated on its own
+        # feed so a fifo run (and a deadline-less / priority-less slo
+        # run) reports byte-identically to the pre-policy engine
+        if self.policy != "fifo":
+            out["policy"] = self.policy
+            out["aging_promotions"] = self.sched.aging_promotions
+        if self._deadline_total:
+            out["deadline_miss_frac"] = round(
+                self._deadline_miss / self._deadline_total, 4)
+        if self._has_priorities and self._slo_total:
+            out["priority_slo_attainment"] = {
+                str(p): round(m / t, 4)
+                for p, (m, t) in sorted(self._priority_slo.items())
+                if t}
+
         # host-RAM spill tier (ISSUE 17): swap traffic and prefix
         # demotion-tier accounting — absent entirely with the tier off,
         # keeping that report byte-identical to the pre-tier engine's
@@ -1993,10 +2042,11 @@ class ServeEngine:
                       **self._replica_kw(),
                       **self._trace_kw(slot.request), **extra)
         if self.timeline and self.sched.waiting:
-            # admission-block attribution: FIFO means only the HEAD of
-            # the queue is ever capacity-blocked (everyone behind it is
-            # blocked BY it) — name why it is still waiting
-            head = self.sched.waiting[0]
+            # admission-block attribution: only the policy's TOP-RANKED
+            # candidate is ever capacity-blocked (everyone behind it is
+            # blocked BY it) — under fifo that is the queue head, under
+            # slo the ranked front — name why it is still waiting
+            head = self.sched.blocked_head()
             head.blocked_iters += 1
             head.blocked_reason = (
                 "no_free_slot"
@@ -2819,6 +2869,14 @@ class ServeEngine:
             fields["slo_met"] = req.slo_met
             if req.slack_s is not None:
                 fields["slack_s"] = req.slack_s
+        # admission-policy riders (ISSUE 20) — absent unless the
+        # request actually carried a deadline / nonzero priority
+        if req.deadline_s is not None:
+            fields["deadline_s"] = req.deadline_s
+            if at == "finish" and req.deadline_miss is not None:
+                fields["deadline_miss"] = req.deadline_miss
+        if req.priority:
+            fields["priority"] = req.priority
         if req.cow_copies:
             fields["cow_copies"] = req.cow_copies
         if self.prefix_cache:
@@ -3008,6 +3066,8 @@ class ServeEngine:
             extra["tp"] = self.tp
             if req.has_slo:
                 extra.update(self._slo_verdict(req))
+            if req.deadline_s is not None:
+                extra.update(self._deadline_verdict(req))
             obs.serve("finish", request=req.rid,
                       tokens=self._generated(req),
                       preemptions=req.preemptions,
@@ -3053,6 +3113,13 @@ class ServeEngine:
         bucket = self._group_slo.setdefault(req.group, [0, 0])
         bucket[0] += int(req.slo_met)
         bucket[1] += 1
+        if self._has_priorities:
+            # per-priority-class attainment (ISSUE 20): only tracked
+            # once any submit named a class, so the rider — and this
+            # dict — stays absent on priority-less traffic
+            pb = self._priority_slo.setdefault(req.priority, [0, 0])
+            pb[0] += int(req.slo_met)
+            pb[1] += 1
         out = {"slo_met": req.slo_met}
         if req.ttft_slo_met is not None:
             out["ttft_slo_met"] = req.ttft_slo_met
@@ -3061,3 +3128,18 @@ class ServeEngine:
         if req.slack_s is not None:
             out["slack_s"] = req.slack_s
         return out
+
+    def _deadline_verdict(self, req: Request) -> dict:
+        """End-to-end deadline verdict at finish (ISSUE 20): measured
+        from the same origin as the SLO verdicts (arrival when
+        threaded, else submit), so deadline slack and TTFT share one
+        time domain. Feeds ``deadline_miss_frac`` — the figure the
+        slo admission policy exists to push down — and the
+        ``deadline_miss`` riders on the finish/timeline events."""
+        origin = (req.arrival_s if req.arrival_s is not None
+                  else req.submit_t)
+        req.deadline_miss = bool(
+            req.finish_t - origin > req.deadline_s)
+        self._deadline_total += 1
+        self._deadline_miss += int(req.deadline_miss)
+        return {"deadline_miss": req.deadline_miss}

@@ -244,14 +244,21 @@ def make_schedule(n_requests: int, vocab_size: int, *,
                   new_lo: int = 4, new_hi: int = 32,
                   alpha: float = 1.5,
                   eos_token_id: Optional[int] = None,
-                  groups: Sequence[str] = ()) -> list:
+                  groups: Sequence[str] = (),
+                  deadline_s: Optional[float] = None,
+                  priorities: Sequence[int] = ()) -> list:
     """Compose an arrival process with heavy-tailed prompt/output
     lengths into ``[(arrival_s, spec), ...]`` sorted by arrival, where
     each spec is ``{"prompt": [ids], "max_new_tokens": n, "group":
     tag?}`` — exactly the keys :meth:`OpenLoopDriver.run` forwards to
     ``submit``. Prompts avoid ``eos_token_id``; ``groups`` (tenants)
-    round-robin over arrivals. Pure in ``seed``: the same call is the
-    same schedule, which is what the replay-identity gates rest on."""
+    round-robin over arrivals, as do ``priorities`` (ISSUE 20
+    admission classes, smaller = more urgent); ``deadline_s`` stamps
+    rows with an end-to-end deadline — a scalar stamps every row, a
+    sequence round-robins aligned with ``priorities``/``groups`` (the
+    per-class-deadline shape the admission bench drives). Pure in ``seed``: the
+    same call is the same schedule, which is what the replay-identity
+    gates rest on."""
     if process == "poisson":
         arrivals = poisson_arrivals(rate, n_requests, seed)
     elif process == "bursty":
@@ -277,13 +284,20 @@ def make_schedule(n_requests: int, vocab_size: int, *,
         spec = {"prompt": prompt, "max_new_tokens": nlens[i]}
         if groups:
             spec["group"] = groups[i % len(groups)]
+        if deadline_s is not None:
+            spec["deadline_s"] = float(
+                deadline_s[i % len(deadline_s)]
+                if isinstance(deadline_s, (list, tuple)) else deadline_s)
+        if priorities:
+            spec["priority"] = int(priorities[i % len(priorities)])
         out.append((arrival, spec))
     return out
 
 
 # -- the driver --------------------------------------------------------------
 
-_SPEC_KEYS = ("temperature", "top_k", "top_p", "seed", "group")
+_SPEC_KEYS = ("temperature", "top_k", "top_p", "seed", "group",
+              "deadline_s", "priority")
 
 
 class OpenLoopDriver:
@@ -334,14 +348,25 @@ class OpenLoopDriver:
 
     # -- submission ----------------------------------------------------------
 
-    def _submit(self, arrival: float, spec: dict, t0: float) -> Request:
+    def _submit(self, arrival: float, spec: dict, t0: float):
         kw = {k: spec[k] for k in _SPEC_KEYS if k in spec}
         req = self.target.submit(
             spec["prompt"], spec["max_new_tokens"],
             arrival_s=t0 + arrival,
             slo=self.slo if self.clock == "wall" else None, **kw)
-        self._recs.append({"arrival": arrival, "req": req,
-                           "group": spec.get("group", "")})
+        if getattr(req, "rejected", False):
+            # structured rate-limit rejection (ISSUE 20): recorded —
+            # never a silent drop — but excluded from service-time
+            # accounting, because the request was refused, not served
+            self._recs.append({"arrival": arrival,
+                               "group": spec.get("group", ""),
+                               "rejected": True})
+            return req
+        rec = {"arrival": arrival, "req": req,
+               "group": spec.get("group", "")}
+        if "deadline_s" in spec:
+            rec["deadline_s"] = float(spec["deadline_s"])
+        self._recs.append(rec)
         return req
 
     # -- clock loops ---------------------------------------------------------
@@ -353,7 +378,7 @@ class OpenLoopDriver:
         iteration stamps them all at this tick — per-iteration
         granularity is the virtual clock's resolution."""
         for rec in self._recs:
-            if "v_finish" in rec:
+            if "v_finish" in rec or "req" not in rec:
                 continue
             req = rec["req"]
             if "v_admit" not in rec and req.state != WAITING:
@@ -362,6 +387,17 @@ class OpenLoopDriver:
                 rec["v_first"] = vt
             if req.finish_t is not None:
                 rec["v_finish"] = vt
+
+    def _set_policy_clock(self, now: float) -> None:
+        """Pin every scheduler's admission-policy clock to the virtual
+        timeline (``t0 + vt``, the same domain ``arrival_s`` is stamped
+        in) so aging promotions under ``policy="slo"`` are a pure
+        function of the schedule — deterministic on a noisy host. Wall
+        mode leaves the clock unpinned (``perf_counter`` truth)."""
+        for eng in getattr(self.target, "engines", None) or [self.target]:
+            sched = getattr(eng, "sched", None)
+            if sched is not None:
+                sched.policy_now = now
 
     def _run_virtual(self, t0: float) -> None:
         idx, vt = 0, 0.0
@@ -377,6 +413,7 @@ class OpenLoopDriver:
                 idx += 1
                 self._submit(arrival, spec, t0)
             if self.target.has_work():
+                self._set_policy_clock(t0 + vt)
                 self.target.step()
                 vt += self.tick_s
                 self._poll(vt)
@@ -463,11 +500,20 @@ class OpenLoopDriver:
         summary also carries ``ttft_p50/p95/p99_s`` and
         ``tpot_p50/p95/p99_s`` over the virtual timeline — the
         deterministic per-side attribution the disagg bench gates read
-        (TTFT is the prefill side's figure, TPOT the decode side's)."""
+        (TTFT is the prefill side's figure, TPOT the decode side's).
+        Structured rate-limit rejections surface as ``rate_limited``
+        and are excluded from attainment (refused, not served late);
+        schedules carrying ``deadline_s`` add ``deadline_misses`` /
+        ``deadline_miss_frac`` — deterministic virtual-timeline
+        verdicts, the admission bench's strictly-lower gate (ISSUE
+        20)."""
         out: dict = {"requests": len(self._recs), "clock": self.clock,
                      "process": self.process}
         if self.rate is not None:
             out["rate"] = self.rate
+        served = [rec for rec in self._recs if "req" in rec]
+        if len(served) < len(self._recs):
+            out["rate_limited"] = len(self._recs) - len(served)
         if self.clock == "virtual":
             from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (  # noqa: E501
                 percentile,
@@ -484,13 +530,29 @@ class OpenLoopDriver:
                     out[f"{label}_p50_s"] = round(percentile(vals, 0.50), 6)
                     out[f"{label}_p95_s"] = round(percentile(vals, 0.95), 6)
                     out[f"{label}_p99_s"] = round(percentile(vals, 0.99), 6)
+        dl_recs = [rec for rec in served if "deadline_s" in rec]
+        if dl_recs:
+            # end-to-end deadline verdicts: wall mode trusts the
+            # engine's stamped verdict, virtual mode recomputes on the
+            # driver's deterministic timeline (the engine's verdict is
+            # perf_counter truth, which would be noisy here)
+            if self.clock == "wall":
+                misses = sum(1 for rec in dl_recs
+                             if rec["req"].deadline_miss)
+            else:
+                misses = sum(
+                    1 for rec in dl_recs
+                    if rec.get("v_finish", float("inf")) - rec["arrival"]
+                    > rec["deadline_s"])
+            out["deadline_misses"] = misses
+            out["deadline_miss_frac"] = round(misses / len(dl_recs), 4)
         if self.slo is None:
             return out
         met = 0
         goodput_tokens = 0
         groups: dict = {}
         miss_phases = dict.fromkeys(MISS_PHASES, 0)
-        for rec in self._recs:
+        for rec in served:
             req = rec["req"]
             if self.clock == "wall":
                 ok = bool(req.slo_met)
@@ -530,7 +592,7 @@ class OpenLoopDriver:
             acc = groups.setdefault(rec["group"], [0, 0])
             acc[0] += int(ok)
             acc[1] += 1
-        total = len(self._recs)
+        total = len(served)
         out["slo_met"] = met
         out["slo_missed"] = total - met
         out["slo_attainment"] = round(met / total, 4) if total else 0.0

@@ -91,6 +91,8 @@ from __future__ import annotations
 
 import contextlib
 import os
+import time
+import types
 from collections import OrderedDict
 from typing import Optional, Union
 
@@ -101,7 +103,15 @@ from huggingface_sagemaker_tensorflow_distributed_tpu.serve.engine import (
     ServeEngine,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (
+    extract_block_sets,
     prefix_chain_keys,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.policy import (
+    RateLimited,
+    TokenBucket,
+    parse_aging_s,
+    parse_policy,
+    parse_rate_limit,
 )
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.scheduler import (
     DECODE,
@@ -239,8 +249,15 @@ class Router:
                  length_threshold: Optional[int] = None,
                  affinity_cap: int = 4096,
                  affinity_max_skew: Optional[int] = None,
-                 trace=None, **engine_kwargs):
+                 trace=None, policy=None, aging_s=None,
+                 rate_limit=None, **engine_kwargs):
         self.roles = parse_roles(roles)
+        # admission policy (ISSUE 20): parsed ONCE here and threaded
+        # into every replica's engine, so one env read configures the
+        # whole fleet identically (a replica_kwargs override can still
+        # diverge a replica deliberately)
+        self.policy = parse_policy(policy)
+        self.aging_s = parse_aging_s(aging_s)
         if self.roles is not None:
             n_roles = self.roles["prefill"] + self.roles["decode"]
             if replicas is not None and parse_replicas(replicas) != n_roles:
@@ -263,7 +280,8 @@ class Router:
                 f"{self.n} replicas")
         self.engines = []
         for i in range(self.n):
-            kw = dict(engine_kwargs)
+            kw = dict(engine_kwargs, policy=self.policy,
+                      aging_s=self.aging_s)
             if replica_kwargs is not None:
                 kw.update(replica_kwargs[i])
             self.engines.append(ServeEngine(model, params, **kw))
@@ -305,6 +323,14 @@ class Router:
         self.affinity_fallbacks = 0
         # chain key -> replica index, newest-used last (LRU aging)
         self._affinity: "OrderedDict[int, int]" = OrderedDict()
+        # per-tenant token buckets (ISSUE 20), keyed on `group`: a
+        # submit past its bucket returns a structured RateLimited
+        # rejection — never a silent drop. The `*` entry is the
+        # default bucket for groups without their own; no spec = no
+        # rate limiting (byte-identical submit path).
+        self._rate_spec = parse_rate_limit(rate_limit)
+        self._buckets: dict[str, TokenBucket] = {}
+        self.rate_limited = 0
 
     # -- placement -----------------------------------------------------------
 
@@ -367,22 +393,32 @@ class Router:
         while len(self._affinity) > self.affinity_cap:
             self._affinity.popitem(last=False)
 
-    def _place(self, prompt) -> int:
+    def _place(self, prompt, max_new_tokens: int = 1) -> int:
         """The policy's CHOICE only — no state moves here. Callers
         commit via :meth:`_commit_place` once the engine has accepted
         the request: a submit the scheduler rejects (over-length, can
         never fit the pool) must not advance the round-robin cursor or
         pollute the affinity index with fingerprints pointing at a
-        replica that will never prefill them."""
+        replica that will never prefill them.
+
+        Under ``policy="slo"`` (ISSUE 20) the default rotation is
+        replaced by live ``load_gauges()`` backpressure — the same
+        waiting-depth/KV-pressure signal the admission key consumes,
+        so cross-replica placement and per-replica admission pull in
+        the same direction. An EXPLICIT placement choice
+        (least_loaded / affinity / length_aware) keeps its own
+        semantics — they are already load- or cache-aware."""
         cand = self._intake()
         if len(cand) == 1:
             return cand[0]
         if self.placement == "round_robin":
+            if self.policy != "fifo":
+                return self._least_loaded(cand)
             return cand[self._rr % len(cand)]
         if self.placement == "least_loaded":
             return self._least_loaded(cand)
         if self.placement == "length_aware":
-            return self._length_aware(prompt, cand)
+            return self._length_aware(prompt, cand, max_new_tokens)
         return self._affine(prompt, cand)
 
     def _capacity_class(self, i: int) -> tuple:
@@ -392,19 +428,38 @@ class Router:
         eng = self.engines[i]
         return (eng.tp, eng.blocks.num_blocks)
 
-    def _length_aware(self, prompt, cand: list[int]) -> int:
+    def _length_aware(self, prompt, cand: list[int],
+                      max_new_tokens: int = 1) -> int:
         """Heterogeneous-fleet policy (ISSUE 18): prompts at/above
         ``length_threshold`` go to the DEEPEST capacity class (TP
         degree, then pool size), short ones to the shallowest — so
         long-context traffic lands on the replicas built for it and
         never crowds the small replicas' pools. Least-loaded inside
         the chosen class; on a homogeneous fleet every replica is one
-        class and this IS least-loaded."""
+        class and this IS least-loaded.
+
+        Admission-aware refinement (ISSUE 20, PR 18 follow-up): the
+        class preference folds in LIVE pool headroom via the
+        ``can_accept(live=True)`` probe — a destination whose pool
+        cannot carry the request's worst case RIGHT NOW is skipped
+        for a class peer with room, and when the whole preferred
+        class is full the request falls out to ANY candidate with
+        room rather than queueing on a full pool. Static length
+        preference alone would happily stack long prompts onto a
+        full deep replica while a shallow one idled."""
+        shim = types.SimpleNamespace(
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=int(max_new_tokens))
         classes = {self._capacity_class(i) for i in cand}
         want = max(classes) if len(prompt) >= self.length_threshold \
             else min(classes)
         pool = [i for i in cand if self._capacity_class(i) == want]
-        return self._least_loaded(pool)
+        roomy = [i for i in pool
+                 if can_accept(self.engines[i], shim, live=True)]
+        if not roomy:
+            roomy = [i for i in cand
+                     if can_accept(self.engines[i], shim, live=True)]
+        return self._least_loaded(roomy or pool)
 
     def _commit_place(self, prompt, choice: int) -> None:
         """Land the placement's state changes for an ACCEPTED request:
@@ -419,18 +474,30 @@ class Router:
 
     # -- public API ----------------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens: int, **kw) -> Request:
+    def submit(self, prompt, max_new_tokens: int, **kw):
         """Place one request per the policy and queue it on the chosen
         replica. Same signature/semantics as
         :meth:`~.engine.ServeEngine.submit` — the returned
-        :class:`Request` is the engine's own handle."""
+        :class:`Request` is the engine's own handle.
+
+        With per-tenant rate limits configured (ISSUE 20), a submit
+        whose ``group`` bucket is empty returns a structured
+        :class:`~.serve.policy.RateLimited` object instead of a
+        Request — a STRUCTURAL rejection (``rate_limited`` serve
+        event, counted, ``retry_after_s`` named), never a silent
+        drop. The bucket clock is the caller's ``arrival_s`` when
+        threaded (deterministic under the virtual-clock driver), else
+        wall."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        limited = self._rate_check(str(kw.get("group", "")),
+                                   kw.get("arrival_s"))
+        if limited is not None:
+            return limited
         if self.roles is not None:
             # the prefill side validates against ITS pool below; also
             # require that SOME decode replica can eventually hold the
             # request, or the post-prefill handoff would retry forever
             # (only reachable on heterogeneous decode sides)
-            import types
             shim = types.SimpleNamespace(
                 prompt=prompt, max_new_tokens=int(max_new_tokens))
             if not any(can_accept(self.engines[j], shim)
@@ -440,7 +507,7 @@ class Router:
                     f"request (prompt {len(prompt)} + max_new_tokens "
                     f"{max_new_tokens}) can never fit any decode "
                     "replica of the disaggregated fleet")
-        i = self._place(prompt)
+        i = self._place(prompt, int(max_new_tokens))
         if self.trace and "trace_id" not in kw:
             kw = dict(kw, trace_id=f"t{self._trace_seq:06d}")
             self._trace_seq += 1
@@ -448,6 +515,34 @@ class Router:
         self._commit_place(prompt, i)       # only an ACCEPTED submit
         self._owner[req.rid] = i
         return req
+
+    def _rate_check(self, group: str,
+                    arrival_s: Optional[float]) -> Optional[RateLimited]:
+        """One token-bucket decision for ``group`` (its own entry, else
+        the ``*`` default, else unlimited). Buckets materialize lazily
+        per group so two tenants sharing the ``*`` spec still meter
+        independently — a per-tenant limit, not a global one."""
+        if not self._rate_spec:
+            return None
+        spec = self._rate_spec.get(group, self._rate_spec.get("*"))
+        if spec is None:
+            return None
+        bucket = self._buckets.get(group)
+        if bucket is None:
+            bucket = self._buckets[group] = TokenBucket(*spec)
+        now = (time.perf_counter() if arrival_s is None
+               else float(arrival_s))
+        ok, retry_after = bucket.try_take(now)
+        if ok:
+            return None
+        self.rate_limited += 1
+        limited = RateLimited(group=group,
+                              retry_after_s=round(retry_after, 6),
+                              rate=spec[0], burst=spec[1])
+        obs.serve("rate_limited", group=group,
+                  retry_after_s=limited.retry_after_s,
+                  rate_limited=self.rate_limited)
+        return limited
 
     def replica_of(self, req: Union[Request, int]) -> int:
         """Which replica currently owns a request (post-drain requeues
@@ -575,11 +670,11 @@ class Router:
                     # lossless fallback
                     req.swap_set = None
                     req.swap_context = 0
-                    j = self._place(req.prompt)
+                    j = self._place(req.prompt, req.max_new_tokens)
                     self.engines[j].adopt(req)
                     self._commit_place(req.prompt, j)
             else:
-                j = self._place(req.prompt)
+                j = self._place(req.prompt, req.max_new_tokens)
                 self.engines[j].adopt(req)      # never rejects
                 self._commit_place(req.prompt, j)
             self._owner[req.rid] = j
@@ -595,12 +690,50 @@ class Router:
                       to_replica=j, **trace_kw)
         migrated = 0
         residents_in_place = 0
+        # land src's in-flight pipeline ONCE for the whole cohort
+        # (each migrate_request's own flush then finds it empty): the
+        # COMMITTED state decides who is hot, and the batched payloads
+        # below must match the exact post-commit context lengths
+        with src._mesh_ctx():
+            if src._pending is not None:
+                src._flush("migrate")
+            if src._pending_spec is not None:
+                pending, src._pending_spec = src._pending_spec, None
+                src._commit_spec(pending)
         # snapshot rids: migrating one resident lands the engine's
         # in-flight pipeline, which can FINISH (or clear) others
         resident_rids = [
             s.request.rid for s in sorted(
                 (s for s in src.sched.slots if s.request is not None),
                 key=lambda s: s.admit_seq, reverse=True)]
+        # batched cohort extraction (ISSUE 20, PR 18 follow-up (c)):
+        # every hot (DECODE) victim with a compatible peer gathers its
+        # block set device-side, then ONE device_get pulls the whole
+        # cohort to host — V victims cost one blocking round-trip, not
+        # V sequential pulls. Extraction seconds amortize evenly over
+        # the cohort so each request's migrate_extract_s rider keeps
+        # its transport-hop-pricing meaning. Migration count, peer
+        # choice, and tokens are identical to the sequential path —
+        # migrate_request falls back to its own extraction whenever a
+        # prefetched set no longer matches.
+        prefetched: dict[int, object] = {}
+        share = 0.0
+        hot = [s for s in src.sched.slots
+               if s.request is not None
+               and s.request.state == DECODE
+               and any(can_accept(self.engines[j], s.request)
+                       for j in self._drain_peers(i, s.request))]
+        if hot:
+            id_lists = [s.table[:src.blocks.blocks_for(s.context_len)]
+                        for s in hot]
+            t0 = time.perf_counter()
+            with src._mesh_ctx():
+                sets = extract_block_sets(
+                    src._pools, id_lists,
+                    d_pools=src._d_pools if src.speculative else None)
+            share = (time.perf_counter() - t0) / len(hot)
+            prefetched = {s.request.rid: bs
+                          for s, bs in zip(hot, sets)}
         for rid in resident_rids:
             if rid in src.finished:
                 continue
@@ -617,7 +750,9 @@ class Router:
                 continue
             j = self._least_loaded(cand)
             try:
-                info = migrate_request(src, self.engines[j], rid)
+                info = migrate_request(src, self.engines[j], rid,
+                                       prefetched=prefetched.get(rid),
+                                       extract_s=share)
             except TransportError:
                 residents_in_place += 1
                 continue
@@ -706,7 +841,13 @@ class Router:
         rate) — the figures the ``scripts/serve.py`` summary and the
         bench line surface."""
         if self.n == 1:
-            return self.engines[0].slo_summary()
+            out = self.engines[0].slo_summary()
+            # the rate-limit counter lives router-side (rejections
+            # never reach an engine) — ride it on the pass-through,
+            # gated like every ISSUE 20 rider
+            if self.rate_limited and out:
+                out = dict(out, rate_limited=self.rate_limited)
+            return out
         reqs = [r for eng in self.engines for r in eng.finished.values()]
         if not reqs:
             return {}
@@ -773,6 +914,31 @@ class Router:
         if any(e._has_arrivals for e in self.engines):
             out["arrival_backlog_peak"] = sum(
                 e._arrival_backlog_peak for e in self.engines)
+        # admission policy (ISSUE 20): fleet rollups, gated exactly
+        # like the engines' own riders — fifo / unlimited / deadline-
+        # less fleets report byte-identically to the pre-policy router
+        if self.policy != "fifo":
+            out["policy"] = self.policy
+            out["aging_promotions"] = sum(
+                e.sched.aging_promotions for e in self.engines)
+        if self.rate_limited:
+            out["rate_limited"] = self.rate_limited
+        dl_total = sum(e._deadline_total for e in self.engines)
+        if dl_total:
+            out["deadline_miss_frac"] = round(
+                sum(e._deadline_miss for e in self.engines)
+                / dl_total, 4)
+        if any(e._has_priorities for e in self.engines):
+            prios: dict = {}
+            for eng in self.engines:
+                for p, (m, t) in eng._priority_slo.items():
+                    acc = prios.setdefault(p, [0, 0])
+                    acc[0] += m
+                    acc[1] += t
+            if prios:
+                out["priority_slo_attainment"] = {
+                    str(p): round(m / t, 4)
+                    for p, (m, t) in sorted(prios.items()) if t}
         if self.placement == "affinity":
             out["affinity_fallbacks"] = self.affinity_fallbacks
         dtok = sum(e.decode_tokens for e in self.engines)

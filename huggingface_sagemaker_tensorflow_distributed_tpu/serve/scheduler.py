@@ -36,6 +36,7 @@ is unit-testable with no jax backend.
 from __future__ import annotations
 
 import itertools
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -44,6 +45,11 @@ import numpy as np
 from huggingface_sagemaker_tensorflow_distributed_tpu.serve.paged_kv import (
     BlockManager,
     PoolExhausted,
+)
+from huggingface_sagemaker_tensorflow_distributed_tpu.serve.policy import (
+    make_policy,
+    parse_aging_s,
+    parse_policy,
 )
 
 WAITING, PREFILL, DECODE, FINISHED = "waiting", "prefill", "decode", "finished"
@@ -126,6 +132,20 @@ class Request:
     ttft_slo_met: Optional[bool] = None
     tpot_slo_met: Optional[bool] = None
     slack_s: Optional[float] = None
+    # admission-policy contract (ISSUE 20): `deadline_s` is an
+    # END-TO-END deadline in seconds measured from the request's
+    # origin (arrival_s when the open-loop driver threaded one, else
+    # submit_t); `priority` is the admission class, smaller = more
+    # urgent, 0 default. Under policy=slo these order WHO admits WHEN
+    # — never WHAT (outputs stay token-identical under every policy).
+    # `aging_promoted` flips once the request waits past the
+    # scheduler's aging bound (the starvation counter telemetry sums);
+    # `deadline_miss` is the engine's finish verdict (None = no
+    # deadline set).
+    deadline_s: Optional[float] = None
+    priority: int = 0
+    aging_promoted: bool = False
+    deadline_miss: Optional[bool] = None
     # swap-based preemption (ISSUE 17): the extracted host-side
     # BlockSet a swapped-out victim carries while WAITING, and the
     # context length it restores to. Unlike recompute, the generated
@@ -172,6 +192,12 @@ class Request:
             target = getattr(self, name)
             if target is not None and not target > 0:
                 raise ValueError(f"{name} must be > 0 when set")
+        if self.deadline_s is not None and not self.deadline_s > 0:
+            raise ValueError("deadline_s must be > 0 when set")
+        if isinstance(self.priority, bool) or not isinstance(
+                self.priority, int):
+            raise ValueError("priority must be an integer class "
+                             "(smaller = more urgent)")
 
     @property
     def sampled(self) -> bool:
@@ -245,9 +271,15 @@ class Slot:
 
 
 class Scheduler:
-    """FIFO admission into ``num_slots`` decode slots, chunked prefill,
+    """Admission into ``num_slots`` decode slots, chunked prefill,
     recompute preemption. The engine owns the clock and the device; this
-    class owns WHO runs.
+    class owns WHO runs. Admission ORDER is pluggable (ISSUE 20):
+    ``policy="fifo"`` (default) walks ``waiting[0]`` exactly as the
+    pre-policy scheduler did — byte-identical telemetry — while
+    ``policy="slo"`` ranks the queue by the aging-bounded
+    deadline/priority/cache-aware key of :mod:`~.serve.policy`. Either
+    way a policy only reorders admission; preemption, capacity math
+    and per-request outputs are untouched.
 
     Under the engine's dispatch-ahead loop (ISSUE 12) every decision
     here consumes LAGGED observations: one decode dispatch may be in
@@ -271,7 +303,8 @@ class Scheduler:
 
     def __init__(self, num_slots: int, blocks: BlockManager,
                  prefill_chunk: int, max_model_len: int,
-                 decode_lookahead: int = 1, prefix_cache: bool = False):
+                 decode_lookahead: int = 1, prefix_cache: bool = False,
+                 policy=None, aging_s=None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if prefill_chunk < 1:
@@ -306,6 +339,16 @@ class Scheduler:
         self._admit_seq = itertools.count()
         self._prefill_rr = 0
         self.n_preemptions = 0
+        # admission policy (ISSUE 20): None for fifo — the original
+        # admit path runs bit-for-bit. `policy_now` is the virtual
+        # clock override the open-loop driver installs so aging and
+        # deadline arithmetic replay deterministically; None = wall
+        # (perf_counter, the engine's stamp domain).
+        self.policy = parse_policy(policy)
+        self.aging_s = parse_aging_s(aging_s)
+        self._policy = make_policy(self.policy, self.aging_s)
+        self.aging_promotions = 0
+        self.policy_now: Optional[float] = None
         # swap-based preemption (ISSUE 17): the engine installs a
         # `hook(slot) -> bool` that may extract the victim's blocks to
         # host BEFORE release (True = swapped; the request's `swap_set`
@@ -409,34 +452,121 @@ class Scheduler:
         cached boundary, and the chunk-grid overlap — shared blocks
         the first prefill chunk rewrites — is privatized (COW) here,
         inside the same capacity check. Returns the slots admitted
-        this call."""
+        this call. Order is the policy's: fifo walks the queue head
+        only; slo ranks the whole queue once per call and lets a
+        smaller-demand candidate fill a slot the front-runner cannot
+        — EXCEPT past an aging-promoted request, where admission
+        stops entirely (the strict starvation bound: nothing younger
+        queue-jumps a starving request, and liveness holds because
+        :meth:`submit` already rejected can-never-fit requests)."""
+        if self._policy is None:
+            return self._admit_fifo()
+        return self._admit_policy()
+
+    def _admit_fifo(self) -> list[Slot]:
         admitted = []
         for slot in self.slots:
             if not self.waiting:
                 break
             if not slot.free:
                 continue
-            req = self.waiting[0]
-            if req.swap_set is not None:
-                if not self._reserve_swapped(req, slot):
-                    break                   # FIFO: no queue-jumping
-                self.waiting.pop(0)
-                admitted.append(slot)
-                continue
-            table, start0, copies, restores = self._reserve(req)
-            if table is None:
+            if not self._try_reserve(self.waiting[0], slot):
                 break                       # FIFO: no queue-jumping
             self.waiting.pop(0)
-            slot.request = req
-            slot.table = table
-            slot.context_len = 0
-            slot.prefill_pos = start0
-            slot.pending_copies = copies
-            slot.pending_restores = restores
-            slot.admit_seq = next(self._admit_seq)
-            req.state = PREFILL
             admitted.append(slot)
         return admitted
+
+    def _admit_policy(self) -> list[Slot]:
+        now = self.policy_clock()
+        for req in self.waiting:
+            if not req.aging_promoted and self._policy.promoted(req, now):
+                req.aging_promoted = True
+                self.aging_promotions += 1
+        ranked = self._policy.rank(self.waiting, now,
+                                   self._demand_blocks)
+        admitted = []
+        for slot in self.slots:
+            if not ranked:
+                break
+            if not slot.free:
+                continue
+            chosen = None
+            for req in ranked:
+                if self._try_reserve(req, slot):
+                    chosen = req
+                    break
+                if req.aging_promoted:
+                    # a promoted (starving) request that cannot fit
+                    # blocks ALL younger admission — the aging bound
+                    # is strict, not advisory
+                    ranked = []
+                    break
+            if chosen is None:
+                break
+            # remove by identity: Request field equality can compare
+            # array prompts elementwise
+            ranked = [r for r in ranked if r is not chosen]
+            for i, r in enumerate(self.waiting):
+                if r is chosen:
+                    del self.waiting[i]
+                    break
+            admitted.append(slot)
+        return admitted
+
+    def _try_reserve(self, req: Request, slot: Slot) -> bool:
+        """Reserve ``slot`` for ``req`` (swapped or fresh) — True on
+        success with the slot fully populated, False with every
+        acquired reference rolled back. Shared by both admit orders so
+        the reservation semantics cannot drift between policies."""
+        if req.swap_set is not None:
+            return self._reserve_swapped(req, slot)
+        table, start0, copies, restores = self._reserve(req)
+        if table is None:
+            return False
+        slot.request = req
+        slot.table = table
+        slot.context_len = 0
+        slot.prefill_pos = start0
+        slot.pending_copies = copies
+        slot.pending_restores = restores
+        slot.admit_seq = next(self._admit_seq)
+        req.state = PREFILL
+        return True
+
+    def policy_clock(self) -> float:
+        """The admission policy's clock: the driver-installed virtual
+        stamp when set (deterministic open-loop replay), else wall
+        ``perf_counter`` — the same domain as every request stamp."""
+        return (time.perf_counter() if self.policy_now is None
+                else self.policy_now)
+
+    def blocked_head(self) -> Optional[Request]:
+        """The request whose admission is blocked when slots/KV run
+        out — ``waiting[0]`` under fifo, the policy's top-ranked
+        candidate otherwise. The engine attributes blocked-iteration
+        telemetry to it."""
+        if not self.waiting:
+            return None
+        if self._policy is None:
+            return self.waiting[0]
+        return self._policy.rank(self.waiting, self.policy_clock(),
+                                 self._demand_blocks)[0]
+
+    def _demand_blocks(self, req: Request) -> int:
+        """Predicted service demand in KV blocks for the policy key:
+        the padded-prompt block need minus the ``peek_prefix`` cached
+        span (a refcount-neutral, LRU-neutral probe), so under KV
+        pressure the largest-cached-prefix request ranks first. A
+        swapped-out victim's demand is exactly its extracted set."""
+        if req.swap_set is not None:
+            return int(req.swap_set.n_blocks)
+        need = self.blocks.blocks_for(self.padded_prompt_len(req))
+        if self.prefix_cache:
+            bs = self.blocks.block_size
+            shared, _ = self.blocks.peek_prefix(
+                req.prompt, max_blocks=(len(req.prompt) - 1) // bs)
+            need -= len(shared)
+        return need
 
     def _reserve_swapped(self, req: Request, slot: Slot) -> bool:
         """Re-admit a SWAPPED-OUT request (ISSUE 17): allocate exactly

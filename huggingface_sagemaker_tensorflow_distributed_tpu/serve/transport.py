@@ -67,14 +67,21 @@ def pool_signature(engine) -> tuple:
     return (int(engine.blocks.block_size), sig(engine._pools), draft)
 
 
-def can_accept(dst, req) -> bool:
+def can_accept(dst, req, live: bool = False) -> bool:
     """True when ``dst`` could EVER hold ``req``: the submit-time
     worst-case block need (padded prompt, full generation + decode
     lookahead, preemption-folded re-prefill) against the destination's
     own chunk grid, model length, and whole pool — the same formula
     :meth:`~.scheduler.Scheduler.submit` validates, re-run because a
     heterogeneous destination's geometry may be smaller than the
-    engine the request was originally admitted to."""
+    engine the request was originally admitted to.
+
+    With ``live=True`` (ISSUE 20, admission-aware placement) the
+    probe additionally requires the worst case to fit the pool's
+    CURRENT headroom (:meth:`~.paged_kv.BlockManager.can_allocate` —
+    free + evictable cached blocks), so a router can skip a
+    destination that is full RIGHT NOW for a peer with room. Purely a
+    read: no refcount, LRU, or allocation state moves either way."""
     s = dst.sched
     total = len(req.prompt) + req.max_new_tokens
     if total + s.decode_lookahead - 1 > s.max_model_len:
@@ -82,10 +89,14 @@ def can_accept(dst, req) -> bool:
     worst = max(s.padded_prompt_len(req),
                 total + s.decode_lookahead - 1,
                 -(-(total - 1) // s.prefill_chunk) * s.prefill_chunk)
-    return s.blocks.blocks_for(worst) <= s.blocks.num_blocks - 1
+    need = s.blocks.blocks_for(worst)
+    if need > s.blocks.num_blocks - 1:
+        return False
+    return s.blocks.can_allocate(need) if live else True
 
 
-def migrate_request(src, dst, rid: int) -> Optional[dict]:
+def migrate_request(src, dst, rid: int, prefetched=None,
+                    extract_s: float = 0.0) -> Optional[dict]:
     """Move resident request ``rid`` from ``src`` to ``dst``.
 
     A DECODE resident moves HOT: its context's block set is extracted
@@ -106,6 +117,17 @@ def migrate_request(src, dst, rid: int) -> Optional[dict]:
     "context_len", "cold"}``. Raises :class:`TransportError` when the
     request is not resident on ``src``, the engines' pool geometries
     differ, or ``dst`` could never hold the request.
+
+    ``prefetched`` (ISSUE 20, the PR 18 drain follow-up) is a
+    :class:`~.paged_kv.BlockSet` the caller already extracted for
+    this request as part of a batched cohort pull
+    (:func:`~.paged_kv.extract_block_sets` — one ``device_get`` per
+    victim cohort instead of one per request), with ``extract_s`` its
+    amortized share of the cohort's extraction seconds. It is used
+    only when it still matches the slot's committed context (the
+    caller must have landed the source pipeline before prefetching);
+    otherwise the per-request extraction runs as before — semantics,
+    migration count, and tokens are identical either way.
     """
     if src is dst:
         raise TransportError(
@@ -151,12 +173,16 @@ def migrate_request(src, dst, rid: int) -> Optional[dict]:
         req.migrate_extract_s = 0.0
     else:
         n = src.blocks.blocks_for(slot.context_len)
-        t0 = time.perf_counter()
-        with src._mesh_ctx():
-            req.swap_set = extract_blocks(
-                src._pools, slot.table[:n],
-                d_pools=src._d_pools if src.speculative else None)
-        req.migrate_extract_s = time.perf_counter() - t0
+        if prefetched is not None and prefetched.n_blocks == n:
+            req.swap_set = prefetched
+            req.migrate_extract_s = float(extract_s)
+        else:
+            t0 = time.perf_counter()
+            with src._mesh_ctx():
+                req.swap_set = extract_blocks(
+                    src._pools, slot.table[:n],
+                    d_pools=src._d_pools if src.speculative else None)
+            req.migrate_extract_s = time.perf_counter() - t0
         req.swap_context = slot.context_len
         nbytes, ctx = req.swap_set.nbytes, slot.context_len
     src.blocks.release(slot.table)

@@ -294,6 +294,26 @@ def main() -> None:
                              "slo_attainment + miss attribution "
                              "(default: HSTD_SERVE_SLO_TTFT_S / "
                              "HSTD_SERVE_SLO_TPOT_S)")
+    parser.add_argument("--policy", default=None,
+                        choices=("fifo", "slo"),
+                        help="admission-ordering policy: fifo = strict "
+                             "arrival order, slo = earliest effective "
+                             "deadline folding in priority class, "
+                             "predicted demand (prefix-cache aware) "
+                             "and a bounded aging term (default: "
+                             "HSTD_SERVE_POLICY or fifo)")
+    parser.add_argument("--aging_s", type=float, default=None,
+                        help="starvation bound for --policy slo: a "
+                             "request waiting this long is promoted "
+                             "ahead of all unpromoted work (default: "
+                             "HSTD_SERVE_AGING_S or 30)")
+    parser.add_argument("--rate_limit", default=None,
+                        help="per-tenant token-bucket admission caps, "
+                             "GROUP=RATE[:BURST],... req/s keyed on "
+                             "each request's group tag ('*' = default "
+                             "bucket); over-budget submits get a "
+                             "structured rate_limited rejection, "
+                             "never a silent drop")
     parser.add_argument("--swap", default=None,
                         choices=("auto", "always", "never", "off"),
                         help="host-RAM KV spill tier: swap preemption "
@@ -364,7 +384,10 @@ def main() -> None:
                     overlap=args.overlap,
                     mesh=args.tp,
                     swap=args.swap,
-                    swap_bytes=args.swap_bytes)
+                    swap_bytes=args.swap_bytes,
+                    policy=args.policy,
+                    aging_s=args.aging_s,
+                    rate_limit=args.rate_limit)
     engine = router.engines[0]
     trace = load_trace(args, model.config.vocab_size - 1)
     # precompile the sampled step variants too when the trace will
@@ -396,8 +419,13 @@ def main() -> None:
         wall = time.perf_counter() - t0
         reqs = [finished[rid] for rid in sorted(finished)]
     else:
-        reqs = [router.submit(p, m, slo=slo_spec, **kw)
-                for p, m, kw in trace]
+        reqs, rejected = [], 0
+        for p, m, kw in trace:
+            r = router.submit(p, m, slo=slo_spec, **kw)
+            if getattr(r, "rejected", False):
+                rejected += 1
+            else:
+                reqs.append(r)
         t0 = time.perf_counter()
         router.run()
         wall = time.perf_counter() - t0
@@ -417,6 +445,11 @@ def main() -> None:
             # met, and the worst axis's margin in seconds
             row["slo_met"] = req.slo_met
             row["slack_s"] = req.slack_s
+        if req.deadline_s is not None:
+            row["deadline_s"] = req.deadline_s
+            row["deadline_miss"] = req.deadline_miss
+        if req.priority:
+            row["priority"] = req.priority
         if router.n > 1:
             row["replica"] = router.replica_of(req)
         if engine.speculative:
@@ -446,9 +479,13 @@ def main() -> None:
                                  "clock": dsum["clock"]}
         for k in ("slo_attainment", "slo_met", "slo_missed",
                   "goodput_tokens", "group_slo_attainment",
-                  "miss_phases", "dominant_miss_phase"):
+                  "miss_phases", "dominant_miss_phase",
+                  "rate_limited", "deadline_misses",
+                  "deadline_miss_frac"):
             if k in dsum:
                 open_extra[k] = dsum[k]
+    elif rejected:
+        open_extra["rate_limited"] = rejected
     if router.n > 1:
         # fleet summary (ISSUE 14): the router's own aggregate (the
         # same figures its final `serve` report telemetry event
@@ -511,6 +548,14 @@ def main() -> None:
                 "group_slo_attainment":
                 rslo.get("group_slo_attainment")}
                if slo_spec is not None and driver is None else {}),
+            **({"policy": router.policy,
+                "aging_promotions": rslo.get("aging_promotions")}
+               if router.policy != "fifo" else {}),
+            **({"deadline_miss_frac": rslo.get("deadline_miss_frac")}
+               if rslo.get("deadline_miss_frac") is not None else {}),
+            **({"priority_slo_attainment":
+                rslo.get("priority_slo_attainment")}
+               if rslo.get("priority_slo_attainment") else {}),
             **open_extra}))
         obs.flush()
         return
@@ -589,6 +634,14 @@ def main() -> None:
         **({"slo_attainment": slo.get("slo_attainment"),
             "group_slo_attainment": slo.get("group_slo_attainment")}
            if slo_spec is not None and driver is None else {}),
+        **({"policy": engine.policy,
+            "aging_promotions": slo.get("aging_promotions")}
+           if engine.policy != "fifo" else {}),
+        **({"deadline_miss_frac": slo.get("deadline_miss_frac")}
+           if slo.get("deadline_miss_frac") is not None else {}),
+        **({"priority_slo_attainment":
+            slo.get("priority_slo_attainment")}
+           if slo.get("priority_slo_attainment") else {}),
         **open_extra}))
     obs.flush()
 

@@ -1,5 +1,5 @@
 """graftlint tests (ISSUE 15): one positive + one negative fixture per
-rule (R1–R6), pragma suppression + mandatory-reason hygiene, byte
+rule (R1–R7), pragma suppression + mandatory-reason hygiene, byte
 determinism across input orderings, the CLI exit-code contract
 (0 clean / 1 bad input / 2 findings, matching ``obsctl diff``), and —
 the teeth — the tier-1 gate that runs the full linter over the real
@@ -278,6 +278,31 @@ def test_r6_release_and_manager_internals_are_legal(tmp_path):
             "        self.free(t)\n"),
     })
     assert active(run_lint(root, rules=["R6"]), "R6") == []
+
+
+# -- R7: admission policy stays jax-free --------------------------------------
+
+def test_r7_fires_on_transitive_import_time_jax(tmp_path):
+    root = make_tree(tmp_path, {
+        f"{PACKAGE}/serve/policy.py": "from {p}.serve import kv\n".format(
+            p=PACKAGE),
+        f"{PACKAGE}/serve/kv.py": "import jax\n",
+    })
+    hits = active(run_lint(root, rules=["R7"]), "R7")
+    assert len(hits) == 1
+    assert hits[0].path == f"{PACKAGE}/serve/kv.py"
+    assert "jax" in hits[0].message and "policy" in hits[0].message
+
+def test_r7_host_side_policy_is_legal(tmp_path):
+    root = make_tree(tmp_path, {
+        f"{PACKAGE}/serve/policy.py": (
+            "import math\n"
+            "def key(req, now):\n"
+            "    return (0, now, req.rid)\n"),
+        # jax elsewhere in serve/ is fine — R7 roots at policy.py only
+        f"{PACKAGE}/serve/engine.py": "import jax\n",
+    })
+    assert active(run_lint(root, rules=["R7"]), "R7") == []
 
 
 # -- pragmas ------------------------------------------------------------------
@@ -580,7 +605,7 @@ def test_no_jax_zone_static_reachability_primary_gate():
     assert f"{PACKAGE}/obs/__init__.py" in r1_zone_roots(project)
 
 def test_rule_catalog_complete():
-    assert sorted(RULES) == ["R1", "R2", "R3", "R4", "R5", "R6"]
+    assert sorted(RULES) == ["R1", "R2", "R3", "R4", "R5", "R6", "R7"]
     for rule in RULES.values():
         assert rule.title and rule.rationale
 

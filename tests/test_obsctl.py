@@ -996,6 +996,61 @@ def test_diff_transport_hop_p99_is_up_worse_ratio(three_hosts):
         assert "serve_transport_hop_s_p99" not in d["regressions"]
 
 
+def test_diff_deadline_miss_frac_is_up_worse_ratio(three_hosts):
+    """ISSUE 20: `serve_deadline_miss_frac` (fraction of deadline-
+    carrying requests whose first token landed past `deadline_s`)
+    diffs as a ratio metric whose worse direction is UP — a rising
+    miss fraction on the same trace means the admission policy (or a
+    capacity regression underneath it) started blowing deadlines the
+    previous build met. The field is a `policy=slo` rider, so the
+    fixture report does not carry it; both sides get it injected, and
+    the poison/missing rows double as the fifo-run case (absent on
+    either side -> skipped, never a fabricated regression)."""
+    import copy
+
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.report import (
+        diff_reports,
+    )
+
+    base = build_report(three_hosts)
+    assert "deadline_miss_frac" not in base["serve"]   # fifo default
+    base = copy.deepcopy(base)
+    base["serve"]["deadline_miss_frac"] = 0.05
+    worse = copy.deepcopy(base)
+    worse["serve"]["deadline_miss_frac"] = 0.20
+    d = diff_reports(base, worse, threshold_pct=5.0)
+    assert "serve_deadline_miss_frac" in d["regressions"]
+    assert d["metrics"]["serve_deadline_miss_frac"][
+        "worse_direction"] == "up"
+    # fewer misses never flag; nor does a sub-threshold drift
+    assert "serve_deadline_miss_frac" not in diff_reports(
+        worse, base, 5.0)["regressions"]
+    slight = copy.deepcopy(base)
+    slight["serve"]["deadline_miss_frac"] = 0.051   # +2%
+    assert "serve_deadline_miss_frac" not in diff_reports(
+        base, slight, 5.0)["regressions"]
+    # zero baseline (every deadline met): misses appearing must still
+    # flag though the percentage is undefined
+    zero = copy.deepcopy(base)
+    zero["serve"]["deadline_miss_frac"] = 0.0
+    worse0 = copy.deepcopy(zero)
+    worse0["serve"]["deadline_miss_frac"] = 0.10
+    d0 = diff_reports(zero, worse0, threshold_pct=5.0)
+    assert "serve_deadline_miss_frac" in d0["regressions"]
+    assert d0["metrics"]["serve_deadline_miss_frac"]["pct"] is None
+    # poison rows: mistyped or missing (== a fifo run, where the
+    # rider is absent by contract) -> skipped, never a crash
+    poisoned = copy.deepcopy(base)
+    poisoned["serve"]["deadline_miss_frac"] = "often"
+    missing = copy.deepcopy(base)
+    del missing["serve"]["deadline_miss_frac"]
+    for a, b in ((base, poisoned), (poisoned, base),
+                 (base, missing), (missing, base)):
+        d = diff_reports(a, b, threshold_pct=5.0)
+        assert "serve_deadline_miss_frac" in d["skipped"]
+        assert "serve_deadline_miss_frac" not in d["regressions"]
+
+
 def test_diff_poisoned_lifecycle_metrics_skip_not_crash(three_hosts):
     """Poisoned inputs for the new metrics: a mistyped (string/bool)
     or missing value must land the metric in `skipped`, never crash

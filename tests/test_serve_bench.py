@@ -22,7 +22,7 @@ def test_serve_bench_smoke(capsys, tmp_path):
     obs.reset(out_dir=str(tmp_path / "telemetry"), enabled=True)
     try:
         (mixed, bucketed, spec, prefix, paged, overlap, tp, router,
-         open_loop, kv_swap, disagg) = bench_serve(smoke=True)
+         open_loop, kv_swap, disagg, slo_adm) = bench_serve(smoke=True)
     finally:
         obs.reset()
     detail = mixed["detail"]
@@ -233,12 +233,31 @@ def test_serve_bench_smoke(capsys, tmp_path):
     # decode rows own TPOT + tokens/sec
     assert ddetail["per_role"]["prefill"]["ttft_p99_s"] > 0
     assert ddetail["per_role"]["decode"]["decode_tokens_per_sec"] > 0
+
+    # the ISSUE 20 admission line: every deterministic gate holds at
+    # smoke scale too — token identity across policies, bitwise
+    # replay, deadline attainment ≥ fifo with misses strictly lower,
+    # structured (counted, never silent) rate-limit rejections, and
+    # ZERO compiled variants minted by reordering
+    adetail = slo_adm["detail"]
+    assert slo_adm.get("error") is None
+    assert slo_adm["value"] is not None
+    assert adetail["tokens_identical"] is True      # WHO, never WHAT
+    assert adetail["replay_identical"] is True
+    assert adetail["compiles_steady"] == 0
+    assert (adetail["deadline_attainment_slo"]
+            >= adetail["deadline_attainment_fifo"])
+    assert (adetail["deadline_miss_frac_slo"]
+            < adetail["deadline_miss_frac_fifo"])
+    assert adetail["rate_limited"] > 0
+    assert (adetail["rate_limited_served"] + adetail["rate_limited"]
+            == adetail["requests"])
     # the stdout lines are the driver contract: parseable JSON, all
-    # eleven metrics present
+    # twelve metrics present
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
     metrics = [json.loads(ln)["metric"] for ln in lines]
-    assert metrics[-11:] == ["serve_continuous_vs_static_speedup",
+    assert metrics[-12:] == ["serve_continuous_vs_static_speedup",
                              "serve_bucketed_gather_decode_speedup",
                              "serve_speculative_decode_speedup",
                              "serve_prefix_cache_ttft_speedup",
@@ -248,7 +267,8 @@ def test_serve_bench_smoke(capsys, tmp_path):
                              "serve_router_scaleout",
                              "serve_open_loop_goodput",
                              "serve_kv_swap_vs_recompute",
-                             "serve_disagg_goodput"]
+                             "serve_disagg_goodput",
+                             "serve_slo_admission_goodput"]
 
 
 @pytest.mark.slow
@@ -461,3 +481,26 @@ def test_serve_bench_full_disagg_trace(capsys):
     assert detail["ttft_p99_s_disagg"] <= detail["ttft_p99_s_mixed"]
     assert (detail["decode_tokens_per_sec_disagg"]
             >= 0.9 * detail["decode_tokens_per_sec_mixed"])
+
+
+@pytest.mark.slow
+def test_serve_bench_full_slo_admission_trace(capsys):
+    """The full CPU open-loop trace past the fifo capacity knee — the
+    ISSUE 20 acceptance surface where the ≥1.1x deadline-attainment
+    ratio IS enforced in the line (measured 1.17x on this container:
+    fifo head-blocks interactive work behind loose-deadline batch
+    rows), with strictly fewer misses and every deterministic gate the
+    smoke tier already pins."""
+    from benchmarks.serve_bench import bench_serve_slo_admission
+
+    result = bench_serve_slo_admission(smoke=False)
+    assert result.get("error") is None
+    detail = result["detail"]
+    assert result["value"] is not None
+    assert result["value"] >= 1.1 * result["vs_baseline"] > 0
+    assert detail["tokens_identical"] is True
+    assert detail["replay_identical"] is True
+    assert detail["compiles_steady"] == 0
+    assert (detail["deadline_miss_frac_slo"]
+            < detail["deadline_miss_frac_fifo"])
+    assert detail["rate_limited"] > 0

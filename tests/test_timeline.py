@@ -359,6 +359,43 @@ def test_slo_attribution_groups_by_replica_when_tagged():
     assert "replica 0:" in text and "replica 1:" in text
 
 
+def test_slo_attribution_rolls_up_priority_classes():
+    """ISSUE 20: records carrying a ``priority`` tag (a ``policy=slo``
+    run with priority classes) get a per-class rollup — attainment and
+    deadline misses per class out of the same machinery. Emitters
+    stamp ``priority`` absent-when-default, so untagged records in a
+    tagged stream count as class 0; a wholly untagged (fifo) stream
+    stays byte-identical with no priorities section at all."""
+    events = [_tl_event(i, dc=0.5 + 0.05 * i, slo_met=True)
+              for i in range(6)]                       # class 0, met
+    events += [_tl_event(6 + i, priority=1, q=4.0 + i, ttft_s=4.2 + i,
+                         slo_met=False, deadline_miss=True)
+               for i in range(2)]                      # class 1, missed
+    doc = slo_attribution(collect_timelines(events), pct=0.95)
+    assert set(doc["priorities"]) == {"0", "1"}
+    assert doc["priorities"]["0"]["requests"] == 6
+    assert doc["priorities"]["1"]["requests"] == 2
+    assert doc["priorities"]["0"]["slo_attainment"] == 1.0
+    assert doc["priorities"]["1"]["slo_attainment"] == 0.0
+    assert doc["priorities"]["1"]["deadline_misses"] == 2
+    assert "deadline_misses" not in doc["priorities"]["0"]
+    assert doc["priorities"]["1"]["e2e_p99_s"] > \
+        doc["priorities"]["0"]["e2e_p99_s"]
+    # a bool priority is not a class tag (schema types it int)
+    plain = slo_attribution(collect_timelines(
+        [_tl_event(i, priority=False) for i in range(4)]), pct=0.95)
+    assert "priorities" not in plain
+    # the text rendering names classes, attainment and misses
+    from huggingface_sagemaker_tensorflow_distributed_tpu.obs.timeline import (
+        render_slo_text,
+    )
+
+    text = render_slo_text(doc)
+    assert "priority 0:" in text and "priority 1:" in text
+    assert "attainment 0.00%" in text
+    assert "2 deadline miss(es)" in text
+
+
 def test_gantt_and_chrome_trace_render():
     recs = collect_timelines([_tl_event(0), _tl_event(1, pe=0.4)])
     text = gantt_text(recs, width=32)
